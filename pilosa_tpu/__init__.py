@@ -1,0 +1,21 @@
+"""pilosa_tpu — a TPU-native distributed roaring-bitmap index.
+
+A from-scratch re-design of Pilosa's capabilities (reference: zman81/pilosa,
+pre-1.0) for TPU hardware: roaring container set-ops run as Pallas kernels
+over HBM-resident container pools, per-slice mapReduce fans out over a
+`jax.sharding.Mesh` with ICI collectives for Count/TopN reductions, and the
+surrounding runtime (HTTP API, PQL, cluster membership, persistence,
+anti-entropy) is host-side Python.
+
+Vocabulary (matches the reference era, pre field/shard rename):
+  Index > Frame > View > Fragment(slice); slice width = 2^20 columns.
+"""
+
+# Width of a slice: number of columns per horizontal shard
+# (reference: fragment.go:46-47).
+SLICE_WIDTH = 1 << 20
+
+# Containers per slice-row: SLICE_WIDTH / 2^16 container span.
+CONTAINERS_PER_ROW = SLICE_WIDTH >> 16  # 16
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Roaring bitmap layer (host side).
+
+The authoritative, mutable representation of fragment data lives here as
+numpy-backed roaring bitmaps with the reference's semantics and on-disk
+format (reference: /root/reference/roaring/roaring.go). The TPU compute
+path consumes snapshots of these bitmaps packed into device container
+pools (see pilosa_tpu.ops).
+"""
+
+from .bitmap import (
+    ARRAY_MAX_SIZE,
+    BITMAP_N,
+    CONTAINER_WIDTH,
+    Bitmap,
+    Container,
+    bitmap_to_values,
+    values_to_bitmap_words,
+)
+from .serialize import COOKIE, fnv32a
+
+__all__ = [
+    "ARRAY_MAX_SIZE",
+    "BITMAP_N",
+    "CONTAINER_WIDTH",
+    "COOKIE",
+    "Bitmap",
+    "Container",
+    "bitmap_to_values",
+    "values_to_bitmap_words",
+    "fnv32a",
+]

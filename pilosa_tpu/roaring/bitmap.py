@@ -1,0 +1,568 @@
+"""Numpy-backed roaring bitmap with the reference's container semantics.
+
+Semantics mirror /root/reference/roaring/roaring.go (containers split the
+uint64 value space into 2^16-wide blocks keyed by value>>16; a container is
+an `array` of sorted values when its cardinality is <= 4096 and a 1024-word
+uint64 `bitmap` otherwise), but the implementation is vectorized numpy
+rather than a translation: container payloads are ndarrays, set ops are
+whole-array kernels, and bulk mutation is first-class (`add_many`) because
+the TPU pipeline feeds from bulk snapshots, not per-bit pointers.
+"""
+
+from __future__ import annotations
+
+import io
+from bisect import bisect_left
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+# Cardinality threshold at which an array container converts to a bitmap
+# container (reference: roaring/roaring.go:833 ArrayMaxSize).
+ARRAY_MAX_SIZE = 4096
+
+# Words per bitmap container: 2^16 bits / 64 (reference: roaring.go:35).
+BITMAP_N = (1 << 16) // 64
+
+# Value span of one container.
+CONTAINER_WIDTH = 1 << 16
+
+_U64 = np.uint64
+_U32 = np.uint32
+
+
+def values_to_bitmap_words(values: np.ndarray) -> np.ndarray:
+    """Pack low-16-bit values into a 1024-word uint64 bitmap."""
+    bits = np.zeros(CONTAINER_WIDTH, dtype=np.uint8)
+    bits[values] = 1
+    return np.packbits(bits, bitorder="little").view(_U64)
+
+
+def bitmap_to_values(words: np.ndarray) -> np.ndarray:
+    """Unpack a 1024-word uint64 bitmap into sorted uint32 values."""
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(_U32)
+
+
+def _popcount_words(words: np.ndarray) -> int:
+    return int(np.bitwise_count(words).sum())
+
+
+class Container:
+    """One 2^16-value block: sorted uint32 array or 1024-word uint64 bitmap.
+
+    `n` is the cardinality. Representation is normalized: n <= 4096 <=> array
+    form (matching the reference's container.check invariant,
+    roaring.go:1163-1181). `shared` marks a container referenced by more than
+    one Bitmap (offset_range views); mutators at the Bitmap level replace
+    shared containers with clones before writing (copy-on-write, the analog
+    of the reference's mapped-container unmap(), roaring.go:860-876).
+    """
+
+    __slots__ = ("array", "bitmap", "shared")
+
+    def __init__(
+        self,
+        array: Optional[np.ndarray] = None,
+        bitmap: Optional[np.ndarray] = None,
+    ):
+        self.array = array
+        self.bitmap = bitmap
+        self.shared = False
+        if array is None and bitmap is None:
+            self.array = np.empty(0, dtype=_U32)
+
+    # -- representation ----------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        if self.array is not None:
+            return len(self.array)
+        return _popcount_words(self.bitmap)
+
+    def is_array(self) -> bool:
+        return self.array is not None
+
+    def normalize(self) -> "Container":
+        """Convert between forms at the 4096 threshold (roaring.go:951,1023)."""
+        if self.array is not None and len(self.array) > ARRAY_MAX_SIZE:
+            self.bitmap = values_to_bitmap_words(self.array)
+            self.array = None
+        elif self.bitmap is not None and _popcount_words(self.bitmap) <= ARRAY_MAX_SIZE:
+            self.array = bitmap_to_values(self.bitmap)
+            self.bitmap = None
+        return self
+
+    def clone(self) -> "Container":
+        if self.array is not None:
+            return Container(array=self.array.copy())
+        return Container(bitmap=self.bitmap.copy())
+
+    def values(self) -> np.ndarray:
+        """Sorted uint32 values present in this container."""
+        if self.array is not None:
+            return self.array
+        return bitmap_to_values(self.bitmap)
+
+    def words(self) -> np.ndarray:
+        """The container as a 1024-word uint64 bitmap (dense view)."""
+        if self.bitmap is not None:
+            return self.bitmap
+        return values_to_bitmap_words(self.array)
+
+    # -- point ops ---------------------------------------------------------
+
+    def contains(self, v: int) -> bool:
+        if self.array is not None:
+            i = np.searchsorted(self.array, v)
+            return i < len(self.array) and int(self.array[i]) == v
+        return bool((int(self.bitmap[v >> 6]) >> (v & 63)) & 1)
+
+    def add(self, v: int) -> bool:
+        """Add low-bits value v. Returns True if it was not already set."""
+        if self.array is not None:
+            i = int(np.searchsorted(self.array, v))
+            if i < len(self.array) and int(self.array[i]) == v:
+                return False
+            self.array = np.insert(self.array, i, _U32(v))
+            self.normalize()
+            return True
+        w, b = v >> 6, v & 63
+        word = int(self.bitmap[w])
+        if (word >> b) & 1:
+            return False
+        self.bitmap[w] = _U64(word | (1 << b))
+        return True
+
+    def remove(self, v: int) -> bool:
+        """Remove low-bits value v. Returns True if it was set."""
+        if self.array is not None:
+            i = int(np.searchsorted(self.array, v))
+            if i >= len(self.array) or int(self.array[i]) != v:
+                return False
+            self.array = np.delete(self.array, i)
+            return True
+        w, b = v >> 6, v & 63
+        word = int(self.bitmap[w])
+        if not (word >> b) & 1:
+            return False
+        self.bitmap[w] = _U64(word & ~(1 << b))
+        self.normalize()
+        return True
+
+    def add_many(self, vals: np.ndarray) -> int:
+        """Bulk add sorted-or-unsorted low-bits values; returns #newly set."""
+        before = self.n
+        if self.array is not None and len(self.array) + len(vals) <= ARRAY_MAX_SIZE:
+            merged = np.union1d(self.array, vals.astype(_U32))
+            self.array = merged.astype(_U32)
+        else:
+            words = self.words().copy() if self.bitmap is None else self.bitmap
+            extra = values_to_bitmap_words(vals)
+            np.bitwise_or(words, extra, out=words)
+            self.array = None
+            self.bitmap = words
+            self.normalize()
+        return self.n - before
+
+    # -- range ops ---------------------------------------------------------
+
+    def count_range(self, start: int, end: int) -> int:
+        """Count of values in [start, end) within this container."""
+        if self.array is not None:
+            i = np.searchsorted(self.array, start, side="left")
+            j = np.searchsorted(self.array, end, side="left")
+            return int(j - i)
+        vals = self.values()
+        return int(
+            np.searchsorted(vals, end, side="left")
+            - np.searchsorted(vals, start, side="left")
+        )
+
+    # -- pairwise set ops --------------------------------------------------
+
+    def intersect(self, other: "Container") -> "Container":
+        if self.is_array() and other.is_array():
+            out = np.intersect1d(self.array, other.array, assume_unique=True)
+            return Container(array=out.astype(_U32))
+        if self.is_array() or other.is_array():
+            arr, bm = (self, other) if self.is_array() else (other, self)
+            a = arr.array
+            mask = (bm.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
+            return Container(array=a[mask.astype(bool)])
+        return Container(bitmap=self.bitmap & other.bitmap).normalize()
+
+    def intersection_count(self, other: "Container") -> int:
+        if self.is_array() and other.is_array():
+            return len(np.intersect1d(self.array, other.array, assume_unique=True))
+        if self.is_array() or other.is_array():
+            arr, bm = (self, other) if self.is_array() else (other, self)
+            a = arr.array
+            mask = (bm.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
+            return int(mask.sum())
+        return _popcount_words(self.bitmap & other.bitmap)
+
+    def union(self, other: "Container") -> "Container":
+        if self.is_array() and other.is_array():
+            out = np.union1d(self.array, other.array).astype(_U32)
+            return Container(array=out).normalize()
+        return Container(bitmap=self.words() | other.words()).normalize()
+
+    def difference(self, other: "Container") -> "Container":
+        if self.is_array():
+            if other.is_array():
+                out = np.setdiff1d(self.array, other.array, assume_unique=True)
+                return Container(array=out.astype(_U32))
+            a = self.array
+            mask = (other.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
+            return Container(array=a[~mask.astype(bool)])
+        return Container(bitmap=self.bitmap & ~other.words()).normalize()
+
+    def xor(self, other: "Container") -> "Container":
+        if self.is_array() and other.is_array():
+            out = np.setxor1d(self.array, other.array, assume_unique=True)
+            return Container(array=out.astype(_U32)).normalize()
+        return Container(bitmap=self.words() ^ other.words()).normalize()
+
+    def check(self) -> list:
+        """Consistency check (reference: roaring.go:1163-1181)."""
+        errs = []
+        if self.array is not None:
+            if np.any(self.array[1:] <= self.array[:-1]):
+                errs.append("array not strictly sorted")
+            if len(self.array) > ARRAY_MAX_SIZE:
+                errs.append("array container over threshold")
+        else:
+            if len(self.bitmap) != BITMAP_N:
+                errs.append("bitmap container has wrong word count")
+            if _popcount_words(self.bitmap) <= ARRAY_MAX_SIZE:
+                errs.append("bitmap container under threshold")
+        return errs
+
+
+class Bitmap:
+    """Roaring bitmap: sorted (key -> Container) map over the uint64 space.
+
+    key = value >> 16 (reference: roaring.go:43-52). Supports an append-only
+    op writer for WAL durability (reference: roaring.go:48-52,617-628).
+    """
+
+    __slots__ = ("keys", "containers", "op_writer", "op_n")
+
+    def __init__(self, values: Optional[Iterable[int]] = None):
+        self.keys: list[int] = []
+        self.containers: list[Container] = []
+        self.op_writer = None  # file-like; ops appended when set
+        self.op_n = 0
+        if values is not None:
+            arr = np.asarray(list(values) if not isinstance(values, np.ndarray) else values, dtype=_U64)
+            if arr.size:
+                self.add_many(arr)
+
+    # -- container index ---------------------------------------------------
+
+    def _find_key(self, key: int) -> int:
+        """Index of key in self.keys, or -1."""
+        i = bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return i
+        return -1
+
+    def _container_for(self, key: int, create: bool = False) -> Optional[Container]:
+        i = bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return self.containers[i]
+        if not create:
+            return None
+        c = Container()
+        self.keys.insert(i, key)
+        self.containers.insert(i, c)
+        return c
+
+    def _writable_container_for(self, key: int, create: bool = False) -> Optional[Container]:
+        """Like _container_for, but copy-on-write: a shared container is
+        replaced with a private clone before any mutation."""
+        i = bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            c = self.containers[i]
+            if c.shared:
+                c = c.clone()
+                self.containers[i] = c
+            return c
+        if not create:
+            return None
+        c = Container()
+        self.keys.insert(i, key)
+        self.containers.insert(i, c)
+        return c
+
+    # -- mutation ----------------------------------------------------------
+
+    def add(self, *values: int) -> bool:
+        """Add values, appending a WAL op per value (reference roaring.go:84-103).
+
+        Returns True if any value was newly set.
+        """
+        changed = False
+        for v in values:
+            v = int(v)
+            if self.op_writer is not None:
+                from .serialize import write_op
+
+                write_op(self.op_writer, 0, v)
+                self.op_n += 1
+            if self._add_one(v):
+                changed = True
+        return changed
+
+    def _add_one(self, v: int) -> bool:
+        return self._writable_container_for(v >> 16, create=True).add(v & 0xFFFF)
+
+    def remove(self, *values: int) -> bool:
+        changed = False
+        for v in values:
+            v = int(v)
+            if self.op_writer is not None:
+                from .serialize import write_op
+
+                write_op(self.op_writer, 1, v)
+                self.op_n += 1
+            if self._remove_one(v):
+                changed = True
+        return changed
+
+    def _remove_one(self, v: int) -> bool:
+        c = self._writable_container_for(v >> 16)
+        if c is None:
+            return False
+        ok = c.remove(v & 0xFFFF)
+        if ok and c.n == 0:
+            i = self._find_key(v >> 16)
+            del self.keys[i]
+            del self.containers[i]
+        return ok
+
+    def add_many(self, values: np.ndarray) -> int:
+        """Bulk add without WAL ops (import path, reference fragment.go:922-989).
+
+        Returns the number of newly-set bits.
+        """
+        values = np.asarray(values, dtype=_U64)
+        if values.size == 0:
+            return 0
+        values = np.unique(values)
+        keys = (values >> _U64(16)).astype(np.int64)
+        low = (values & _U64(0xFFFF)).astype(_U32)
+        total = 0
+        # Group by container key: values are sorted, so keys are runs.
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [len(keys)]))
+        for s, e in zip(starts, ends):
+            c = self._writable_container_for(int(keys[s]), create=True)
+            total += c.add_many(low[s:e])
+        return total
+
+    # -- queries -----------------------------------------------------------
+
+    def contains(self, v: int) -> bool:
+        c = self._container_for(int(v) >> 16)
+        return c is not None and c.contains(int(v) & 0xFFFF)
+
+    def count(self) -> int:
+        return sum(c.n for c in self.containers)
+
+    def count_range(self, start: int, end: int) -> int:
+        """Count of values in [start, end) (reference roaring.go CountRange)."""
+        if start >= end:
+            return 0
+        skey, ekey = start >> 16, (end - 1) >> 16
+        total = 0
+        for key, c in zip(self.keys, self.containers):
+            if key < skey or key > ekey:
+                continue
+            if key == skey or key == ekey:
+                lo = (start & 0xFFFF) if key == skey else 0
+                hi = ((end - 1) & 0xFFFF) + 1 if key == ekey else CONTAINER_WIDTH
+                total += c.count_range(lo, hi)
+            else:
+                total += c.n
+        return total
+
+    def max(self) -> int:
+        if not self.keys:
+            return 0
+        vals = self.containers[-1].values()
+        return (self.keys[-1] << 16) | int(vals[-1])
+
+    def slice(self) -> np.ndarray:
+        """All values, sorted, as a uint64 array (reference Bitmap.Slice)."""
+        if not self.keys:
+            return np.empty(0, dtype=_U64)
+        parts = [
+            (np.int64(key) << 16) | c.values().astype(np.int64)
+            for key, c in zip(self.keys, self.containers)
+        ]
+        return np.concatenate(parts).astype(_U64)
+
+    def slice_range(self, start: int, end: int) -> np.ndarray:
+        """Values in [start, end), sorted."""
+        vals = self.slice()
+        i = np.searchsorted(vals, start, side="left")
+        j = np.searchsorted(vals, end, side="left")
+        return vals[i:j]
+
+    def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
+        """Re-key containers in [start,end) to begin at `offset`.
+
+        offset/start/end must be container-aligned (multiples of 2^16);
+        used for row materialization (reference roaring.go OffsetRange,
+        fragment.go:332-367).
+        """
+        if offset & 0xFFFF or start & 0xFFFF or end & 0xFFFF:
+            raise ValueError("offset/start/end must be multiples of 2^16")
+        okey, skey, ekey = offset >> 16, start >> 16, end >> 16
+        out = Bitmap()
+        lo = bisect_left(self.keys, skey)
+        hi = bisect_left(self.keys, ekey)
+        for i in range(lo, hi):
+            c = self.containers[i]
+            c.shared = True  # both sides now copy-on-write before mutating
+            out.keys.append(okey + (self.keys[i] - skey))
+            out.containers.append(c)
+        return out
+
+    # -- pairwise set ops --------------------------------------------------
+
+    def _merge(self, other: "Bitmap", op: str) -> "Bitmap":
+        out = Bitmap()
+        i = j = 0
+        a_keys, b_keys = self.keys, other.keys
+        while i < len(a_keys) or j < len(b_keys):
+            ka = a_keys[i] if i < len(a_keys) else None
+            kb = b_keys[j] if j < len(b_keys) else None
+            if kb is None or (ka is not None and ka < kb):
+                if op in ("union", "difference", "xor"):
+                    out.keys.append(ka)
+                    out.containers.append(self.containers[i].clone())
+                i += 1
+            elif ka is None or kb < ka:
+                if op in ("union", "xor"):
+                    out.keys.append(kb)
+                    out.containers.append(other.containers[j].clone())
+                j += 1
+            else:
+                ca, cb = self.containers[i], other.containers[j]
+                if op == "intersect":
+                    c = ca.intersect(cb)
+                elif op == "union":
+                    c = ca.union(cb)
+                elif op == "difference":
+                    c = ca.difference(cb)
+                else:
+                    c = ca.xor(cb)
+                if c.n > 0:
+                    out.keys.append(ka)
+                    out.containers.append(c)
+                i += 1
+                j += 1
+        return out
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        return self._merge(other, "intersect")
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        return self._merge(other, "union")
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        return self._merge(other, "difference")
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        return self._merge(other, "xor")
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        """Cardinality of the intersection without materializing it
+        (reference roaring.go:329-343 — the fused kernel the TPU path mirrors).
+        """
+        total = 0
+        i = j = 0
+        while i < len(self.keys) and j < len(other.keys):
+            if self.keys[i] < other.keys[j]:
+                i += 1
+            elif self.keys[i] > other.keys[j]:
+                j += 1
+            else:
+                total += self.containers[i].intersection_count(other.containers[j])
+                i += 1
+                j += 1
+        return total
+
+    # -- iteration ---------------------------------------------------------
+
+    def __iter__(self) -> Iterator[int]:
+        for key, c in zip(self.keys, self.containers):
+            base = key << 16
+            for v in c.values():
+                yield base | int(v)
+
+    def iterator_from(self, seek: int) -> Iterator[int]:
+        """Iterate values >= seek (reference Iterator.Seek)."""
+        start = bisect_left(self.keys, seek >> 16)
+        for i in range(start, len(self.keys)):
+            base = self.keys[i] << 16
+            vals = self.containers[i].values()
+            if self.keys[i] == seek >> 16:
+                vals = vals[np.searchsorted(vals, seek & 0xFFFF):]
+            for v in vals:
+                yield base | int(v)
+
+    # -- maintenance -------------------------------------------------------
+
+    def clone(self) -> "Bitmap":
+        out = Bitmap()
+        out.keys = list(self.keys)
+        out.containers = [c.clone() for c in self.containers]
+        return out
+
+    def check(self) -> list:
+        errs = []
+        for i in range(1, len(self.keys)):
+            if self.keys[i] <= self.keys[i - 1]:
+                errs.append(f"keys out of order at {i}")
+        for key, c in zip(self.keys, self.containers):
+            for e in c.check():
+                errs.append(f"container {key}: {e}")
+        return errs
+
+    def info(self) -> dict:
+        """Per-container stats (reference BitmapInfo / `pilosa inspect`)."""
+        return {
+            "op_n": self.op_n,
+            "containers": [
+                {
+                    "key": key,
+                    "type": "array" if c.is_array() else "bitmap",
+                    "n": c.n,
+                    "alloc": (len(c.array) * 4 if c.is_array() else BITMAP_N * 8),
+                }
+                for key, c in zip(self.keys, self.containers)
+            ],
+        }
+
+    # -- serialization (see serialize.py) ----------------------------------
+
+    def write_to(self, w) -> int:
+        from .serialize import write_bitmap
+
+        return write_bitmap(self, w)
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        self.write_to(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        from .serialize import read_bitmap
+
+        return read_bitmap(data)

@@ -1,0 +1,128 @@
+"""On-disk format + op log, byte-compatible with the reference.
+
+Layout (reference /root/reference/roaring/roaring.go:475-614):
+
+    u32 cookie (12346) | u32 containerCount
+    containerCount x { u64 key | u32 n-1 }            # 12-byte headers
+    containerCount x { u32 absolute offset }
+    container blocks: array -> n x u32 LE; bitmap -> 1024 x u64 LE
+    op log: repeated { u8 type | u64 value | u32 fnv32a(first 9 bytes) }
+
+All little-endian. Containers with n <= 4096 are stored in array form,
+larger in bitmap form (the reader infers form from n).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .bitmap import ARRAY_MAX_SIZE, BITMAP_N, Bitmap, Container
+
+COOKIE = 12346
+HEADER_SIZE = 8
+OP_SIZE = 13
+
+
+def fnv32a(data: bytes) -> int:
+    """32-bit FNV-1a (reference op checksums, roaring.go:1595-1616)."""
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def write_op(w, typ: int, value: int) -> int:
+    """Append one WAL op: {type u8, value u64, fnv32a u32} = 13 bytes."""
+    body = struct.pack("<BQ", typ, value)
+    w.write(body + struct.pack("<I", fnv32a(body)))
+    return OP_SIZE
+
+
+def read_ops(data: bytes):
+    """Parse a run of WAL ops; yields (type, value). Raises on bad checksum."""
+    off = 0
+    while off < len(data):
+        if off + OP_SIZE > len(data):
+            raise ValueError(f"op data out of bounds: len={len(data) - off}")
+        body = data[off : off + 9]
+        (chk,) = struct.unpack_from("<I", data, off + 9)
+        if chk != fnv32a(body):
+            raise ValueError(
+                f"checksum mismatch: exp={fnv32a(body):08x}, got={chk:08x}"
+            )
+        typ, value = struct.unpack("<BQ", body)
+        yield typ, value
+        off += OP_SIZE
+
+
+def _container_bytes(c: Container) -> bytes:
+    if c.is_array():
+        return c.array.astype("<u4").tobytes()
+    return c.bitmap.astype("<u8").tobytes()
+
+
+def write_bitmap(b: Bitmap, w) -> int:
+    """Serialize the snapshot region (no ops). Returns bytes written."""
+    entries = [
+        (key, c) for key, c in zip(b.keys, b.containers) if c.n > 0
+    ]
+    n_written = 0
+    header = struct.pack("<II", COOKIE, len(entries))
+    keyhdrs = b"".join(
+        struct.pack("<QI", key, c.n - 1) for key, c in entries
+    )
+    blocks = [_container_bytes(c) for _, c in entries]
+    offset = HEADER_SIZE + len(entries) * 12 + len(entries) * 4
+    offsets = bytearray()
+    for blk in blocks:
+        offsets += struct.pack("<I", offset)
+        offset += len(blk)
+    for chunk in (header, keyhdrs, bytes(offsets), *blocks):
+        w.write(chunk)
+        n_written += len(chunk)
+    return n_written
+
+
+def read_bitmap(data: bytes) -> Bitmap:
+    """Parse snapshot + replay trailing op log (reference roaring.go:536-614)."""
+    if len(data) < HEADER_SIZE:
+        raise ValueError("data too small")
+    cookie, key_n = struct.unpack_from("<II", data, 0)
+    if cookie != COOKIE:
+        raise ValueError("invalid roaring file")
+
+    b = Bitmap()
+    ns = []
+    for i in range(key_n):
+        key, n_minus_1 = struct.unpack_from("<QI", data, HEADER_SIZE + i * 12)
+        b.keys.append(key)
+        ns.append(n_minus_1 + 1)
+
+    ops_offset = HEADER_SIZE + key_n * 12
+    end = ops_offset + key_n * 4
+    for i in range(key_n):
+        (offset,) = struct.unpack_from("<I", data, ops_offset + i * 4)
+        if offset >= len(data):
+            raise ValueError(f"offset out of bounds: off={offset}, len={len(data)}")
+        n = ns[i]
+        if n <= ARRAY_MAX_SIZE:
+            arr = np.frombuffer(data, dtype="<u4", count=n, offset=offset)
+            b.containers.append(Container(array=arr.astype(np.uint32)))
+            end = offset + n * 4
+        else:
+            words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=offset)
+            b.containers.append(Container(bitmap=words.astype(np.uint64)))
+            end = offset + BITMAP_N * 8
+
+    for typ, value in read_ops(data[end:]):
+        if typ == 0:
+            b._add_one(value)
+        elif typ == 1:
+            b._remove_one(value)
+        else:
+            raise ValueError(f"invalid op type: {typ}")
+        b.op_n += 1
+    return b

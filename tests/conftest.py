@@ -1,0 +1,17 @@
+"""Test environment: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's test strategy of deterministic fake clusters
+(/root/reference/cluster_test.go ModHasher): multi-device behavior is tested
+on CPU-backed virtual devices, and Pallas kernels run in interpret mode.
+"""
+
+import os
+
+# Must be set before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+

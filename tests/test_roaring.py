@@ -1,0 +1,166 @@
+"""Differential tests of the host roaring layer vs plain Python sets.
+
+Analog of the reference's asm-vs-Go differential suite
+(/root/reference/roaring/assembly_test.go): random data, compare against a
+trivially-correct model.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import (
+    ARRAY_MAX_SIZE,
+    Bitmap,
+    fnv32a,
+)
+from pilosa_tpu.roaring.serialize import read_bitmap, read_ops, write_op
+
+
+def random_values(rng, n, lo=0, hi=1 << 22):
+    return np.unique(rng.integers(lo, hi, size=n, dtype=np.uint64))
+
+
+@pytest.mark.parametrize("n", [0, 1, 10, 5000, 70000])
+def test_add_count_contains(n):
+    rng = np.random.default_rng(n)
+    vals = random_values(rng, n)
+    b = Bitmap(vals)
+    assert b.count() == len(vals)
+    model = set(int(v) for v in vals)
+    for v in list(model)[:100]:
+        assert b.contains(v)
+    assert not b.contains(hi_missing(model))
+    got = b.slice()
+    assert np.array_equal(got, vals)
+
+
+def hi_missing(model):
+    v = 1 << 23
+    while v in model:
+        v += 1
+    return v
+
+
+def test_add_remove_single():
+    b = Bitmap()
+    assert b.add(7, 100000, 7)
+    assert b.count() == 2
+    assert b.remove(7)
+    assert not b.remove(7)
+    assert b.count() == 1
+    assert b.max() == 100000
+
+
+def test_array_bitmap_conversion_threshold():
+    b = Bitmap()
+    vals = np.arange(ARRAY_MAX_SIZE + 1, dtype=np.uint64)
+    b.add_many(vals)
+    assert not b.containers[0].is_array()
+    b.remove(0)
+    # dropping back to 4096 converts to array (reference roaring.go:1023)
+    assert b.containers[0].is_array()
+    assert b.count() == ARRAY_MAX_SIZE
+    assert not b.check()
+
+
+@pytest.mark.parametrize("na,nb", [(100, 100), (5000, 100), (100, 5000), (8000, 9000), (0, 100)])
+def test_set_ops_differential(na, nb):
+    rng = np.random.default_rng(na * 31 + nb)
+    a_vals = random_values(rng, na, hi=1 << 18)
+    b_vals = random_values(rng, nb, hi=1 << 18)
+    a, b = Bitmap(a_vals), Bitmap(b_vals)
+    sa, sb = set(map(int, a_vals)), set(map(int, b_vals))
+
+    assert set(map(int, a.intersect(b).slice())) == sa & sb
+    assert set(map(int, a.union(b).slice())) == sa | sb
+    assert set(map(int, a.difference(b).slice())) == sa - sb
+    assert set(map(int, a.xor(b).slice())) == sa ^ sb
+    assert a.intersection_count(b) == len(sa & sb)
+    assert not a.intersect(b).check()
+    assert not a.union(b).check()
+
+
+def test_count_range():
+    rng = np.random.default_rng(5)
+    vals = random_values(rng, 20000, hi=1 << 20)
+    b = Bitmap(vals)
+    model = np.asarray(sorted(map(int, vals)))
+    for start, end in [(0, 1 << 20), (1000, 2000), (65536, 65537), (0, 0), (70000, 300000)]:
+        expected = int(((model >= start) & (model < end)).sum())
+        assert b.count_range(start, end) == expected, (start, end)
+
+
+def test_offset_range():
+    b = Bitmap([1, 70000, 200000, (1 << 20) + 5])
+    # Extract the second container-range and re-key to zero.
+    out = b.offset_range(0, 65536, 131072)
+    assert list(out) == [70000 - 65536]
+
+
+def test_serialization_roundtrip():
+    rng = np.random.default_rng(9)
+    vals = random_values(rng, 30000, hi=1 << 22)  # mixes array+bitmap containers
+    b = Bitmap(vals)
+    data = b.to_bytes()
+    b2 = Bitmap.from_bytes(data)
+    assert np.array_equal(b2.slice(), b.slice())
+    assert not b2.check()
+
+
+def test_op_log_replay():
+    b = Bitmap([5])
+    data = b.to_bytes()
+    # Append ops manually after the snapshot.
+    buf = io.BytesIO()
+    write_op(buf, 0, 123456)
+    write_op(buf, 0, 5_000_000)
+    write_op(buf, 1, 5)
+    b2 = read_bitmap(data + buf.getvalue())
+    assert set(b2) == {123456, 5_000_000}
+    assert b2.op_n == 3
+
+
+def test_op_log_checksum_detects_corruption():
+    buf = io.BytesIO()
+    write_op(buf, 0, 42)
+    raw = bytearray(buf.getvalue())
+    raw[3] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        list(read_ops(bytes(raw)))
+
+
+def test_op_writer_appends():
+    buf = io.BytesIO()
+    b = Bitmap()
+    b.op_writer = buf
+    b.add(1)
+    b.add(2)
+    b.remove(1)
+    ops = list(read_ops(buf.getvalue()))
+    assert ops == [(0, 1), (0, 2), (1, 1)]
+    assert b.op_n == 3
+
+
+def test_fnv32a_known_vector():
+    # FNV-1a("a") = 0xe40c292c; ensures checksum parity with Go's hash/fnv.
+    assert fnv32a(b"a") == 0xE40C292C
+    assert fnv32a(b"") == 2166136261
+
+
+def test_clone_copy_on_write_offset_range():
+    b = Bitmap([1, 2, 3])
+    view = b.offset_range(0, 0, 65536)
+    # view shares containers; mutating the clone must not affect the source
+    c = view.clone()
+    c.add(9)
+    assert not b.contains(9)
+    # direct mutation of the view copies-on-write, source unaffected
+    view.add(11)
+    assert view.contains(11) and not b.contains(11)
+    # and mutation of the source does not leak into the view
+    b.add(12)
+    assert b.contains(12) and not view.contains(12)
+    b.remove(1)
+    assert view.contains(1)
