@@ -1,0 +1,43 @@
+"""Device compute layer: HBM-resident container pools + Pallas/XLA kernels.
+
+This is the TPU re-design of the reference's compute core — the roaring
+container set-op kernels and POPCNT assembly
+(/root/reference/roaring/roaring.go:1192-1558,
+/root/reference/roaring/assembly_amd64.s). Instead of per-container
+type-dispatched loops, fragments are uploaded as fixed-shape pools of
+bitmap-form containers ((C, 2048) uint32 in HBM); rows are gathered as
+(16, 2048) dense blocks, and whole PQL expression trees evaluate as fused
+elementwise dataflow with popcount reductions — one XLA/Pallas launch per
+query batch, never materializing intermediates to HBM.
+"""
+
+from .pool import (
+    CONTAINER_WORDS,
+    INVALID_KEY,
+    FragmentPool,
+    build_pool,
+    build_pool_arrays,
+    gather_row,
+    pool_row_counts,
+)
+from .bitops import (
+    count_pair,
+    dense_row_count,
+    popcount_words,
+)
+from .kernels import fused_pair_count, use_pallas
+
+__all__ = [
+    "CONTAINER_WORDS",
+    "INVALID_KEY",
+    "FragmentPool",
+    "build_pool",
+    "build_pool_arrays",
+    "gather_row",
+    "pool_row_counts",
+    "count_pair",
+    "dense_row_count",
+    "popcount_words",
+    "fused_pair_count",
+    "use_pallas",
+]

@@ -1,0 +1,40 @@
+"""Pure-XLA bitwise ops over dense (…, 2048)-word blocks.
+
+These are the jnp reference semantics for the Pallas kernels in
+kernels.py (differential-test pairing, the analog of the reference's
+asm-vs-Go suite, /root/reference/roaring/assembly_test.go) and the
+fallback path on non-TPU backends. XLA fuses the elementwise op with the
+popcount reduction, which already beats the reference's
+materialize-then-count Count path (SURVEY.md §3.2 note).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Bitwise combiners by PQL-level name.
+BINARY_OPS = {
+    "and": jnp.bitwise_and,
+    "or": jnp.bitwise_or,
+    "xor": jnp.bitwise_xor,
+    "andnot": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+}
+
+
+def popcount_words(words: jax.Array) -> jax.Array:
+    """Total set bits in a word block (reference popcntSliceAsm,
+    roaring/assembly_amd64.s:25-44). int32: a fragment holds <= 2^20 bits
+    per row; cross-slice totals are aggregated host-side in Python ints."""
+    return jax.lax.population_count(words).astype(jnp.int32).sum()
+
+
+def count_pair(a: jax.Array, b: jax.Array, op: str = "and") -> jax.Array:
+    """Fused popcount(op(a, b)) without materializing the result to HBM
+    (reference popcnt{And,Or,Xor,Mask}SliceAsm, assembly_amd64.s:47-115)."""
+    return jax.lax.population_count(BINARY_OPS[op](a, b)).astype(jnp.int32).sum()
+
+
+def dense_row_count(row: jax.Array) -> jax.Array:
+    """Bit count of one materialized dense row block."""
+    return popcount_words(row)

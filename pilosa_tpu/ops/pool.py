@@ -1,0 +1,134 @@
+"""FragmentPool: a fragment's containers as fixed-shape device arrays.
+
+The host roaring bitmap (pilosa_tpu.roaring) stays authoritative and
+mutable; the pool is its device-resident compute image. Containers are
+unified to bitmap form on upload — arrays with n <= 4096 cost 8 KB here,
+which buys static shapes, coalesced HBM reads, and elementwise kernels
+(the "padded pool + bitmap-only on device" design from SURVEY.md §7).
+
+Key layout: a bit at (row, col) within one slice sits at linear position
+pos = row * 2^20 + (col % 2^20) (reference fragment.go:1511-1514), so
+container key = pos >> 16 and row r spans exactly keys
+[16r, 16r+16) — a row is a gather of <= 16 containers.
+
+Row IDs are arbitrary uint64 on the host, far beyond int32 device keys.
+The pool therefore stores DENSE row indices: the host keeps the sorted
+array of distinct row IDs present in the fragment (`row_ids`), and a
+device key is dense_index*16 + block. Callers translate real row IDs to
+dense indices (np.searchsorted on row_ids) before calling device code.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..roaring.bitmap import Bitmap
+
+# uint32 words per container: 2^16 bits / 32.
+CONTAINER_WORDS = 2048
+
+# Containers spanned by one slice-row: 2^20 / 2^16.
+ROW_SPAN = 16
+
+# Sentinel key for padding entries (larger than any real key so the
+# key array stays sorted).
+INVALID_KEY = np.int32(2**31 - 1)
+
+
+class FragmentPool(NamedTuple):
+    """Device image of one fragment.
+
+    keys:  (C,) int32, sorted ascending, padded with INVALID_KEY.
+           key = dense_row_index * 16 + block (NOT real row id; see module
+           docstring).
+    words: (C, CONTAINER_WORDS) uint32 bitmap-form containers
+    n:     () int32 — number of live containers (<= C)
+    """
+
+    keys: jax.Array
+    words: jax.Array
+    n: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+
+def _round_capacity(n: int) -> int:
+    """Pad to the next power of two (min 16) so recompilation only happens
+    on doubling, not on every container insert."""
+    c = 16
+    while c < n:
+        c *= 2
+    return c
+
+
+def build_pool_arrays(bitmap: Bitmap, capacity: Optional[int] = None):
+    """Host-side packing: roaring bitmap -> (keys, words, n, row_ids).
+
+    row_ids is the sorted uint64 array of distinct real row IDs present;
+    device keys are dense_row_index*16 + block.
+    """
+    n = len(bitmap.keys)
+    cap = capacity if capacity is not None else _round_capacity(n)
+    if cap < n:
+        raise ValueError(f"capacity {cap} < container count {n}")
+    real_keys = np.asarray(bitmap.keys, dtype=np.uint64)
+    row_ids = np.unique(real_keys >> np.uint64(4))
+    dense_row = np.searchsorted(row_ids, real_keys >> np.uint64(4))
+    keys = np.full(cap, INVALID_KEY, dtype=np.int32)
+    words = np.zeros((cap, CONTAINER_WORDS), dtype=np.uint32)
+    for i, c in enumerate(bitmap.containers):
+        keys[i] = np.int32(dense_row[i] * ROW_SPAN + int(real_keys[i] & np.uint64(15)))
+        # u64[1024] little-endian words -> u32[2048]
+        words[i] = c.words().view(np.uint32)
+    return keys, words, np.int32(n), row_ids
+
+
+def build_pool(bitmap: Bitmap, capacity: Optional[int] = None, device=None):
+    """Upload a fragment to the device. Returns (FragmentPool, row_ids):
+    row_ids stays host-side for real-rowID <-> dense-index translation."""
+    keys, words, n, row_ids = build_pool_arrays(bitmap, capacity)
+    put = partial(jax.device_put, device=device) if device else jax.device_put
+    return FragmentPool(keys=put(keys), words=put(words), n=put(n)), row_ids
+
+
+@partial(jax.jit, static_argnames=())
+def gather_row(pool: FragmentPool, dense_row) -> jax.Array:
+    """Materialize dense row index `dense_row` as a (16, 2048) uint32 block.
+
+    TPU analog of Fragment.row's OffsetRange materialization
+    (reference fragment.go:332-367) — but a bounded gather instead of a
+    container-list walk, so it stays inside jit with static shapes.
+    A dense index with no containers (e.g. an absent row mapped to an
+    out-of-range index by the caller) gathers all-zero.
+    """
+    targets = jnp.int32(dense_row) * ROW_SPAN + jnp.arange(ROW_SPAN, dtype=jnp.int32)
+    idx = jnp.searchsorted(pool.keys, targets)
+    idx = jnp.clip(idx, 0, pool.capacity - 1)
+    hit = pool.keys[idx] == targets
+    rows = pool.words[idx]  # (16, 2048)
+    return jnp.where(hit[:, None], rows, jnp.uint32(0))
+
+
+@partial(jax.jit, static_argnames=("num_rows",))
+def pool_row_counts(pool: FragmentPool, num_rows: int) -> jax.Array:
+    """Per-dense-row bit counts over the whole pool: popcount each
+    container, segment-sum by dense row (key >> 4). Feeds TopN (reference
+    fragment.go:493-625 walks the rank cache; on device we can afford the
+    exact scan). num_rows is the dense row count (len(row_ids))."""
+    per_container = jax.lax.population_count(pool.words).sum(
+        axis=1, dtype=jnp.int32
+    )
+    valid = pool.keys != INVALID_KEY
+    dense = jnp.where(valid, pool.keys // ROW_SPAN, num_rows)
+    return jax.ops.segment_sum(
+        jnp.where(valid, per_container, 0),
+        dense,
+        num_segments=num_rows + 1,
+    )[:num_rows]

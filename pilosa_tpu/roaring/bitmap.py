@@ -169,15 +169,22 @@ class Container:
 
     def count_range(self, start: int, end: int) -> int:
         """Count of values in [start, end) within this container."""
+        if start >= end:
+            return 0
         if self.array is not None:
             i = np.searchsorted(self.array, start, side="left")
             j = np.searchsorted(self.array, end, side="left")
             return int(j - i)
-        vals = self.values()
-        return int(
-            np.searchsorted(vals, end, side="left")
-            - np.searchsorted(vals, start, side="left")
-        )
+        # Bitmap form: popcount whole middle words, mask the edges.
+        sw, ew = start >> 6, (end - 1) >> 6
+        if sw == ew:
+            word = (int(self.bitmap[sw]) >> (start & 63)) & ((1 << (end - start)) - 1)
+            return word.bit_count()
+        total = (int(self.bitmap[sw]) >> (start & 63)).bit_count()
+        total += (int(self.bitmap[ew]) & ((1 << (((end - 1) & 63) + 1)) - 1)).bit_count()
+        if ew > sw + 1:
+            total += int(np.bitwise_count(self.bitmap[sw + 1 : ew]).sum())
+        return total
 
     # -- pairwise set ops --------------------------------------------------
 
@@ -378,9 +385,10 @@ class Bitmap:
             return 0
         skey, ekey = start >> 16, (end - 1) >> 16
         total = 0
-        for key, c in zip(self.keys, self.containers):
-            if key < skey or key > ekey:
-                continue
+        lo_i = bisect_left(self.keys, skey)
+        hi_i = bisect_left(self.keys, ekey + 1)
+        for i in range(lo_i, hi_i):
+            key, c = self.keys[i], self.containers[i]
             if key == skey or key == ekey:
                 lo = (start & 0xFFFF) if key == skey else 0
                 hi = ((end - 1) & 0xFFFF) + 1 if key == ekey else CONTAINER_WIDTH
@@ -406,11 +414,25 @@ class Bitmap:
         return np.concatenate(parts).astype(_U64)
 
     def slice_range(self, start: int, end: int) -> np.ndarray:
-        """Values in [start, end), sorted."""
-        vals = self.slice()
-        i = np.searchsorted(vals, start, side="left")
-        j = np.searchsorted(vals, end, side="left")
-        return vals[i:j]
+        """Values in [start, end), sorted. Touches only containers whose
+        key window overlaps the range."""
+        if start >= end or not self.keys:
+            return np.empty(0, dtype=_U64)
+        skey, ekey = start >> 16, (end - 1) >> 16
+        lo_i = bisect_left(self.keys, skey)
+        hi_i = bisect_left(self.keys, ekey + 1)
+        parts = []
+        for i in range(lo_i, hi_i):
+            key = self.keys[i]
+            v = (np.int64(key) << 16) | self.containers[i].values().astype(np.int64)
+            if key == skey:
+                v = v[np.searchsorted(v, start, side="left"):]
+            if key == ekey:
+                v = v[: np.searchsorted(v, end, side="left")]
+            parts.append(v)
+        if not parts:
+            return np.empty(0, dtype=_U64)
+        return np.concatenate(parts).astype(_U64)
 
     def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
         """Re-key containers in [start,end) to begin at `offset`.

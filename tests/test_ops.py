@@ -1,0 +1,137 @@
+"""Differential tests: device pool ops vs the host roaring layer.
+
+The analog of the reference's asm-vs-Go differential suite
+(/root/reference/roaring/assembly_test.go): random fragments, host
+roaring is the model, device kernels must agree. Runs on the CPU backend
+(conftest) with Pallas in interpret mode.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.ops import (
+    build_pool,
+    count_pair,
+    fused_pair_count,
+    gather_row,
+    pool_row_counts,
+)
+from pilosa_tpu.roaring import Bitmap
+
+
+def make_fragment_bitmap(rng, rows, density=0.001):
+    """Random fragment: bits at pos = row*2^20 + col."""
+    b = Bitmap()
+    for r in rows:
+        n = max(1, int(SLICE_WIDTH * density))
+        cols = np.unique(rng.integers(0, SLICE_WIDTH, size=n, dtype=np.uint64))
+        b.add_many((np.uint64(r) << np.uint64(20)) | cols)
+    return b
+
+
+def row_values(bitmap, r):
+    lo, hi = r * SLICE_WIDTH, (r + 1) * SLICE_WIDTH
+    return set(int(v) - lo for v in bitmap.slice_range(lo, hi))
+
+
+def dense_of(row_ids, r):
+    """Real row ID -> dense index; absent rows map past the end (zero gather)."""
+    i = int(np.searchsorted(row_ids, np.uint64(r)))
+    if i < len(row_ids) and row_ids[i] == np.uint64(r):
+        return i
+    return len(row_ids)
+
+
+@pytest.mark.parametrize("density", [0.0001, 0.01])
+def test_gather_row_matches_host(density):
+    rng = np.random.default_rng(1)
+    b = make_fragment_bitmap(rng, rows=[0, 3, 7], density=density)
+    pool, row_ids = build_pool(b)
+    for r in [0, 3, 7, 5]:
+        block = np.asarray(gather_row(pool, dense_of(row_ids, r)))  # (16, 2048) uint32
+        bits = np.unpackbits(
+            block.view(np.uint8), bitorder="little"
+        )
+        got = set(np.nonzero(bits)[0])
+        assert got == row_values(b, r), f"row {r}"
+
+
+@pytest.mark.parametrize("op,setop", [
+    ("and", lambda a, b: a & b),
+    ("or", lambda a, b: a | b),
+    ("xor", lambda a, b: a ^ b),
+    ("andnot", lambda a, b: a - b),
+])
+def test_fused_pair_count_matches_host(op, setop):
+    rng = np.random.default_rng(7)
+    b = make_fragment_bitmap(rng, rows=[1, 2], density=0.005)
+    pool, row_ids = build_pool(b)
+    r1 = gather_row(pool, dense_of(row_ids, 1))
+    r2 = gather_row(pool, dense_of(row_ids, 2))
+    expected = len(setop(row_values(b, 1), row_values(b, 2)))
+    # XLA path
+    assert int(count_pair(r1, r2, op)) == expected
+    # Pallas path (interpret mode on CPU)
+    got = int(fused_pair_count(r1, r2, op, force_pallas=True, interpret=True))
+    assert got == expected
+
+
+def test_fused_pair_count_nonaligned_block():
+    # M not a multiple of the kernel block: padding must not change counts.
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.integers(0, 2**32, size=(5, 2048), dtype=np.uint64).astype(np.uint32))
+    b = jnp.asarray(rng.integers(0, 2**32, size=(5, 2048), dtype=np.uint64).astype(np.uint32))
+    expected = int(np.bitwise_count(np.asarray(a) & np.asarray(b)).sum())
+    got = int(fused_pair_count(a, b, "and", force_pallas=True, interpret=True))
+    assert got == expected
+
+
+def test_pool_row_counts():
+    rng = np.random.default_rng(11)
+    b = make_fragment_bitmap(rng, rows=[0, 2, 9], density=0.002)
+    pool, row_ids = build_pool(b)
+    counts = np.asarray(pool_row_counts(pool, num_rows=len(row_ids)))
+    assert list(row_ids) == [0, 2, 9]
+    for i, r in enumerate(row_ids):
+        assert counts[i] == len(row_values(b, int(r))), f"row {r}"
+
+
+def test_pool_padding_is_inert():
+    # Same bitmap at two capacities must produce identical results.
+    rng = np.random.default_rng(13)
+    b = make_fragment_bitmap(rng, rows=[0, 1], density=0.001)
+    p1, _ = build_pool(b)
+    p2, _ = build_pool(b, capacity=p1.capacity * 4)
+    assert int(fused_pair_count(gather_row(p1, 0), gather_row(p1, 1), "and",
+                                force_pallas=True, interpret=True)) == \
+           int(fused_pair_count(gather_row(p2, 0), gather_row(p2, 1), "and",
+                                force_pallas=True, interpret=True))
+    c1 = np.asarray(pool_row_counts(p1, 2))
+    c2 = np.asarray(pool_row_counts(p2, 2))
+    assert np.array_equal(c1, c2)
+
+
+def test_empty_row_gather():
+    b = Bitmap([5])  # row 0 only
+    pool, row_ids = build_pool(b)
+    block = np.asarray(gather_row(pool, dense_of(row_ids, 42)))
+    assert block.sum() == 0
+
+
+def test_huge_row_ids_via_dense_mapping():
+    # Row IDs near 2^40: int32 device keys would overflow without the
+    # dense-row indirection.
+    r_hi = (1 << 40) + 3
+    b = Bitmap()
+    b.add_many(np.array([7, (np.uint64(r_hi) << np.uint64(20)) | np.uint64(7),
+                         (np.uint64(r_hi) << np.uint64(20)) | np.uint64(99)], dtype=np.uint64))
+    pool, row_ids = build_pool(b)
+    assert list(row_ids) == [0, r_hi]
+    blk = np.asarray(gather_row(pool, dense_of(row_ids, r_hi)))
+    bits = np.unpackbits(blk.view(np.uint8), bitorder="little")
+    assert set(np.nonzero(bits)[0]) == {7, 99}
+    counts = np.asarray(pool_row_counts(pool, num_rows=len(row_ids)))
+    assert list(counts) == [1, 2]
