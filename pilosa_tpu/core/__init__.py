@@ -1,0 +1,42 @@
+"""Core data model: Holder > Index > Frame > View > Fragment.
+
+Host-side object tree with reference semantics (/root/reference/
+holder.go, index.go, frame.go, view.go, fragment.go); fragments own the
+authoritative roaring bitmap plus its device-pool compute image.
+"""
+
+from .timequantum import (
+    TimeQuantum,
+    parse_time_quantum,
+    view_by_time_unit,
+    views_by_time,
+    views_by_time_range,
+)
+from .row import Row
+from .cache import LRUCache, RankCache, SimpleCache
+from .attr import AttrStore
+from .fragment import Fragment
+from .view import View, VIEW_STANDARD, VIEW_INVERSE
+from .frame import Frame
+from .index import Index
+from .holder import Holder
+
+__all__ = [
+    "TimeQuantum",
+    "parse_time_quantum",
+    "view_by_time_unit",
+    "views_by_time",
+    "views_by_time_range",
+    "Row",
+    "LRUCache",
+    "RankCache",
+    "SimpleCache",
+    "AttrStore",
+    "Fragment",
+    "View",
+    "VIEW_STANDARD",
+    "VIEW_INVERSE",
+    "Frame",
+    "Index",
+    "Holder",
+]

@@ -1,0 +1,150 @@
+"""AttrStore: durable id -> attribute-map storage with checksummed blocks.
+
+Parity with /root/reference/attr.go (BoltDB there, stdlib sqlite3 here):
+values limited to str/int/bool/float (attr.go:35-40); SetAttrs merges
+into existing maps; 100-id blocks expose checksums so replicas can diff
+and sync only divergent blocks (attr.go:181-241, holder.go:439-528).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# IDs per checksummed block (reference attr.go:32).
+ATTR_BLOCK_SIZE = 100
+
+_ALLOWED = (str, int, bool, float)
+
+
+def _validate(attrs: dict) -> dict:
+    for k, v in attrs.items():
+        if v is not None and not isinstance(v, _ALLOWED):
+            raise TypeError(f"invalid attr type for {k!r}: {type(v).__name__}")
+    return attrs
+
+
+def _key(id_: int) -> str:
+    # Zero-padded so lexicographic order == numeric order for uint64.
+    return f"{id_:020d}"
+
+
+class AttrStore:
+    """sqlite-backed attribute store with an in-memory cache."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._db: Optional[sqlite3.Connection] = None
+        self._cache: Dict[int, dict] = {}
+        self._lock = threading.RLock()
+
+    def open(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS attrs (id TEXT PRIMARY KEY, data TEXT NOT NULL)"
+        )
+        self._db.commit()
+
+    def close(self):
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+        self._cache.clear()
+
+    def attrs(self, id_: int) -> dict:
+        with self._lock:
+            if id_ in self._cache:
+                return dict(self._cache[id_])
+            row = self._db.execute(
+                "SELECT data FROM attrs WHERE id = ?", (_key(id_),)
+            ).fetchone()
+            m = json.loads(row[0]) if row else {}
+            self._cache[id_] = m
+            return dict(m)
+
+    def set_attrs(self, id_: int, m: dict):
+        """Merge m into id's attrs; None values delete keys (attr.go:118)."""
+        _validate(m)
+        with self._lock:
+            cur = self.attrs(id_)
+            for k, v in m.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            self._db.execute(
+                "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
+                (_key(id_), json.dumps(cur, sort_keys=True)),
+            )
+            self._db.commit()
+            self._cache[id_] = cur
+
+    def set_bulk_attrs(self, items: Dict[int, dict]):
+        with self._lock:
+            for id_, m in items.items():
+                _validate(m)
+            for id_, m in items.items():
+                cur = self.attrs(id_)
+                cur.update({k: v for k, v in m.items() if v is not None})
+                for k, v in m.items():
+                    if v is None:
+                        cur.pop(k, None)
+                self._db.execute(
+                    "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
+                    (_key(id_), json.dumps(cur, sort_keys=True)),
+                )
+                self._cache[id_] = cur
+            self._db.commit()
+
+    # -- anti-entropy blocks ----------------------------------------------
+
+    def _rows(self) -> List[Tuple[int, str]]:
+        return [
+            (int(k), data)
+            for k, data in self._db.execute("SELECT id, data FROM attrs ORDER BY id")
+        ]
+
+    def blocks(self) -> List[Tuple[int, bytes]]:
+        """[(block_id, checksum)] over 100-id blocks (attr.go:181-209)."""
+        out: List[Tuple[int, bytes]] = []
+        h = None
+        cur_block = None
+        for id_, data in self._rows():
+            blk = id_ // ATTR_BLOCK_SIZE
+            if blk != cur_block:
+                if h is not None:
+                    out.append((cur_block, h.digest()))
+                cur_block, h = blk, hashlib.sha1()
+            h.update(_key(id_).encode())
+            h.update(data.encode())
+        if h is not None:
+            out.append((cur_block, h.digest()))
+        return out
+
+    def block_data(self, block_id: int) -> Dict[int, dict]:
+        """All attrs in one block (attr.go:212-241)."""
+        lo, hi = block_id * ATTR_BLOCK_SIZE, (block_id + 1) * ATTR_BLOCK_SIZE
+        return {
+            id_: json.loads(data)
+            for id_, data in self._rows()
+            if lo <= id_ < hi
+        }
+
+
+def diff_blocks(
+    local: List[Tuple[int, bytes]], remote: List[Tuple[int, bytes]]
+) -> List[int]:
+    """Block ids where remote differs from local (reference
+    AttrBlocks.Diff, attr.go:398-428): present only remotely, or both
+    present with different checksums."""
+    lmap = dict(local)
+    out = []
+    for blk, sum_ in remote:
+        if lmap.get(blk) != sum_:
+            out.append(blk)
+    return sorted(out)

@@ -1,0 +1,156 @@
+"""Count caches backing TopN (parity with /root/reference/cache.go).
+
+RankCache keeps the top-N row counts with threshold-gated entry, a 10 s
+invalidation damper, and 1.1x trim; LRUCache is the bounded alternative;
+SimpleCache is the unbounded row-object cache.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Tuple
+
+# Entry-threshold slack factor (reference cache.go:30).
+THRESHOLD_FACTOR = 1.1
+
+# Cache types (reference frame.go defaults).
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+DEFAULT_CACHE_SIZE = 50000
+
+# Pairs are (id, count) tuples ordered by count desc, id asc — the
+# BitmapPair ordering (cache.go:280-341).
+
+
+def _sort_pairs(pairs: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+
+class RankCache:
+    """Threshold-gated top-N count cache (cache.go:126-275)."""
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE, clock: Callable[[], float] = time.monotonic):
+        self.entries: Dict[int, int] = {}
+        self.rankings: List[Tuple[int, int]] = []
+        self.max_entries = max_entries
+        self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
+        self.threshold_value = 0
+        self._clock = clock
+        self._update_time = float("-inf")
+
+    def add(self, id_: int, n: int):
+        if n < self.threshold_value:
+            return
+        self.entries[id_] = n
+        self.invalidate()
+
+    def bulk_add(self, id_: int, n: int):
+        """Unsorted add; call invalidate() after the batch (cache.go:206)."""
+        if n < self.threshold_value:
+            return
+        self.entries[id_] = n
+
+    def get(self, id_: int) -> int:
+        return self.entries.get(id_, 0)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def ids(self) -> List[int]:
+        return sorted(self.entries)
+
+    def invalidate(self):
+        # Damper: at most one recalculation per 10 s (cache.go:255-260).
+        if self._clock() - self._update_time < 10:
+            return
+        self.recalculate()
+
+    def recalculate(self):
+        rankings = _sort_pairs(list(self.entries.items()))
+        if len(rankings) > self.max_entries:
+            self.threshold_value = rankings[self.max_entries][1]
+            rankings = rankings[: self.max_entries]
+        else:
+            self.threshold_value = 1
+        self.rankings = rankings
+        self._update_time = self._clock()
+        if len(self.entries) > self.threshold_buffer:
+            self.entries = {
+                id_: n for id_, n in self.entries.items() if n > self.threshold_value
+            }
+
+    def top(self) -> List[Tuple[int, int]]:
+        return list(self.rankings)
+
+
+class LRUCache:
+    """Bounded LRU count cache (cache.go:55-123)."""
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE):
+        self.max_entries = max_entries
+        self._od: OrderedDict[int, int] = OrderedDict()
+
+    def add(self, id_: int, n: int):
+        self._od[id_] = n
+        self._od.move_to_end(id_)
+        while len(self._od) > self.max_entries:
+            self._od.popitem(last=False)
+
+    bulk_add = add
+
+    def get(self, id_: int) -> int:
+        n = self._od.get(id_, 0)
+        if id_ in self._od:
+            self._od.move_to_end(id_)
+        return n
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def ids(self) -> List[int]:
+        return sorted(self._od)
+
+    def invalidate(self):
+        pass
+
+    def recalculate(self):
+        pass
+
+    def top(self) -> List[Tuple[int, int]]:
+        return _sort_pairs(list(self._od.items()))
+
+
+def new_cache(cache_type: str, size: int, clock=time.monotonic):
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankCache(size, clock=clock)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(size)
+    raise ValueError(f"unknown cache type: {cache_type}")
+
+
+class SimpleCache:
+    """Unbounded row cache (cache.go:449-461)."""
+
+    def __init__(self):
+        self._m: dict = {}
+
+    def fetch(self, id_: int):
+        return self._m.get(id_)
+
+    def add(self, id_: int, row):
+        self._m[id_] = row
+
+    def invalidate(self, id_: int):
+        self._m.pop(id_, None)
+
+    def clear(self):
+        self._m.clear()
+
+
+def add_to_pairs(pairs: List[Tuple[int, int]], other: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Merge-by-id summing counts (reference Pairs.Add, cache.go:343-361)."""
+    m: Dict[int, int] = dict(pairs)
+    for id_, n in other:
+        m[id_] = m.get(id_, 0) + n
+    return _sort_pairs(list(m.items()))

@@ -1,0 +1,471 @@
+"""Fragment: storage + compute unit for one (frame, view, slice).
+
+Parity with /root/reference/fragment.go: owns the durable roaring file
+(snapshot region + WAL, snapshot every MAX_OP_N=2000 ops via temp+rename),
+an exclusive flock, the TopN count cache with `.cache` persistence,
+SHA-1 checksummed 100-row blocks for anti-entropy, and majority-consensus
+block merge. The TPU twist: the fragment lazily maintains a device
+FragmentPool (pilosa_tpu.ops) as its compute image; host mutations mark
+it dirty and it rebuilds on next use.
+
+Bit addressing: pos = rowID * SLICE_WIDTH + (columnID % SLICE_WIDTH)
+(reference fragment.go:1511-1514); columnID is absolute, storage is
+slice-local.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import json
+import os
+import tarfile
+import io
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+from ..roaring import Bitmap
+from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_cache
+from .row import Row
+
+# Snapshot after this many WAL ops (reference fragment.go:62-65).
+MAX_OP_N = 2000
+
+# Rows per checksummed block (reference fragment.go HashBlockSize).
+HASH_BLOCK_SIZE = 100
+
+
+class TopOptions:
+    """Options for Fragment.top (reference fragment.go TopOptions)."""
+
+    def __init__(self, n=0, src=None, row_ids=None, min_threshold=0,
+                 filter_field="", filter_values=None, tanimoto_threshold=0):
+        self.n = n
+        self.src = src  # Row
+        self.row_ids = row_ids or []
+        self.min_threshold = min_threshold
+        self.filter_field = filter_field
+        self.filter_values = filter_values or []
+        self.tanimoto_threshold = tanimoto_threshold
+
+
+class Fragment:
+    """One (frame, view, slice) of data."""
+
+    def __init__(self, path: str, index: str, frame: str, view: str, slice_: int,
+                 cache_type: str = CACHE_TYPE_RANKED,
+                 cache_size: int = DEFAULT_CACHE_SIZE,
+                 row_attr_store=None, stats=None):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.view = view
+        self.slice = slice_
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.row_attr_store = row_attr_store
+        self.stats = stats
+
+        self.storage = Bitmap()
+        self.op_n = 0
+        self.max_op_n = MAX_OP_N
+        self.cache = new_cache(cache_type, cache_size)
+        self.checksums: Dict[int, bytes] = {}
+        self._op_file = None
+        self._lock_file = None
+        self._row_cache: Dict[int, Row] = {}
+
+        # Device compute image (built lazily; see `pool`).
+        self._pool = None
+        self._pool_row_ids = None
+        self._pool_dirty = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def cache_path(self) -> str:
+        return self.path + ".cache"
+
+    def open(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # Exclusive advisory lock (reference fragment.go:191).
+        self._lock_file = open(self.path + ".lock", "w")
+        try:
+            fcntl.flock(self._lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_file.close()
+            self._lock_file = None
+            raise RuntimeError(f"fragment locked by another process: {self.path}")
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            with open(self.path, "rb") as f:
+                self.storage = Bitmap.from_bytes(f.read())
+            self.op_n = self.storage.op_n
+        else:
+            with open(self.path, "wb") as f:
+                self.storage.write_to(f)
+        # Unbuffered: each 13-byte op reaches the OS immediately — the
+        # durability point (reference appends straight to the fd,
+        # roaring.go:617-628; a buffered handle would lose ops on crash).
+        self._op_file = open(self.path, "ab", buffering=0)
+        self.storage.op_writer = self._op_file
+        self._load_cache()
+
+    def close(self):
+        self.flush_cache()
+        if self._op_file is not None:
+            self._op_file.close()
+            self._op_file = None
+        self.storage.op_writer = None
+        if self._lock_file is not None:
+            fcntl.flock(self._lock_file, fcntl.LOCK_UN)
+            self._lock_file.close()
+            self._lock_file = None
+
+    # -- reads -------------------------------------------------------------
+
+    def row(self, row_id: int) -> Row:
+        """Materialize one row as a slice-local segment (fragment.go:332-367)."""
+        cached = self._row_cache.get(row_id)
+        if cached is not None:
+            return cached
+        seg = self.storage.offset_range(
+            0, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
+        )
+        r = Row.from_segment(self.slice, seg)
+        self._row_cache[row_id] = r
+        return r
+
+    def count(self) -> int:
+        return self.storage.count()
+
+    def max_row_id(self) -> int:
+        return self.storage.max() // SLICE_WIDTH
+
+    def for_each_bit(self):
+        """Yield (rowID, absolute columnID) pairs (fragment.go:471-488)."""
+        base = self.slice * SLICE_WIDTH
+        for pos in self.storage:
+            yield pos // SLICE_WIDTH, base + (pos % SLICE_WIDTH)
+
+    # -- writes ------------------------------------------------------------
+
+    def _pos(self, row_id: int, column_id: int) -> int:
+        return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        """Set a bit; WAL-append, maybe snapshot, update caches.
+        Returns True if the bit was newly set (fragment.go:371-413)."""
+        changed = self.storage.add(self._pos(row_id, column_id))
+        self._mark_dirty(row_id)
+        if changed:
+            self.cache.add(row_id, self.row(row_id).count())
+            if self.stats:
+                self.stats.count("setN", 1)
+        self._increment_op_n()
+        return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        changed = self.storage.remove(self._pos(row_id, column_id))
+        self._mark_dirty(row_id)
+        if changed:
+            self.cache.add(row_id, self.row(row_id).count())
+            if self.stats:
+                self.stats.count("clearN", 1)
+        self._increment_op_n()
+        return changed
+
+    def _mark_dirty(self, row_id: Optional[int]):
+        self._pool_dirty = True
+        self.checksums.pop(
+            -1 if row_id is None else row_id // HASH_BLOCK_SIZE, None
+        )
+        if row_id is None:
+            self.checksums.clear()
+            self._row_cache.clear()
+        else:
+            self._row_cache.pop(row_id, None)
+
+    def _increment_op_n(self):
+        self.op_n += 1
+        if self.op_n > self.max_op_n:
+            self.snapshot()
+
+    def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]):
+        """Bulk import: WAL-detached adds + forced snapshot
+        (fragment.go:922-989)."""
+        rows = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        if rows.shape != cols.shape:
+            raise ValueError("row/column mismatch")
+        pos = rows * np.uint64(SLICE_WIDTH) + (cols % np.uint64(SLICE_WIDTH))
+        self.storage.op_writer = None
+        try:
+            self.storage.add_many(pos)
+        finally:
+            self.storage.op_writer = self._op_file
+        self._mark_dirty(None)
+        for r in np.unique(rows):
+            self.cache.bulk_add(int(r), self.row(int(r)).count())
+        self.cache.invalidate()
+        self.snapshot()
+
+    def snapshot(self):
+        """Atomically rewrite the file: write temp, fsync, rename, reopen
+        WAL (fragment.go:992-1057)."""
+        start = time.monotonic()
+        if self._op_file is not None:
+            self._op_file.close()
+            self._op_file = None
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            self.storage.write_to(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.storage.op_n = 0
+        self.op_n = 0
+        self._op_file = open(self.path, "ab", buffering=0)
+        self.storage.op_writer = self._op_file
+        if self.stats:
+            self.stats.timing("snapshot_us", int((time.monotonic() - start) * 1e6))
+
+    # -- TopN ---------------------------------------------------------------
+
+    def _top_pairs(self, row_ids: Sequence[int]) -> List[Tuple[int, int]]:
+        if not row_ids:
+            self.cache.invalidate()
+            return self.cache.top()
+        return [(r, self.row(r).count()) for r in row_ids]
+
+    def top(self, opt: TopOptions) -> List[Tuple[int, int]]:
+        """Top rows by count (reference fragment.go:493-625), including
+        src-intersection recount, min-threshold, attr filters, and the
+        Tanimoto band."""
+        pairs = self._top_pairs(opt.row_ids)
+        n = 0 if opt.row_ids else opt.n
+
+        filters = set(opt.filter_values) if (opt.filter_field and opt.filter_values) else None
+
+        tanimoto = 0
+        min_tan = max_tan = 0.0
+        src_count = 0
+        if opt.tanimoto_threshold > 0 and opt.src is not None:
+            tanimoto = opt.tanimoto_threshold
+            src_count = opt.src.count()
+            min_tan = src_count * tanimoto / 100.0
+            max_tan = src_count * 100.0 / tanimoto
+
+        results: List[Tuple[int, int]] = []  # kept sorted desc by count
+
+        def push(pair):
+            results.append(pair)
+            results.sort(key=lambda p: (-p[1], p[0]))
+
+        for row_id, cnt in pairs:
+            if cnt <= 0:
+                continue
+            if tanimoto > 0:
+                if cnt <= min_tan or cnt >= max_tan:
+                    continue
+            elif cnt < opt.min_threshold:
+                continue
+            if filters is not None:
+                if self.row_attr_store is None:
+                    continue
+                attr = self.row_attr_store.attrs(row_id)
+                if not attr or attr.get(opt.filter_field) not in filters:
+                    continue
+
+            if n == 0 or len(results) < n:
+                count = cnt
+                if opt.src is not None:
+                    count = opt.src.intersection_count(self.row(row_id))
+                if count == 0:
+                    continue
+                if tanimoto > 0:
+                    t = -(-100 * count // (cnt + src_count - count))  # ceil
+                    if t <= tanimoto:
+                        continue
+                elif count < opt.min_threshold:
+                    continue
+                push((row_id, count))
+                if n > 0 and len(results) == n and opt.src is None:
+                    break
+                continue
+
+            threshold = results[-1][1]
+            if threshold < opt.min_threshold or cnt < threshold:
+                break
+            count = opt.src.intersection_count(self.row(row_id))
+            if count < threshold:
+                continue
+            push((row_id, count))
+            results[:] = results[:n] if n else results
+
+        return results[:n] if n else results
+
+    # -- block checksums / anti-entropy -------------------------------------
+
+    def _block_of(self, pos: int) -> int:
+        return pos // (HASH_BLOCK_SIZE * SLICE_WIDTH)
+
+    def blocks(self) -> List[Tuple[int, bytes]]:
+        """[(block_id, sha1)] for all non-empty 100-row blocks
+        (fragment.go:703-767). Checksums are cached per block and
+        invalidated by writes."""
+        out: List[Tuple[int, bytes]] = []
+        if not self.storage.keys:
+            return out
+        max_block = self._block_of(self.storage.max())
+        for blk in range(max_block + 1):
+            cached = self.checksums.get(blk)
+            if cached is not None:
+                out.append((blk, cached))
+                continue
+            lo = blk * HASH_BLOCK_SIZE * SLICE_WIDTH
+            vals = self.storage.slice_range(lo, lo + HASH_BLOCK_SIZE * SLICE_WIDTH)
+            if len(vals) == 0:
+                continue
+            digest = hashlib.sha1(vals.astype("<u8").tobytes()).digest()
+            self.checksums[blk] = digest
+            out.append((blk, digest))
+        return out
+
+    def checksum(self) -> bytes:
+        h = hashlib.sha1()
+        for _, c in self.blocks():
+            h.update(c)
+        return h.digest()
+
+    def block_data(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(rowIDs, slice-local columnIDs) for one block (fragment.go:783-794)."""
+        lo = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
+        vals = self.storage.slice_range(lo, lo + HASH_BLOCK_SIZE * SLICE_WIDTH)
+        return vals // SLICE_WIDTH, vals % SLICE_WIDTH
+
+    def merge_block(self, block_id: int, data: List[Tuple[np.ndarray, np.ndarray]]):
+        """Majority-consensus merge of one block across replicas
+        (fragment.go:796-920). `data` holds each remote's (rowIDs, colIDs).
+        Applies the consensus locally; returns per-remote (sets, clears)
+        diffs as (rowIDs, colIDs) pair arrays."""
+        lo = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
+        hi = lo + HASH_BLOCK_SIZE * SLICE_WIDTH
+
+        participants = [self.storage.slice_range(lo, hi)]
+        for rows, cols in data:
+            rows = np.asarray(rows, dtype=np.uint64)
+            cols = np.asarray(cols, dtype=np.uint64)
+            if rows.shape != cols.shape:
+                raise ValueError("pair set mismatch")
+            pos = rows * np.uint64(SLICE_WIDTH) + cols
+            pos = pos[(pos >= lo) & (pos < hi)]
+            participants.append(np.unique(pos))
+
+        majority = (len(participants) + 1) // 2
+        all_pos, counts = np.unique(np.concatenate(participants), return_counts=True)
+        consensus = all_pos[counts >= majority]
+
+        out = []
+        base = self.slice * SLICE_WIDTH
+        for i, mine in enumerate(participants):
+            sets = np.setdiff1d(consensus, mine, assume_unique=True)
+            clears = np.setdiff1d(mine, consensus, assume_unique=True)
+            if i == 0:
+                for p in sets:
+                    self.set_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
+                for p in clears:
+                    self.clear_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
+            else:
+                out.append((
+                    (sets // SLICE_WIDTH, sets % SLICE_WIDTH),
+                    (clears // SLICE_WIDTH, clears % SLICE_WIDTH),
+                ))
+        return out
+
+    # -- cache persistence ---------------------------------------------------
+
+    def flush_cache(self):
+        """Persist cache pairs as JSON (analog of the protobuf `.cache`
+        file, fragment.go:1073-1093)."""
+        try:
+            pairs = self.cache.top() or [(i, self.cache.get(i)) for i in self.cache.ids()]
+            with open(self.cache_path, "w") as f:
+                json.dump([[int(i), int(n)] for i, n in pairs], f)
+        except OSError:
+            pass
+
+    def _load_cache(self):
+        if not os.path.exists(self.cache_path):
+            # No persisted cache (fresh fragment or crash before flush):
+            # rebuild from storage so TopN stays correct. Row IDs come
+            # straight from the container keys (key >> 4 = rowID), so this
+            # costs one count per distinct row, not a full scan.
+            self.rebuild_cache()
+            return
+        try:
+            with open(self.cache_path) as f:
+                pairs = json.load(f)
+        except (OSError, ValueError):
+            return
+        for id_, _n in pairs:
+            self.cache.bulk_add(int(id_), self.row(int(id_)).count())
+        self.cache.recalculate()
+
+    def rebuild_cache(self):
+        """Recompute all row counts from storage (crash recovery path)."""
+        row_span = SLICE_WIDTH >> 16  # containers per row; keep jax out of host paths
+
+        row_ids = sorted({k // row_span for k in self.storage.keys})
+        for r in row_ids:
+            self.cache.bulk_add(r, self.row(r).count())
+        if row_ids:
+            self.cache.recalculate()
+
+    # -- backup/restore ------------------------------------------------------
+
+    def write_to_tar(self, fileobj):
+        """Stream data+cache as a tar archive (fragment.go:1095-1153)."""
+        with tarfile.open(fileobj=fileobj, mode="w|") as tar:
+            data = self.storage.to_bytes()
+            info = tarfile.TarInfo("data")
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+            cache = json.dumps(
+                [[int(i), int(n)] for i, n in (self.cache.top() or [])]
+            ).encode()
+            info = tarfile.TarInfo("cache")
+            info.size = len(cache)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(cache))
+
+    def read_from_tar(self, fileobj):
+        """Restore from a tar archive produced by write_to_tar
+        (fragment.go:1155-1266)."""
+        with tarfile.open(fileobj=fileobj, mode="r|") as tar:
+            for member in tar:
+                buf = tar.extractfile(member).read()
+                if member.name == "data":
+                    self.storage.op_writer = None
+                    self.storage = Bitmap.from_bytes(buf)
+                    self._mark_dirty(None)
+                    self.snapshot()
+                elif member.name == "cache":
+                    for id_, _n in json.loads(buf or b"[]"):
+                        self.cache.bulk_add(int(id_), self.row(int(id_)).count())
+                    self.cache.recalculate()
+
+    # -- device compute image ------------------------------------------------
+
+    @property
+    def pool(self):
+        """(FragmentPool, row_ids) device image, rebuilt when dirty."""
+        if self._pool_dirty or self._pool is None:
+            from ..ops import build_pool
+
+            self._pool, self._pool_row_ids = build_pool(self.storage)
+            self._pool_dirty = False
+        return self._pool, self._pool_row_ids

@@ -1,0 +1,216 @@
+"""Frame: a table of rows, owning views + the row-attribute store.
+
+Parity with /root/reference/frame.go: JSON `.meta` (rowLabel,
+inverseEnabled, cacheType/Size, timeQuantum — protobuf in the reference,
+frame.go:281-336), time-quantum fan-out on SetBit (frame.go:446-485),
+and bulk Import that splits bits by (view, slice) and reverses row/col
+for inverse views (frame.go:530-606).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from datetime import datetime
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..utils import validate_label, validate_name
+from .attr import AttrStore
+from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
+from .timequantum import TimeQuantum, views_by_time
+from .view import VIEW_INVERSE, VIEW_STANDARD, View
+
+DEFAULT_ROW_LABEL = "rowID"
+
+
+class Frame:
+    def __init__(self, path: str, index: str, name: str,
+                 row_label: str = DEFAULT_ROW_LABEL,
+                 inverse_enabled: bool = False,
+                 cache_type: str = CACHE_TYPE_RANKED,
+                 cache_size: int = DEFAULT_CACHE_SIZE,
+                 time_quantum: str = "",
+                 stats=None, broadcaster=None):
+        validate_name(name)
+        self.path = path
+        self.index = index
+        self.name = name
+        self.row_label = row_label
+        self.inverse_enabled = inverse_enabled
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.time_quantum = TimeQuantum(time_quantum)
+        self.stats = stats
+        self.broadcaster = broadcaster
+        self.views: Dict[str, View] = {}
+        self.row_attr_store = AttrStore(os.path.join(path, "attrs.db"))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def open(self):
+        os.makedirs(self.path, exist_ok=True)
+        self._load_meta()
+        self.row_attr_store.open()
+        for name in sorted(os.listdir(self.path)):
+            vpath = os.path.join(self.path, name)
+            if not os.path.isdir(vpath) or name == "attrs.db":
+                continue
+            view = self._new_view(name)
+            view.open()
+            self.views[name] = view
+
+    def close(self):
+        self._save_meta()
+        for v in self.views.values():
+            v.close()
+        self.views.clear()
+        self.row_attr_store.close()
+
+    def _load_meta(self):
+        if not os.path.exists(self.meta_path):
+            self._save_meta()
+            return
+        with open(self.meta_path) as f:
+            meta = json.load(f)
+        self.row_label = meta.get("rowLabel", self.row_label)
+        self.inverse_enabled = meta.get("inverseEnabled", self.inverse_enabled)
+        self.cache_type = meta.get("cacheType", self.cache_type)
+        self.cache_size = meta.get("cacheSize", self.cache_size)
+        self.time_quantum = TimeQuantum(meta.get("timeQuantum", str(self.time_quantum)))
+
+    def _save_meta(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self.meta_path, "w") as f:
+            json.dump({
+                "rowLabel": self.row_label,
+                "inverseEnabled": self.inverse_enabled,
+                "cacheType": self.cache_type,
+                "cacheSize": self.cache_size,
+                "timeQuantum": str(self.time_quantum),
+            }, f)
+
+    def set_time_quantum(self, q: TimeQuantum):
+        self.time_quantum = q
+        self._save_meta()
+
+    def set_row_label(self, label: str):
+        self.row_label = validate_label(label)
+        self._save_meta()
+
+    # -- views -------------------------------------------------------------
+
+    def _new_view(self, name: str) -> View:
+        return View(
+            path=os.path.join(self.path, name),
+            index=self.index,
+            frame=self.name,
+            name=name,
+            cache_type=self.cache_type,
+            cache_size=self.cache_size,
+            row_attr_store=self.row_attr_store,
+            stats=self.stats.with_tags(f"view:{name}") if self.stats else None,
+            broadcaster=self.broadcaster,
+        )
+
+    def view(self, name: str) -> Optional[View]:
+        return self.views.get(name)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        v = self.views.get(name)
+        if v is None:
+            v = self._new_view(name)
+            v.open()
+            self.views[name] = v
+        return v
+
+    def max_slice(self) -> int:
+        return max((v.max_slice() for v in self.views.values()), default=0)
+
+    def max_inverse_slice(self) -> int:
+        v = self.views.get(VIEW_INVERSE)
+        return v.max_slice() if v else 0
+
+    # -- writes ------------------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int, t: Optional[datetime] = None) -> bool:
+        """Set on standard view, time views for t, and the reversed
+        inverse view (frame.go:446-485)."""
+        changed = self.create_view_if_not_exists(VIEW_STANDARD).set_bit(row_id, column_id)
+        if t is not None:
+            for vname in views_by_time(VIEW_STANDARD, t, self.time_quantum):
+                if self.create_view_if_not_exists(vname).set_bit(row_id, column_id):
+                    changed = True
+        if self.inverse_enabled:
+            if self.create_view_if_not_exists(VIEW_INVERSE).set_bit(column_id, row_id):
+                changed = True
+            if t is not None:
+                for vname in views_by_time(VIEW_INVERSE, t, self.time_quantum):
+                    if self.create_view_if_not_exists(vname).set_bit(column_id, row_id):
+                        changed = True
+        return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        v = self.views.get(VIEW_STANDARD)
+        changed = v.clear_bit(row_id, column_id) if v else False
+        if self.inverse_enabled:
+            iv = self.views.get(VIEW_INVERSE)
+            if iv and iv.clear_bit(column_id, row_id):
+                changed = True
+        return changed
+
+    def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int],
+                    timestamps: Optional[Sequence[Optional[datetime]]] = None):
+        """Bulk import, splitting by (view, slice) including time views and
+        reversed inverse views (frame.go:530-606)."""
+        rows = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        if rows.shape != cols.shape:
+            raise ValueError("row/column mismatch")
+
+        # view name -> (rows, cols) accumulators
+        buckets: Dict[str, list] = {VIEW_STANDARD: [rows, cols]}
+        if timestamps is not None:
+            by_view: Dict[str, list] = {}
+            for r, c, t in zip(rows, cols, timestamps):
+                if t is None:
+                    continue
+                for vname in views_by_time(VIEW_STANDARD, t, self.time_quantum):
+                    by_view.setdefault(vname, [[], []])
+                    by_view[vname][0].append(r)
+                    by_view[vname][1].append(c)
+            for vname, (rs, cs) in by_view.items():
+                buckets[vname] = [np.asarray(rs, dtype=np.uint64),
+                                  np.asarray(cs, dtype=np.uint64)]
+        if self.inverse_enabled:
+            for vname, (rs, cs) in list(buckets.items()):
+                iv = vname.replace(VIEW_STANDARD, VIEW_INVERSE, 1)
+                buckets[iv] = [cs, rs]
+
+        from .. import SLICE_WIDTH
+
+        for vname, (rs, cs) in buckets.items():
+            view = self.create_view_if_not_exists(vname)
+            slices = cs // np.uint64(SLICE_WIDTH)
+            for s in np.unique(slices):
+                m = slices == s
+                frag = view.create_fragment_if_not_exists(int(s))
+                frag.import_bits(rs[m], cs[m])
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "meta": {
+                "rowLabel": self.row_label,
+                "inverseEnabled": self.inverse_enabled,
+                "cacheType": self.cache_type,
+                "cacheSize": self.cache_size,
+                "timeQuantum": str(self.time_quantum),
+            },
+            "views": sorted(self.views),
+        }

@@ -1,0 +1,108 @@
+"""Holder: root container of all indexes on a node.
+
+Parity with /root/reference/holder.go: scans the data directory on open,
+navigation helpers down to fragments, schema listing, and periodic cache
+flush (driven by the server loop rather than a goroutine here).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Optional
+
+from ..utils import NopStats
+from .index import Index
+
+
+class Holder:
+    def __init__(self, path: str, stats=None, broadcaster=None):
+        self.path = path
+        self.stats = stats or NopStats()
+        self.broadcaster = broadcaster
+        self.indexes: Dict[str, Index] = {}
+
+    def open(self):
+        os.makedirs(self.path, exist_ok=True)
+        for name in sorted(os.listdir(self.path)):
+            ipath = os.path.join(self.path, name)
+            if not os.path.isdir(ipath):
+                continue
+            idx = self._new_index(name)
+            idx.open()
+            self.indexes[name] = idx
+
+    def close(self):
+        for idx in self.indexes.values():
+            idx.close()
+        self.indexes.clear()
+
+    # -- index CRUD ---------------------------------------------------------
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def _new_index(self, name: str, **options) -> Index:
+        return Index(
+            path=os.path.join(self.path, name),
+            name=name,
+            stats=self.stats.with_tags(f"index:{name}"),
+            broadcaster=self.broadcaster,
+            **options,
+        )
+
+    def create_index(self, name: str, **options) -> Index:
+        if name in self.indexes:
+            raise ValueError(f"index already exists: {name}")
+        return self._create_index(name, **options)
+
+    def create_index_if_not_exists(self, name: str, **options) -> Index:
+        idx = self.indexes.get(name)
+        if idx is not None:
+            return idx
+        return self._create_index(name, **options)
+
+    def _create_index(self, name: str, **options) -> Index:
+        idx = self._new_index(name, **options)
+        idx.open()
+        self.indexes[name] = idx
+        return idx
+
+    def delete_index(self, name: str):
+        idx = self.indexes.pop(name, None)
+        if idx is not None:
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
+
+    # -- navigation ---------------------------------------------------------
+
+    def frame(self, index: str, frame: str):
+        idx = self.indexes.get(index)
+        return idx.frame(frame) if idx else None
+
+    def view(self, index: str, frame: str, view: str):
+        f = self.frame(index, frame)
+        return f.view(view) if f else None
+
+    def fragment(self, index: str, frame: str, view: str, slice_: int):
+        v = self.view(index, frame, view)
+        return v.fragment(slice_) if v else None
+
+    # -- schema --------------------------------------------------------------
+
+    def schema(self) -> List[dict]:
+        return [idx.to_dict() for _, idx in sorted(self.indexes.items())]
+
+    def max_slices(self) -> Dict[str, int]:
+        return {name: idx.max_slice() for name, idx in self.indexes.items()}
+
+    def max_inverse_slices(self) -> Dict[str, int]:
+        return {name: idx.max_inverse_slice() for name, idx in self.indexes.items()}
+
+    def flush_caches(self):
+        """Persist fragment count caches (holder.go:326-358)."""
+        for idx in self.indexes.values():
+            for frame in idx.frames.values():
+                for view in frame.views.values():
+                    for frag in view.fragments.values():
+                        frag.flush_cache()

@@ -1,0 +1,152 @@
+"""Index: namespace of frames + column attributes.
+
+Parity with /root/reference/index.go: JSON `.meta` (columnLabel, default
+timeQuantum), column attr store, max-slice tracking including
+remoteMaxSlice learned from peers (index.go:252-273), and frame CRUD.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Dict, Optional
+
+from ..utils import validate_label, validate_name
+from .attr import AttrStore
+from .frame import Frame
+from .timequantum import TimeQuantum
+
+DEFAULT_COLUMN_LABEL = "columnID"
+
+
+class Index:
+    def __init__(self, path: str, name: str,
+                 column_label: str = DEFAULT_COLUMN_LABEL,
+                 time_quantum: str = "", stats=None, broadcaster=None):
+        validate_name(name)
+        self.path = path
+        self.name = name
+        self.column_label = column_label
+        self.time_quantum = TimeQuantum(time_quantum)
+        self.stats = stats
+        self.broadcaster = broadcaster
+        self.frames: Dict[str, Frame] = {}
+        self.column_attr_store = AttrStore(os.path.join(path, "attrs.db"))
+        self.remote_max_slice = 0
+        self.remote_max_inverse_slice = 0
+
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def open(self):
+        os.makedirs(self.path, exist_ok=True)
+        self._load_meta()
+        self.column_attr_store.open()
+        for name in sorted(os.listdir(self.path)):
+            fpath = os.path.join(self.path, name)
+            if not os.path.isdir(fpath):
+                continue
+            frame = self._new_frame(name)
+            frame.open()
+            self.frames[name] = frame
+
+    def close(self):
+        self._save_meta()
+        for f in self.frames.values():
+            f.close()
+        self.frames.clear()
+        self.column_attr_store.close()
+
+    def _load_meta(self):
+        if not os.path.exists(self.meta_path):
+            self._save_meta()
+            return
+        with open(self.meta_path) as f:
+            meta = json.load(f)
+        self.column_label = meta.get("columnLabel", self.column_label)
+        self.time_quantum = TimeQuantum(meta.get("timeQuantum", str(self.time_quantum)))
+
+    def _save_meta(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self.meta_path, "w") as f:
+            json.dump({
+                "columnLabel": self.column_label,
+                "timeQuantum": str(self.time_quantum),
+            }, f)
+
+    def set_column_label(self, label: str):
+        self.column_label = validate_label(label)
+        self._save_meta()
+
+    def set_time_quantum(self, q: TimeQuantum):
+        self.time_quantum = q
+        self._save_meta()
+
+    # -- slices ------------------------------------------------------------
+
+    def max_slice(self) -> int:
+        """Highest slice owned locally or seen remotely (index.go:252-266)."""
+        m = max((f.max_slice() for f in self.frames.values()), default=0)
+        return max(m, self.remote_max_slice)
+
+    def max_inverse_slice(self) -> int:
+        m = max((f.max_inverse_slice() for f in self.frames.values()), default=0)
+        return max(m, self.remote_max_inverse_slice)
+
+    def set_remote_max_slice(self, n: int):
+        self.remote_max_slice = max(self.remote_max_slice, n)
+
+    def set_remote_max_inverse_slice(self, n: int):
+        self.remote_max_inverse_slice = max(self.remote_max_inverse_slice, n)
+
+    # -- frames ------------------------------------------------------------
+
+    def frame(self, name: str) -> Optional[Frame]:
+        return self.frames.get(name)
+
+    def _new_frame(self, name: str, **options) -> Frame:
+        return Frame(
+            path=os.path.join(self.path, name),
+            index=self.name,
+            name=name,
+            stats=self.stats.with_tags(f"frame:{name}") if self.stats else None,
+            broadcaster=self.broadcaster,
+            **options,
+        )
+
+    def create_frame(self, name: str, **options) -> Frame:
+        if name in self.frames:
+            raise ValueError(f"frame already exists: {name}")
+        return self._create_frame(name, **options)
+
+    def create_frame_if_not_exists(self, name: str, **options) -> Frame:
+        f = self.frames.get(name)
+        if f is not None:
+            return f
+        return self._create_frame(name, **options)
+
+    def _create_frame(self, name: str, **options) -> Frame:
+        # A frame inherits the index's default time quantum (index.go:354-432).
+        options.setdefault("time_quantum", str(self.time_quantum))
+        frame = self._new_frame(name, **options)
+        frame.open()
+        self.frames[name] = frame
+        return frame
+
+    def delete_frame(self, name: str):
+        f = self.frames.pop(name, None)
+        if f is not None:
+            f.close()
+            shutil.rmtree(f.path, ignore_errors=True)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "meta": {
+                "columnLabel": self.column_label,
+                "timeQuantum": str(self.time_quantum),
+            },
+            "frames": [f.to_dict() for _, f in sorted(self.frames.items())],
+        }

@@ -1,0 +1,124 @@
+"""Row: a query-level bitmap spanning many slices.
+
+Parity with /root/reference/bitmap.go (the segmented `Bitmap` type): a
+sorted map of slice -> slice-local roaring bitmap. Set ops merge
+per-slice segments; counts are cached per segment. `attrs` rides along
+for query responses (executor.go:218-247).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+from ..roaring import Bitmap
+
+
+class Row:
+    """Segmented bitmap over the global column space."""
+
+    __slots__ = ("segments", "attrs", "_counts")
+
+    def __init__(self, columns: Optional[Iterable[int]] = None):
+        self.segments: Dict[int, Bitmap] = {}  # slice -> slice-local bitmap
+        self.attrs: dict = {}
+        self._counts: Dict[int, int] = {}
+        if columns is not None:
+            for c in columns:
+                self.set_bit(int(c))
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_segment(cls, slice_: int, bitmap: Bitmap) -> "Row":
+        """Wrap one slice-local roaring bitmap (fragment row materialization)."""
+        r = cls()
+        r.segments[slice_] = bitmap
+        return r
+
+    def set_bit(self, column: int) -> bool:
+        slice_ = column // SLICE_WIDTH
+        seg = self.segments.get(slice_)
+        if seg is None:
+            seg = self.segments[slice_] = Bitmap()
+        self._counts.pop(slice_, None)
+        return seg.add(column % SLICE_WIDTH)
+
+    def merge(self, other: "Row") -> None:
+        """Union other into self (reference Bitmap.Merge, bitmap.go:45)."""
+        for s, seg in other.segments.items():
+            mine = self.segments.get(s)
+            self.segments[s] = seg.clone() if mine is None else mine.union(seg)
+            self._counts.pop(s, None)
+
+    # -- set ops -----------------------------------------------------------
+
+    def _binop(self, other: "Row", op: str, keep_left_only: bool) -> "Row":
+        # Pass-through segments are cloned: result Rows must never alias
+        # source segments (fragment row caches hand out shared Rows).
+        out = Row()
+        for s, seg in self.segments.items():
+            oseg = other.segments.get(s)
+            if oseg is None:
+                if keep_left_only:
+                    out.segments[s] = seg.clone()
+                continue
+            merged = getattr(seg, op)(oseg)
+            out.segments[s] = merged
+        if op in ("union", "xor"):
+            for s, oseg in other.segments.items():
+                if s not in self.segments:
+                    out.segments[s] = oseg.clone()
+        out.segments = {s: b for s, b in sorted(out.segments.items())}
+        return out
+
+    def intersect(self, other: "Row") -> "Row":
+        return self._binop(other, "intersect", keep_left_only=False)
+
+    def union(self, other: "Row") -> "Row":
+        return self._binop(other, "union", keep_left_only=True)
+
+    def difference(self, other: "Row") -> "Row":
+        return self._binop(other, "difference", keep_left_only=True)
+
+    def xor(self, other: "Row") -> "Row":
+        return self._binop(other, "xor", keep_left_only=True)
+
+    def intersection_count(self, other: "Row") -> int:
+        total = 0
+        for s, seg in self.segments.items():
+            oseg = other.segments.get(s)
+            if oseg is not None:
+                total += seg.intersection_count(oseg)
+        return total
+
+    # -- queries -----------------------------------------------------------
+
+    def count(self) -> int:
+        total = 0
+        for s, seg in self.segments.items():
+            n = self._counts.get(s)
+            if n is None:
+                n = self._counts[s] = seg.count()
+            total += n
+        return total
+
+    def columns(self) -> np.ndarray:
+        """Absolute column IDs, sorted uint64."""
+        parts = [
+            seg.slice().astype(np.uint64) + np.uint64(s * SLICE_WIDTH)
+            for s, seg in sorted(self.segments.items())
+        ]
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def __iter__(self):
+        for v in self.columns():
+            yield int(v)
+
+    def to_dict(self) -> dict:
+        """JSON shape used by the HTTP layer (handler.go bitmap responses)."""
+        return {"attrs": self.attrs, "bits": [int(v) for v in self.columns()]}

@@ -1,0 +1,21 @@
+"""PQL — the Pilosa Query Language (parity with /root/reference/pql/).
+
+Grammar: query = call+; call = IDENT '(' child-calls, key=value args ')';
+values are idents (true/false/null), quoted strings, integers, floats, or
+[lists] (TopN filters). The canonical `Call.__str__` re-serialization is
+what travels to remote nodes (reference executor.go:1000-1083).
+"""
+
+from .ast import Call, Query
+from .parser import ParseError, Parser, parse_string
+from .scanner import Scanner, Token
+
+__all__ = [
+    "Call",
+    "Query",
+    "ParseError",
+    "Parser",
+    "parse_string",
+    "Scanner",
+    "Token",
+]

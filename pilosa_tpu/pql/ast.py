@@ -1,0 +1,113 @@
+"""PQL AST (parity with /root/reference/pql/ast.go).
+
+Arg values carry the parser's Python types: int, float, bool, None, str,
+list. `__str__` is the canonical serialization used for remote execution,
+so it must round-trip through the parser.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_fmt_value(x) if isinstance(x, str) else _fmt_plain(x) for x in v) + "]"
+    return _fmt_plain(v)
+
+
+def _fmt_plain(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        # Positional notation only: the PQL scanner has no exponent
+        # syntax, and this string must re-parse on remote nodes.
+        s = repr(v)
+        if "e" in s or "E" in s:
+            s = format(v, ".17f").rstrip("0")
+            if s.endswith("."):
+                s += "0"
+        return s
+    return str(v)
+
+
+@dataclass
+class Call:
+    name: str
+    args: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def uint_arg(self, key: str):
+        """(value, present). Raises TypeError on a non-integer value
+        (reference Call.UintArg, ast.go:52-66)."""
+        if key not in self.args:
+            return 0, False
+        v = self.args[key]
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise TypeError(f"could not convert {v!r} to uint64 in Call.uint_arg")
+        return v & 0xFFFFFFFFFFFFFFFF, True
+
+    def uint_slice_arg(self, key: str):
+        """(values, present) for list args (reference UintSliceArg)."""
+        if key not in self.args:
+            return [], False
+        v = self.args[key]
+        if not isinstance(v, (list, tuple)) or any(
+            isinstance(x, bool) or not isinstance(x, int) for x in v
+        ):
+            raise TypeError(f"unexpected type in uint_slice_arg, val {v!r}")
+        return [x & 0xFFFFFFFFFFFFFFFF for x in v], True
+
+    def keys(self) -> list:
+        return sorted(self.args)
+
+    def clone(self) -> "Call":
+        return Call(
+            name=self.name,
+            args=dict(self.args),
+            children=[c.clone() for c in self.children],
+        )
+
+    def supports_inverse(self) -> bool:
+        """Only Bitmap() may target the inverse view (ast.go:174-179)."""
+        return self.name == "Bitmap"
+
+    def is_inverse(self, row_label: str, column_label: str) -> bool:
+        """True when the call addresses the inverse view: column arg given,
+        row arg absent (ast.go:181-195)."""
+        if not self.supports_inverse():
+            return False
+        try:
+            _, row_ok = self.uint_arg(row_label)
+            _, col_ok = self.uint_arg(column_label)
+        except TypeError:
+            return False
+        return (not row_ok) and col_ok
+
+    def __str__(self) -> str:
+        parts = [str(c) for c in self.children]
+        parts += [f"{k}={_fmt_value(self.args[k])}" for k in self.keys()]
+        return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
+
+
+@dataclass
+class Query:
+    calls: list = field(default_factory=list)
+
+    def write_call_n(self) -> int:
+        """Number of write calls (SetBit/ClearBit/Set*Attrs)."""
+        return sum(
+            1
+            for c in self.calls
+            if c.name in ("SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs")
+        )
+
+    def __str__(self) -> str:
+        return "\n".join(str(c) for c in self.calls)

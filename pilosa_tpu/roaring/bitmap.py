@@ -424,11 +424,12 @@ class Bitmap:
         parts = []
         for i in range(lo_i, hi_i):
             key = self.keys[i]
-            v = (np.int64(key) << 16) | self.containers[i].values().astype(np.int64)
+            # uint64 throughout: keys can exceed 2^47, where int64<<16 wraps.
+            v = (np.uint64(key) << np.uint64(16)) | self.containers[i].values().astype(_U64)
             if key == skey:
-                v = v[np.searchsorted(v, start, side="left"):]
+                v = v[np.searchsorted(v, _U64(start), side="left"):]
             if key == ekey:
-                v = v[: np.searchsorted(v, end, side="left")]
+                v = v[: np.searchsorted(v, _U64(end), side="left")]
             parts.append(v)
         if not parts:
             return np.empty(0, dtype=_U64)

@@ -1,0 +1,13 @@
+"""Cross-cutting utilities: validation, errors, stats."""
+
+from .validation import validate_label, validate_name
+from .stats import ExpvarStats, MultiStats, NopStats, StatsClient
+
+__all__ = [
+    "validate_label",
+    "validate_name",
+    "ExpvarStats",
+    "MultiStats",
+    "NopStats",
+    "StatsClient",
+]

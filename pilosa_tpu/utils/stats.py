@@ -1,0 +1,110 @@
+"""StatsClient interface + in-memory/expvar-style backends
+(parity with /root/reference/stats.go)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Iterable, Optional
+
+
+class StatsClient:
+    """Interface: Count/Gauge/Histogram/Set/Timing + tag scoping."""
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1):
+        pass
+
+    def gauge(self, name: str, value: float):
+        pass
+
+    def histogram(self, name: str, value: float):
+        pass
+
+    def set(self, name: str, value: str):
+        pass
+
+    def timing(self, name: str, value_us: int):
+        pass
+
+
+class NopStats(StatsClient):
+    pass
+
+
+class ExpvarStats(StatsClient):
+    """In-process counters, exposed at /debug/vars (stats.go:70-131)."""
+
+    def __init__(self, tags: Optional[Iterable[str]] = None, parent=None):
+        self._parent = parent
+        self.tags = tuple(tags or ())
+        if parent is None:
+            self._lock = threading.Lock()
+            self.values: Dict[str, float] = defaultdict(float)
+            self.sets: Dict[str, str] = {}
+        else:
+            self._lock = parent._lock
+            self.values = parent.values
+            self.sets = parent.sets
+
+    def _key(self, name: str) -> str:
+        return ",".join(self.tags + (name,)) if self.tags else name
+
+    def with_tags(self, *tags: str) -> "ExpvarStats":
+        child = ExpvarStats(self.tags + tags, parent=self)
+        return child
+
+    def count(self, name: str, value: int = 1):
+        with self._lock:
+            self.values[self._key(name)] += value
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            self.values[self._key(name)] = value
+
+    def histogram(self, name: str, value: float):
+        self.count(name + ".sum", value)
+        self.count(name + ".count", 1)
+
+    def set(self, name: str, value: str):
+        with self._lock:
+            self.sets[self._key(name)] = value
+
+    def timing(self, name: str, value_us: int):
+        self.histogram(name + ".us", value_us)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {**self.values, **self.sets}
+
+
+class MultiStats(StatsClient):
+    """Fan-out to several backends (stats.go:133-185)."""
+
+    def __init__(self, clients):
+        self.clients = list(clients)
+
+    def with_tags(self, *tags: str):
+        return MultiStats([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name, value=1):
+        for c in self.clients:
+            c.count(name, value)
+
+    def gauge(self, name, value):
+        for c in self.clients:
+            c.gauge(name, value)
+
+    def histogram(self, name, value):
+        for c in self.clients:
+            c.histogram(name, value)
+
+    def set(self, name, value):
+        for c in self.clients:
+            c.set(name, value)
+
+    def timing(self, name, value_us):
+        for c in self.clients:
+            c.timing(name, value_us)

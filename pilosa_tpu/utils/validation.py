@@ -1,0 +1,18 @@
+"""Name/label validation (parity with /root/reference/pilosa.go:109-122)."""
+
+import re
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,64}$")
+_LABEL_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]{0,64}$")
+
+
+def validate_name(name: str) -> str:
+    if not _NAME_RE.match(name or ""):
+        raise ValueError(f"invalid index or frame's name: {name!r}")
+    return name
+
+
+def validate_label(label: str) -> str:
+    if not _LABEL_RE.match(label or ""):
+        raise ValueError(f"invalid row or column label: {label!r}")
+    return label

@@ -7,11 +7,21 @@ on CPU-backed virtual devices, and Pallas kernels run in interpret mode.
 
 import os
 
-# Must be set before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests are CPU-only. The axon TPU sitecustomize hook (PYTHONPATH
+# /root/.axon_site) may have imported jax at interpreter startup with
+# JAX_PLATFORMS=axon latched; env vars alone are too late here, so force
+# the platform through jax.config — read at first backend initialization,
+# which hasn't happened yet. This keeps the suite independent of TPU
+# tunnel health.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 

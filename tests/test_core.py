@@ -1,0 +1,372 @@
+"""Core data model tests, following the reference's wrapper-and-reopen
+pattern (/root/reference/fragment_test.go, frame_test.go, holder_test.go)."""
+
+import os
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.core import (
+    AttrStore,
+    Fragment,
+    Frame,
+    Holder,
+    LRUCache,
+    RankCache,
+    Row,
+    TimeQuantum,
+    views_by_time,
+    views_by_time_range,
+)
+from pilosa_tpu.core.attr import diff_blocks
+from pilosa_tpu.core.fragment import TopOptions
+
+
+# -- fragment ---------------------------------------------------------------
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    yield f
+    f.close()
+
+
+def test_fragment_set_clear_row(frag):
+    assert frag.set_bit(120, 1)
+    assert frag.set_bit(120, 6)
+    assert frag.set_bit(121, 0)
+    assert not frag.set_bit(120, 1)  # already set
+    assert list(frag.row(120)) == [1, 6]
+    assert frag.count() == 3
+    assert frag.clear_bit(120, 6)
+    assert not frag.clear_bit(120, 6)
+    assert list(frag.row(120)) == [1]
+
+
+def test_fragment_row_absolute_columns(tmp_path):
+    f = Fragment(str(tmp_path / "3"), "i", "f", "standard", 3)
+    f.open()
+    try:
+        f.set_bit(5, 3 * SLICE_WIDTH + 100)
+        assert list(f.row(5)) == [3 * SLICE_WIDTH + 100]
+    finally:
+        f.close()
+
+
+def test_fragment_persistence_roundtrip(tmp_path):
+    path = str(tmp_path / "0")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(1, 100)
+    f.set_bit(2, 200)
+    f.close()
+    # WAL ops are on disk; reopen replays them.
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    f2.open()
+    try:
+        assert list(f2.row(1)) == [100]
+        assert list(f2.row(2)) == [200]
+    finally:
+        f2.close()
+
+
+def test_fragment_snapshot_trigger(tmp_path):
+    path = str(tmp_path / "0")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.max_op_n = 10
+    f.open()
+    for i in range(12):
+        f.set_bit(0, i)
+    assert f.op_n <= 10  # snapshot reset
+    f.close()
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    f2.open()
+    try:
+        assert f2.row(0).count() == 12
+    finally:
+        f2.close()
+
+
+def test_fragment_flock_exclusive(tmp_path):
+    path = str(tmp_path / "0")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    try:
+        f2 = Fragment(path, "i", "f", "standard", 0)
+        with pytest.raises(RuntimeError, match="locked"):
+            f2.open()
+    finally:
+        f.close()
+
+
+def test_fragment_import_and_top(frag):
+    # rows with decreasing cardinality
+    rows, cols = [], []
+    for r, n in [(10, 50), (11, 40), (12, 30), (13, 5)]:
+        rows += [r] * n
+        cols += list(range(n))
+    frag.import_bits(rows, cols)
+    top = frag.top(TopOptions(n=2))
+    assert top == [(10, 50), (11, 40)]
+    # src-intersection recount (reference fragment.go Top w/ Src)
+    src = Row(range(10))
+    top = frag.top(TopOptions(n=3, src=src))
+    assert top == [(10, 10), (11, 10), (12, 10)]
+    # row_ids filter disables truncation
+    top = frag.top(TopOptions(row_ids=[12, 13]))
+    assert top == [(12, 30), (13, 5)]
+
+
+def test_fragment_blocks_and_merge(tmp_path):
+    f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    f2 = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0)
+    f1.open(), f2.open()
+    try:
+        for r, c in [(1, 1), (1, 2), (2, 5)]:
+            f1.set_bit(r, c)
+        for r, c in [(1, 1), (2, 5), (3, 9)]:
+            f2.set_bit(r, c)
+        b1, b2 = dict(f1.blocks()), dict(f2.blocks())
+        assert b1 != b2
+        # Merge remote block 0 into f1: consensus of 2 participants
+        # (majority = (2+1)//2 = 1... ties resolve to set).
+        rows, cols = f2.block_data(0)
+        diffs = f1.merge_block(0, [(rows, cols)])
+        # consensus = union at majority 1: {1,1},{1,2},{2,5},{3,9}
+        assert set(f1.for_each_bit()) == {(1, 1), (1, 2), (2, 5), (3, 9)}
+        (sets, clears) = diffs[0]
+        assert list(zip(*sets)) == [(1, 2)]  # remote needs (1,2)
+        assert list(zip(*clears))[0:0] == []
+    finally:
+        f1.close(), f2.close()
+
+
+def test_fragment_checksum_changes_on_write(frag):
+    c0 = frag.checksum()
+    frag.set_bit(0, 0)
+    assert frag.checksum() != c0
+
+
+def test_fragment_tar_roundtrip(tmp_path):
+    import io
+    f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    f1.open()
+    f1.import_bits([1, 1, 2], [3, 4, 5])
+    buf = io.BytesIO()
+    f1.write_to_tar(buf)
+    f1.close()
+    buf.seek(0)
+    f2 = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0)
+    f2.open()
+    try:
+        f2.read_from_tar(buf)
+        assert set(f2.for_each_bit()) == {(1, 3), (1, 4), (2, 5)}
+    finally:
+        f2.close()
+
+
+# -- row --------------------------------------------------------------------
+
+def test_row_cross_slice_ops():
+    a = Row([1, SLICE_WIDTH + 1, 2 * SLICE_WIDTH + 3])
+    b = Row([1, SLICE_WIDTH + 2, 2 * SLICE_WIDTH + 3])
+    assert list(a.intersect(b)) == [1, 2 * SLICE_WIDTH + 3]
+    assert a.union(b).count() == 4
+    assert list(a.difference(b)) == [SLICE_WIDTH + 1]
+    assert a.intersection_count(b) == 2
+    assert a.count() == 3
+
+
+# -- caches -----------------------------------------------------------------
+
+def test_rank_cache_threshold_and_trim():
+    clock = [0.0]
+    c = RankCache(max_entries=3, clock=lambda: clock[0])
+    for i, n in enumerate([100, 90, 80, 70, 60]):
+        c.add(i, n)
+        clock[0] += 11  # defeat the damper
+    assert [p[0] for p in c.top()] == [0, 1, 2]
+    # threshold gate: counts below threshold are ignored
+    c.add(99, 1)
+    assert c.get(99) == 0
+
+
+def test_rank_cache_damper():
+    clock = [0.0]
+    c = RankCache(max_entries=10, clock=lambda: clock[0])
+    c.add(1, 5)
+    c.add(2, 50)  # within 10s: no recalculation
+    assert [p[0] for p in c.top()] == [1]
+    clock[0] += 11
+    c.invalidate()
+    assert [p[0] for p in c.top()] == [2, 1]
+
+
+def test_lru_cache_eviction():
+    c = LRUCache(max_entries=2)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.get(1)
+    c.add(3, 30)  # evicts 2 (least recently used)
+    assert c.ids() == [1, 3]
+
+
+# -- attrs ------------------------------------------------------------------
+
+def test_attr_store(tmp_path):
+    s = AttrStore(str(tmp_path / "attrs.db"))
+    s.open()
+    try:
+        s.set_attrs(1, {"name": "a", "n": 5, "ok": True, "f": 1.5})
+        s.set_attrs(1, {"n": 6, "name": None})
+        assert s.attrs(1) == {"n": 6, "ok": True, "f": 1.5}
+        with pytest.raises(TypeError):
+            s.set_attrs(2, {"bad": [1, 2]})
+        s.set_bulk_attrs({10: {"x": 1}, 250: {"y": 2}})
+        blocks = s.blocks()
+        assert [b for b, _ in blocks] == [0, 2]
+        assert s.block_data(2) == {250: {"y": 2}}
+    finally:
+        s.close()
+
+
+def test_attr_diff_blocks(tmp_path):
+    a = AttrStore(str(tmp_path / "a.db"))
+    b = AttrStore(str(tmp_path / "b.db"))
+    a.open(), b.open()
+    try:
+        a.set_attrs(1, {"x": 1})
+        b.set_attrs(1, {"x": 2})
+        b.set_attrs(500, {"y": 1})
+        assert diff_blocks(a.blocks(), b.blocks()) == [0, 5]
+    finally:
+        a.close(), b.close()
+
+
+# -- time quantum ------------------------------------------------------------
+
+def test_views_by_time():
+    t = datetime(2017, 4, 9, 11)
+    assert views_by_time("standard", t, TimeQuantum("YMDH")) == [
+        "standard_2017", "standard_201704", "standard_20170409",
+        "standard_2017040911",
+    ]
+
+
+def test_views_by_time_range_reference_vectors():
+    # Expected values from /root/reference/time_test.go:88-126.
+    cases = [
+        ("Y", datetime(2000, 1, 1), datetime(2002, 1, 1),
+         ["F_2000", "F_2001"]),
+        ("YM", datetime(2000, 11, 1), datetime(2003, 3, 1),
+         ["F_200011", "F_200012", "F_2001", "F_2002", "F_200301", "F_200302"]),
+        ("YMD", datetime(2000, 11, 28), datetime(2003, 3, 2),
+         ["F_20001128", "F_20001129", "F_20001130", "F_200012", "F_2001",
+          "F_2002", "F_200301", "F_200302", "F_20030301"]),
+        ("YMDH", datetime(2000, 11, 28, 22), datetime(2002, 3, 1, 3),
+         ["F_2000112822", "F_2000112823", "F_20001129", "F_20001130",
+          "F_200012", "F_2001", "F_200201", "F_200202", "F_2002030100",
+          "F_2002030101", "F_2002030102"]),
+        ("M", datetime(2000, 1, 1), datetime(2000, 3, 1),
+         ["F_200001", "F_200002"]),
+    ]
+    for q, start, end, expected in cases:
+        got = views_by_time_range("F", start, end, TimeQuantum(q))
+        assert got == expected, q
+
+
+# -- frame / index / holder ---------------------------------------------------
+
+def test_frame_time_and_inverse_views(tmp_path):
+    f = Frame(str(tmp_path / "f"), "i", "f", inverse_enabled=True,
+              time_quantum="YM")
+    f.open()
+    try:
+        f.set_bit(1, 9, t=datetime(2017, 4, 1))
+        assert sorted(f.views) == [
+            "inverse", "inverse_2017", "inverse_201704",
+            "standard", "standard_2017", "standard_201704",
+        ]
+        assert list(f.view("standard").fragments[0].row(1)) == [9]
+        assert list(f.view("inverse").fragments[0].row(9)) == [1]
+    finally:
+        f.close()
+
+
+def test_holder_roundtrip(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("myidx")
+    fr = idx.create_frame("myframe", inverse_enabled=True)
+    fr.set_bit(10, 20)
+    fr.row_attr_store.set_attrs(10, {"tag": "x"})
+    h.close()
+
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    try:
+        fr2 = h2.frame("myidx", "myframe")
+        assert fr2 is not None
+        assert fr2.inverse_enabled
+        assert list(fr2.view("standard").fragments[0].row(10)) == [20]
+        assert fr2.row_attr_store.attrs(10) == {"tag": "x"}
+        assert h2.schema()[0]["name"] == "myidx"
+        frag = h2.fragment("myidx", "myframe", "standard", 0)
+        assert frag is not None and frag.count() == 1
+    finally:
+        h2.close()
+
+
+def test_frame_import_with_inverse(tmp_path):
+    f = Frame(str(tmp_path / "f"), "i", "f", inverse_enabled=True)
+    f.open()
+    try:
+        f.import_bits([1, 1, 2], [5, SLICE_WIDTH + 6, 7])
+        std = f.view("standard")
+        assert sorted(std.fragments) == [0, 1]
+        assert list(std.fragments[1].row(1)) == [SLICE_WIDTH + 6]
+        inv = f.view("inverse")
+        assert list(inv.fragments[0].row(5)) == [1]
+    finally:
+        f.close()
+
+
+def test_index_frame_validation(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        with pytest.raises(ValueError):
+            h.create_index("Bad_Name")
+        idx = h.create_index("ok")
+        with pytest.raises(ValueError):
+            idx.create_frame("9bad")
+        idx.create_frame("fine")
+        with pytest.raises(ValueError, match="already exists"):
+            idx.create_frame("fine")
+    finally:
+        h.close()
+
+
+def test_views_by_time_range_month_end_start():
+    # day-31 start crossing shorter months must normalize, not raise
+    got = views_by_time_range("F", datetime(2017, 1, 31), datetime(2017, 6, 1),
+                              TimeQuantum("YMD"))
+    assert got[0] == "F_20170131"
+    assert "F_201702" in got or any(v.startswith("F_201702") for v in got)
+
+
+def test_row_result_does_not_alias_source():
+    r1 = Row([5])
+    u = r1.union(Row())
+    u.set_bit(6)
+    assert list(r1) == [5]
+    d = r1.difference(Row([999]))
+    d.set_bit(7)
+    assert list(r1) == [5]
+    m = Row()
+    m.merge(r1)
+    m.set_bit(8)
+    assert list(r1) == [5]

@@ -1,0 +1,116 @@
+"""PQL parser tests, mirroring the reference's coverage
+(/root/reference/pql/parser_test.go patterns): call trees, args, lists,
+errors, and canonical-string round-trips."""
+
+import pytest
+
+from pilosa_tpu.pql import Call, ParseError, parse_string
+
+
+def test_single_call():
+    q = parse_string("Bitmap(rowID=10, frame='f')")
+    assert len(q.calls) == 1
+    c = q.calls[0]
+    assert c.name == "Bitmap"
+    assert c.args == {"rowID": 10, "frame": "f"}
+    assert c.children == []
+
+
+def test_nested_children_and_args():
+    q = parse_string('Count(Intersect(Bitmap(rowID=1, frame="a"), Bitmap(rowID=2, frame="b")))')
+    c = q.calls[0]
+    assert c.name == "Count"
+    inner = c.children[0]
+    assert inner.name == "Intersect"
+    assert [x.name for x in inner.children] == ["Bitmap", "Bitmap"]
+    assert inner.children[0].args["rowID"] == 1
+
+
+def test_children_then_args():
+    q = parse_string("TopN(Bitmap(rowID=1, frame='f'), frame='f', n=20)")
+    c = q.calls[0]
+    assert len(c.children) == 1
+    assert c.args["n"] == 20
+
+
+def test_multiple_calls():
+    q = parse_string("SetBit(id=1, frame='f', columnID=2) SetBit(id=3, frame='f', columnID=4)")
+    assert [c.name for c in q.calls] == ["SetBit", "SetBit"]
+    assert q.write_call_n() == 2
+
+
+def test_value_types():
+    q = parse_string(
+        'F(a=1, b=-2, c=1.5, d="s", e=ident, f=true, g=false, h=null, i=[1,2,"x"])'
+    )
+    a = q.calls[0].args
+    assert a["a"] == 1 and a["b"] == -2
+    assert a["c"] == 1.5
+    assert a["d"] == "s" and a["e"] == "ident"
+    assert a["f"] is True and a["g"] is False and a["h"] is None
+    assert a["i"] == [1, 2, "x"]
+
+
+def test_string_escapes():
+    q = parse_string('F(x="a\\"b", y=\'c\\nd\')')
+    assert q.calls[0].args["x"] == 'a"b'
+    assert q.calls[0].args["y"] == "c\nd"
+
+
+@pytest.mark.parametrize("src,msg", [
+    ("", "unexpected EOF"),
+    ("Bitmap(", "expected comma, right paren, or identifier"),
+    ("Bitmap(rowID=1 rowID=2)", "expected comma"),
+    ("Bitmap(rowID=1, rowID=2)", "argument key already used"),
+    ("42(x=1)", "expected identifier"),
+    ("Bitmap(x=,)", "invalid argument value"),
+])
+def test_parse_errors(src, msg):
+    with pytest.raises(ParseError, match=msg):
+        parse_string(src)
+
+
+def test_canonical_string_roundtrip():
+    srcs = [
+        'Count(Intersect(Bitmap(frame="a", rowID=1), Bitmap(frame="b", rowID=2)))',
+        'TopN(frame="f", ids=[1,2,3], n=20)',
+        'Range(end="2017-01-01T00:00", frame="f", rowID=1, start="2016-01-01T00:00")',
+        'SetBit(columnID=2, frame="f", rowID=1)',
+    ]
+    for src in srcs:
+        q = parse_string(src)
+        assert str(q.calls[0]) == src  # args serialize in sorted key order
+        # and the serialization re-parses to the same AST
+        q2 = parse_string(str(q.calls[0]))
+        assert q2.calls[0] == q.calls[0]
+
+
+def test_uint_args():
+    c = parse_string("F(a=5, b=[1,2], s='x')").calls[0]
+    assert c.uint_arg("a") == (5, True)
+    assert c.uint_arg("missing") == (0, False)
+    with pytest.raises(TypeError):
+        c.uint_arg("s")
+    assert c.uint_slice_arg("b") == ([1, 2], True)
+
+
+def test_inverse_detection():
+    row_label, col_label = "rowID", "columnID"
+    assert parse_string("Bitmap(columnID=3, frame='f')").calls[0].is_inverse(row_label, col_label)
+    assert not parse_string("Bitmap(rowID=3, frame='f')").calls[0].is_inverse(row_label, col_label)
+    assert not parse_string("Count(Bitmap(columnID=3))").calls[0].is_inverse(row_label, col_label)
+
+
+def test_malformed_number_is_parse_error():
+    with pytest.raises(ParseError, match="invalid integer literal"):
+        parse_string("Bitmap(id=-)")
+    with pytest.raises(ParseError, match="invalid list value"):
+        parse_string("F(x=[-])")
+
+
+def test_small_float_roundtrip():
+    q = parse_string("F(x=0.5)")
+    q.calls[0].args["x"] = 1e-05
+    s = str(q.calls[0])
+    assert "e" not in s and "E" not in s
+    assert parse_string(s).calls[0].args["x"] == 1e-05

@@ -164,3 +164,11 @@ def test_clone_copy_on_write_offset_range():
     assert b.contains(12) and not view.contains(12)
     b.remove(1)
     assert view.contains(1)
+
+
+def test_slice_range_huge_values():
+    # keys >= 2^47 overflow int64<<16; must stay uint64 end-to-end
+    hi = (1 << 63) + 5
+    b = Bitmap([hi, hi + 70000])
+    got = b.slice_range(1 << 63, (1 << 63) + (1 << 17))
+    assert [int(v) for v in got] == [hi, hi + 70000]
