@@ -38,11 +38,13 @@ class RankCache:
         self.threshold_value = 0
         self._clock = clock
         self._update_time = float("-inf")
+        self._dirty = False
 
     def add(self, id_: int, n: int):
         if n < self.threshold_value:
             return
         self.entries[id_] = n
+        self._dirty = True
         self.invalidate()
 
     def bulk_add(self, id_: int, n: int):
@@ -50,6 +52,7 @@ class RankCache:
         if n < self.threshold_value:
             return
         self.entries[id_] = n
+        self._dirty = True
 
     def get(self, id_: int) -> int:
         return self.entries.get(id_, 0)
@@ -75,12 +78,19 @@ class RankCache:
             self.threshold_value = 1
         self.rankings = rankings
         self._update_time = self._clock()
+        self._dirty = False
         if len(self.entries) > self.threshold_buffer:
             self.entries = {
                 id_: n for id_, n in self.entries.items() if n > self.threshold_value
             }
 
     def top(self) -> List[Tuple[int, int]]:
+        # Deviation from the reference: its 10 s damper leaves Top() stale
+        # right after writes (cache.go:255-260 + fragment.go:627-634 — the
+        # reference's own executor TopN test races this window). Writes
+        # stay damper-cheap; the read path recalculates iff dirty.
+        if self._dirty:
+            self.recalculate()
         return list(self.rankings)
 
 

@@ -15,6 +15,7 @@ slice-local.
 
 from __future__ import annotations
 
+import bisect
 import fcntl
 import hashlib
 import json
@@ -235,10 +236,19 @@ class Fragment:
     # -- TopN ---------------------------------------------------------------
 
     def _top_pairs(self, row_ids: Sequence[int]) -> List[Tuple[int, int]]:
+        """Reference topBitmapPairs (fragment.go:627-658): rank cache when
+        no ids requested; otherwise exact per-id counts, zeros dropped,
+        sorted desc. Deviation: requested ids always recount from storage
+        — the reference trusts cache.Get first, but threshold-gated
+        RankCache.add never records drops to zero, so a cleared row would
+        keep its stale count and poison TopN's exact phase 2."""
         if not row_ids:
-            self.cache.invalidate()
+            # cache.top() recalculates when dirty; no invalidate() needed.
             return self.cache.top()
-        return [(r, self.row(r).count()) for r in row_ids]
+        pairs = [(r, self.row(r).count()) for r in row_ids]
+        pairs = [(r, n) for r, n in pairs if n > 0]
+        pairs.sort(key=lambda p: (-p[1], p[0]))
+        return pairs
 
     def top(self, opt: TopOptions) -> List[Tuple[int, int]]:
         """Top rows by count (reference fragment.go:493-625), including
@@ -261,8 +271,7 @@ class Fragment:
         results: List[Tuple[int, int]] = []  # kept sorted desc by count
 
         def push(pair):
-            results.append(pair)
-            results.sort(key=lambda p: (-p[1], p[0]))
+            bisect.insort(results, pair, key=lambda p: (-p[1], p[0]))
 
         for row_id, cnt in pairs:
             if cnt <= 0:
@@ -314,13 +323,16 @@ class Fragment:
 
     def blocks(self) -> List[Tuple[int, bytes]]:
         """[(block_id, sha1)] for all non-empty 100-row blocks
-        (fragment.go:703-767). Checksums are cached per block and
-        invalidated by writes."""
+        (fragment.go:703-767). Only blocks with live containers are
+        visited — a 100-row block spans exactly 1600 containers, so
+        candidate block ids come straight from the container keys (a
+        sparse huge-rowID fragment must not scan the dense block range).
+        Checksums are cached per block and invalidated by writes."""
         out: List[Tuple[int, bytes]] = []
         if not self.storage.keys:
             return out
-        max_block = self._block_of(self.storage.max())
-        for blk in range(max_block + 1):
+        containers_per_block = HASH_BLOCK_SIZE * SLICE_WIDTH >> 16
+        for blk in sorted({int(k) // containers_per_block for k in self.storage.keys}):
             cached = self.checksums.get(blk)
             if cached is not None:
                 out.append((blk, cached))
@@ -392,8 +404,10 @@ class Fragment:
         file, fragment.go:1073-1093)."""
         try:
             pairs = self.cache.top() or [(i, self.cache.get(i)) for i in self.cache.ids()]
-            with open(self.cache_path, "w") as f:
+            tmp = self.cache_path + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump([[int(i), int(n)] for i, n in pairs], f)
+            os.replace(tmp, self.cache_path)
         except OSError:
             pass
 
@@ -409,6 +423,9 @@ class Fragment:
             with open(self.cache_path) as f:
                 pairs = json.load(f)
         except (OSError, ValueError):
+            # Corrupt/truncated cache file (e.g. crash mid-flush): rebuild
+            # from storage rather than serving an empty TopN cache.
+            self.rebuild_cache()
             return
         for id_, _n in pairs:
             self.cache.bulk_add(int(id_), self.row(int(id_)).count())

@@ -1,0 +1,48 @@
+"""Framework error types (parity with /root/reference/pilosa.go:25-53
+error vars). The HTTP layer maps these to status codes the way
+handler.go does."""
+
+
+class PilosaError(Exception):
+    """Base class for framework errors."""
+
+
+class IndexRequiredError(PilosaError):
+    def __init__(self):
+        super().__init__("index required")
+
+
+class IndexNotFoundError(PilosaError):
+    def __init__(self):
+        super().__init__("index not found")
+
+
+class IndexExistsError(PilosaError):
+    def __init__(self):
+        super().__init__("index already exists")
+
+
+class FrameNotFoundError(PilosaError):
+    def __init__(self):
+        super().__init__("frame not found")
+
+
+class FrameExistsError(PilosaError):
+    def __init__(self):
+        super().__init__("frame already exists")
+
+
+class FragmentNotFoundError(PilosaError):
+    def __init__(self):
+        super().__init__("fragment not found")
+
+
+class SliceUnavailableError(PilosaError):
+    """No node available for a slice (reference errSliceUnavailable)."""
+
+    def __init__(self):
+        super().__init__("slice unavailable")
+
+
+class QueryError(PilosaError):
+    """Invalid query arguments/shape."""

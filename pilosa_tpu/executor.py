@@ -1,0 +1,616 @@
+"""Executor: recursive PQL evaluation fanned out per-slice.
+
+Parity with /root/reference/executor.go: bitmap calls (Bitmap, Union,
+Intersect, Difference, Range) map per-slice and merge; Count sums
+per-slice counts; TopN is two-phase (approximate pass, then exact
+re-count of the merged candidate ids); SetBit/ClearBit route to every
+replica owner of the bit's slice; SetRowAttrs/SetColumnAttrs apply
+locally and broadcast to all other nodes. A failed node's slices are
+re-split across remaining replicas (executor.go:1140-1151).
+
+The TPU twist: Count over a pure bitmap-op tree takes a fused device
+path — the whole expression tree compiles to one XLA computation per
+slice batch (pilosa_tpu.parallel.plan), popcounting the combined blocks
+without materializing intermediate rows (closing the reference's
+materialize-then-count gap, SURVEY.md §3.2 note).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from datetime import datetime
+from typing import Callable, List, Optional, Sequence
+
+from .core import views_by_time_range
+from .core.cache import add_to_pairs
+from .core.fragment import TopOptions
+from .core.frame import DEFAULT_ROW_LABEL
+from .core.index import DEFAULT_COLUMN_LABEL
+from .core.row import Row
+from .core.view import VIEW_INVERSE, VIEW_STANDARD
+from .errors import (
+    FrameNotFoundError,
+    IndexNotFoundError,
+    IndexRequiredError,
+    QueryError,
+    SliceUnavailableError,
+)
+from .pql import Call, Query
+from . import SLICE_WIDTH
+
+# Frame used when a query doesn't specify one (executor.go:35).
+DEFAULT_FRAME = "general"
+
+# Lowest count a TopN pass will consider (executor.go:37-39).
+MIN_THRESHOLD = 1
+
+# PQL timestamp format (reference TimeFormat "2006-01-02T15:04").
+TIME_FORMAT = "%Y-%m-%dT%H:%M"
+
+_WRITE_CALLS = ("ClearBit", "SetBit", "SetRowAttrs", "SetColumnAttrs")
+
+
+class ExecOptions:
+    """Per-Execute context (executor.go:1253-1256)."""
+
+    def __init__(self, remote: bool = False):
+        self.remote = remote
+
+
+def parse_time(s: str) -> datetime:
+    return datetime.strptime(s, TIME_FORMAT)
+
+
+def needs_slices(calls: Sequence[Call]) -> bool:
+    """True when any call requires per-slice fan-out (executor.go:1281)."""
+    return any(c.name not in _WRITE_CALLS for c in calls)
+
+
+class Executor:
+    """Evaluates PQL against a Holder, fanning out across the cluster.
+
+    `client` is the remote-execution seam (reference Executor.HTTPClient
+    + exec, executor.go:1000-1083): any object with
+    execute_query(node, index, query: str, slices, remote=True) -> list.
+    Tests inject fakes here; the HTTP layer injects the real client.
+    """
+
+    def __init__(self, holder, host: str = "", cluster=None, client=None,
+                 use_device: Optional[bool] = None, max_workers: int = 8):
+        self.holder = holder
+        self.host = host
+        self.cluster = cluster
+        self.client = client
+        # None = auto (device path when available); False = host roaring only.
+        self.use_device = use_device
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    # -- top level -----------------------------------------------------------
+
+    def execute(self, index: str, q: Query, slices: Optional[Sequence[int]] = None,
+                opt: Optional[ExecOptions] = None) -> list:
+        """Execute each call serially, returning one result per call
+        (executor.go:62-145)."""
+        if not index:
+            raise IndexRequiredError()
+        opt = opt or ExecOptions()
+
+        need = needs_slices(q.calls)
+        inverse_slices: List[int] = []
+        column_label = DEFAULT_COLUMN_LABEL
+
+        idx = self.holder.index(index)
+        if slices:
+            slices = list(slices)
+        else:
+            slices = []
+            if need:
+                if idx is None:
+                    raise IndexNotFoundError()
+                slices = list(range(idx.max_slice() + 1))
+                inverse_slices = list(range(idx.max_inverse_slice() + 1))
+                column_label = idx.column_label
+
+        # Bulk attribute insertion fast path (executor.go:857-941).
+        if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
+            return self._execute_bulk_set_row_attrs(index, q.calls, opt)
+
+        results = []
+        for call in q.calls:
+            call_slices = slices
+            if call.supports_inverse() and need:
+                frame = call.args.get("frame") or DEFAULT_FRAME
+                f = self.holder.frame(index, frame)
+                if f is None:
+                    raise FrameNotFoundError()
+                if call.is_inverse(f.row_label, column_label):
+                    call_slices = inverse_slices
+            results.append(self._execute_call(index, call, call_slices, opt))
+        return results
+
+    def _execute_call(self, index: str, c: Call, slices: Sequence[int],
+                      opt: ExecOptions):
+        if c.name == "ClearBit":
+            return self._execute_clear_bit(index, c, opt)
+        if c.name == "Count":
+            return self._execute_count(index, c, slices, opt)
+        if c.name == "SetBit":
+            return self._execute_set_bit(index, c, opt)
+        if c.name == "SetRowAttrs":
+            return self._execute_set_row_attrs(index, c, opt)
+        if c.name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(index, c, opt)
+        if c.name == "TopN":
+            return self._execute_top_n(index, c, slices, opt)
+        return self._execute_bitmap_call(index, c, slices, opt)
+
+    # -- bitmap calls --------------------------------------------------------
+
+    def _execute_bitmap_call(self, index: str, c: Call, slices: Sequence[int],
+                             opt: ExecOptions) -> Row:
+        def map_fn(slice_):
+            return self.execute_bitmap_call_slice(index, c, slice_)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = Row()
+            prev.merge(v)
+            return prev
+
+        row = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        if row is None:
+            row = Row()
+
+        # Attach attrs for root Bitmap() calls (executor.go:218-247).
+        if c.name == "Bitmap":
+            idx = self.holder.index(index)
+            if idx is not None:
+                col_id, col_ok = c.uint_arg(idx.column_label)
+                if col_ok:
+                    row.attrs = idx.column_attr_store.attrs(col_id)
+                else:
+                    f = idx.frame(c.args.get("frame") or DEFAULT_FRAME)
+                    if f is not None:
+                        row_id, _ = c.uint_arg(f.row_label)
+                        row.attrs = f.row_attr_store.attrs(row_id)
+        return row
+
+    def execute_bitmap_call_slice(self, index: str, c: Call, slice_: int) -> Row:
+        """One slice of a bitmap call (executor.go:253-268)."""
+        if c.name == "Bitmap":
+            return self._execute_bitmap_slice(index, c, slice_)
+        if c.name == "Difference":
+            return self._execute_binop_slice(index, c, slice_, "difference")
+        if c.name == "Intersect":
+            return self._execute_binop_slice(index, c, slice_, "intersect")
+        if c.name == "Range":
+            return self._execute_range_slice(index, c, slice_)
+        if c.name == "Union":
+            return self._execute_binop_slice(index, c, slice_, "union")
+        raise QueryError(f"unknown call: {c.name}")
+
+    def _execute_bitmap_slice(self, index: str, c: Call, slice_: int) -> Row:
+        """Bitmap(rowID=..) / Bitmap(columnID=..) for one slice
+        (executor.go:420-465)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        column_label = idx.column_label
+
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        row_label = f.row_label
+
+        row_id, row_ok = c.uint_arg(row_label)
+        col_id, col_ok = c.uint_arg(column_label)
+        if row_ok and col_ok:
+            raise QueryError(
+                f"Bitmap() cannot specify both {row_label} and {column_label} values")
+        if not row_ok and not col_ok:
+            raise QueryError(
+                f"Bitmap() must specify either {row_label} or {column_label} values")
+
+        view, id_ = VIEW_STANDARD, row_id
+        if col_ok:
+            if not f.inverse_enabled:
+                raise QueryError(
+                    "Bitmap() cannot retrieve columns unless inverse storage enabled")
+            view, id_ = VIEW_INVERSE, col_id
+
+        frag = self.holder.fragment(index, frame, view, slice_)
+        if frag is None:
+            return Row()
+        return frag.row(id_)
+
+    def _execute_binop_slice(self, index: str, c: Call, slice_: int, op: str) -> Row:
+        if not c.children:
+            if op == "union":
+                return Row()
+            raise QueryError(f"empty {c.name} query is currently not supported")
+        other = None
+        for child in c.children:
+            row = self.execute_bitmap_call_slice(index, child, slice_)
+            other = row if other is None else getattr(other, op)(row)
+        return other
+
+    def _execute_range_slice(self, index: str, c: Call, slice_: int) -> Row:
+        """Range(frame=.., <row>=.., start=.., end=..) over time-quantum
+        views (executor.go:490-546)."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise FrameNotFoundError()
+        row_id, _ = c.uint_arg(f.row_label)
+
+        start = c.args.get("start")
+        if not isinstance(start, str):
+            raise QueryError("Range() start time required")
+        end = c.args.get("end")
+        if not isinstance(end, str):
+            raise QueryError("Range() end time required")
+        try:
+            start_t = parse_time(start)
+            end_t = parse_time(end)
+        except ValueError:
+            raise QueryError("cannot parse Range() time")
+
+        q = f.time_quantum
+        if not str(q):
+            return Row()
+
+        out = Row()
+        for vname in views_by_time_range(VIEW_STANDARD, start_t, end_t, q):
+            frag = self.holder.fragment(index, frame, vname, slice_)
+            if frag is None:
+                continue
+            out = out.union(frag.row(row_id))
+        return out
+
+    # -- count ---------------------------------------------------------------
+
+    def _execute_count(self, index: str, c: Call, slices: Sequence[int],
+                       opt: ExecOptions) -> int:
+        if len(c.children) == 0:
+            raise QueryError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise QueryError("Count() only accepts a single bitmap input")
+        child = c.children[0]
+
+        device_plan = self._device_plan_for(index, child)
+
+        def map_fn(slice_):
+            if device_plan is not None:
+                n = device_plan.count_slice(slice_)
+                if n is not None:
+                    return n
+            return self.execute_bitmap_call_slice(index, child, slice_).count()
+
+        def reduce_fn(prev, v):
+            return (prev or 0) + v
+
+        result = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        return int(result or 0)
+
+    def _device_plan_for(self, index: str, tree: Call):
+        """Compile a pure bitmap-op tree for fused device eval; None when
+        the tree or backend doesn't qualify. use_device: True forces the
+        device path, False forces host roaring, None = auto (device when a
+        TPU backend is live)."""
+        if self.use_device is False:
+            return None
+        if self.use_device is None:
+            import jax
+
+            if jax.default_backend() != "tpu":
+                return None
+        from .parallel.plan import compile_count_plan
+
+        return compile_count_plan(self.holder, index, tree)
+
+    # -- TopN ----------------------------------------------------------------
+
+    def _execute_top_n(self, index: str, c: Call, slices: Sequence[int],
+                       opt: ExecOptions) -> List[tuple]:
+        """Two-phase TopN (executor.go:273-310)."""
+        row_ids, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+
+        pairs = self._execute_top_n_slices(index, c, slices, opt)
+        if not pairs or row_ids or opt.remote:
+            return pairs
+
+        # Phase 2: exact re-count of candidate ids, only at the coordinator.
+        other = c.clone()
+        other.args["ids"] = sorted(p[0] for p in pairs)
+        trimmed = self._execute_top_n_slices(index, other, slices, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_top_n_slices(self, index: str, c: Call, slices: Sequence[int],
+                              opt: ExecOptions) -> List[tuple]:
+        def map_fn(slice_):
+            return self.execute_top_n_slice(index, c, slice_)
+
+        def reduce_fn(prev, v):
+            return add_to_pairs(prev or [], v)
+
+        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
+        pairs.sort(key=lambda p: (-p[1], p[0]))
+        return pairs
+
+    def execute_top_n_slice(self, index: str, c: Call, slice_: int) -> List[tuple]:
+        """One slice of TopN (executor.go:333-396)."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        n, _ = c.uint_arg("n")
+        field = c.args.get("field") or ""
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        filters = c.args.get("filters") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+
+        src = None
+        if len(c.children) == 1:
+            src = self.execute_bitmap_call_slice(index, c.children[0], slice_)
+        elif len(c.children) > 1:
+            raise QueryError("TopN() can only have one input bitmap")
+
+        f = self.holder.fragment(index, frame, VIEW_STANDARD, slice_)
+        if f is None:
+            return []
+        if min_threshold <= 0:
+            min_threshold = MIN_THRESHOLD
+        if tanimoto > 100:
+            raise QueryError("Tanimoto Threshold is from 1 to 100 only")
+        return f.top(TopOptions(
+            n=n,
+            src=src,
+            row_ids=row_ids,
+            min_threshold=min_threshold,
+            filter_field=field,
+            filter_values=filters,
+            tanimoto_threshold=tanimoto,
+        ))
+
+    # -- writes --------------------------------------------------------------
+
+    def _read_bit_args(self, index: str, c: Call):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        frame = c.args.get("frame")
+        if not isinstance(frame, str):
+            raise QueryError(f"{c.name}() frame required")
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+
+        row_id, ok = c.uint_arg(f.row_label)
+        if not ok:
+            raise QueryError(f"{c.name}() row field '{f.row_label}' required")
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise QueryError(f"{c.name}() column field '{idx.column_label}' required")
+        return f, row_id, col_id
+
+    def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        f, row_id, col_id = self._read_bit_args(index, c)
+
+        timestamp = None
+        ts = c.args.get("timestamp")
+        if isinstance(ts, str):
+            try:
+                timestamp = parse_time(ts)
+            except ValueError:
+                raise QueryError(f"invalid date: {ts}")
+
+        return self._execute_mutate_view(
+            index, c, opt, col_id,
+            lambda: f.set_bit(row_id, col_id, timestamp))
+
+    def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        f, row_id, col_id = self._read_bit_args(index, c)
+        return self._execute_mutate_view(
+            index, c, opt, col_id,
+            lambda: f.clear_bit(row_id, col_id))
+
+    def _execute_mutate_view(self, index: str, c: Call, opt: ExecOptions,
+                             col_id: int, local_fn: Callable[[], bool]) -> bool:
+        """Route a bit mutation to every replica owner of its slice
+        (executor.go:767-797)."""
+        slice_ = col_id // SLICE_WIDTH
+        ret = False
+        for node in self._fragment_nodes(index, slice_):
+            if node is None or node.host == self.host:
+                if local_fn():
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            res = self._exec_remote(node, index, Query(calls=[c]), None, opt)
+            if res and res[0]:
+                ret = True
+        return ret
+
+    def _fragment_nodes(self, index: str, slice_: int):
+        if self.cluster is None or not self.cluster.nodes:
+            return [None]  # single-node: always local
+        return self.cluster.fragment_nodes(index, slice_)
+
+    def _other_nodes(self):
+        if self.cluster is None:
+            return []
+        return [n for n in self.cluster.nodes if n.host != self.host]
+
+    def _execute_set_row_attrs(self, index: str, c: Call, opt: ExecOptions):
+        """SetRowAttrs (executor.go:799-855)."""
+        frame_name = c.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise QueryError("SetRowAttrs() frame required")
+        f = self.holder.frame(index, frame_name)
+        if f is None:
+            raise FrameNotFoundError()
+        row_id, ok = c.uint_arg(f.row_label)
+        if not ok:
+            raise QueryError(f"SetRowAttrs() row field '{f.row_label}' required")
+
+        attrs = dict(c.args)
+        attrs.pop("frame", None)
+        attrs.pop(f.row_label, None)
+        f.row_attr_store.set_attrs(row_id, attrs)
+
+        if not opt.remote:
+            self._broadcast_query(index, Query(calls=[c]), opt)
+        return None
+
+    def _execute_bulk_set_row_attrs(self, index: str, calls: Sequence[Call],
+                                    opt: ExecOptions) -> list:
+        """Grouped bulk insertion (executor.go:857-941)."""
+        by_frame = {}
+        for c in calls:
+            frame_name = c.args.get("frame")
+            if not isinstance(frame_name, str):
+                raise QueryError("SetRowAttrs() frame required")
+            f = self.holder.frame(index, frame_name)
+            if f is None:
+                raise FrameNotFoundError()
+            row_id, ok = c.uint_arg(f.row_label)
+            if not ok:
+                raise QueryError(f"SetRowAttrs() row field '{f.row_label}' required")
+            attrs = dict(c.args)
+            attrs.pop("frame", None)
+            attrs.pop(f.row_label, None)
+            by_frame.setdefault(frame_name, {}).setdefault(row_id, {}).update(attrs)
+
+        for frame_name, items in by_frame.items():
+            self.holder.frame(index, frame_name).row_attr_store.set_bulk_attrs(items)
+
+        if not opt.remote:
+            self._broadcast_query(index, Query(calls=list(calls)), opt)
+        return [None] * len(calls)
+
+    def _execute_set_column_attrs(self, index: str, c: Call, opt: ExecOptions):
+        """SetColumnAttrs (executor.go:943-998)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+
+        id_, ok = c.uint_arg("id")
+        col_name = "id"
+        if not ok:
+            id_, ok = c.uint_arg(idx.column_label)
+            if not ok:
+                raise QueryError("SetColumnAttrs() id required")
+            col_name = idx.column_label
+
+        attrs = dict(c.args)
+        attrs.pop(col_name, None)
+        idx.column_attr_store.set_attrs(id_, attrs)
+
+        if not opt.remote:
+            self._broadcast_query(index, Query(calls=[c]), opt)
+        return None
+
+    def _broadcast_query(self, index: str, q: Query, opt: ExecOptions):
+        """Forward a write to every other node in parallel; first error
+        wins (executor.go:833-855)."""
+        nodes = self._other_nodes()
+        if not nodes:
+            return
+        futures = [
+            self._pool.submit(self._exec_remote, node, index, q, None, opt)
+            for node in nodes
+        ]
+        for fut in futures:
+            fut.result()
+
+    # -- distributed fan-out -------------------------------------------------
+
+    def _exec_remote(self, node, index: str, q: Query,
+                     slices: Optional[Sequence[int]], opt: ExecOptions) -> list:
+        """Remote execution via the injected client (executor.go:1000-1083).
+        The query travels as its canonical PQL serialization."""
+        if self.client is None:
+            raise SliceUnavailableError()
+        return self.client.execute_query(
+            node, index, str(q), slices or [], remote=True)
+
+    def _slices_by_node(self, nodes, index: str, slices: Sequence[int]):
+        """node -> slices owned, restricted to `nodes`
+        (executor.go:1087-1101)."""
+        m = {}
+        for slice_ in slices:
+            for owner in self.cluster.fragment_nodes(index, slice_):
+                if owner in nodes:
+                    m.setdefault(owner, []).append(slice_)
+                    break
+            else:
+                raise SliceUnavailableError()
+        return m
+
+    def _map_reduce(self, index: str, slices: Sequence[int], c: Call,
+                    opt: ExecOptions, map_fn, reduce_fn):
+        """Cluster-wide map + reduce with node-failure re-split
+        (executor.go:1103-1163)."""
+        if self.cluster is None or not self.cluster.nodes:
+            return self._mapper_local(slices, map_fn, reduce_fn)
+
+        if opt.remote:
+            # Already forwarded: restrict to the local node.
+            nodes = [self.cluster.node_by_host(self.host)]
+        else:
+            nodes = list(self.cluster.nodes)
+
+        return self._mapper(nodes, index, slices, c, opt, map_fn, reduce_fn)
+
+    def _mapper(self, nodes, index: str, slices: Sequence[int], c: Call,
+                opt: ExecOptions, map_fn, reduce_fn):
+        m = self._slices_by_node(nodes, index, slices)
+
+        futures = {}
+        for node, node_slices in m.items():
+            if node.host == self.host:
+                fut = self._pool.submit(self._mapper_local, node_slices,
+                                        map_fn, reduce_fn)
+            elif not opt.remote:
+                fut = self._pool.submit(self._exec_remote_one, node, index, c,
+                                        node_slices, opt)
+            else:
+                continue
+            futures[fut] = (node, node_slices)
+
+        result = None
+        pending = set(futures)
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                node, node_slices = futures[fut]
+                try:
+                    v = fut.result()
+                except Exception as err:
+                    # Re-split this node's slices across remaining replicas
+                    # (executor.go:1140-1151).
+                    remaining = [n for n in nodes if n is not node]
+                    try:
+                        v = self._mapper(remaining, index, node_slices, c,
+                                         opt, map_fn, reduce_fn)
+                    except SliceUnavailableError:
+                        raise err
+                result = reduce_fn(result, v)
+        return result
+
+    def _exec_remote_one(self, node, index: str, c: Call,
+                         slices: Sequence[int], opt: ExecOptions):
+        results = self._exec_remote(node, index, Query(calls=[c]), slices, opt)
+        return results[0] if results else None
+
+    def _mapper_local(self, slices: Sequence[int], map_fn, reduce_fn):
+        """Local per-slice map + reduce (executor.go:1200-1236). reduce_fn
+        must handle prev=None by allocating a fresh accumulator — results
+        never alias fragment row caches."""
+        result = None
+        for slice_ in slices:
+            result = reduce_fn(result, map_fn(slice_))
+        return result

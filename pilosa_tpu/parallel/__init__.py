@@ -1,0 +1,35 @@
+"""Distributed layer: cluster topology, slice placement, and the TPU
+mesh execution path.
+
+Two planes, mirroring SURVEY.md §2.4/§5:
+  - host plane (`cluster`): node membership, jump-hash partition →
+    replica placement, slice ownership — the scheduling metadata the
+    executor uses to fan queries out (reference cluster.go).
+  - device plane (`mesh`): slices sharded across TPU devices of a
+    `jax.sharding.Mesh`; Count/TopN reductions ride ICI collectives
+    (psum) instead of the reference's HTTP mapReduce merge.
+"""
+
+from .cluster import (
+    DEFAULT_PARTITION_N,
+    DEFAULT_REPLICA_N,
+    Cluster,
+    ConstHasher,
+    JmpHasher,
+    ModHasher,
+    Node,
+    NODE_STATE_DOWN,
+    NODE_STATE_UP,
+)
+
+__all__ = [
+    "DEFAULT_PARTITION_N",
+    "DEFAULT_REPLICA_N",
+    "Cluster",
+    "ConstHasher",
+    "JmpHasher",
+    "ModHasher",
+    "Node",
+    "NODE_STATE_DOWN",
+    "NODE_STATE_UP",
+]

@@ -1,0 +1,170 @@
+"""Cluster topology: nodes, partitions, replica placement.
+
+Parity with /root/reference/cluster.go: the column space is sharded into
+2^20-wide slices; (index, slice) hashes to one of PartitionN partitions
+via fnv64a, and a partition maps to ReplicaN consecutive nodes on the
+ring chosen by jump consistent hash (cluster.go:198-277).
+
+The same math places slices onto TPU devices in the mesh plane
+(parallel.mesh): a device mesh is just a cluster whose "nodes" are
+devices, so placement stays consistent between the host fan-out path and
+the device-sharded path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+DEFAULT_PARTITION_N = 16
+DEFAULT_REPLICA_N = 1
+
+NODE_STATE_UP = "UP"
+NODE_STATE_DOWN = "DOWN"
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv64a(data: bytes) -> int:
+    h = _FNV64_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV64_PRIME) & _MASK64
+    return h
+
+
+class Node:
+    """One cluster member (reference cluster.go:39-57)."""
+
+    def __init__(self, host: str, internal_host: str = ""):
+        self.host = host
+        self.internal_host = internal_host
+        self.status: Optional[dict] = None
+
+    def set_state(self, state: str):
+        if self.status is None:
+            self.status = {}
+        self.status["state"] = state
+
+    def to_dict(self) -> dict:
+        return {"host": self.host, "internalHost": self.internal_host}
+
+    def __repr__(self):
+        return f"Node({self.host!r})"
+
+
+class JmpHasher:
+    """Jump consistent hash (Lamping & Veach), the reference's default
+    placement hash (cluster.go:266-277)."""
+
+    def hash(self, key: int, n: int) -> int:
+        key &= _MASK64
+        b, j = -1, 0
+        while j < n:
+            b = j
+            key = (key * 2862933555777941757 + 1) & _MASK64
+            j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+        return b
+
+
+class ModHasher:
+    """key % n — deterministic fake for tests (reference cluster_test.go)."""
+
+    def hash(self, key: int, n: int) -> int:
+        return key % n
+
+
+class ConstHasher:
+    """Always the same bucket — test fake (reference cluster_test.go)."""
+
+    def __init__(self, i: int = 0):
+        self.i = i
+
+    def hash(self, key: int, n: int) -> int:
+        return self.i
+
+
+class Cluster:
+    """Node list + placement math (reference cluster.go:121-254)."""
+
+    def __init__(self, nodes: Optional[List[Node]] = None,
+                 hasher=None,
+                 partition_n: int = DEFAULT_PARTITION_N,
+                 replica_n: int = DEFAULT_REPLICA_N):
+        self.nodes: List[Node] = nodes or []
+        self.hasher = hasher or JmpHasher()
+        self.partition_n = partition_n
+        self.replica_n = replica_n
+        # Live membership, fed by the gossip/nodeset layer; None means
+        # "no liveness source, treat everyone as up".
+        self.node_set_hosts: Optional[List[str]] = None
+
+    # -- membership ----------------------------------------------------------
+
+    def hosts(self) -> List[str]:
+        return [n.host for n in self.nodes]
+
+    def node_by_host(self, host: str) -> Optional[Node]:
+        for n in self.nodes:
+            if n.host == host:
+                return n
+        return None
+
+    def node_states(self) -> Dict[str, str]:
+        """host -> UP/DOWN (reference cluster.go:156-169)."""
+        live = set(self.node_set_hosts if self.node_set_hosts is not None
+                   else self.hosts())
+        return {
+            n.host: NODE_STATE_UP if n.host in live else NODE_STATE_DOWN
+            for n in self.nodes
+        }
+
+    # -- placement -----------------------------------------------------------
+
+    def partition(self, index: str, slice_: int) -> int:
+        """(index, slice) -> partition id via fnv64a over index bytes +
+        big-endian slice (reference cluster.go:198-207)."""
+        data = index.encode() + int(slice_).to_bytes(8, "big")
+        return fnv64a(data) % self.partition_n
+
+    def partition_nodes(self, partition_id: int) -> List[Node]:
+        """Replica owners: jump-hash primary + consecutive ring nodes
+        (reference cluster.go:220-240)."""
+        if not self.nodes:
+            return []
+        replica_n = min(max(self.replica_n, 1), len(self.nodes))
+        primary = self.hasher.hash(partition_id, len(self.nodes))
+        return [self.nodes[(primary + i) % len(self.nodes)]
+                for i in range(replica_n)]
+
+    def fragment_nodes(self, index: str, slice_: int) -> List[Node]:
+        return self.partition_nodes(self.partition(index, slice_))
+
+    def owns_fragment(self, host: str, index: str, slice_: int) -> bool:
+        return any(n.host == host for n in self.fragment_nodes(index, slice_))
+
+    def owns_slices(self, index: str, max_slice: int, host: str) -> List[int]:
+        """Slices whose PRIMARY owner is host (reference cluster.go:243-254
+        — primary only, not replicas)."""
+        out = []
+        for s in range(max_slice + 1):
+            p = self.partition(index, s)
+            primary = self.hasher.hash(p, len(self.nodes))
+            if self.nodes[primary].host == host:
+                out.append(s)
+        return out
+
+    def status(self) -> dict:
+        return {"nodes": [n.status or {"host": n.host} for n in self.nodes]}
+
+
+def new_test_cluster(n: int) -> Cluster:
+    """n fake nodes host0..host{n-1} with ModHasher — the reference's
+    deterministic test cluster (cluster_test.go:146-177)."""
+    return Cluster(
+        nodes=[Node(f"host{i}") for i in range(n)],
+        hasher=ModHasher(),
+        partition_n=n,
+        replica_n=1,
+    )

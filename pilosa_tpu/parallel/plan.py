@@ -1,0 +1,148 @@
+"""PQL call-tree → fused device computation.
+
+The reference Count path materializes the intersection, then counts it
+(executor.go:567-597 over roaring intersect kernels). Here a pure
+bitmap-op tree — Bitmap / Intersect / Union / Difference over standard
+views — compiles to ONE XLA computation per slice: gather each leaf row
+as a (16, 2048) uint32 block from the fragment's HBM pool, combine
+elementwise, popcount-reduce. No intermediate row ever hits HBM; this is
+the "small compiler from pql.Call trees to jitted functions with a cache
+keyed on tree shape" (SURVEY.md §7 hard parts).
+
+Jit caching: the compiled function is cached on the tree's op-shape
+signature (json of the nested op list), so repeated queries of the same
+shape — the common case for a query workload — reuse the compiled
+executable across row ids, fragments, and slices of the same pool
+capacity.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pool import gather_row
+from ..core.view import VIEW_STANDARD
+
+# Call names evaluable on device, keyed to bitwise combiners.
+_TREE_OPS = {"Intersect": "and", "Union": "or", "Difference": "andnot"}
+
+
+def _tree_signature(node) -> object:
+    """Canonical nested-list shape of a call tree; leaves are numbered in
+    depth-first order."""
+    counter = [0]
+
+    def walk(n):
+        if n[0] == "leaf":
+            i = counter[0]
+            counter[0] += 1
+            return ["leaf", i]
+        return [n[0]] + [walk(c) for c in n[1:]]
+
+    return walk(node)
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_count(sig: str):
+    """Build + jit the evaluator for one tree shape."""
+    tree = json.loads(sig)
+
+    def eval_node(node, leaves):
+        if node[0] == "leaf":
+            pool, dense_idx = leaves[node[1]]
+            return gather_row(pool, dense_idx)
+        vals = [eval_node(c, leaves) for c in node[1:]]
+        op = node[0]
+        acc = vals[0]
+        for v in vals[1:]:
+            if op == "and":
+                acc = acc & v
+            elif op == "or":
+                acc = acc | v
+            else:  # andnot
+                acc = acc & ~v
+        return acc
+
+    def count(leaves):
+        blk = eval_node(tree, leaves)
+        return jax.lax.population_count(blk).astype(jnp.int32).sum()
+
+    return jax.jit(count)
+
+
+class CountPlan:
+    """A compiled Count over one index's call tree. `count_slice` returns
+    the slice's count, or None when this slice must fall back to the
+    host path (e.g. a referenced fragment is absent)."""
+
+    def __init__(self, holder, index: str, shape, leaves: List[tuple]):
+        self.holder = holder
+        self.index = index
+        # leaves: [(frame_name, row_id)] in depth-first order.
+        self.leaves = leaves
+        self._sig = json.dumps(_tree_signature(shape))
+        self._fn = _compiled_count(self._sig)
+
+    def count_slice(self, slice_: int) -> Optional[int]:
+        leaf_args = []
+        for frame, row_id in self.leaves:
+            frag = self.holder.fragment(self.index, frame, VIEW_STANDARD, slice_)
+            if frag is None:
+                return None
+            pool, row_ids = frag.pool
+            i = int(np.searchsorted(row_ids, np.uint64(row_id)))
+            if i >= len(row_ids) or row_ids[i] != np.uint64(row_id):
+                # Absent row: any dense index past the live keys gathers
+                # all-zero (pool.py gather_row hit-mask).
+                i = len(row_ids)
+            leaf_args.append((pool, jnp.int32(i)))
+        return int(self._fn(tuple(leaf_args)))
+
+
+def _lower_tree(holder, index: str, c, leaves: List[tuple]):
+    """Call → nested shape list, collecting leaves; None if not lowerable."""
+    if c.name == "Bitmap":
+        from ..executor import DEFAULT_FRAME
+
+        idx = holder.index(index)
+        if idx is None:
+            return None
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = idx.frame(frame)
+        if f is None:
+            return None
+        try:
+            row_id, row_ok = c.uint_arg(f.row_label)
+            _, col_ok = c.uint_arg(idx.column_label)
+        except TypeError:
+            return None
+        if not row_ok or col_ok:
+            return None  # inverse/invalid → host path
+        leaves.append((frame, row_id))
+        return ["leaf"]
+    op = _TREE_OPS.get(c.name)
+    if op is None or not c.children:
+        return None
+    parts = []
+    for child in c.children:
+        sub = _lower_tree(holder, index, child, leaves)
+        if sub is None:
+            return None
+        parts.append(sub)
+    return [op] + parts
+
+
+def compile_count_plan(holder, index: str, tree) -> Optional[CountPlan]:
+    """Compile Count's child tree for fused device eval; None when the
+    tree doesn't qualify (Range, inverse views, unknown frames, ...)."""
+    leaves: List[tuple] = []
+    shape = _lower_tree(holder, index, tree, leaves)
+    if shape is None or shape == ["leaf"] and not leaves:
+        return None
+    return CountPlan(holder, index, shape, leaves)

@@ -1,0 +1,82 @@
+"""Cluster placement tests (model: /root/reference/cluster_test.go)."""
+
+import pytest
+
+from pilosa_tpu.parallel import Cluster, ConstHasher, JmpHasher, ModHasher, Node
+from pilosa_tpu.parallel.cluster import fnv64a, new_test_cluster
+
+
+def test_fnv64a_known_vectors():
+    # Standard FNV-1a 64 test vectors.
+    assert fnv64a(b"") == 0xCBF29CE484222325
+    assert fnv64a(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv64a(b"foobar") == 0x85944171F73967E8
+
+
+def test_jmp_hasher_properties():
+    h = JmpHasher()
+    # In range, deterministic.
+    for key in (0, 1, 2, 1 << 40, (1 << 64) - 1):
+        for n in (1, 2, 7, 16):
+            b = h.hash(key, n)
+            assert 0 <= b < n
+            assert b == h.hash(key, n)
+    # Monotone consistency: growing n only moves keys to the NEW bucket.
+    for key in range(200):
+        prev = h.hash(key, 7)
+        nxt = h.hash(key, 8)
+        assert nxt == prev or nxt == 7
+
+
+def test_partition_deterministic_and_in_range():
+    c = Cluster(nodes=[Node("host0"), Node("host1")], partition_n=16)
+    seen = set()
+    for s in range(64):
+        p = c.partition("i", s)
+        assert 0 <= p < 16
+        assert p == c.partition("i", s)
+        seen.add(p)
+    assert len(seen) > 4  # spreads
+    # Index name participates in the hash.
+    assert any(c.partition("i", s) != c.partition("j", s) for s in range(16))
+
+
+def test_partition_nodes_replication():
+    nodes = [Node(f"host{i}") for i in range(4)]
+    c = Cluster(nodes=nodes, hasher=ModHasher(), partition_n=4, replica_n=2)
+    owners = c.partition_nodes(1)
+    # ModHasher: primary = 1 % 4, replica ring-consecutive.
+    assert [n.host for n in owners] == ["host1", "host2"]
+    # Replica count clamps to cluster size.
+    c.replica_n = 9
+    assert len(c.partition_nodes(0)) == 4
+    # Zero replica count defaults to one (cluster.go:224-229).
+    c.replica_n = 0
+    assert len(c.partition_nodes(0)) == 1
+
+
+def test_owns_fragment_and_slices():
+    c = new_test_cluster(3)
+    for s in range(12):
+        owners = c.fragment_nodes("idx", s)
+        assert len(owners) == 1
+        assert c.owns_fragment(owners[0].host, "idx", s)
+    # Every slice has exactly one primary owner; union covers all slices.
+    all_owned = sorted(
+        s for h in c.hosts() for s in c.owns_slices("idx", 11, h)
+    )
+    assert all_owned == list(range(12))
+
+
+def test_const_hasher():
+    c = Cluster(nodes=[Node("a"), Node("b")], hasher=ConstHasher(1),
+                partition_n=2, replica_n=1)
+    for s in range(8):
+        assert [n.host for n in c.fragment_nodes("i", s)] == ["b"]
+
+
+def test_node_states():
+    c = new_test_cluster(2)
+    assert c.node_states() == {"host0": "UP", "host1": "UP"}
+    c.node_set_hosts = ["host0"]
+    assert c.node_states() == {"host0": "UP", "host1": "DOWN"}

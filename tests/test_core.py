@@ -198,11 +198,15 @@ def test_rank_cache_damper():
     clock = [0.0]
     c = RankCache(max_entries=10, clock=lambda: clock[0])
     c.add(1, 5)
-    c.add(2, 50)  # within 10s: no recalculation
-    assert [p[0] for p in c.top()] == [1]
+    c.add(2, 50)  # within 10s: invalidate() doesn't resort...
+    assert [p[0] for p in c.rankings] == [1]
+    # ...but the read path recalculates when dirty (stale-TopN fix).
+    assert [p[0] for p in c.top()] == [2, 1]
+    # Damper window passed: invalidate() recalculates again.
+    c.bulk_add(3, 100)
     clock[0] += 11
     c.invalidate()
-    assert [p[0] for p in c.top()] == [2, 1]
+    assert [p[0] for p in c.rankings] == [3, 2, 1]
 
 
 def test_lru_cache_eviction():
@@ -370,3 +374,45 @@ def test_row_result_does_not_alias_source():
     m.merge(r1)
     m.set_bit(8)
     assert list(r1) == [5]
+
+
+# -- regression: review findings --------------------------------------------
+
+def test_fragment_blocks_sparse_huge_row(frag):
+    """blocks() must visit only blocks with live containers — a single bit
+    at a huge rowID must not scan the dense block range."""
+    frag.set_bit(2**34, 5)
+    frag.set_bit(1, 7)
+    blocks = frag.blocks()  # must return promptly
+    containers_per_block = 100 * SLICE_WIDTH >> 16
+    expected_blocks = {(2**34 * 16) // containers_per_block,
+                       (1 * 16) // containers_per_block}
+    assert {b for b, _ in blocks} == expected_blocks
+
+
+def test_fragment_corrupt_cache_file_rebuilds(tmp_path):
+    path = str(tmp_path / "0")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(2, 1)
+    f.set_bit(2, 3)
+    f.set_bit(5, 1)
+    f.close()
+    # Simulate crash mid-flush: truncated JSON.
+    with open(path + ".cache", "w") as fh:
+        fh.write('[[2, ')
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    f2.open()
+    try:
+        assert f2.top(TopOptions(n=10)) == [(2, 2), (5, 1)]
+    finally:
+        f2.close()
+
+
+def test_fragment_top_requested_ids_exact_after_clear(frag):
+    """Explicitly requested row ids must be recounted exactly, not served
+    from the threshold-gated rank cache (which never records zero)."""
+    frag.set_bit(2, 1)
+    frag.cache.recalculate()  # threshold_value becomes 1
+    frag.clear_bit(2, 1)      # cache.add(2, 0) is gated out
+    assert frag.top(TopOptions(row_ids=[2])) == []

@@ -1,0 +1,258 @@
+"""Executor tests (model: /root/reference/executor_test.go — real local
+executor, mocked remote client at the RPC seam)."""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.errors import QueryError
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.parallel import Cluster, ModHasher, Node
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu import SLICE_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def make_executor(holder, **kw):
+    return Executor(holder, use_device=kw.pop("use_device", False), **kw)
+
+
+def seed(holder, index="i", frame="general", bits=()):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    for row, col in bits:
+        f.set_bit(row, col)
+    return f
+
+
+def q(executor, index, pql, slices=None, opt=None):
+    return executor.execute(index, parse_string(pql), slices, opt)
+
+
+class TestBitmapCalls:
+    def test_bitmap(self, holder):
+        seed(holder, bits=[(10, 0), (10, 3), (10, SLICE_WIDTH + 1)])
+        e = make_executor(holder)
+        row = q(e, "i", "Bitmap(rowID=10)")[0]
+        assert list(row) == [0, 3, SLICE_WIDTH + 1]
+
+    def test_bitmap_attaches_row_attrs(self, holder):
+        f = seed(holder, bits=[(10, 0)])
+        f.row_attr_store.set_attrs(10, {"foo": "bar"})
+        e = make_executor(holder)
+        row = q(e, "i", "Bitmap(rowID=10)")[0]
+        assert row.attrs == {"foo": "bar"}
+
+    def test_intersect_union_difference(self, holder):
+        seed(holder, bits=[
+            (10, 0), (10, 1), (10, SLICE_WIDTH + 2),
+            (11, 1), (11, 2), (11, SLICE_WIDTH + 2),
+        ])
+        e = make_executor(holder)
+        assert list(q(e, "i", "Intersect(Bitmap(rowID=10), Bitmap(rowID=11))")[0]) \
+            == [1, SLICE_WIDTH + 2]
+        assert list(q(e, "i", "Union(Bitmap(rowID=10), Bitmap(rowID=11))")[0]) \
+            == [0, 1, 2, SLICE_WIDTH + 2]
+        assert list(q(e, "i", "Difference(Bitmap(rowID=10), Bitmap(rowID=11))")[0]) \
+            == [0]
+
+    def test_count(self, holder):
+        seed(holder, bits=[(10, 3), (10, SLICE_WIDTH + 1), (10, 2 * SLICE_WIDTH + 5)])
+        e = make_executor(holder)
+        assert q(e, "i", "Count(Bitmap(rowID=10))")[0] == 3
+
+    def test_count_device_matches_host(self, holder):
+        seed(holder, bits=[
+            (10, 0), (10, 1), (10, SLICE_WIDTH + 2), (10, 65536 + 7),
+            (11, 1), (11, SLICE_WIDTH + 2), (11, 99999),
+        ])
+        host = make_executor(holder, use_device=False)
+        dev = make_executor(holder, use_device=True)
+        for pql in (
+            "Count(Bitmap(rowID=10))",
+            "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=11)))",
+            "Count(Union(Bitmap(rowID=10), Bitmap(rowID=11)))",
+            "Count(Difference(Bitmap(rowID=10), Bitmap(rowID=11)))",
+            "Count(Bitmap(rowID=999))",
+        ):
+            assert q(dev, "i", pql)[0] == q(host, "i", pql)[0], pql
+
+    def test_range(self, holder):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general", time_quantum="YMDH")
+        f.set_bit(1, 100, t=datetime(2017, 4, 2, 12, 0))
+        f.set_bit(1, 200, t=datetime(2017, 4, 3, 9, 0))
+        f.set_bit(1, 300, t=datetime(2018, 1, 1, 0, 0))
+        e = make_executor(holder)
+        row = q(e, "i", 'Range(rowID=1, frame="general", start="2017-04-01T00:00", end="2017-05-01T00:00")')[0]
+        assert list(row) == [100, 200]
+
+    def test_count_empty_query_error(self, holder):
+        seed(holder)
+        e = make_executor(holder)
+        with pytest.raises(QueryError):
+            q(e, "i", "Count()")
+
+
+class TestTopN:
+    def test_topn(self, holder):
+        bits = [(0, c) for c in range(5)] + [(1, c) for c in range(3)] \
+            + [(2, c) for c in range(8)] + [(3, SLICE_WIDTH + 1)]
+        seed(holder, bits=bits)
+        e = make_executor(holder)
+        pairs = q(e, "i", 'TopN(frame="general", n=2)')[0]
+        assert pairs == [(2, 8), (0, 5)]
+
+    def test_topn_with_src(self, holder):
+        bits = [(0, c) for c in range(5)] + [(1, c) for c in range(10, 13)] \
+            + [(2, c) for c in range(8)] + [(9, 0), (9, 1), (9, 11)]
+        seed(holder, bits=bits)
+        e = make_executor(holder)
+        pairs = q(e, "i", 'TopN(Bitmap(rowID=9), frame="general", n=3)')[0]
+        # Intersection counts with row 9 {0,1,11}: row9->3, row0->2, row2->2.
+        assert pairs == [(9, 3), (0, 2), (2, 2)]
+
+    def test_topn_multislice_exact_recount(self, holder):
+        # Row 0 dominates slice 0, row 1 dominates slice 1; exact phase-2
+        # recount must rank globally.
+        bits = [(0, c) for c in range(10)] + [(1, c) for c in range(4)] \
+            + [(1, SLICE_WIDTH + c) for c in range(9)]
+        seed(holder, bits=bits)
+        e = make_executor(holder)
+        pairs = q(e, "i", 'TopN(frame="general", n=2)')[0]
+        assert pairs == [(1, 13), (0, 10)]
+
+
+class TestWrites:
+    def test_setbit_clearbit(self, holder):
+        seed(holder)
+        e = make_executor(holder)
+        assert q(e, "i", "SetBit(frame=\"general\", rowID=1, columnID=9)")[0] is True
+        assert q(e, "i", "SetBit(frame=\"general\", rowID=1, columnID=9)")[0] is False
+        assert list(q(e, "i", "Bitmap(rowID=1)")[0]) == [9]
+        assert q(e, "i", "ClearBit(frame=\"general\", rowID=1, columnID=9)")[0] is True
+        assert q(e, "i", "ClearBit(frame=\"general\", rowID=1, columnID=9)")[0] is False
+
+    def test_setbit_with_timestamp(self, holder):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("general", time_quantum="YM")
+        e = make_executor(holder)
+        q(e, "i", 'SetBit(frame="general", rowID=1, columnID=2, timestamp="2017-04-02T12:30")')
+        row = q(e, "i", 'Range(rowID=1, frame="general", start="2017-04-01T00:00", end="2017-05-01T00:00")')[0]
+        assert list(row) == [2]
+
+    def test_set_row_attrs(self, holder):
+        f = seed(holder)
+        e = make_executor(holder)
+        q(e, "i", 'SetRowAttrs(frame="general", rowID=7, x=123, y="z", b=true)')
+        assert f.row_attr_store.attrs(7) == {"x": 123, "y": "z", "b": True}
+        # Bulk fast path: multiple SetRowAttrs in one query.
+        res = q(e, "i", 'SetRowAttrs(frame="general", rowID=8, v=1)\n'
+                        'SetRowAttrs(frame="general", rowID=9, v=2)')
+        assert res == [None, None]
+        assert f.row_attr_store.attrs(8) == {"v": 1}
+        assert f.row_attr_store.attrs(9) == {"v": 2}
+
+    def test_set_column_attrs(self, holder):
+        seed(holder)
+        e = make_executor(holder)
+        q(e, "i", 'SetColumnAttrs(id=3, color="red")')
+        assert holder.index("i").column_attr_store.attrs(3) == {"color": "red"}
+
+
+class TestDistributed:
+    """Real local executor + mocked remote (executor_test.go:473-693)."""
+
+    def _cluster(self, replica_n=1):
+        return Cluster(nodes=[Node("host0"), Node("host1")],
+                       hasher=ModHasher(), partition_n=4, replica_n=replica_n)
+
+    def test_remote_count_forwarded(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = self._cluster()
+        calls = []
+
+        class MockClient:
+            def execute_query(self, node, index, query, slices, remote):
+                calls.append((node.host, index, query, tuple(slices), remote))
+                return [len(slices)]  # 1 bit per slice seeded above
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=MockClient(), use_device=False)
+        total = q(e, "i", "Count(Bitmap(rowID=10))")[0]
+        assert total == 4
+        # Exactly the slices host1 owns were forwarded, query re-serialized.
+        (host, index, query, slices, remote), = calls
+        assert host == "host1" and index == "i" and remote is True
+        assert query == "Count(Bitmap(rowID=10))"
+        expected = tuple(s for s in range(4)
+                         if cluster.fragment_nodes("i", s)[0].host == "host1")
+        assert slices == expected and len(slices) > 0
+
+    def test_remote_failure_fails_over_to_replica(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = self._cluster(replica_n=2)
+
+        class FailingClient:
+            def execute_query(self, node, index, query, slices, remote):
+                raise ConnectionError("node down")
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=FailingClient(), use_device=False)
+        # host1's slices re-split onto host0 (the replica), served locally.
+        assert q(e, "i", "Count(Bitmap(rowID=10))")[0] == 4
+
+    def test_remote_failure_no_replica_raises(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = self._cluster(replica_n=1)
+
+        class FailingClient:
+            def execute_query(self, node, index, query, slices, remote):
+                raise ConnectionError("node down")
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=FailingClient(), use_device=False)
+        with pytest.raises(ConnectionError):
+            q(e, "i", "Count(Bitmap(rowID=10))")
+
+    def test_remote_opt_restricts_to_local(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = self._cluster()
+
+        class ExplodingClient:
+            def execute_query(self, *a, **kw):
+                raise AssertionError("remote exec must not happen when opt.remote")
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=ExplodingClient(), use_device=False)
+        local = [s for s in range(4)
+                 if cluster.fragment_nodes("i", s)[0].host == "host0"]
+        n = e.execute("i", parse_string("Count(Bitmap(rowID=10))"),
+                      local, ExecOptions(remote=True))[0]
+        assert n == len(local)
+
+    def test_setbit_routed_to_replicas(self, holder):
+        seed(holder)
+        cluster = self._cluster(replica_n=2)
+        calls = []
+
+        class MockClient:
+            def execute_query(self, node, index, query, slices, remote):
+                calls.append((node.host, query))
+                return [True]
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=MockClient(), use_device=False)
+        changed = q(e, "i", 'SetBit(frame="general", rowID=1, columnID=0)')[0]
+        assert changed is True
+        # Local write applied + forwarded to the other replica once.
+        assert list(holder.fragment("i", "general", "standard", 0).row(1)) == [0]
+        assert calls == [("host1", 'SetBit(columnID=0, frame="general", rowID=1)')]
