@@ -1,0 +1,104 @@
+"""Benchmark: Count(Intersect(row_a, row_b)) over a ~1B-column index.
+
+The BASELINE.json north-star config: two fully-populated rows spanning
+960 slices (960 * 2^20 = 1,006,632,960 columns), fused
+intersect+popcount on device (pilosa_tpu.parallel.mesh) vs the host
+CPU popcount path (numpy bitwise_count over the same container words —
+the stand-in for the reference's amd64 POPCNT assembly,
+/root/reference/roaring/assembly_amd64.s popcntAndSlice).
+
+Prints ONE JSON line: {"metric", "value" (queries/sec), "unit",
+"vs_baseline" (device QPS / host-CPU QPS)}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_index(num_slices: int, seed: int = 7):
+    """Directly build the stacked (S, 32, 2048) pool: rows 0 and 1 fully
+    dense containers of random words (content doesn't affect op cost)."""
+    from pilosa_tpu.ops.pool import CONTAINER_WORDS, ROW_SPAN
+
+    rng = np.random.default_rng(seed)
+    cap = 2 * ROW_SPAN  # rows 0 and 1
+    keys = np.broadcast_to(
+        np.arange(cap, dtype=np.int32), (num_slices, cap)).copy()
+    words = rng.integers(0, 2**32, size=(num_slices, cap, CONTAINER_WORDS),
+                         dtype=np.uint32)
+    return keys, words
+
+
+def bench_device(keys, words, iters: int):
+    import jax
+
+    from pilosa_tpu.parallel import ShardedIndex, compile_mesh_count, default_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = default_mesh()
+    sharding = NamedSharding(mesh, P("slices"))
+    index = ShardedIndex(
+        keys=jax.device_put(keys, sharding),
+        words=jax.device_put(words, sharding),
+    )
+    fn = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
+    ids = np.int32([0, 1])
+
+    out = fn(index, ids)  # compile + warmup
+    jax.block_until_ready(out)
+    # Block per call: pipelined dispatch overstates throughput through
+    # the remote-TPU relay (acks can land before execution completes).
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(index, ids)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    dt = times[len(times) // 2]  # median
+    return int(out), dt
+
+
+def bench_host(words, iters: int):
+    """CPU reference path: fused popcount(and) over the same words."""
+    from pilosa_tpu.ops.pool import ROW_SPAN
+
+    wa = np.ascontiguousarray(words[:, :ROW_SPAN, :]).reshape(-1)
+    wb = np.ascontiguousarray(words[:, ROW_SPAN:, :]).reshape(-1)
+    total = int(np.bitwise_count(wa & wb).sum())  # warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        total = int(np.bitwise_count(wa & wb).sum())
+    dt = (time.perf_counter() - t0) / iters
+    return total, dt
+
+
+def main():
+    import jax
+
+    num_slices = 960  # 960 * 2^20 = 1,006,632,960 columns
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        num_slices = 96  # CI/CPU smoke: keep the shape, shrink the scale
+
+    keys, words = build_index(num_slices)
+    dev_count, dev_dt = bench_device(keys, words, iters=30 if on_tpu else 3)
+    host_count, host_dt = bench_host(words, iters=3)
+    # Device count is an int32 sum; compare against the two's-complement
+    # wrap of the host total.
+    assert dev_count == int(np.int32(np.uint64(host_count))), (dev_count, host_count)
+
+    qps = 1.0 / dev_dt
+    result = {
+        "metric": f"intersect_count_{num_slices << 20}cols_qps",
+        "value": round(qps, 2),
+        "unit": "queries/sec",
+        "vs_baseline": round(host_dt / dev_dt, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

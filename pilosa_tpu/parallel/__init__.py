@@ -21,8 +21,28 @@ from .cluster import (
     NODE_STATE_DOWN,
     NODE_STATE_UP,
 )
+from .mesh import (
+    SLICE_AXIS,
+    ShardedIndex,
+    build_sharded_index,
+    compile_mesh_apply_writes,
+    compile_mesh_count,
+    compile_mesh_step,
+    compile_mesh_topn,
+    default_mesh,
+    plan_writes,
+)
 
 __all__ = [
+    "SLICE_AXIS",
+    "ShardedIndex",
+    "build_sharded_index",
+    "compile_mesh_apply_writes",
+    "compile_mesh_count",
+    "compile_mesh_step",
+    "compile_mesh_topn",
+    "default_mesh",
+    "plan_writes",
     "DEFAULT_PARTITION_N",
     "DEFAULT_REPLICA_N",
     "Cluster",

@@ -1,0 +1,331 @@
+"""Device-mesh execution: slices sharded across TPU devices, reductions
+over ICI collectives.
+
+This is the TPU-native replacement for the reference's cluster mapReduce
+(executor.go:1103-1163): instead of HTTP fan-out + coordinator merge,
+all slices of an index live stacked in HBM across a
+`jax.sharding.Mesh`, one shard_map'd computation evaluates the query on
+every device's local slices, and Count / per-row totals reduce with
+`lax.psum` over the mesh axis (ICI), never leaving the device fabric.
+
+Layout: a ShardedIndex stacks per-slice FragmentPools into
+  keys  (S, C)        int32   — C = max container capacity over slices
+  words (S, C, 2048)  uint32  — bitmap-form containers
+sharded on the leading (slice) axis. Container keys use GLOBAL dense row
+indices (one row-id table for the whole index), so a row's dense index is
+the same on every shard and query row-lookups broadcast as scalars.
+
+TopN here is EXACT: per-row popcounts segment-summed on every shard,
+psum'd over the mesh, then a replicated lax.top_k — no rank-cache
+approximation pass (closes the reference's two-phase TopN refetch,
+executor.go:273-310, with one collective).
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import SLICE_WIDTH
+from ..ops.pool import CONTAINER_WORDS, INVALID_KEY, ROW_SPAN, FragmentPool
+from .plan import _tree_signature, eval_tree
+
+SLICE_AXIS = "slices"
+
+
+class ShardedIndex(NamedTuple):
+    """One frame/view's fragments, stacked and mesh-sharded."""
+
+    keys: jax.Array   # (S, C) int32, INVALID_KEY padded
+    words: jax.Array  # (S, C, CONTAINER_WORDS) uint32
+
+    @property
+    def num_slices(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[1]
+
+
+def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
+                        capacity: Optional[int] = None):
+    """Stack per-slice host bitmaps into a ShardedIndex.
+
+    bitmaps[s] is the slice-s roaring Bitmap (or None for an absent
+    fragment). Returns (ShardedIndex, row_ids): row_ids is the GLOBAL
+    sorted uint64 row-id table shared by all shards. The slice count is
+    padded up to a multiple of the mesh axis size.
+    """
+    n_dev = mesh.shape[SLICE_AXIS] if mesh is not None else 1
+    s = max(1, len(bitmaps))
+    s_pad = -(-s // n_dev) * n_dev
+
+    # Global dense row table.
+    all_rows = [np.asarray(b.keys, dtype=np.uint64) >> np.uint64(4)
+                for b in bitmaps if b is not None and len(b.keys)]
+    row_ids = (np.unique(np.concatenate(all_rows)) if all_rows
+               else np.empty(0, dtype=np.uint64))
+
+    counts = [len(b.keys) if b is not None else 0 for b in bitmaps]
+    cap = capacity or max(1, max(counts, default=1))
+
+    keys = np.full((s_pad, cap), INVALID_KEY, dtype=np.int32)
+    words = np.zeros((s_pad, cap, CONTAINER_WORDS), dtype=np.uint32)
+    for si, b in enumerate(bitmaps):
+        if b is None or not len(b.keys):
+            continue
+        real = np.asarray(b.keys, dtype=np.uint64)
+        dense = np.searchsorted(row_ids, real >> np.uint64(4))
+        k = (dense * ROW_SPAN + (real & np.uint64(15)).astype(np.int64)).astype(np.int32)
+        order = np.argsort(k)
+        keys[si, : len(k)] = k[order]
+        for j, ci in enumerate(order):
+            words[si, j] = b.containers[ci].words().view(np.uint32)
+
+    idx = ShardedIndex(keys=jnp.asarray(keys), words=jnp.asarray(words))
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(SLICE_AXIS))
+        idx = ShardedIndex(
+            keys=jax.device_put(idx.keys, sharding),
+            words=jax.device_put(idx.words, sharding),
+        )
+    return idx, row_ids
+
+
+def _local_pools(keys, words):
+    """Vmap helper: treat each local slice as a FragmentPool."""
+    return FragmentPool(keys=keys, words=words, n=jnp.int32(0))
+
+
+# Shared per-slice kernels — the compile_mesh_* entry points and the fused
+# compile_mesh_step all build from these, so the standalone kernels and
+# the fused step cannot drift apart.
+
+def _count_one_slice(tree, num_leaves, keys, words, idxs):
+    """Fused tree-eval + popcount for one slice's pool.
+
+    int32: a global count saturates at 2^31-1 set bits (~2.1B); the JAX
+    default config has no device int64. Callers needing beyond that
+    aggregate per-slice counts host-side in Python ints."""
+    pool = _local_pools(keys, words)
+    leaves = tuple((pool, idxs[i]) for i in range(num_leaves))
+    blk = eval_tree(tree, leaves)
+    return lax.population_count(blk).astype(jnp.int32).sum()
+
+
+def _row_counts_one_slice(num_rows, keys, words):
+    """Per-dense-row popcounts for one slice's pool (segment-sum by
+    key >> 4)."""
+    per_container = lax.population_count(words).sum(axis=1, dtype=jnp.int32)
+    valid = keys != INVALID_KEY
+    dense = jnp.where(valid, keys // ROW_SPAN, num_rows)
+    return jax.ops.segment_sum(
+        jnp.where(valid, per_container, 0), dense,
+        num_segments=num_rows + 1)[:num_rows]
+
+
+def _apply_writes_one_slice(words, slot, word, mask):
+    """Scatter a planned write batch into one slice's words.
+
+    Scatter-max, not scatter-set: padding entries are (slot=0, mask=0)
+    no-ops that may collide with a real write's target, and
+    set-with-duplicates keeps an arbitrary one. cur|mask >= cur
+    numerically, so max() keeps the real update."""
+    cur = words[slot, word]
+    return words.at[slot, word].max(cur | mask)
+
+
+# -- fused count over the mesh ----------------------------------------------
+
+def compile_mesh_count(mesh: Mesh, tree_shape, num_leaves: int):
+    """Jit a Count over a bitmap-op tree for a mesh-sharded index.
+
+    Returns fn(sharded_index, leaf_dense_ids (num_leaves,) int32) -> int32
+    replicated global count. Per-shard: evaluate the tree on every local
+    slice (vmap), popcount-sum, then psum over the slice axis (ICI).
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    one_slice = partial(_count_one_slice, tree, num_leaves)
+
+    def per_shard(keys, words, idxs):
+        counts = jax.vmap(one_slice, in_axes=(0, 0, None))(keys, words, idxs)
+        return lax.psum(counts.sum(), SLICE_AXIS)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS), P(SLICE_AXIS), P()),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex, leaf_ids):
+        return fn(index.keys, index.words, leaf_ids)
+
+    return run
+
+
+# -- exact TopN over the mesh ------------------------------------------------
+
+def compile_mesh_topn(mesh: Mesh, num_rows: int, k: int):
+    """Jit an EXACT TopN: global per-row popcounts + replicated top_k.
+
+    Returns fn(sharded_index) -> (counts (k,) int32, dense_row_ids (k,)).
+    """
+    one_slice = partial(_row_counts_one_slice, num_rows)
+
+    def per_shard(keys, words):
+        local = jax.vmap(one_slice)(keys, words).sum(axis=0)
+        total = lax.psum(local, SLICE_AXIS)
+        vals, ids = lax.top_k(total, k)
+        return vals, ids
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS), P(SLICE_AXIS)),
+        out_specs=(P(), P()),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex):
+        return fn(index.keys, index.words)
+
+    return run
+
+
+# -- device-side write application -------------------------------------------
+
+def plan_writes(keys: np.ndarray, row_ids: np.ndarray,
+                slice_writes: List[Tuple[np.ndarray, np.ndarray]],
+                batch: int):
+    """Host-side write planning: (row, col) batches per slice →
+    (slot, word, mask) scatter plans with OR-combined duplicates.
+
+    The device applies bits only into containers already present in the
+    pool (SURVEY.md §7 "mutation on device" hard part: host buffers
+    writes, device applies them as one scatter per step; container
+    allocation stays a host responsibility). Unknown rows/containers are
+    dropped — callers must ensure containers exist (import path does).
+    Returns (slot (S,B), word (S,B), mask (S,B)) int32/uint32, padded
+    with no-op (slot=0, mask=0) entries. Raises ValueError when a
+    slice's distinct scatter targets exceed `batch` — a partial write
+    must never be applied silently.
+    """
+    s = keys.shape[0]
+    slot = np.zeros((s, batch), dtype=np.int32)
+    word = np.zeros((s, batch), dtype=np.int32)
+    mask = np.zeros((s, batch), dtype=np.uint32)
+    for si, (rows, cols) in enumerate(slice_writes):
+        if rows is None or len(rows) == 0 or len(row_ids) == 0:
+            continue
+        rows = np.asarray(rows, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64) % np.uint64(SLICE_WIDTH)
+        dense = np.searchsorted(row_ids, rows)
+        ok = (dense < len(row_ids)) & (row_ids[np.minimum(dense, len(row_ids) - 1)] == rows)
+        pos = rows * np.uint64(SLICE_WIDTH) + cols
+        key = (dense * ROW_SPAN + ((pos >> np.uint64(16)) & np.uint64(15)).astype(np.int64)).astype(np.int32)
+        sl = np.searchsorted(keys[si], key)
+        ok &= (sl < keys.shape[1]) & (keys[si][np.minimum(sl, keys.shape[1] - 1)] == key)
+        wd = ((pos & np.uint64(0xFFFF)) >> np.uint64(5)).astype(np.int32)
+        mk = (np.uint32(1) << (pos & np.uint64(31)).astype(np.uint32))
+        sl, wd, mk = sl[ok], wd[ok], mk[ok]
+        # OR-combine duplicates so the device scatter has unique targets.
+        flat = sl.astype(np.int64) * CONTAINER_WORDS + wd
+        order = np.argsort(flat, kind="stable")
+        flat, sl, wd, mk = flat[order], sl[order], wd[order], mk[order]
+        uniq, start = np.unique(flat, return_index=True)
+        combined = np.bitwise_or.reduceat(mk, start) if len(mk) else mk
+        if len(uniq) > batch:
+            raise ValueError(
+                f"slice {si}: {len(uniq)} scatter targets exceed write "
+                f"batch {batch}; split the write batch")
+        n = len(uniq)
+        slot[si, :n] = sl[start][:n]
+        word[si, :n] = wd[start][:n]
+        mask[si, :n] = combined[:n]
+    return slot, word, mask
+
+
+def compile_mesh_apply_writes(mesh: Mesh):
+    """Jit the per-step scatter-OR of planned writes into the sharded
+    pools. Write plans have unique (slot, word) targets per slice
+    (plan_writes), so gather-OR-scatter is exact."""
+
+    def per_shard(keys, words, slot, word, mask):
+        return keys, jax.vmap(_apply_writes_one_slice)(words, slot, word, mask)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS),) * 5,
+        out_specs=(P(SLICE_AXIS), P(SLICE_AXIS)),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex, slot, word, mask):
+        keys, words = fn(index.keys, index.words, slot, word, mask)
+        return ShardedIndex(keys=keys, words=words)
+
+    return run
+
+
+def compile_mesh_step(mesh: Mesh, tree_shape, num_leaves: int,
+                      num_rows: int, k: int):
+    """The full per-step pipeline as ONE jitted shard_map: apply a
+    planned write batch to the sharded pools, evaluate a fused count
+    query, and compute the exact global TopN — write scatter, query
+    dataflow, and both ICI reductions in a single XLA program. This is
+    the multi-chip "training step" the driver dry-runs
+    (__graft_entry__.dryrun_multichip).
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    count_one = partial(_count_one_slice, tree, num_leaves)
+    rows_one = partial(_row_counts_one_slice, num_rows)
+
+    def per_shard(keys, words, slot, word, mask, leaf_ids):
+        # 1. writes
+        words = jax.vmap(_apply_writes_one_slice)(words, slot, word, mask)
+
+        # 2. fused count query over the updated pools
+        count = lax.psum(
+            jax.vmap(count_one, in_axes=(0, 0, None))(keys, words, leaf_ids).sum(),
+            SLICE_AXIS)
+
+        # 3. exact TopN over all rows
+        totals = lax.psum(jax.vmap(rows_one)(keys, words).sum(axis=0), SLICE_AXIS)
+        top_vals, top_ids = lax.top_k(totals, k)
+        return keys, words, count, top_vals, top_ids
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS),) * 5 + (P(),),
+        out_specs=(P(SLICE_AXIS), P(SLICE_AXIS), P(), P(), P()),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex, slot, word, mask, leaf_ids):
+        keys, words, count, top_vals, top_ids = fn(
+            index.keys, index.words, slot, word, mask, leaf_ids)
+        return ShardedIndex(keys=keys, words=words), count, top_vals, top_ids
+
+    return run
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the first n (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (SLICE_AXIS,))

@@ -48,29 +48,34 @@ def _tree_signature(node) -> object:
     return walk(node)
 
 
+def eval_tree(tree, leaves):
+    """Evaluate a nested op-shape list over leaf (pool, dense_idx) pairs,
+    returning the combined (16, 2048) uint32 block. Shared by the
+    per-slice jitted path here and the mesh-sharded path
+    (parallel.mesh)."""
+    if tree[0] == "leaf":
+        pool, dense_idx = leaves[tree[1]]
+        return gather_row(pool, dense_idx)
+    vals = [eval_tree(c, leaves) for c in tree[1:]]
+    op = tree[0]
+    acc = vals[0]
+    for v in vals[1:]:
+        if op == "and":
+            acc = acc & v
+        elif op == "or":
+            acc = acc | v
+        else:  # andnot
+            acc = acc & ~v
+    return acc
+
+
 @functools.lru_cache(maxsize=256)
 def _compiled_count(sig: str):
     """Build + jit the evaluator for one tree shape."""
     tree = json.loads(sig)
 
-    def eval_node(node, leaves):
-        if node[0] == "leaf":
-            pool, dense_idx = leaves[node[1]]
-            return gather_row(pool, dense_idx)
-        vals = [eval_node(c, leaves) for c in node[1:]]
-        op = node[0]
-        acc = vals[0]
-        for v in vals[1:]:
-            if op == "and":
-                acc = acc & v
-            elif op == "or":
-                acc = acc | v
-            else:  # andnot
-                acc = acc & ~v
-        return acc
-
     def count(leaves):
-        blk = eval_node(tree, leaves)
+        blk = eval_tree(tree, leaves)
         return jax.lax.population_count(blk).astype(jnp.int32).sum()
 
     return jax.jit(count)

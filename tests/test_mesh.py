@@ -1,0 +1,169 @@
+"""Mesh-sharded execution tests on the 8-device virtual CPU mesh
+(conftest.py), the analog of the reference's in-process multi-node
+cluster tests (/root/reference/client_test.go createCluster)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.parallel import (
+    build_sharded_index,
+    compile_mesh_apply_writes,
+    compile_mesh_count,
+    compile_mesh_topn,
+    default_mesh,
+    plan_writes,
+)
+
+
+def make_bitmaps(num_slices, bits_by_slice):
+    """bits_by_slice: {slice: [(row, slice-local col)]} -> list of Bitmaps."""
+    out = []
+    for s in range(num_slices):
+        b = Bitmap()
+        for row, col in bits_by_slice.get(s, []):
+            b.add(row * SLICE_WIDTH + col)
+        out.append(b)
+    return out
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return default_mesh()
+
+
+def test_sharded_count_matches_host(mesh):
+    rng = np.random.default_rng(42)
+    num_slices = 8
+    bits = {}
+    expect_a = expect_b = 0
+    host_sets = {10: set(), 11: set()}
+    for s in range(num_slices):
+        pairs = []
+        for row in (10, 11):
+            cols = rng.choice(SLICE_WIDTH, size=500, replace=False)
+            pairs += [(row, int(c)) for c in cols]
+            host_sets[row] |= {s * SLICE_WIDTH + int(c) for c in cols}
+        bits[s] = pairs
+    bitmaps = make_bitmaps(num_slices, bits)
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+
+    # Count(Bitmap(10)), Count(Intersect(10, 11)), Count(Union),
+    # Count(Difference) — vs host set arithmetic.
+    def dense(r):
+        return int(np.searchsorted(row_ids, np.uint64(r)))
+
+    leaf = compile_mesh_count(mesh, ["leaf"], 1)
+    assert int(leaf(idx, np.int32([dense(10)]))) == len(host_sets[10])
+
+    pair = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
+    ids = np.int32([dense(10), dense(11)])
+    assert int(pair(idx, ids)) == len(host_sets[10] & host_sets[11])
+
+    union = compile_mesh_count(mesh, ["or", ["leaf"], ["leaf"]], 2)
+    assert int(union(idx, ids)) == len(host_sets[10] | host_sets[11])
+
+    diff = compile_mesh_count(mesh, ["andnot", ["leaf"], ["leaf"]], 2)
+    assert int(diff(idx, ids)) == len(host_sets[10] - host_sets[11])
+
+
+def test_sharded_count_absent_row_is_zero(mesh):
+    bitmaps = make_bitmaps(8, {0: [(5, 1)]})
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    fn = compile_mesh_count(mesh, ["leaf"], 1)
+    # Dense index past the row table gathers all-zero.
+    assert int(fn(idx, np.int32([len(row_ids)]))) == 0
+
+
+def test_sharded_topn_exact(mesh):
+    # Rows with known global cardinalities spread across slices.
+    bits = {}
+    for s in range(8):
+        bits[s] = [(0, c) for c in range(10)] + [(1, c) for c in range(3)]
+    bits[3] += [(2, c) for c in range(100, 400)]
+    bitmaps = make_bitmaps(8, bits)
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    fn = compile_mesh_topn(mesh, num_rows=len(row_ids), k=2)
+    counts, dense_ids = fn(idx)
+    top = [(int(row_ids[i]), int(c)) for c, i in zip(counts, dense_ids)]
+    assert top == [(2, 300), (0, 80)]
+
+
+def test_mesh_apply_writes_then_count(mesh):
+    # Seed containers for rows 0 and 1 on every slice, then apply a write
+    # batch on device and recount.
+    bits = {s: [(0, 0), (1, 0)] for s in range(8)}
+    bitmaps = make_bitmaps(8, bits)
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+
+    keys_host = np.asarray(idx.keys)
+    writes = [(np.array([0, 0, 1], dtype=np.uint64),
+               np.array([s * SLICE_WIDTH + 5, s * SLICE_WIDTH + 5,
+                         s * SLICE_WIDTH + 9], dtype=np.uint64))
+              for s in range(8)]
+    slot, word, mask = plan_writes(keys_host, row_ids, writes, batch=4)
+    apply_fn = compile_mesh_apply_writes(mesh)
+    idx2 = apply_fn(idx, slot, word, mask)
+
+    count = compile_mesh_count(mesh, ["leaf"], 1)
+    # Row 0: col 0 + col 5 per slice (duplicate write OR-combined) = 16.
+    assert int(count(idx2, np.int32([0]))) == 16
+    assert int(count(idx2, np.int32([1]))) == 16
+    # Original index unchanged (functional update).
+    assert int(count(idx, np.int32([0]))) == 8
+
+
+def test_slice_padding_to_mesh_multiple(mesh):
+    # 5 slices pad to 8 for an 8-device mesh; padded slices are empty.
+    bitmaps = make_bitmaps(5, {0: [(7, 3)], 4: [(7, 9)]})
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    assert idx.num_slices == 8
+    fn = compile_mesh_count(mesh, ["leaf"], 1)
+    assert int(fn(idx, np.int32([0]))) == 2
+
+
+def test_plan_writes_overflow_raises(mesh):
+    bitmaps = make_bitmaps(8, {s: [(0, 0)] for s in range(8)})
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    keys_host = np.asarray(idx.keys)
+    # 5 distinct words in one container > batch=4 must raise, not truncate.
+    writes = [(np.zeros(5, dtype=np.uint64),
+               np.arange(5, dtype=np.uint64) * 32)] + [(None, None)] * 7
+    with pytest.raises(ValueError, match="exceed write batch"):
+        plan_writes(keys_host, row_ids, writes, batch=4)
+
+
+def test_plan_writes_empty_row_table(mesh):
+    bitmaps = make_bitmaps(8, {})
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    assert len(row_ids) == 0
+    keys_host = np.asarray(idx.keys)
+    writes = [(np.array([3], dtype=np.uint64), np.array([1], dtype=np.uint64))] \
+        + [(None, None)] * 7
+    slot, word, mask = plan_writes(keys_host, row_ids, writes, batch=2)
+    assert not mask.any()  # unknown rows dropped, no crash
+
+
+def test_mesh_step_matches_separate_kernels(mesh):
+    from pilosa_tpu.parallel import compile_mesh_step
+    bits = {s: [(0, 0), (1, 0), (1, 5)] for s in range(8)}
+    bitmaps = make_bitmaps(8, bits)
+    idx, row_ids = build_sharded_index(bitmaps, mesh)
+    keys_host = np.asarray(idx.keys)
+    writes = [(np.array([0], dtype=np.uint64),
+               np.array([5], dtype=np.uint64)) for _ in range(8)]
+    slot, word, mask = plan_writes(keys_host, row_ids, writes, batch=2)
+
+    step = compile_mesh_step(mesh, ["and", ["leaf"], ["leaf"]], 2,
+                             num_rows=len(row_ids), k=2)
+    idx2, count, top_vals, top_ids = step(idx, slot, word, mask,
+                                          np.int32([0, 1]))
+    # Separate kernels over the separately-applied writes must agree.
+    applied = compile_mesh_apply_writes(mesh)(idx, slot, word, mask)
+    cnt2 = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)(
+        applied, np.int32([0, 1]))
+    tv, ti = compile_mesh_topn(mesh, num_rows=len(row_ids), k=2)(applied)
+    assert int(count) == int(cnt2) == 16  # {0,5} ∩ {0,5} per slice
+    assert list(map(int, top_vals)) == list(map(int, tv))
+    assert list(map(int, top_ids)) == list(map(int, ti))
