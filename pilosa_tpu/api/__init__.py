@@ -1,0 +1,15 @@
+"""HTTP API layer: router/handlers, internal client, node server.
+
+The reference's L5 (handler.go, client.go) re-designed around a
+transport-agnostic core: `Handler.handle()` maps (method, path, params,
+headers, body) -> (status, headers, body) with no socket anywhere, so
+tests drive it directly (the httptest.NewRecorder pattern,
+SURVEY.md §4.8) and `serve()` adapts it onto a stdlib threading HTTP
+server.
+"""
+
+from .handler import Handler, Response
+from .client import InternalClient
+from .server import APIServer, serve
+
+__all__ = ["Handler", "Response", "InternalClient", "APIServer", "serve"]

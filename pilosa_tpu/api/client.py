@@ -1,0 +1,244 @@
+"""InternalClient: node-to-node HTTP client (parity with
+/root/reference/client.go).
+
+Carries the three RPC planes (SURVEY.md §5): query fan-out
+(execute_query with remote=True — the Executor.exec seam), bulk import,
+and anti-entropy (fragment blocks / block data / attr diffs) plus
+backup/restore streaming. Everything is stdlib urllib; wire bodies are
+the pilosa_tpu.wire protobufs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..errors import PilosaError
+from ..wire import pb, result_from_proto, PROTOBUF_CT
+
+
+class ClientError(PilosaError):
+    """Transport or remote-side failure of an internal RPC."""
+
+
+def _host_url(host: str) -> str:
+    if "://" not in host:
+        host = "http://" + host
+    return host.rstrip("/")
+
+
+class InternalClient:
+    """HTTP client bound to one remote node."""
+
+    def __init__(self, host: str, timeout: float = 30.0):
+        self.host = _host_url(host)
+        self.timeout = timeout
+
+    # -- low level -----------------------------------------------------------
+
+    def _do(self, method: str, path: str,
+            params: Optional[dict] = None, body: bytes = b"",
+            content_type: str = "", accept: str = "") -> Tuple[int, bytes]:
+        url = self.host + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, data=body or None, method=method)
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        if accept:
+            req.add_header("Accept", accept)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, OSError) as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+
+    def _check(self, status: int, data: bytes, what: str):
+        if status >= 400:
+            try:
+                msg = json.loads(data.decode()).get("error", "")
+            except Exception:
+                msg = data[:200].decode(errors="replace")
+            raise ClientError(f"{what}: status={status} {msg}")
+
+    # -- query plane ---------------------------------------------------------
+
+    def execute_query(self, node, index: str, query: str,
+                      slices: Sequence[int], remote: bool = True) -> list:
+        """POST /index/{i}/query with protobuf QueryRequest, PQL
+        re-serialized to a string (executor.go:1000-1083). `node` is
+        accepted for interface parity with the executor seam; this
+        client is already bound to one host."""
+        req = pb.QueryRequest(query=query, remote=remote)
+        req.slices.extend(int(s) for s in slices)
+        status, data = self._do(
+            "POST", f"/index/{index}/query", body=req.SerializeToString(),
+            content_type=PROTOBUF_CT, accept=PROTOBUF_CT)
+        resp = pb.QueryResponse()
+        try:
+            resp.ParseFromString(data)
+        except Exception:
+            self._check(status, data, "query")
+            raise
+        if resp.err:
+            raise ClientError(resp.err)
+        self._check(status, data, "query")
+        return [result_from_proto(r) for r in resp.results]
+
+    # -- import plane --------------------------------------------------------
+
+    def import_bits(self, index: str, frame: str, slice_: int,
+                    row_ids: Sequence[int], column_ids: Sequence[int],
+                    timestamps: Optional[Sequence[int]] = None):
+        """POST /import protobuf ImportRequest (client.go:304-390)."""
+        req = pb.ImportRequest(index=index, frame=frame, slice=slice_)
+        req.row_ids.extend(int(r) for r in row_ids)
+        req.column_ids.extend(int(c) for c in column_ids)
+        if timestamps:
+            req.timestamps.extend(int(t) for t in timestamps)
+        status, data = self._do("POST", "/import",
+                                body=req.SerializeToString(),
+                                content_type=PROTOBUF_CT)
+        self._check(status, data, "import")
+
+    def export_csv(self, index: str, frame: str, view: str,
+                   slice_: int) -> str:
+        status, data = self._do("GET", "/export", params={
+            "index": index, "frame": frame, "view": view, "slice": slice_})
+        self._check(status, data, "export")
+        return data.decode()
+
+    # -- schema / status -----------------------------------------------------
+
+    def schema(self) -> List[dict]:
+        status, data = self._do("GET", "/schema")
+        self._check(status, data, "schema")
+        return json.loads(data.decode())["indexes"]
+
+    def max_slices(self, inverse: bool = False) -> Dict[str, int]:
+        params = {"inverse": "true"} if inverse else None
+        status, data = self._do("GET", "/slices/max", params=params)
+        self._check(status, data, "slices/max")
+        return {k: int(v)
+                for k, v in json.loads(data.decode())["maxSlices"].items()}
+
+    def frame_views(self, index: str, frame: str) -> List[str]:
+        status, data = self._do("GET", f"/index/{index}/frame/{frame}/views")
+        self._check(status, data, "views")
+        return json.loads(data.decode())["views"]
+
+    def fragment_nodes(self, index: str, slice_: int) -> List[dict]:
+        status, data = self._do("GET", "/fragment/nodes",
+                                params={"index": index, "slice": slice_})
+        self._check(status, data, "fragment/nodes")
+        return json.loads(data.decode())
+
+    def node_status(self) -> pb.NodeStatus:
+        """GET /internal/status — gossip-lite state pull."""
+        status, data = self._do("GET", "/internal/status")
+        self._check(status, data, "internal/status")
+        msg = pb.NodeStatus()
+        msg.ParseFromString(data)
+        return msg
+
+    def send_message(self, data: bytes):
+        """POST a framed broadcast message to /internal/message."""
+        status, resp = self._do("POST", "/internal/message", body=data,
+                                content_type="application/octet-stream")
+        self._check(status, resp, "internal/message")
+
+    # -- anti-entropy plane --------------------------------------------------
+
+    def fragment_blocks(self, index: str, frame: str, view: str,
+                        slice_: int) -> List[Tuple[int, bytes]]:
+        """GET /fragment/blocks -> [(block id, checksum)]
+        (client.go:798)."""
+        status, data = self._do("GET", "/fragment/blocks", params={
+            "index": index, "frame": frame, "view": view, "slice": slice_})
+        self._check(status, data, "fragment/blocks")
+        return [(int(b["id"]), bytes.fromhex(b["checksum"]))
+                for b in json.loads(data.decode())["blocks"]]
+
+    def block_data(self, index: str, frame: str, view: str, slice_: int,
+                   block: int) -> Tuple[List[int], List[int]]:
+        """GET /fragment/block/data -> (row_ids, column_ids)
+        (client.go:849-888)."""
+        req = pb.BlockDataRequest(index=index, frame=frame, view=view,
+                                  slice=slice_, block=block)
+        status, data = self._do("GET", "/fragment/block/data",
+                                body=req.SerializeToString(),
+                                content_type=PROTOBUF_CT, accept=PROTOBUF_CT)
+        self._check(status, data, "fragment/block/data")
+        resp = pb.BlockDataResponse()
+        resp.ParseFromString(data)
+        return list(resp.row_ids), list(resp.column_ids)
+
+    def column_attr_diff(self, index: str,
+                         blocks: List[Tuple[int, bytes]]) -> Dict[int, dict]:
+        return self._attr_diff(f"/index/{index}/attr/diff", blocks)
+
+    def row_attr_diff(self, index: str, frame: str,
+                      blocks: List[Tuple[int, bytes]]) -> Dict[int, dict]:
+        return self._attr_diff(f"/index/{index}/frame/{frame}/attr/diff",
+                               blocks)
+
+    def _attr_diff(self, path: str,
+                   blocks: List[Tuple[int, bytes]]) -> Dict[int, dict]:
+        body = json.dumps({"blocks": [{"id": bid, "checksum": cs.hex()}
+                                      for bid, cs in blocks]}).encode()
+        status, data = self._do("POST", path, body=body,
+                                content_type="application/json")
+        self._check(status, data, "attr/diff")
+        return {int(k): v
+                for k, v in json.loads(data.decode())["attrs"].items()}
+
+    # -- backup / restore ----------------------------------------------------
+
+    def fragment_data(self, index: str, frame: str, view: str,
+                      slice_: int) -> Optional[bytes]:
+        """GET /fragment/data tar; None when the fragment doesn't exist
+        (client.go BackupSlice 404 handling)."""
+        status, data = self._do("GET", "/fragment/data", params={
+            "index": index, "frame": frame, "view": view, "slice": slice_})
+        if status == 404:
+            return None
+        self._check(status, data, "fragment/data")
+        return data
+
+    def restore_fragment(self, index: str, frame: str, view: str,
+                         slice_: int, tar_bytes: bytes):
+        status, data = self._do("POST", "/fragment/data", params={
+            "index": index, "frame": frame, "view": view, "slice": slice_},
+            body=tar_bytes, content_type="application/octet-stream")
+        self._check(status, data, "fragment/data")
+
+    def backup_frame(self, index: str, frame: str, view: str,
+                     max_slice: int) -> List[Tuple[int, bytes]]:
+        """Pull every existing fragment tar of a (frame, view)
+        (client.go BackupTo 463-545)."""
+        out = []
+        for s in range(max_slice + 1):
+            data = self.fragment_data(index, frame, view, s)
+            if data is not None:
+                out.append((s, data))
+        return out
+
+    def create_index(self, index: str, **options):
+        body = json.dumps({"options": options}).encode() if options else b"{}"
+        status, data = self._do("POST", f"/index/{index}", body=body,
+                                content_type="application/json")
+        if status != 409:
+            self._check(status, data, "create index")
+
+    def create_frame(self, index: str, frame: str, **options):
+        body = json.dumps({"options": options}).encode() if options else b"{}"
+        status, data = self._do("POST", f"/index/{index}/frame/{frame}",
+                                body=body, content_type="application/json")
+        if status != 409:
+            self._check(status, data, "create frame")

@@ -1,0 +1,652 @@
+"""HTTP route table + handlers (parity with /root/reference/handler.go).
+
+Routes (reference handler.go:81-121):
+
+    GET    /                                     WebUI console
+    GET    /index                                list indexes (schema)
+    GET    /index/{index}                        index info
+    POST   /index/{index}                        create index
+    DELETE /index/{index}                        delete index
+    POST   /index/{index}/attr/diff              column-attr anti-entropy diff
+    PATCH  /index/{index}/time-quantum           set index time quantum
+    POST   /index/{index}/query                  PQL query (JSON or protobuf)
+    POST   /index/{index}/frame/{frame}          create frame
+    DELETE /index/{index}/frame/{frame}          delete frame
+    POST   /index/{index}/frame/{frame}/attr/diff   row-attr diff
+    POST   /index/{index}/frame/{frame}/restore  pull frame data from a host
+    PATCH  /index/{index}/frame/{frame}/time-quantum
+    GET    /index/{index}/frame/{frame}/views    list view names
+    GET    /export                               fragment as CSV
+    GET    /fragment/data                        fragment tar (backup)
+    POST   /fragment/data                        fragment tar (restore)
+    GET    /fragment/blocks                      block checksums
+    GET    /fragment/block/data                  block row/col pairs (protobuf)
+    GET    /fragment/nodes                       replica nodes for a slice
+    POST   /import                               bulk import (protobuf)
+    GET    /hosts                                cluster hosts
+    GET    /schema                               full schema
+    GET    /slices/max                           per-index max slice
+    GET    /status                               cluster status
+    GET    /version
+    GET    /debug/vars                           stats snapshot
+    POST   /internal/message                     broadcast receive (this
+                                                 framework's internal plane —
+                                                 replaces the reference's
+                                                 separate internal port)
+    GET    /internal/status                      NodeStatus exchange
+                                                 (gossip-lite pull)
+
+Content negotiation: `Content-Type: application/x-protobuf` request
+bodies and `Accept: application/x-protobuf` responses use the wire
+messages; everything else is JSON (handler.go:811,873 readQueryRequest /
+writeQueryResponse).
+"""
+
+from __future__ import annotations
+
+import binascii
+import io
+import json
+import re
+from datetime import datetime, timezone
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from ..core.attr import diff_blocks
+from ..core.row import Row
+from ..core.timequantum import parse_time_quantum
+from ..errors import (
+    FragmentNotFoundError,
+    FrameExistsError,
+    FrameNotFoundError,
+    IndexExistsError,
+    IndexNotFoundError,
+    PilosaError,
+    QueryError,
+)
+from ..pql import Parser, ParseError
+from ..executor import ExecOptions
+from ..utils.stats import ExpvarStats
+from ..wire import (
+    attrs_from_proto,
+    attrs_to_proto,
+    pb,
+    result_to_proto,
+    unmarshal_message,
+)
+
+VERSION = "0.1.0"
+
+PROTOBUF = "application/x-protobuf"
+
+_WEBUI_PAGE = """<!doctype html>
+<html><head><title>pilosa-tpu console</title><style>
+body{font-family:monospace;margin:2em;max-width:60em}
+textarea,input{font-family:monospace;width:100%}
+pre{background:#f4f4f4;padding:1em;overflow:auto}
+</style></head><body>
+<h1>pilosa-tpu</h1>
+<p>index: <input id="idx" value="i"></p>
+<p><textarea id="q" rows="4">Count(Bitmap(id=1, frame=general))</textarea></p>
+<p><button onclick="run()">query</button></p>
+<pre id="out"></pre>
+<script>
+async function run(){
+  const r = await fetch('/index/'+document.getElementById('idx').value+'/query',
+    {method:'POST', body:document.getElementById('q').value});
+  document.getElementById('out').textContent =
+    JSON.stringify(await r.json(), null, 2);
+}
+</script></body></html>"""
+
+
+class Response(NamedTuple):
+    status: int
+    headers: Dict[str, str]
+    body: bytes
+
+    def json(self):
+        return json.loads(self.body.decode() or "null")
+
+
+def _json_resp(obj, status: int = 200) -> Response:
+    return Response(status, {"Content-Type": "application/json"},
+                    (json.dumps(obj) + "\n").encode())
+
+
+def _proto_resp(msg, status: int = 200) -> Response:
+    return Response(status, {"Content-Type": PROTOBUF}, msg.SerializeToString())
+
+
+def _error_status(err: Exception) -> int:
+    if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
+                        FragmentNotFoundError)):
+        return 404
+    if isinstance(err, (IndexExistsError, FrameExistsError)):
+        return 409
+    if isinstance(err, (QueryError, ParseError, ValueError, KeyError)):
+        return 400
+    return 500
+
+
+class Route(NamedTuple):
+    method: str
+    pattern: re.Pattern
+    fn: Callable
+
+
+class Handler:
+    """Transport-agnostic request handler bound to a Holder + Executor.
+
+    `executor` needs `.execute(index, query, slices, opt) -> list`.
+    Tests may swap it for a fake (the HandlerExecutor.ExecuteFn seam,
+    reference handler_test.go:822-826).
+    """
+
+    def __init__(self, holder, executor, cluster=None, host: str = "",
+                 broadcaster=None, broadcast_handler=None,
+                 status_handler=None, client_factory=None, stats=None,
+                 logger=None):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.host = host
+        # Outbound schema-change notifications (handler.go:366-639).
+        self.broadcaster = broadcaster
+        # Receives unmarshalled broadcast messages (server.ReceiveMessage).
+        self.broadcast_handler = broadcast_handler
+        # Provides local_status() for /internal/status and /status.
+        self.status_handler = status_handler
+        # client_factory(host) -> InternalClient, used by frame restore.
+        self.client_factory = client_factory
+        self.stats = stats if stats is not None else ExpvarStats()
+        self.logger = logger
+        self.version = VERSION
+        self._routes: List[Route] = []
+        r = self._add_route
+        r("GET", r"/", self._get_webui)
+        r("GET", r"/index", self._get_indexes)
+        r("GET", r"/index/(?P<index>[^/]+)", self._get_index)
+        r("POST", r"/index/(?P<index>[^/]+)", self._post_index)
+        r("DELETE", r"/index/(?P<index>[^/]+)", self._delete_index)
+        r("POST", r"/index/(?P<index>[^/]+)/attr/diff", self._post_index_attr_diff)
+        r("PATCH", r"/index/(?P<index>[^/]+)/time-quantum",
+          self._patch_index_time_quantum)
+        r("POST", r"/index/(?P<index>[^/]+)/query", self._post_query)
+        r("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)",
+          self._post_frame)
+        r("DELETE", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)",
+          self._delete_frame)
+        r("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff",
+          self._post_frame_attr_diff)
+        r("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore",
+          self._post_frame_restore)
+        r("PATCH", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum",
+          self._patch_frame_time_quantum)
+        r("GET", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views",
+          self._get_frame_views)
+        r("GET", r"/export", self._get_export)
+        r("GET", r"/fragment/data", self._get_fragment_data)
+        r("POST", r"/fragment/data", self._post_fragment_data)
+        r("GET", r"/fragment/blocks", self._get_fragment_blocks)
+        r("GET", r"/fragment/block/data", self._get_fragment_block_data)
+        r("GET", r"/fragment/nodes", self._get_fragment_nodes)
+        r("POST", r"/import", self._post_import)
+        r("GET", r"/hosts", self._get_hosts)
+        r("GET", r"/schema", self._get_schema)
+        r("GET", r"/slices/max", self._get_slice_max)
+        r("GET", r"/status", self._get_status)
+        r("GET", r"/version", self._get_version)
+        r("GET", r"/debug/vars", self._get_expvar)
+        r("POST", r"/internal/message", self._post_internal_message)
+        r("GET", r"/internal/status", self._get_internal_status)
+
+    def _add_route(self, method: str, pattern: str, fn: Callable):
+        self._routes.append(Route(method, re.compile("^" + pattern + "$"), fn))
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(self, method: str, path: str,
+               params: Optional[Dict[str, str]] = None,
+               headers: Optional[Dict[str, str]] = None,
+               body: bytes = b"") -> Response:
+        params = params or {}
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        path_matched = False
+        for route in self._routes:
+            m = route.pattern.match(path)
+            if m is None:
+                continue
+            path_matched = True
+            if route.method != method:
+                continue
+            try:
+                return route.fn(m.groupdict(), params, headers, body)
+            except PilosaError as e:
+                return _json_resp({"error": str(e)}, _error_status(e))
+            except (ValueError, KeyError, TypeError, binascii.Error) as e:
+                return _json_resp({"error": str(e) or type(e).__name__}, 400)
+            except Exception as e:  # noqa: BLE001 — never drop the connection
+                return _json_resp(
+                    {"error": f"internal error: {type(e).__name__}: {e}"}, 500)
+        if path_matched:
+            return _json_resp({"error": "method not allowed"}, 405)
+        return _json_resp({"error": "not found"}, 404)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _accepts_proto(self, headers) -> bool:
+        return PROTOBUF in headers.get("accept", "")
+
+    def _sends_proto(self, headers) -> bool:
+        return PROTOBUF in headers.get("content-type", "")
+
+    def _fragment_args(self, params):
+        index = params["index"]
+        frame = params["frame"]
+        view = params.get("view", "standard")
+        slice_ = int(params["slice"])
+        return index, frame, view, slice_
+
+    # -- webui / misc --------------------------------------------------------
+
+    def _get_webui(self, pv, params, headers, body) -> Response:
+        return Response(200, {"Content-Type": "text/html"},
+                        _WEBUI_PAGE.encode())
+
+    def _get_version(self, pv, params, headers, body) -> Response:
+        return _json_resp({"version": self.version})
+
+    def _get_expvar(self, pv, params, headers, body) -> Response:
+        snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        return _json_resp(snap)
+
+    def _get_hosts(self, pv, params, headers, body) -> Response:
+        nodes = self.cluster.nodes if self.cluster else []
+        return _json_resp([n.to_dict() for n in nodes])
+
+    def _get_status(self, pv, params, headers, body) -> Response:
+        """Cluster status: this node's status plus last-known peer states."""
+        if self.status_handler is None:
+            return _json_resp({"error": "status not supported"}, 501)
+        status = self.status_handler.cluster_status()
+        if self._accepts_proto(headers):
+            return _proto_resp(status)
+        return _json_resp(_cluster_status_to_dict(status))
+
+    # -- schema --------------------------------------------------------------
+
+    def _get_schema(self, pv, params, headers, body) -> Response:
+        return _json_resp({"indexes": self.holder.schema()})
+
+    def _get_indexes(self, pv, params, headers, body) -> Response:
+        return self._get_schema(pv, params, headers, body)
+
+    def _get_slice_max(self, pv, params, headers, body) -> Response:
+        maxes = self.holder.max_slices()
+        if params.get("inverse") == "true":
+            maxes = self.holder.max_inverse_slices()
+        if self._accepts_proto(headers):
+            msg = pb.MaxSlicesResponse()
+            for k, v in maxes.items():
+                msg.max_slices[k] = v
+            return _proto_resp(msg)
+        return _json_resp({"maxSlices": maxes})
+
+    def _get_index(self, pv, params, headers, body) -> Response:
+        idx = self.holder.index(pv["index"])
+        if idx is None:
+            raise IndexNotFoundError()
+        return _json_resp({"index": idx.to_dict()})
+
+    def _post_index(self, pv, params, headers, body) -> Response:
+        opts = _decode_options(body, {"columnLabel": "column_label",
+                                      "timeQuantum": "time_quantum"})
+        idx = self.holder.create_index(pv["index"], **opts)
+        if self.broadcaster is not None:
+            self.broadcaster.send_sync(pb.CreateIndexMessage(
+                index=idx.name, meta=pb.IndexMeta(
+                    column_label=idx.column_label,
+                    time_quantum=str(idx.time_quantum))))
+        return _json_resp({})
+
+    def _delete_index(self, pv, params, headers, body) -> Response:
+        self.holder.delete_index(pv["index"])
+        if self.broadcaster is not None:
+            self.broadcaster.send_sync(
+                pb.DeleteIndexMessage(index=pv["index"]))
+        return _json_resp({})
+
+    def _patch_index_time_quantum(self, pv, params, headers, body) -> Response:
+        q = json.loads(body.decode() or "{}").get("timeQuantum", "")
+        idx = self.holder.index(pv["index"])
+        if idx is None:
+            raise IndexNotFoundError()
+        idx.set_time_quantum(parse_time_quantum(q))
+        return _json_resp({})
+
+    def _post_frame(self, pv, params, headers, body) -> Response:
+        opts = _decode_options(body, {
+            "rowLabel": "row_label", "inverseEnabled": "inverse_enabled",
+            "cacheType": "cache_type", "cacheSize": "cache_size",
+            "timeQuantum": "time_quantum"})
+        idx = self.holder.index(pv["index"])
+        if idx is None:
+            raise IndexNotFoundError()
+        f = idx.create_frame(pv["frame"], **opts)
+        if self.broadcaster is not None:
+            self.broadcaster.send_sync(pb.CreateFrameMessage(
+                index=idx.name, frame=f.name, meta=pb.FrameMeta(
+                    row_label=f.row_label,
+                    inverse_enabled=f.inverse_enabled,
+                    cache_type=f.cache_type, cache_size=f.cache_size,
+                    time_quantum=str(f.time_quantum))))
+        return _json_resp({})
+
+    def _delete_frame(self, pv, params, headers, body) -> Response:
+        idx = self.holder.index(pv["index"])
+        if idx is None:
+            raise IndexNotFoundError()
+        idx.delete_frame(pv["frame"])
+        if self.broadcaster is not None:
+            self.broadcaster.send_sync(pb.DeleteFrameMessage(
+                index=pv["index"], frame=pv["frame"]))
+        return _json_resp({})
+
+    def _patch_frame_time_quantum(self, pv, params, headers, body) -> Response:
+        q = json.loads(body.decode() or "{}").get("timeQuantum", "")
+        f = self.holder.frame(pv["index"], pv["frame"])
+        if f is None:
+            raise FrameNotFoundError()
+        f.set_time_quantum(parse_time_quantum(q))
+        return _json_resp({})
+
+    def _get_frame_views(self, pv, params, headers, body) -> Response:
+        f = self.holder.frame(pv["index"], pv["frame"])
+        if f is None:
+            raise FrameNotFoundError()
+        return _json_resp({"views": sorted(f.views.keys())})
+
+    # -- query ---------------------------------------------------------------
+
+    def _post_query(self, pv, params, headers, body) -> Response:
+        index = pv["index"]
+        # Read request: protobuf QueryRequest or raw PQL + URL params
+        # (reference readQueryRequest, handler.go:811-871).
+        if self._sends_proto(headers):
+            req = pb.QueryRequest()
+            req.ParseFromString(body)
+            query, slices = req.query, list(req.slices)
+            column_attrs, remote = req.column_attrs, req.remote
+        else:
+            query = body.decode()
+            slices = [int(s) for s in params.get("slices", "").split(",")
+                      if s != ""]
+            column_attrs = params.get("columnAttrs") == "true"
+            remote = False
+
+        try:
+            q = Parser(query).parse()
+            results = self.executor.execute(
+                index, q, slices or None, ExecOptions(remote=remote))
+        except PilosaError as e:
+            return self._query_error(e, headers)
+        except ParseError as e:
+            return self._query_error(e, headers)
+
+        col_sets = []
+        if column_attrs:
+            col_sets = self._column_attr_sets(index, results)
+
+        if self._accepts_proto(headers):
+            resp = pb.QueryResponse()
+            resp.results.extend(result_to_proto(r) for r in results)
+            for cid, attrs in col_sets:
+                cs = resp.column_attr_sets.add()
+                cs.id = cid
+                cs.attrs.extend(attrs_to_proto(attrs))
+            return _proto_resp(resp)
+
+        out = {"results": [_result_to_json(r) for r in results]}
+        if column_attrs:
+            out["columnAttrs"] = [{"id": cid, "attrs": attrs}
+                                  for cid, attrs in col_sets]
+        return _json_resp(out)
+
+    def _query_error(self, e, headers) -> Response:
+        if self._accepts_proto(headers):
+            return _proto_resp(pb.QueryResponse(err=str(e)), 400)
+        return _json_resp({"error": str(e)}, 400)
+
+    def _column_attr_sets(self, index: str, results) -> List[Tuple[int, dict]]:
+        """Attrs for every column appearing in row results
+        (handler.go handlePostQuery columnAttrSets)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            return []
+        seen = set()
+        out = []
+        for r in results:
+            if not isinstance(r, Row):
+                continue
+            for col in r.columns():
+                col = int(col)
+                if col in seen:
+                    continue
+                seen.add(col)
+                attrs = idx.column_attr_store.attrs(col)
+                if attrs:
+                    out.append((col, attrs))
+        out.sort()
+        return out
+
+    # -- import / export -----------------------------------------------------
+
+    def _post_import(self, pv, params, headers, body) -> Response:
+        req = pb.ImportRequest()
+        req.ParseFromString(body)
+        # Validate ownership of the slice (handler.go:931).
+        if self.cluster is not None and self.host:
+            if not self.cluster.owns_fragment(self.host, req.index, req.slice):
+                return _json_resp(
+                    {"error": f"host does not own slice {req.slice}"}, 412)
+        idx = self.holder.index(req.index)
+        if idx is None:
+            raise IndexNotFoundError()
+        f = idx.frame(req.frame)
+        if f is None:
+            raise FrameNotFoundError()
+        timestamps = None
+        if len(req.timestamps):
+            timestamps = [
+                datetime.fromtimestamp(t, timezone.utc).replace(tzinfo=None)
+                if t else None
+                for t in req.timestamps]
+        f.import_bits(list(req.row_ids), list(req.column_ids), timestamps)
+        if self._accepts_proto(headers):
+            return _proto_resp(pb.ImportResponse())
+        return _json_resp({})
+
+    def _get_export(self, pv, params, headers, body) -> Response:
+        index, frame, view, slice_ = self._fragment_args(params)
+        frag = self.holder.fragment(index, frame, view, slice_)
+        if frag is None:
+            raise FragmentNotFoundError()
+        buf = io.StringIO()
+        for row_id, col_id in frag.for_each_bit():
+            buf.write(f"{row_id},{col_id}\n")
+        return Response(200, {"Content-Type": "text/csv"},
+                        buf.getvalue().encode())
+
+    # -- fragment data plane -------------------------------------------------
+
+    def _get_fragment_nodes(self, pv, params, headers, body) -> Response:
+        index = params["index"]
+        slice_ = int(params["slice"])
+        nodes = (self.cluster.fragment_nodes(index, slice_)
+                 if self.cluster else [])
+        return _json_resp([n.to_dict() for n in nodes])
+
+    def _get_fragment_data(self, pv, params, headers, body) -> Response:
+        index, frame, view, slice_ = self._fragment_args(params)
+        frag = self.holder.fragment(index, frame, view, slice_)
+        if frag is None:
+            raise FragmentNotFoundError()
+        buf = io.BytesIO()
+        frag.write_to_tar(buf)
+        return Response(200, {"Content-Type": "application/octet-stream"},
+                        buf.getvalue())
+
+    def _post_fragment_data(self, pv, params, headers, body) -> Response:
+        index, frame, view, slice_ = self._fragment_args(params)
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise FrameNotFoundError()
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(slice_)
+        frag.read_from_tar(io.BytesIO(body))
+        return _json_resp({})
+
+    def _get_fragment_blocks(self, pv, params, headers, body) -> Response:
+        index, frame, view, slice_ = self._fragment_args(params)
+        frag = self.holder.fragment(index, frame, view, slice_)
+        if frag is None:
+            raise FragmentNotFoundError()
+        blocks = [{"id": bid, "checksum": cs.hex()}
+                  for bid, cs in frag.blocks()]
+        return _json_resp({"blocks": blocks})
+
+    def _get_fragment_block_data(self, pv, params, headers, body) -> Response:
+        req = pb.BlockDataRequest()
+        if body:
+            req.ParseFromString(body)
+        else:
+            req.index = params["index"]
+            req.frame = params["frame"]
+            req.view = params.get("view", "standard")
+            req.slice = int(params["slice"])
+            req.block = int(params["block"])
+        frag = self.holder.fragment(req.index, req.frame, req.view, req.slice)
+        if frag is None:
+            raise FragmentNotFoundError()
+        rows, cols = frag.block_data(req.block)
+        resp = pb.BlockDataResponse()
+        resp.row_ids.extend(int(r) for r in rows)
+        resp.column_ids.extend(int(c) for c in cols)
+        if self._accepts_proto(headers):
+            return _proto_resp(resp)
+        return _json_resp({"rowIDs": [int(r) for r in rows],
+                           "columnIDs": [int(c) for c in cols]})
+
+    # -- attr diff (anti-entropy) -------------------------------------------
+
+    def _post_index_attr_diff(self, pv, params, headers, body) -> Response:
+        idx = self.holder.index(pv["index"])
+        if idx is None:
+            raise IndexNotFoundError()
+        return self._attr_diff(idx.column_attr_store, body)
+
+    def _post_frame_attr_diff(self, pv, params, headers, body) -> Response:
+        f = self.holder.frame(pv["index"], pv["frame"])
+        if f is None:
+            raise FrameNotFoundError()
+        return self._attr_diff(f.row_attr_store, body)
+
+    def _attr_diff(self, store, body: bytes) -> Response:
+        """The requester sends its block checksums; respond with every
+        attr in OUR blocks the requester is missing or disagrees on
+        (handler.go attr/diff + attr.go Diff: diff is taken from the
+        requester's perspective against this node's store)."""
+        req = json.loads(body.decode() or "{}")
+        requester = [(int(b["id"]), bytes.fromhex(b["checksum"]))
+                     for b in req.get("blocks", [])]
+        ids = diff_blocks(requester, store.blocks())
+        attrs = {}
+        for bid in ids:
+            attrs.update({str(k): v
+                          for k, v in store.block_data(bid).items()})
+        return _json_resp({"attrs": attrs})
+
+    # -- restore -------------------------------------------------------------
+
+    def _post_frame_restore(self, pv, params, headers, body) -> Response:
+        """Pull every fragment of a frame from a remote host
+        (handler.go:1180 handlePostFrameRestore)."""
+        host = params.get("host")
+        if not host:
+            return _json_resp({"error": "host required"}, 400)
+        if self.client_factory is None:
+            return _json_resp({"error": "restore not supported"}, 501)
+        index, frame = pv["index"], pv["frame"]
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise FrameNotFoundError()
+        client = self.client_factory(host)
+        maxes = client.max_slices()
+        inverse_maxes = client.max_slices(inverse=True)
+        for view_name in client.frame_views(index, frame):
+            v = f.create_view_if_not_exists(view_name)
+            # Inverse views are sliced over row-space, standard/time
+            # views over column-space — each has its own max.
+            from ..core.view import is_inverse_view
+            n = (inverse_maxes if is_inverse_view(view_name)
+                 else maxes).get(index, 0)
+            for slice_ in range(n + 1):
+                data = client.fragment_data(index, frame, view_name, slice_)
+                if data is None:
+                    continue
+                frag = v.create_fragment_if_not_exists(slice_)
+                frag.read_from_tar(io.BytesIO(data))
+        return _json_resp({})
+
+    # -- internal control plane ---------------------------------------------
+
+    def _post_internal_message(self, pv, params, headers, body) -> Response:
+        if self.broadcast_handler is None:
+            return _json_resp({"error": "broadcast not supported"}, 501)
+        msg = unmarshal_message(body)
+        self.broadcast_handler.receive_message(msg)
+        return _json_resp({})
+
+    def _get_internal_status(self, pv, params, headers, body) -> Response:
+        if self.status_handler is None:
+            return _json_resp({"error": "status not supported"}, 501)
+        status = self.status_handler.local_status()
+        return _proto_resp(status)
+
+
+# ---- JSON encoding of results ----------------------------------------------
+
+def _result_to_json(result):
+    if isinstance(result, Row):
+        return {"attrs": result.attrs,
+                "bits": [int(c) for c in result.columns()]}
+    if isinstance(result, list):
+        return [{"id": int(k), "count": int(n)} for k, n in result]
+    return result  # int, bool, or None
+
+
+def _decode_options(body: bytes, mapping: Dict[str, str]) -> dict:
+    doc = json.loads(body.decode() or "{}")
+    if not isinstance(doc, dict):
+        raise ValueError("request body must be a JSON object")
+    raw = doc.get("options", {})
+    if not isinstance(raw, dict):
+        raise ValueError("options must be a JSON object")
+    out = {}
+    for k, v in raw.items():
+        if k not in mapping:
+            raise ValueError(f"unknown option: {k}")
+        out[mapping[k]] = v
+    return out
+
+
+def _cluster_status_to_dict(status) -> dict:
+    return {"nodes": [{
+        "host": n.host,
+        "state": n.state,
+        "indexes": [{
+            "name": i.name,
+            "maxSlice": i.max_slice,
+            "frames": [f.name for f in i.frames],
+        } for i in n.indexes],
+    } for n in status.nodes]}

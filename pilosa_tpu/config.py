@@ -1,0 +1,114 @@
+"""Node configuration (parity with /root/reference/config.go).
+
+TOML schema:
+
+    data-dir = "~/.pilosa_tpu"
+    host = "localhost:10101"
+    log-path = ""
+
+    [cluster]
+    replicas = 1
+    partitions = 16
+    hosts = ["localhost:10101"]
+    polling-interval = "60s"
+
+    [anti-entropy]
+    interval = "10m"
+
+Defaults match the reference (port 10101, 1 replica, 16 partitions,
+10-minute anti-entropy, 60-second status polling). Durations accept Go
+style strings ("10m", "60s", "1h30m").
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+from typing import List, Optional
+
+from .parallel.cluster import DEFAULT_PARTITION_N, DEFAULT_REPLICA_N
+
+DEFAULT_HOST = "localhost:10101"
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
+DEFAULT_POLLING_INTERVAL = 60.0
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")
+_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+
+
+def parse_duration(s) -> float:
+    """Go-style duration string -> seconds ("10m", "1h30m", "250ms");
+    bare numbers are seconds."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = s.strip()
+    if not s:
+        return 0.0
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {s!r}")
+        total += float(m.group(1)) * _UNIT_S[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration: {s!r}")
+    return total
+
+
+class Config:
+    def __init__(self):
+        self.data_dir: str = "~/.pilosa_tpu"
+        self.host: str = DEFAULT_HOST
+        self.log_path: str = ""
+        self.cluster_hosts: List[str] = [DEFAULT_HOST]
+        self.replica_n: int = DEFAULT_REPLICA_N
+        self.partition_n: int = DEFAULT_PARTITION_N
+        self.polling_interval: float = DEFAULT_POLLING_INTERVAL
+        self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
+
+    @classmethod
+    def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
+        if is_text:
+            data = tomllib.loads(path_or_text)
+        else:
+            with open(path_or_text, "rb") as f:
+                data = tomllib.load(f)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Config":
+        c = cls()
+        c.data_dir = data.get("data-dir", c.data_dir)
+        c.host = data.get("host", c.host)
+        c.log_path = data.get("log-path", c.log_path)
+        cl = data.get("cluster", {})
+        c.cluster_hosts = list(cl.get("hosts", [])) or [c.host]
+        c.replica_n = int(cl.get("replicas", c.replica_n))
+        c.partition_n = int(cl.get("partitions", c.partition_n))
+        if "polling-interval" in cl:
+            c.polling_interval = parse_duration(cl["polling-interval"])
+        ae = data.get("anti-entropy", {})
+        if "interval" in ae:
+            c.anti_entropy_interval = parse_duration(ae["interval"])
+        return c
+
+    def expanded_data_dir(self) -> str:
+        return os.path.expanduser(self.data_dir)
+
+    def to_toml(self) -> str:
+        """Default-config printer (`pilosa config`, ctl/config.go)."""
+        hosts = ", ".join(f'"{h}"' for h in self.cluster_hosts)
+        return (
+            f'data-dir = "{self.data_dir}"\n'
+            f'host = "{self.host}"\n'
+            f'log-path = "{self.log_path}"\n'
+            f"\n[cluster]\n"
+            f"replicas = {self.replica_n}\n"
+            f"partitions = {self.partition_n}\n"
+            f"hosts = [{hosts}]\n"
+            f'polling-interval = "{int(self.polling_interval)}s"\n'
+            f"\n[anti-entropy]\n"
+            f'interval = "{int(self.anti_entropy_interval)}s"\n'
+        )

@@ -11,6 +11,7 @@ import os
 import shutil
 from typing import Dict, List, Optional
 
+from ..errors import IndexExistsError
 from ..utils import NopStats
 from .index import Index
 
@@ -53,7 +54,7 @@ class Holder:
 
     def create_index(self, name: str, **options) -> Index:
         if name in self.indexes:
-            raise ValueError(f"index already exists: {name}")
+            raise IndexExistsError()
         return self._create_index(name, **options)
 
     def create_index_if_not_exists(self, name: str, **options) -> Index:

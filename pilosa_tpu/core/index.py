@@ -12,6 +12,7 @@ import os
 import shutil
 from typing import Dict, Optional
 
+from ..errors import FrameExistsError
 from ..utils import validate_label, validate_name
 from .attr import AttrStore
 from .frame import Frame
@@ -118,7 +119,7 @@ class Index:
 
     def create_frame(self, name: str, **options) -> Frame:
         if name in self.frames:
-            raise ValueError(f"frame already exists: {name}")
+            raise FrameExistsError()
         return self._create_frame(name, **options)
 
     def create_frame_if_not_exists(self, name: str, **options) -> Frame:

@@ -1,0 +1,232 @@
+"""Active anti-entropy: HolderSyncer + FragmentSyncer.
+
+Parity with /root/reference/holder.go:364-562 and fragment.go:1300-1481:
+walk every index/frame/view/slice this node owns; diff attr-store block
+checksums and fragment block checksums against every replica; pull
+divergent block data, majority-merge, and push SetBit/ClearBit PQL
+diffs back to the remotes that are missing consensus bits.
+
+`client_factory(host)` yields an InternalClient (or any object with the
+same attr-diff / fragment-blocks / block-data / execute_query surface —
+tests inject fakes, the mockable-collective-layer pattern from
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .view import VIEW_INVERSE, VIEW_STANDARD
+
+
+class Closing:
+    """Cooperative cancellation flag shared with the server's close path
+    (reference closing chan semantics)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def close(self):
+        self._event.set()
+
+    @property
+    def closed(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float) -> bool:
+        return self._event.wait(timeout)
+
+
+class FragmentSyncer:
+    """Syncs one fragment across its replica set
+    (fragment.go:1300-1481)."""
+
+    def __init__(self, fragment, host: str, nodes,
+                 client_factory: Callable, closing: Optional[Closing] = None,
+                 logger=None, row_label: str = "rowID",
+                 column_label: str = "columnID"):
+        self.fragment = fragment
+        self.host = host
+        self.nodes = nodes  # replica owner Nodes incl. self
+        self.client_factory = client_factory
+        self.closing = closing or Closing()
+        self.logger = logger
+        # The frame's actual labels (the reference hardcodes the
+        # defaults, fragment.go:1462-1466, which breaks custom labels —
+        # deliberately fixed here).
+        self.row_label = row_label
+        self.column_label = column_label
+
+    def _peers(self) -> List[str]:
+        return [n.host for n in self.nodes if n.host != self.host]
+
+    def sync_fragment(self):
+        """Compare block checksums across replicas; merge every block
+        that differs anywhere (fragment.go:1320-1399)."""
+        f = self.fragment
+        local = dict(f.blocks())
+        remote_sets = []
+        for host in self._peers():
+            if self.closing.closed:
+                return
+            client = self.client_factory(host)
+            remote_sets.append((host, dict(client.fragment_blocks(
+                f.index, f.frame, f.view, f.slice))))
+
+        # Block ids where any replica disagrees with local (either side
+        # missing, or checksums differ).
+        dirty = set()
+        for _, blocks in remote_sets:
+            for bid, cs in blocks.items():
+                if local.get(bid) != cs:
+                    dirty.add(bid)
+            for bid, cs in local.items():
+                if blocks.get(bid) != cs:
+                    dirty.add(bid)
+
+        for bid in sorted(dirty):
+            if self.closing.closed:
+                return
+            self.sync_block(bid)
+
+    def sync_block(self, block_id: int):
+        """Majority-merge one block and push diffs to remotes
+        (fragment.go:1401-1481)."""
+        f = self.fragment
+        peers = self._peers()
+        data = []
+        for host in peers:
+            client = self.client_factory(host)
+            rows, cols = client.block_data(
+                f.index, f.frame, f.view, f.slice, block_id)
+            data.append((rows, cols))
+
+        diffs = f.merge_block(block_id, data)
+
+        # Push consensus diffs to each remote as SetBit/ClearBit PQL —
+        # only for the standard view, whose orientation SetBit speaks
+        # (fragment.go:1458-1477 "Only sync the standard block"; other
+        # views converge via their own local merges on each replica).
+        if f.view != VIEW_STANDARD:
+            return
+        base = f.slice * _slice_width()
+        for host, ((set_rows, set_cols), (clear_rows, clear_cols)) in zip(
+                peers, diffs):
+            calls = []
+            for r, c in zip(set_rows, set_cols):
+                calls.append(self._bit_pql("SetBit", int(r), base + int(c)))
+            for r, c in zip(clear_rows, clear_cols):
+                calls.append(self._bit_pql("ClearBit", int(r), base + int(c)))
+            if not calls:
+                continue
+            client = self.client_factory(host)
+            client.execute_query(None, f.index, "".join(calls), [],
+                                 remote=True)
+
+    def _bit_pql(self, name: str, row_id: int, column_id: int) -> str:
+        f = self.fragment
+        return (f"{name}(frame={f.frame!r}, {self.row_label}={row_id}, "
+                f"{self.column_label}={column_id})")
+
+
+class HolderSyncer:
+    """Cluster-wide anti-entropy walk (holder.go:364-562)."""
+
+    def __init__(self, holder, host: str, cluster,
+                 client_factory: Callable, closing: Optional[Closing] = None,
+                 logger=None):
+        self.holder = holder
+        self.host = host
+        self.cluster = cluster
+        self.client_factory = client_factory
+        self.closing = closing or Closing()
+        self.logger = logger
+
+    def _log(self, msg: str):
+        if self.logger is not None:
+            self.logger.info(msg)
+
+    def sync_holder(self):
+        """Walk the schema, syncing attrs and owned fragments
+        (holder.go:385-436)."""
+        for index_name in sorted(self.holder.indexes):
+            if self.closing.closed:
+                return
+            idx = self.holder.index(index_name)
+            if idx is None:
+                continue
+            self.sync_index(idx)
+            max_slices = {
+                VIEW_STANDARD: idx.max_slice(),
+                VIEW_INVERSE: idx.max_inverse_slice(),
+            }
+            for frame_name in sorted(idx.frames):
+                f = idx.frame(frame_name)
+                if f is None:
+                    continue
+                self.sync_frame(index_name, f)
+                for view in list(f.views.values()):
+                    is_inv = view.name == VIEW_INVERSE or \
+                        view.name.startswith(VIEW_INVERSE + "_")
+                    limit = max_slices[VIEW_INVERSE if is_inv
+                                       else VIEW_STANDARD]
+                    for slice_ in range(limit + 1):
+                        if self.closing.closed:
+                            return
+                        if not self.cluster.owns_fragment(
+                                self.host, index_name, slice_):
+                            continue
+                        self.sync_fragment(index_name, f.name, view.name,
+                                           slice_)
+
+    def sync_index(self, idx):
+        """Column-attr block diff against every other node
+        (holder.go:439-481)."""
+        self._sync_attrs(idx.column_attr_store,
+                         lambda client, blocks:
+                         client.column_attr_diff(idx.name, blocks))
+
+    def sync_frame(self, index_name: str, frame):
+        """Row-attr block diff (holder.go:484-528)."""
+        self._sync_attrs(frame.row_attr_store,
+                         lambda client, blocks:
+                         client.row_attr_diff(index_name, frame.name, blocks))
+
+    def _sync_attrs(self, store, diff_fn):
+        for node in self.cluster.nodes:
+            if node.host == self.host or self.closing.closed:
+                continue
+            client = self.client_factory(node.host)
+            try:
+                attrs = diff_fn(client, store.blocks())
+            except Exception as e:  # noqa: BLE001 — skip unreachable peers
+                self._log(f"attr sync with {node.host} failed: {e}")
+                continue
+            if attrs:
+                store.set_bulk_attrs(attrs)
+
+    def sync_fragment(self, index: str, frame: str, view: str, slice_: int):
+        """Ensure the fragment exists locally, then replica-sync it
+        (holder.go:531-562)."""
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(slice_)
+        nodes = self.cluster.fragment_nodes(index, slice_)
+        idx = self.holder.index(index)
+        syncer = FragmentSyncer(frag, self.host, nodes,
+                                self.client_factory, self.closing,
+                                self.logger, row_label=f.row_label,
+                                column_label=idx.column_label)
+        try:
+            syncer.sync_fragment()
+        except Exception as e:  # noqa: BLE001 — sync is best-effort
+            self._log(f"fragment sync {index}/{frame}/{view}/{slice_} "
+                      f"failed: {e}")
+
+
+def _slice_width() -> int:
+    from .. import SLICE_WIDTH
+    return SLICE_WIDTH

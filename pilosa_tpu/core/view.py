@@ -87,11 +87,10 @@ class View:
         is_new_max = self.fragments and slice_ > self.max_slice() or not self.fragments and slice_ > 0
         frag = self._open_fragment(slice_)
         if is_new_max and self.broadcaster is not None:
-            self.broadcaster.send_async({
-                "type": "create-slice",
-                "index": self.index,
-                "slice": slice_,
-            })
+            from ..wire import pb
+            self.broadcaster.send_async(pb.CreateSliceMessage(
+                index=self.index, slice=slice_,
+                is_inverse=is_inverse_view(self.name)))
         return frag
 
     def set_bit(self, row_id: int, column_id: int) -> bool:

@@ -10,6 +10,13 @@ Two planes, mirroring SURVEY.md §2.4/§5:
     (psum) instead of the reference's HTTP mapReduce merge.
 """
 
+from .broadcast import (
+    Broadcaster,
+    HTTPBroadcaster,
+    NodeSet,
+    NopBroadcaster,
+    StaticNodeSet,
+)
 from .cluster import (
     DEFAULT_PARTITION_N,
     DEFAULT_REPLICA_N,
@@ -20,18 +27,28 @@ from .cluster import (
     Node,
     NODE_STATE_DOWN,
     NODE_STATE_UP,
+    new_test_cluster,
 )
-from .mesh import (
-    SLICE_AXIS,
-    ShardedIndex,
-    build_sharded_index,
-    compile_mesh_apply_writes,
-    compile_mesh_count,
-    compile_mesh_step,
-    compile_mesh_topn,
-    default_mesh,
-    plan_writes,
+# The mesh module pulls in jax; load it lazily so host-only paths
+# (config, CLI utilities, pure-HTTP nodes) import fast.
+_MESH_NAMES = (
+    "SLICE_AXIS",
+    "ShardedIndex",
+    "build_sharded_index",
+    "compile_mesh_apply_writes",
+    "compile_mesh_count",
+    "compile_mesh_step",
+    "compile_mesh_topn",
+    "default_mesh",
+    "plan_writes",
 )
+
+
+def __getattr__(name):
+    if name in _MESH_NAMES:
+        from . import mesh
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "SLICE_AXIS",
@@ -43,6 +60,12 @@ __all__ = [
     "compile_mesh_topn",
     "default_mesh",
     "plan_writes",
+    "Broadcaster",
+    "HTTPBroadcaster",
+    "NodeSet",
+    "NopBroadcaster",
+    "StaticNodeSet",
+    "new_test_cluster",
     "DEFAULT_PARTITION_N",
     "DEFAULT_REPLICA_N",
     "Cluster",

@@ -1,0 +1,114 @@
+"""Broadcast plane: schema/slice change propagation between nodes.
+
+Parity with /root/reference/broadcast.go + httpbroadcast/: a
+`Broadcaster` sends typed wire messages (CreateSlice / CreateIndex /
+DeleteIndex / CreateFrame / DeleteFrame) to peers; a `BroadcastHandler`
+(the Server) applies received ones. Transport is the node's own HTTP
+API (`POST /internal/message` with the 1-byte-tag framing) — this
+framework folds the reference's separate internal port and memberlist
+gossip into one plane; liveness comes from the status-poll daemon
+(server.py) instead of gossip probes.
+
+send_sync  = deliver to every peer now, surfacing errors (reference
+             GossipNodeSet.SendSync direct TCP, gossip.go:124-149).
+send_async = fire-and-forget on worker threads (TransmitLimitedQueue
+             analog, gossip.go:152-164).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
+
+from ..wire import marshal_message
+
+
+class Broadcaster:
+    """Interface (broadcast.go:61-64)."""
+
+    def send_sync(self, msg) -> None:
+        raise NotImplementedError
+
+    def send_async(self, msg) -> None:
+        raise NotImplementedError
+
+
+class NopBroadcaster(Broadcaster):
+    def send_sync(self, msg) -> None:
+        pass
+
+    def send_async(self, msg) -> None:
+        pass
+
+
+class NodeSet:
+    """Interface: the set of peer hosts (broadcast.go:26-32)."""
+
+    def nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class StaticNodeSet(NodeSet):
+    """Fixed host list from config (broadcast.go:35-58)."""
+
+    def __init__(self, hosts: Optional[Sequence[str]] = None):
+        self._hosts = list(hosts or [])
+
+    def nodes(self) -> List[str]:
+        return list(self._hosts)
+
+    def join(self, hosts: Sequence[str]):
+        for h in hosts:
+            if h not in self._hosts:
+                self._hosts.append(h)
+
+
+class HTTPBroadcaster(Broadcaster):
+    """Delivers framed messages to every peer over the internal HTTP
+    plane (httpbroadcast/messenger.go:33-120).
+
+    `client_factory(host) -> client with .send_message(bytes)`;
+    `local_host` is excluded from delivery.
+    """
+
+    def __init__(self, node_set: NodeSet, local_host: str,
+                 client_factory: Callable, logger=None):
+        self.node_set = node_set
+        self.local_host = local_host
+        self.client_factory = client_factory
+        self.logger = logger
+
+    def _peers(self) -> List[str]:
+        return [h for h in self.node_set.nodes() if h != self.local_host]
+
+    def _send(self, host: str, data: bytes) -> Optional[Exception]:
+        try:
+            self.client_factory(host).send_message(data)
+            return None
+        except Exception as e:  # noqa: BLE001 — transport errors surface to caller
+            if self.logger is not None:
+                self.logger.warning(f"broadcast to {host} failed: {e}")
+            return e
+
+    def send_sync(self, msg) -> None:
+        data = marshal_message(msg)
+        peers = self._peers()
+        if not peers:
+            return
+        with ThreadPoolExecutor(max_workers=len(peers)) as pool:
+            for err in pool.map(lambda h: self._send(h, data), peers):
+                if err is not None:
+                    raise err
+
+    def send_async(self, msg) -> None:
+        data = marshal_message(msg)
+        for host in self._peers():
+            threading.Thread(target=self._send, args=(host, data),
+                             daemon=True).start()

@@ -40,12 +40,10 @@ class Node:
     def __init__(self, host: str, internal_host: str = ""):
         self.host = host
         self.internal_host = internal_host
-        self.status: Optional[dict] = None
+        self.state = NODE_STATE_UP
 
     def set_state(self, state: str):
-        if self.status is None:
-            self.status = {}
-        self.status["state"] = state
+        self.state = state
 
     def to_dict(self) -> dict:
         return {"host": self.host, "internalHost": self.internal_host}
@@ -116,7 +114,9 @@ class Cluster:
         live = set(self.node_set_hosts if self.node_set_hosts is not None
                    else self.hosts())
         return {
-            n.host: NODE_STATE_UP if n.host in live else NODE_STATE_DOWN
+            n.host: NODE_STATE_UP
+            if n.host in live and n.state == NODE_STATE_UP
+            else NODE_STATE_DOWN
             for n in self.nodes
         }
 
@@ -156,7 +156,8 @@ class Cluster:
         return out
 
     def status(self) -> dict:
-        return {"nodes": [n.status or {"host": n.host} for n in self.nodes]}
+        return {"nodes": [{"host": n.host, "state": n.state}
+                          for n in self.nodes]}
 
 
 def new_test_cluster(n: int) -> Cluster:

@@ -1,0 +1,273 @@
+"""Server: the node runtime (parity with /root/reference/server.go).
+
+Wires Config -> Holder + Cluster + Broadcaster + Executor + Handler +
+APIServer, applies received broadcast messages (schema + slice
+changes), exchanges NodeStatus with peers, and runs the background
+daemons:
+
+  - anti-entropy loop    (default 10 min; server.go:182-214)
+  - status poll loop     (default 60 s; replaces both the reference's
+                          maxSlice polling, server.go:217-252, and its
+                          memberlist gossip state sync: each tick pulls
+                          /internal/status from every peer, merges
+                          schema + remote max slices, and marks
+                          unreachable peers DOWN for query failover)
+  - cache flush loop     (1 min; holder.go:326-358)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from .api import APIServer, Handler, InternalClient
+from .config import Config
+from .core.holder import Holder
+from .core.syncer import Closing, HolderSyncer
+from .core.view import VIEW_INVERSE, VIEW_STANDARD
+from .executor import Executor
+from .parallel.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
+from .parallel.cluster import (
+    NODE_STATE_DOWN,
+    NODE_STATE_UP,
+    Cluster,
+    Node,
+)
+from .utils.stats import ExpvarStats
+from .wire import pb
+
+CACHE_FLUSH_INTERVAL = 60.0
+
+
+class ClusterClient:
+    """Routes executor remote calls to per-node InternalClients (the
+    reference passes node hosts into Client per call; here one routing
+    object satisfies the executor's client seam)."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self._clients: Dict[str, InternalClient] = {}
+        self._lock = threading.Lock()
+
+    def for_host(self, host: str) -> InternalClient:
+        with self._lock:
+            c = self._clients.get(host)
+            if c is None:
+                c = self._clients[host] = InternalClient(
+                    host, timeout=self.timeout)
+            return c
+
+    def execute_query(self, node, index, query, slices, remote=True):
+        return self.for_host(node.host).execute_query(
+            node, index, query, slices, remote=remote)
+
+
+class Server:
+    """One node: HTTP API + executor + daemons."""
+
+    def __init__(self, config: Optional[Config] = None, logger=None):
+        self.config = config or Config()
+        self.logger = logger or logging.getLogger("pilosa_tpu")
+        self.closing = Closing()
+
+        self.stats = ExpvarStats()
+        self.holder = Holder(self.config.expanded_data_dir(),
+                             stats=self.stats)
+        self.cluster = Cluster(
+            nodes=[Node(h) for h in self.config.cluster_hosts],
+            replica_n=self.config.replica_n,
+            partition_n=self.config.partition_n,
+        )
+        self.host = self.config.host
+        self.client = ClusterClient()
+
+        self.node_set = StaticNodeSet(self.config.cluster_hosts)
+        if len(self.config.cluster_hosts) > 1:
+            self.broadcaster = HTTPBroadcaster(
+                self.node_set, self.host, self.client.for_host,
+                logger=self.logger)
+        else:
+            self.broadcaster = NopBroadcaster()
+        self.holder.broadcaster = self.broadcaster
+
+        self.executor = Executor(self.holder, host=self.host,
+                                 cluster=self.cluster, client=self.client)
+        self.handler = Handler(
+            self.holder, self.executor, cluster=self.cluster,
+            host=self.host, broadcaster=self.broadcaster,
+            broadcast_handler=self, status_handler=self,
+            client_factory=self.client.for_host, stats=self.stats,
+            logger=self.logger)
+
+        self._api: Optional[APIServer] = None
+        self._threads: list = []
+        # Last NodeStatus seen per peer host (gossip-lite state).
+        self._peer_status: Dict[str, pb.NodeStatus] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def open(self, port: Optional[int] = None):
+        """Open holder + listener + daemons (server.go:89-154)."""
+        self.holder.open()
+        bind_host, _, bind_port = self.host.partition(":")
+        if port is None:
+            port = int(bind_port or 10101)
+        self._api = APIServer(self.handler, bind_host or "127.0.0.1", port,
+                              logger=self.logger)
+        # Rebind host to the actual listening address (port 0 support).
+        h, p = self._api.address
+        if port == 0:
+            self.host = f"{bind_host or h}:{p}"
+            node = self.cluster.node_by_host(self.config.host)
+            if node is not None:
+                node.host = self.host
+            self.executor.host = self.host
+            self.handler.host = self.host
+        self._api.start()
+
+        for name, fn, interval in [
+            ("anti-entropy", self._anti_entropy_tick,
+             self.config.anti_entropy_interval),
+            ("status-poll", self._status_poll_tick,
+             self.config.polling_interval),
+            ("cache-flush", self._cache_flush_tick, CACHE_FLUSH_INTERVAL),
+        ]:
+            t = threading.Thread(target=self._loop, name=name,
+                                 args=(fn, interval), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self):
+        self.closing.close()
+        if self._api is not None:
+            self._api.close()
+        self.holder.close()
+
+    def _loop(self, fn, interval: float):
+        while not self.closing.wait(interval):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — daemons never die
+                self.logger.warning(f"daemon error: {e}")
+
+    # -- daemons -------------------------------------------------------------
+
+    def _anti_entropy_tick(self):
+        if len(self.cluster.nodes) <= 1:
+            return
+        syncer = HolderSyncer(self.holder, self.host, self.cluster,
+                              self.client.for_host, self.closing,
+                              self.logger)
+        syncer.sync_holder()
+        self.stats.count("anti_entropy")
+
+    def _status_poll_tick(self):
+        """Pull NodeStatus from every peer; merge schema/max-slices;
+        track liveness."""
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            try:
+                status = self.client.for_host(node.host).node_status()
+            except Exception:  # noqa: BLE001 — unreachable peer
+                node.set_state(NODE_STATE_DOWN)
+                continue
+            node.set_state(NODE_STATE_UP)
+            self._peer_status[node.host] = status
+            self.handle_remote_status(status)
+
+    def _cache_flush_tick(self):
+        self.holder.flush_caches()
+
+    # -- BroadcastHandler (server.go:255-300) --------------------------------
+
+    def receive_message(self, msg):
+        if isinstance(msg, pb.CreateSliceMessage):
+            idx = self.holder.index(msg.index)
+            if idx is None:
+                raise ValueError(f"local index not found: {msg.index}")
+            if msg.is_inverse:
+                idx.set_remote_max_inverse_slice(msg.slice)
+            else:
+                idx.set_remote_max_slice(msg.slice)
+        elif isinstance(msg, pb.CreateIndexMessage):
+            self.holder.create_index_if_not_exists(
+                msg.index, column_label=msg.meta.column_label or "columnID",
+                time_quantum=msg.meta.time_quantum)
+        elif isinstance(msg, pb.DeleteIndexMessage):
+            self.holder.delete_index(msg.index)
+        elif isinstance(msg, pb.CreateFrameMessage):
+            idx = self.holder.index(msg.index)
+            if idx is None:
+                raise ValueError(f"local index not found: {msg.index}")
+            idx.create_frame_if_not_exists(
+                msg.frame, row_label=msg.meta.row_label or "rowID",
+                inverse_enabled=msg.meta.inverse_enabled,
+                cache_type=msg.meta.cache_type or "ranked",
+                cache_size=msg.meta.cache_size or 50000,
+                time_quantum=msg.meta.time_quantum)
+        elif isinstance(msg, pb.DeleteFrameMessage):
+            idx = self.holder.index(msg.index)
+            if idx is not None:
+                idx.delete_frame(msg.frame)
+        else:
+            raise ValueError(f"unknown message: {type(msg).__name__}")
+
+    # -- StatusHandler (server.go:306-387) -----------------------------------
+
+    def local_status(self) -> pb.NodeStatus:
+        ns = pb.NodeStatus(host=self.host, state=NODE_STATE_UP)
+        for info in self.holder.schema():
+            idx = self.holder.index(info["name"])
+            ii = ns.indexes.add()
+            ii.name = info["name"]
+            ii.meta.column_label = idx.column_label
+            ii.meta.time_quantum = str(idx.time_quantum)
+            ii.max_slice = idx.max_slice()
+            ii.max_inverse_slice = idx.max_inverse_slice()
+            for fi in info.get("frames", []):
+                f = idx.frame(fi["name"])
+                fr = ii.frames.add()
+                fr.name = fi["name"]
+                fr.meta.row_label = f.row_label
+                fr.meta.inverse_enabled = f.inverse_enabled
+                fr.meta.cache_type = f.cache_type
+                fr.meta.cache_size = f.cache_size
+                fr.meta.time_quantum = str(f.time_quantum)
+        return ns
+
+    def cluster_status(self) -> pb.ClusterStatus:
+        cs = pb.ClusterStatus()
+        cs.nodes.append(self.local_status())
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            st = self._peer_status.get(node.host)
+            if st is not None:
+                peer = cs.nodes.add()
+                peer.CopyFrom(st)
+                peer.state = node.state
+            else:
+                cs.nodes.add(host=node.host, state=node.state)
+        return cs
+
+    def handle_remote_status(self, status: pb.NodeStatus):
+        """Merge a peer's schema into the local holder
+        (server.go:357-387: auto-create remote indexes/frames, learn
+        remote max slices)."""
+        for ii in status.indexes:
+            idx = self.holder.create_index_if_not_exists(
+                ii.name,
+                column_label=ii.meta.column_label or "columnID",
+                time_quantum=ii.meta.time_quantum)
+            idx.set_remote_max_slice(ii.max_slice)
+            idx.set_remote_max_inverse_slice(ii.max_inverse_slice)
+            for fr in ii.frames:
+                idx.create_frame_if_not_exists(
+                    fr.name, row_label=fr.meta.row_label or "rowID",
+                    inverse_enabled=fr.meta.inverse_enabled,
+                    cache_type=fr.meta.cache_type or "ranked",
+                    cache_size=fr.meta.cache_size or 50000,
+                    time_quantum=fr.meta.time_quantum)

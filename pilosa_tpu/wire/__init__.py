@@ -1,0 +1,139 @@
+"""Wire layer: protobuf messages + converters + broadcast framing.
+
+The data plane (query RPC, import, block sync) and the control plane
+(broadcast messages, status sync) share one generated module,
+`pilosa_pb2`. Converters translate between executor-level Python values
+(Row / int / pairs / bool) and `QueryResult` messages; broadcast
+messages frame as a 1-byte type tag + serialized payload (reference
+broadcast.go:110-166).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from . import pilosa_pb2 as pb
+
+# Content type for protobuf request/response bodies.
+PROTOBUF_CT = "application/x-protobuf"
+
+# Attr value kinds (reference attr.go:35-40).
+ATTR_STRING = 1
+ATTR_INT = 2
+ATTR_BOOL = 3
+ATTR_FLOAT = 4
+
+# Broadcast message type tags (reference broadcast.go:110-116).
+MSG_CREATE_SLICE = 1
+MSG_CREATE_INDEX = 2
+MSG_DELETE_INDEX = 3
+MSG_CREATE_FRAME = 4
+MSG_DELETE_FRAME = 5
+
+_MSG_TYPES = {
+    MSG_CREATE_SLICE: pb.CreateSliceMessage,
+    MSG_CREATE_INDEX: pb.CreateIndexMessage,
+    MSG_DELETE_INDEX: pb.DeleteIndexMessage,
+    MSG_CREATE_FRAME: pb.CreateFrameMessage,
+    MSG_DELETE_FRAME: pb.DeleteFrameMessage,
+}
+_MSG_TAGS = {v: k for k, v in _MSG_TYPES.items()}
+
+
+# ---- attrs -----------------------------------------------------------------
+
+def attrs_to_proto(m: dict) -> List[pb.Attr]:
+    """dict -> sorted Attr list (bool checked before int: bool is int)."""
+    out = []
+    for k in sorted(m):
+        v = m[k]
+        a = pb.Attr(key=k)
+        if isinstance(v, bool):
+            a.kind, a.bool_value = ATTR_BOOL, v
+        elif isinstance(v, int):
+            a.kind, a.int_value = ATTR_INT, v
+        elif isinstance(v, float):
+            a.kind, a.float_value = ATTR_FLOAT, v
+        elif isinstance(v, str):
+            a.kind, a.string_value = ATTR_STRING, v
+        else:
+            raise TypeError(f"invalid attr type for {k!r}: {type(v).__name__}")
+        out.append(a)
+    return out
+
+
+def attrs_from_proto(attrs) -> dict:
+    out = {}
+    for a in attrs:
+        if a.kind == ATTR_STRING:
+            out[a.key] = a.string_value
+        elif a.kind == ATTR_INT:
+            out[a.key] = int(a.int_value)
+        elif a.kind == ATTR_BOOL:
+            out[a.key] = bool(a.bool_value)
+        elif a.kind == ATTR_FLOAT:
+            out[a.key] = float(a.float_value)
+    return out
+
+
+# ---- query results ---------------------------------------------------------
+
+def result_to_proto(result) -> pb.QueryResult:
+    """Executor result value -> QueryResult (handler writeQueryResponse
+    analog). Dispatch mirrors the executor's result types: Row for
+    bitmap calls, (id, count) pairs for TopN, int for Count, bool for
+    SetBit/ClearBit, None for attr writes."""
+    from ..core.row import Row
+
+    qr = pb.QueryResult()
+    if isinstance(result, Row):
+        qr.row.bits.extend(int(c) for c in result.columns())
+        qr.row.attrs.extend(attrs_to_proto(result.attrs))
+    elif isinstance(result, bool):
+        qr.changed = result
+    elif isinstance(result, int):
+        qr.n = result
+    elif isinstance(result, list):
+        qr.pairs.extend(pb.Pair(key=int(k), count=int(n)) for k, n in result)
+    elif result is not None:
+        raise TypeError(f"unserializable result: {type(result).__name__}")
+    return qr
+
+
+def result_from_proto(qr: pb.QueryResult):
+    """QueryResult -> executor-level value. The wire can't distinguish
+    Count(0) / SetBit(false) / empty-Row, so remote results normalize:
+    a result with no row/pairs decodes as an int (the reducers for
+    Count and SetBit treat ints and bools interchangeably)."""
+    from ..core.row import Row
+
+    if len(qr.row.bits) or len(qr.row.attrs):
+        row = Row(int(b) for b in qr.row.bits)
+        row.attrs = attrs_from_proto(qr.row.attrs)
+        return row
+    if len(qr.pairs):
+        return [(int(p.key), int(p.count)) for p in qr.pairs]
+    if qr.changed:
+        return True
+    return int(qr.n)
+
+
+# ---- broadcast framing -----------------------------------------------------
+
+def marshal_message(msg) -> bytes:
+    """1-byte type tag + protobuf payload (broadcast.go:119-140)."""
+    tag = _MSG_TAGS.get(type(msg))
+    if tag is None:
+        raise TypeError(f"message type not implemented: {type(msg).__name__}")
+    return bytes([tag]) + msg.SerializeToString()
+
+
+def unmarshal_message(data: bytes):
+    if not data:
+        raise ValueError("empty broadcast message")
+    cls = _MSG_TYPES.get(data[0])
+    if cls is None:
+        raise ValueError(f"invalid message type: {data[0]}")
+    msg = cls()
+    msg.ParseFromString(data[1:])
+    return msg

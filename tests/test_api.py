@@ -1,0 +1,291 @@
+"""HTTP handler tests, driven without sockets (the httptest.NewRecorder
+pattern, /root/reference/handler_test.go: every route exercised against
+a real Holder, JSON and protobuf)."""
+
+import json
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.api import Handler
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.parallel import new_test_cluster
+from pilosa_tpu.wire import PROTOBUF_CT, pb, marshal_message
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    cluster = new_test_cluster(1)
+    ex = Executor(holder, host=cluster.nodes[0].host, cluster=cluster,
+                  use_device=False)
+    handler = Handler(holder, ex, cluster=cluster,
+                      host=cluster.nodes[0].host)
+    yield holder, handler
+    holder.close()
+
+
+def post(handler, path, body=b"", **kw):
+    return handler.handle("POST", path, body=body, **kw)
+
+
+def seed(handler):
+    assert post(handler, "/index/i").status == 200
+    assert post(handler, "/index/i/frame/f").status == 200
+
+
+class TestSchemaRoutes:
+    def test_create_get_delete_index(self, env):
+        _, h = env
+        assert post(h, "/index/i",
+                    body=b'{"options":{"columnLabel":"cid"}}').status == 200
+        r = h.handle("GET", "/index/i")
+        assert r.json()["index"]["meta"]["columnLabel"] == "cid"
+        # duplicate -> 409
+        assert post(h, "/index/i").status == 409
+        assert h.handle("DELETE", "/index/i").status == 200
+        assert h.handle("GET", "/index/i").status == 404
+
+    def test_unknown_option_rejected(self, env):
+        _, h = env
+        r = post(h, "/index/i", body=b'{"options":{"bogus":1}}')
+        assert r.status == 400
+
+    def test_create_delete_frame(self, env):
+        _, h = env
+        post(h, "/index/i")
+        r = post(h, "/index/i/frame/f",
+                 body=b'{"options":{"inverseEnabled":true}}')
+        assert r.status == 200
+        assert post(h, "/index/i/frame/f").status == 409
+        assert h.handle("DELETE", "/index/i/frame/f").status == 200
+
+    def test_schema_and_slices_max(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query",
+             body=f"SetBit(rowID=1, frame=f, columnID={SLICE_WIDTH + 1})"
+             .encode())
+        r = h.handle("GET", "/schema")
+        assert r.json()["indexes"][0]["name"] == "i"
+        r = h.handle("GET", "/slices/max")
+        assert r.json()["maxSlices"] == {"i": 1}
+
+    def test_time_quantum_patch(self, env):
+        holder, h = env
+        seed(h)
+        r = h.handle("PATCH", "/index/i/time-quantum",
+                     body=b'{"timeQuantum":"YMD"}')
+        assert r.status == 200
+        assert str(holder.index("i").time_quantum) == "YMD"
+        r = h.handle("PATCH", "/index/i/frame/f/time-quantum",
+                     body=b'{"timeQuantum":"YM"}')
+        assert r.status == 200
+        assert str(holder.frame("i", "f").time_quantum) == "YM"
+
+    def test_views_listing(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=1, frame=f, columnID=2)")
+        r = h.handle("GET", "/index/i/frame/f/views")
+        assert r.json()["views"] == ["standard"]
+
+
+class TestQueryRoute:
+    def test_json_query(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=1, frame=f, columnID=3)")
+        r = post(h, "/index/i/query", body=b"Bitmap(rowID=1, frame=f)")
+        assert r.json()["results"][0]["bits"] == [3]
+
+    def test_protobuf_query(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=1, frame=f, columnID=3)")
+        req = pb.QueryRequest(query="Count(Bitmap(rowID=1, frame=f))")
+        r = post(h, "/index/i/query", body=req.SerializeToString(),
+                 headers={"Content-Type": PROTOBUF_CT,
+                          "Accept": PROTOBUF_CT})
+        resp = pb.QueryResponse()
+        resp.ParseFromString(r.body)
+        assert resp.results[0].n == 1
+
+    def test_query_slices_param(self, env):
+        _, h = env
+        seed(h)
+        for s in range(3):
+            post(h, "/index/i/query",
+                 body=f"SetBit(rowID=1, frame=f, columnID={s * SLICE_WIDTH})"
+                 .encode())
+        r = post(h, "/index/i/query", params={"slices": "0,2"},
+                 body=b"Count(Bitmap(rowID=1, frame=f))")
+        assert r.json()["results"] == [2]
+
+    def test_parse_error_is_400(self, env):
+        _, h = env
+        seed(h)
+        r = post(h, "/index/i/query", body=b"Bitmap(")
+        assert r.status == 400
+        assert "error" in r.json()
+
+    def test_column_attrs(self, env):
+        holder, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=1, frame=f, columnID=3)")
+        post(h, "/index/i/query",
+             body=b'SetColumnAttrs(id=3, name="three")')
+        r = post(h, "/index/i/query", params={"columnAttrs": "true"},
+                 body=b"Bitmap(rowID=1, frame=f)")
+        assert r.json()["columnAttrs"] == [
+            {"id": 3, "attrs": {"name": "three"}}]
+
+    def test_method_not_allowed(self, env):
+        _, h = env
+        seed(h)
+        assert h.handle("GET", "/index/i/query").status == 405
+
+
+class TestImportExport:
+    def test_import_then_export(self, env):
+        _, h = env
+        seed(h)
+        req = pb.ImportRequest(index="i", frame="f", slice=0)
+        req.row_ids.extend([0, 0, 1])
+        req.column_ids.extend([1, 5, 7])
+        r = post(h, "/import", body=req.SerializeToString(),
+                 headers={"Content-Type": PROTOBUF_CT})
+        assert r.status == 200
+        r = h.handle("GET", "/export", params={
+            "index": "i", "frame": "f", "view": "standard", "slice": "0"})
+        assert r.body.decode() == "0,1\n0,5\n1,7\n"
+
+    def test_import_missing_frame_404(self, env):
+        _, h = env
+        post(h, "/index/i")
+        req = pb.ImportRequest(index="i", frame="nope", slice=0)
+        r = post(h, "/import", body=req.SerializeToString())
+        assert r.status == 404
+
+
+class TestFragmentRoutes:
+    def test_blocks_and_block_data(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=1, frame=f, columnID=3)")
+        r = h.handle("GET", "/fragment/blocks", params={
+            "index": "i", "frame": "f", "view": "standard", "slice": "0"})
+        blocks = r.json()["blocks"]
+        assert len(blocks) == 1
+        r = h.handle("GET", "/fragment/block/data", params={
+            "index": "i", "frame": "f", "view": "standard", "slice": "0",
+            "block": str(blocks[0]["id"])})
+        assert r.json() == {"rowIDs": [1], "columnIDs": [3]}
+
+    def test_fragment_data_roundtrip(self, env):
+        _, h = env
+        seed(h)
+        post(h, "/index/i/query", body=b"SetBit(rowID=9, frame=f, columnID=4)")
+        r = h.handle("GET", "/fragment/data", params={
+            "index": "i", "frame": "f", "view": "standard", "slice": "0"})
+        assert r.status == 200
+        tar = r.body
+        # restore into a different frame
+        post(h, "/index/i/frame/g")
+        r = post(h, "/fragment/data", body=tar, params={
+            "index": "i", "frame": "g", "view": "standard", "slice": "0"})
+        assert r.status == 200
+        r = post(h, "/index/i/query", body=b"Bitmap(rowID=9, frame=g)")
+        assert r.json()["results"][0]["bits"] == [4]
+
+    def test_fragment_nodes(self, env):
+        _, h = env
+        r = h.handle("GET", "/fragment/nodes",
+                     params={"index": "i", "slice": "0"})
+        assert r.status == 200
+        assert len(r.json()) == 1
+
+
+class TestAttrDiff:
+    def test_index_attr_diff(self, env):
+        holder, h = env
+        seed(h)
+        store = holder.index("i").column_attr_store
+        store.set_attrs(1, {"a": 1})
+        store.set_attrs(250, {"b": "x"})
+        # requester with no blocks: everything it is missing comes back
+        r = post(h, "/index/i/attr/diff", body=b'{"blocks": []}')
+        assert r.status == 200
+        assert r.json()["attrs"] == {"1": {"a": 1}, "250": {"b": "x"}}
+        # requester agrees on block 2 but not block 0 -> only block 0
+        blocks = holder.index("i").column_attr_store.blocks()
+        agree = [{"id": bid, "checksum": cs.hex()} for bid, cs in blocks
+                 if bid == 2]
+        mismatch = agree + [{"id": 0, "checksum": "00"}]
+        r = post(h, "/index/i/attr/diff", body=json.dumps(
+            {"blocks": mismatch}).encode())
+        assert r.json()["attrs"] == {"1": {"a": 1}}
+
+    def test_frame_attr_diff(self, env):
+        holder, h = env
+        seed(h)
+        holder.frame("i", "f").row_attr_store.set_attrs(7, {"tag": "t"})
+        r = post(h, "/index/i/frame/f/attr/diff", body=json.dumps(
+            {"blocks": [{"id": 0, "checksum": "00"}]}).encode())
+        assert r.json()["attrs"] == {"7": {"tag": "t"}}
+
+
+class TestMiscRoutes:
+    def test_version(self, env):
+        _, h = env
+        assert "version" in h.handle("GET", "/version").json()
+
+    def test_hosts(self, env):
+        _, h = env
+        assert h.handle("GET", "/hosts").json()[0]["host"] == "host0"
+
+    def test_webui(self, env):
+        _, h = env
+        r = h.handle("GET", "/")
+        assert r.status == 200
+        assert b"pilosa-tpu" in r.body
+
+    def test_debug_vars(self, env):
+        _, h = env
+        assert h.handle("GET", "/debug/vars").status == 200
+
+    def test_not_found(self, env):
+        _, h = env
+        assert h.handle("GET", "/nope").status == 404
+
+
+class TestBroadcastSends:
+    """Handler emits schema-change broadcasts (handler.go:366-639)."""
+
+    def test_create_index_broadcasts(self, env):
+        holder, h = env
+
+        sent = []
+
+        class FakeBroadcaster:
+            def send_sync(self, msg):
+                sent.append(msg)
+
+            def send_async(self, msg):
+                sent.append(msg)
+
+        h.broadcaster = FakeBroadcaster()
+        post(h, "/index/i")
+        post(h, "/index/i/frame/f")
+        h.handle("DELETE", "/index/i/frame/f")
+        h.handle("DELETE", "/index/i")
+        kinds = [type(m).__name__ for m in sent]
+        assert kinds == ["CreateIndexMessage", "CreateFrameMessage",
+                         "DeleteFrameMessage", "DeleteIndexMessage"]
+        # messages survive the wire framing
+        data = marshal_message(sent[0])
+        from pilosa_tpu.wire import unmarshal_message
+        m = unmarshal_message(data)
+        assert m.index == "i"

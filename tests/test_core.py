@@ -21,6 +21,7 @@ from pilosa_tpu.core import (
     views_by_time_range,
 )
 from pilosa_tpu.core.attr import diff_blocks
+from pilosa_tpu.errors import FrameExistsError
 from pilosa_tpu.core.fragment import TopOptions
 
 
@@ -348,7 +349,7 @@ def test_index_frame_validation(tmp_path):
         with pytest.raises(ValueError):
             idx.create_frame("9bad")
         idx.create_frame("fine")
-        with pytest.raises(ValueError, match="already exists"):
+        with pytest.raises(FrameExistsError):
             idx.create_frame("fine")
     finally:
         h.close()
