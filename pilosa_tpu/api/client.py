@@ -10,7 +10,6 @@ the pilosa_tpu.wire protobufs.
 
 from __future__ import annotations
 
-import io
 import json
 import urllib.error
 import urllib.parse
@@ -157,10 +156,14 @@ class InternalClient:
 
     def fragment_blocks(self, index: str, frame: str, view: str,
                         slice_: int) -> List[Tuple[int, bytes]]:
-        """GET /fragment/blocks -> [(block id, checksum)]
-        (client.go:798)."""
+        """GET /fragment/blocks -> [(block id, checksum)]; a replica
+        that has not created the fragment yet reads as empty (client.go
+        FragmentBlocks ErrFragmentNotFound tolerance,
+        fragment.go:1345)."""
         status, data = self._do("GET", "/fragment/blocks", params={
             "index": index, "frame": frame, "view": view, "slice": slice_})
+        if status == 404:
+            return []
         self._check(status, data, "fragment/blocks")
         return [(int(b["id"]), bytes.fromhex(b["checksum"]))
                 for b in json.loads(data.decode())["blocks"]]
@@ -174,6 +177,8 @@ class InternalClient:
         status, data = self._do("GET", "/fragment/block/data",
                                 body=req.SerializeToString(),
                                 content_type=PROTOBUF_CT, accept=PROTOBUF_CT)
+        if status == 404:
+            return [], []  # fragment not created on this replica yet
         self._check(status, data, "fragment/block/data")
         resp = pb.BlockDataResponse()
         resp.ParseFromString(data)

@@ -67,7 +67,7 @@ from ..pql import Parser, ParseError
 from ..executor import ExecOptions
 from ..utils.stats import ExpvarStats
 from ..wire import (
-    attrs_from_proto,
+    PROTOBUF_CT,
     attrs_to_proto,
     pb,
     result_to_proto,
@@ -75,8 +75,6 @@ from ..wire import (
 )
 
 VERSION = "0.1.0"
-
-PROTOBUF = "application/x-protobuf"
 
 _WEBUI_PAGE = """<!doctype html>
 <html><head><title>pilosa-tpu console</title><style>
@@ -114,7 +112,7 @@ def _json_resp(obj, status: int = 200) -> Response:
 
 
 def _proto_resp(msg, status: int = 200) -> Response:
-    return Response(status, {"Content-Type": PROTOBUF}, msg.SerializeToString())
+    return Response(status, {"Content-Type": PROTOBUF_CT}, msg.SerializeToString())
 
 
 def _error_status(err: Exception) -> int:
@@ -235,10 +233,10 @@ class Handler:
     # -- helpers -------------------------------------------------------------
 
     def _accepts_proto(self, headers) -> bool:
-        return PROTOBUF in headers.get("accept", "")
+        return PROTOBUF_CT in headers.get("accept", "")
 
     def _sends_proto(self, headers) -> bool:
-        return PROTOBUF in headers.get("content-type", "")
+        return PROTOBUF_CT in headers.get("content-type", "")
 
     def _fragment_args(self, params):
         index = params["index"]
@@ -282,9 +280,10 @@ class Handler:
         return self._get_schema(pv, params, headers, body)
 
     def _get_slice_max(self, pv, params, headers, body) -> Response:
-        maxes = self.holder.max_slices()
         if params.get("inverse") == "true":
             maxes = self.holder.max_inverse_slices()
+        else:
+            maxes = self.holder.max_slices()
         if self._accepts_proto(headers):
             msg = pb.MaxSlicesResponse()
             for k, v in maxes.items():

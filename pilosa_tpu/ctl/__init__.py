@@ -1,0 +1,3 @@
+"""CLI command logic (parity with the reference's cmd/ + ctl/ packages:
+server, import, export, backup, restore, bench, check, inspect, sort,
+config — SURVEY.md §2.6)."""

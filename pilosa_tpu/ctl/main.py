@@ -1,0 +1,435 @@
+"""`python -m pilosa_tpu.ctl.main` — the pilosa-tpu binary.
+
+Subcommands (reference cmd/*.go + ctl/*.go, SURVEY.md §2.6):
+
+    server    run a node
+    import    CSV (row,col[,timestamp]) -> cluster /import RPCs
+    export    frame -> CSV on stdout
+    backup    frame view -> local tar archive
+    restore   local tar archive -> cluster
+    bench     set-bit / intersect-count micro-benchmarks
+    check     offline consistency check of fragment data files
+    inspect   per-container stats dump of a data file
+    sort      sort an import CSV in fragment/position order
+    config    print the default TOML config
+
+Flag precedence mirrors the reference's viper wiring (cmd/root.go:
+99-153): explicit flags > PILOSA_TPU_* env vars > --config TOML file >
+defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tarfile
+import time
+from datetime import datetime
+from typing import List, Optional, Tuple
+
+from ..config import Config
+
+# Import CSV timestamp layout (reference ctl/import.go TimeFormat).
+TIME_FORMAT = "%Y-%m-%dT%H:%M"
+
+# Bits buffered per import RPC batch (reference buffers 10M lines,
+# ctl/import.go:57; smaller default keeps request bodies modest).
+DEFAULT_IMPORT_BUFFER = 1_000_000
+
+
+def _env(name: str, default=None):
+    return os.environ.get("PILOSA_TPU_" + name.upper().replace("-", "_"),
+                          default)
+
+
+def build_config(args) -> Config:
+    """flags > env > TOML > defaults."""
+    if getattr(args, "config", None):
+        cfg = Config.from_toml(args.config)
+    else:
+        cfg = Config()
+    env_host = _env("host")
+    if env_host:
+        cfg.host = env_host
+    env_dir = _env("data_dir")
+    if env_dir:
+        cfg.data_dir = env_dir
+    if getattr(args, "data_dir", None):
+        cfg.data_dir = args.data_dir
+    if getattr(args, "bind", None):
+        cfg.host = args.bind
+        if cfg.cluster_hosts == [Config().host]:
+            cfg.cluster_hosts = [args.bind]
+    if getattr(args, "hosts", None):
+        cfg.cluster_hosts = [h.strip() for h in args.hosts.split(",")]
+    if getattr(args, "replicas", None):
+        cfg.replica_n = args.replicas
+    return cfg
+
+
+# ---- server ----------------------------------------------------------------
+
+def cmd_server(args) -> int:
+    import logging
+
+    from ..server import Server
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(message)s",
+        filename=args.log_path or None)
+    cfg = build_config(args)
+    srv = Server(cfg)
+    srv.open()
+    print(f"pilosa-tpu listening on http://{srv.host} "
+          f"(data: {cfg.expanded_data_dir()})", flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        srv.close()
+    return 0
+
+
+# ---- import ----------------------------------------------------------------
+
+def parse_import_rows(lines, clock=None) -> List[Tuple[int, int, int]]:
+    """CSV lines -> (rowID, columnID, unix-ts-or-0)
+    (ctl/import.go:97-199)."""
+    out = []
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(",")
+        if len(parts) < 2:
+            raise ValueError(f"line {lineno}: bad row: {line!r}")
+        ts = 0
+        if len(parts) > 2 and parts[2].strip():
+            ts = int(datetime.strptime(parts[2].strip(),
+                                       TIME_FORMAT).timestamp())
+        out.append((int(parts[0]), int(parts[1]), ts))
+    return out
+
+
+def cmd_import(args) -> int:
+    from .. import SLICE_WIDTH
+    from ..api import InternalClient
+
+    client = InternalClient(args.host)
+    if args.create:
+        client.create_index(args.index)
+        client.create_frame(args.index, args.frame)
+
+    def flush(bits: List[Tuple[int, int, int]]):
+        by_slice = {}
+        for r, c, ts in bits:
+            by_slice.setdefault(c // SLICE_WIDTH, []).append((r, c, ts))
+        for slice_, group in sorted(by_slice.items()):
+            group.sort()
+            rows = [g[0] for g in group]
+            cols = [g[1] for g in group]
+            tss = [g[2] for g in group]
+            if not any(tss):
+                tss = None
+            # send to every owner node (client.go:355-390)
+            nodes = client.fragment_nodes(args.index, slice_)
+            for nd in nodes or [{"host": args.host}]:
+                InternalClient(nd["host"]).import_bits(
+                    args.index, args.frame, slice_, rows, cols, tss)
+            print(f"imported {len(group)} bits into slice {slice_} "
+                  f"({len(nodes) or 1} node(s))", file=sys.stderr)
+
+    buf: List[Tuple[int, int, int]] = []
+    for path in args.paths:
+        f = sys.stdin if path == "-" else open(path)
+        try:
+            for chunk_start in iter(lambda: f.readlines(1 << 20), []):
+                buf.extend(parse_import_rows(chunk_start))
+                if len(buf) >= args.buffer_size:
+                    flush(buf)
+                    buf = []
+        finally:
+            if f is not sys.stdin:
+                f.close()
+    if buf:
+        flush(buf)
+    return 0
+
+
+# ---- export ----------------------------------------------------------------
+
+def cmd_export(args) -> int:
+    from ..api import InternalClient
+
+    client = InternalClient(args.host)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        max_slice = client.max_slices().get(args.index, 0)
+        for s in range(max_slice + 1):
+            try:
+                out.write(client.export_csv(args.index, args.frame,
+                                            args.view, s))
+            except Exception:  # noqa: BLE001 — missing fragment: skip
+                continue
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+# ---- backup / restore ------------------------------------------------------
+
+def cmd_backup(args) -> int:
+    """Write a tar archive with one `slice.N` member per existing
+    fragment; each member is the fragment's own data+cache tar
+    (client.go BackupTo analog)."""
+    from ..api import InternalClient
+    import io
+
+    client = InternalClient(args.host)
+    inverse = args.view.startswith("inverse")
+    max_slice = client.max_slices(inverse=inverse).get(args.index, 0)
+    n = 0
+    with tarfile.open(args.output, "w") as tf:
+        for s in range(max_slice + 1):
+            data = client.fragment_data(args.index, args.frame, args.view, s)
+            if data is None:
+                continue
+            info = tarfile.TarInfo(name=f"slice.{s}")
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tf.addfile(info, io.BytesIO(data))
+            n += 1
+    print(f"backed up {n} fragment(s) to {args.output}", file=sys.stderr)
+    return 0
+
+
+def cmd_restore(args) -> int:
+    from ..api import InternalClient
+
+    client = InternalClient(args.host)
+    n = 0
+    with tarfile.open(args.input, "r") as tf:
+        for member in tf.getmembers():
+            if not member.name.startswith("slice."):
+                raise ValueError(f"unexpected archive member: {member.name}")
+            slice_ = int(member.name.split(".", 1)[1])
+            data = tf.extractfile(member).read()
+            client.restore_fragment(args.index, args.frame, args.view,
+                                    slice_, data)
+            n += 1
+    print(f"restored {n} fragment(s) from {args.input}", file=sys.stderr)
+    return 0
+
+
+# ---- bench -----------------------------------------------------------------
+
+def cmd_bench(args) -> int:
+    """Micro-bench against a live node (ctl/bench.go:29-102; the
+    reference implements only set-bit — intersect-count added to match
+    BASELINE.json)."""
+    import random
+
+    from ..api import InternalClient
+
+    client = InternalClient(args.host)
+    client.create_index(args.index)
+    client.create_frame(args.index, args.frame)
+    rng = random.Random(1)
+
+    if args.op == "set-bit":
+        t0 = time.perf_counter()
+        for i in range(args.n):
+            q = (f"SetBit({args.row_label}={rng.randrange(args.max_row_id)},"
+                 f" frame='{args.frame}',"
+                 f" {args.column_label}={rng.randrange(args.max_column_id)})")
+            client.execute_query(None, args.index, q, [], remote=False)
+        dt = time.perf_counter() - t0
+    elif args.op == "intersect-count":
+        for r in (1, 2):
+            cols = rng.sample(range(args.max_column_id), k=min(
+                1000, args.max_column_id))
+            pql = "".join(
+                f"SetBit({args.row_label}={r}, frame='{args.frame}',"
+                f" {args.column_label}={c})" for c in cols)
+            client.execute_query(None, args.index, pql, [], remote=False)
+        q = (f"Count(Intersect(Bitmap({args.row_label}=1, "
+             f"frame='{args.frame}'), Bitmap({args.row_label}=2, "
+             f"frame='{args.frame}')))")
+        t0 = time.perf_counter()
+        for _ in range(args.n):
+            client.execute_query(None, args.index, q, [], remote=False)
+        dt = time.perf_counter() - t0
+    else:
+        print(f"unknown bench op: {args.op}", file=sys.stderr)
+        return 1
+    print(json.dumps({"op": args.op, "n": args.n,
+                      "seconds": round(dt, 4),
+                      "ops_per_sec": round(args.n / dt, 2)}))
+    return 0
+
+
+# ---- offline file tools ----------------------------------------------------
+
+def cmd_check(args) -> int:
+    """Offline consistency check of fragment data files
+    (ctl/check.go:34-50)."""
+    from ..roaring.serialize import read_bitmap
+
+    rc = 0
+    for path in args.paths:
+        try:
+            with open(path, "rb") as f:
+                b = read_bitmap(f.read())
+            errs = b.check()
+            if errs:
+                rc = 1
+                for e in errs:
+                    print(f"{path}: {e}")
+            else:
+                print(f"{path}: ok ({b.count()} bits)")
+        except Exception as e:  # noqa: BLE001 — report and continue
+            rc = 1
+            print(f"{path}: {e}")
+    return rc
+
+
+def cmd_inspect(args) -> int:
+    """Per-container stats of a data file (ctl/inspect.go)."""
+    from ..roaring.serialize import read_bitmap
+
+    with open(args.path, "rb") as f:
+        b = read_bitmap(f.read())
+    info = b.info()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_sort(args) -> int:
+    """Sort import CSV in fragment/position order for fast import
+    (ctl/sort.go)."""
+    from .. import SLICE_WIDTH
+
+    with (sys.stdin if args.path == "-" else open(args.path)) as f:
+        rows = parse_import_rows(f)
+    rows.sort(key=lambda rc: (rc[1] // SLICE_WIDTH,
+                              rc[0] * SLICE_WIDTH + rc[1] % SLICE_WIDTH))
+    out = sys.stdout
+    for r, c, ts in rows:
+        if ts:
+            out.write(f"{r},{c},{datetime.fromtimestamp(ts).strftime(TIME_FORMAT)}\n")
+        else:
+            out.write(f"{r},{c}\n")
+    return 0
+
+
+def cmd_config(args) -> int:
+    print(Config().to_toml(), end="")
+    return 0
+
+
+# ---- argument parsing ------------------------------------------------------
+
+def _add_host(p):
+    p.add_argument("--host", default=_env("host", "localhost:10101"),
+                   help="address of a cluster node")
+
+
+def _add_ifv(p, view=True):
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    if view:
+        p.add_argument("-v", "--view", default="standard")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="pilosa-tpu", description="TPU-native bitmap index")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("server", help="run a node")
+    p.add_argument("-c", "--config", help="TOML config file")
+    p.add_argument("-d", "--data-dir")
+    p.add_argument("-b", "--bind", help="host:port to listen on")
+    p.add_argument("--hosts", help="comma-separated cluster hosts")
+    p.add_argument("--replicas", type=int)
+    p.add_argument("--log-path", default="")
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV bits")
+    _add_host(p)
+    _add_ifv(p, view=False)
+    p.add_argument("--create", action="store_true",
+                   help="create index/frame if missing")
+    p.add_argument("--buffer-size", type=int, default=DEFAULT_IMPORT_BUFFER)
+    p.add_argument("paths", nargs="+", help="CSV files ('-' for stdin)")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("export", help="export a frame as CSV")
+    _add_host(p)
+    _add_ifv(p)
+    p.add_argument("-o", "--output", default="-")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("backup", help="backup a frame view to a tar file")
+    _add_host(p)
+    _add_ifv(p)
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("restore", help="restore a frame view from a tar file")
+    _add_host(p)
+    _add_ifv(p)
+    p.add_argument("input")
+    p.set_defaults(fn=cmd_restore)
+
+    p = sub.add_parser("bench", help="run micro-benchmarks against a node")
+    _add_host(p)
+    p.add_argument("-i", "--index", default="bench")
+    p.add_argument("-f", "--frame", default="general")
+    p.add_argument("--op", default="set-bit",
+                   choices=["set-bit", "intersect-count"])
+    p.add_argument("-n", type=int, default=1000)
+    p.add_argument("--max-row-id", type=int, default=1000)
+    p.add_argument("--max-column-id", type=int, default=1000)
+    p.add_argument("--row-label", default="rowID")
+    p.add_argument("--column-label", default="columnID")
+    p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("check", help="check fragment data files")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("inspect", help="inspect a fragment data file")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("sort", help="sort import CSV in fragment order")
+    p.add_argument("path", help="CSV file ('-' for stdin)")
+    p.set_defaults(fn=cmd_sort)
+
+    p = sub.add_parser("config", help="print the default config")
+    p.set_defaults(fn=cmd_config)
+
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ from .errors import (
     QueryError,
     SliceUnavailableError,
 )
+from .parallel.cluster import NODE_STATE_UP
 from .pql import Call, Query
 from . import SLICE_WIDTH
 
@@ -542,12 +543,17 @@ class Executor:
         (executor.go:1087-1101)."""
         m = {}
         for slice_ in slices:
-            for owner in self.cluster.fragment_nodes(index, slice_):
-                if owner in nodes:
-                    m.setdefault(owner, []).append(slice_)
-                    break
-            else:
+            owners = [o for o in self.cluster.fragment_nodes(index, slice_)
+                      if o in nodes]
+            if not owners:
                 raise SliceUnavailableError()
+            # Prefer replicas the status-poll daemon currently sees UP;
+            # a slice whose owners are all marked DOWN still tries one
+            # (liveness is advisory — the reactive re-split below is
+            # the authority, executor.go:1140-1151).
+            up = [o for o in owners if o.state == NODE_STATE_UP]
+            pick = (up or owners)[0]
+            m.setdefault(pick, []).append(slice_)
         return m
 
     def _map_reduce(self, index: str, slices: Sequence[int], c: Call,

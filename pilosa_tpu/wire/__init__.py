@@ -87,34 +87,41 @@ def result_to_proto(result) -> pb.QueryResult:
 
     qr = pb.QueryResult()
     if isinstance(result, Row):
+        qr.kind = pb.QueryResult.ROW
         qr.row.bits.extend(int(c) for c in result.columns())
         qr.row.attrs.extend(attrs_to_proto(result.attrs))
     elif isinstance(result, bool):
+        qr.kind = pb.QueryResult.CHANGED
         qr.changed = result
     elif isinstance(result, int):
+        qr.kind = pb.QueryResult.COUNT
         qr.n = result
     elif isinstance(result, list):
+        qr.kind = pb.QueryResult.PAIRS
         qr.pairs.extend(pb.Pair(key=int(k), count=int(n)) for k, n in result)
-    elif result is not None:
+    elif result is None:
+        qr.kind = pb.QueryResult.NONE
+    else:
         raise TypeError(f"unserializable result: {type(result).__name__}")
     return qr
 
 
 def result_from_proto(qr: pb.QueryResult):
-    """QueryResult -> executor-level value. The wire can't distinguish
-    Count(0) / SetBit(false) / empty-Row, so remote results normalize:
-    a result with no row/pairs decodes as an int (the reducers for
-    Count and SetBit treat ints and bools interchangeably)."""
+    """QueryResult -> executor-level value, dispatched on the explicit
+    kind tag (an empty Row result must NOT decode as Count(0) — the
+    coordinator's merge reducers are typed)."""
     from ..core.row import Row
 
-    if len(qr.row.bits) or len(qr.row.attrs):
+    if qr.kind == pb.QueryResult.ROW:
         row = Row(int(b) for b in qr.row.bits)
         row.attrs = attrs_from_proto(qr.row.attrs)
         return row
-    if len(qr.pairs):
+    if qr.kind == pb.QueryResult.PAIRS:
         return [(int(p.key), int(p.count)) for p in qr.pairs]
-    if qr.changed:
-        return True
+    if qr.kind == pb.QueryResult.CHANGED:
+        return bool(qr.changed)
+    if qr.kind == pb.QueryResult.NONE:
+        return None
     return int(qr.n)
 
 

@@ -1,0 +1,155 @@
+"""CLI tests (model: /root/reference/cmd/*_test.go config plumbing +
+ctl command logic; live-node paths reuse the in-process Server)."""
+
+import io
+import json
+import os
+import socket
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.config import Config
+from pilosa_tpu.ctl.main import (
+    build_config,
+    cmd_check,
+    cmd_inspect,
+    cmd_sort,
+    main,
+    make_parser,
+    parse_import_rows,
+)
+
+
+def test_parser_covers_all_subcommands():
+    ap = make_parser()
+    for cmd in ["server", "import", "export", "backup", "restore",
+                "bench", "check", "inspect", "sort", "config"]:
+        # every subcommand parses its own --help without crashing
+        with pytest.raises(SystemExit) as e:
+            ap.parse_args([cmd, "--help"])
+        assert e.value.code == 0
+
+
+def test_config_command(capsys):
+    assert main(["config"]) == 0
+    out = capsys.readouterr().out
+    cfg = Config.from_toml(out, is_text=True)
+    assert cfg.host == Config().host
+
+
+def test_build_config_precedence(tmp_path, monkeypatch):
+    toml = tmp_path / "c.toml"
+    toml.write_text('host = "from-toml:1"\ndata-dir = "/toml-dir"\n')
+    ap = make_parser()
+    # TOML only
+    args = ap.parse_args(["server", "-c", str(toml)])
+    cfg = build_config(args)
+    assert cfg.host == "from-toml:1"
+    assert cfg.data_dir == "/toml-dir"
+    # env overrides toml
+    monkeypatch.setenv("PILOSA_TPU_HOST", "from-env:2")
+    cfg = build_config(ap.parse_args(["server", "-c", str(toml)]))
+    assert cfg.host == "from-env:2"
+    # flag overrides env
+    cfg = build_config(ap.parse_args(
+        ["server", "-c", str(toml), "-b", "from-flag:3", "-d", "/flag-dir"]))
+    assert cfg.host == "from-flag:3"
+    assert cfg.data_dir == "/flag-dir"
+
+
+def test_parse_import_rows():
+    rows = parse_import_rows(["1,2", "3,4,2017-04-01T12:30", "", " 5 , 6 "])
+    assert rows[0] == (1, 2, 0)
+    assert rows[1][0:2] == (3, 4) and rows[1][2] > 0
+    assert rows[2] == (5, 6, 0)
+    with pytest.raises(ValueError, match="bad row"):
+        parse_import_rows(["justone"])
+
+
+def test_sort_orders_by_fragment_then_pos(tmp_path, capsys):
+    p = tmp_path / "bits.csv"
+    p.write_text(f"5,{SLICE_WIDTH}\n1,7\n0,9\n1,3\n")
+    ap = make_parser()
+    assert cmd_sort(ap.parse_args(["sort", str(p)])) == 0
+    out = capsys.readouterr().out.splitlines()
+    # slice 0 first (pos order: row asc then col), then slice 1
+    assert out == ["0,9", "1,3", "1,7", f"5,{SLICE_WIDTH}"]
+
+
+def test_check_and_inspect(tmp_path, capsys):
+    from pilosa_tpu.roaring import Bitmap
+
+    b = Bitmap([1, 2, 70000])
+    path = tmp_path / "data"
+    path.write_bytes(b.to_bytes())
+    ap = make_parser()
+    assert cmd_check(ap.parse_args(["check", str(path)])) == 0
+    assert "ok (3 bits)" in capsys.readouterr().out
+
+    assert cmd_inspect(ap.parse_args(["inspect", str(path)])) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert [c["key"] for c in info["containers"]] == [0, 1]
+
+    # corrupt the cookie -> check fails
+    raw = bytearray(path.read_bytes())
+    raw[0] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    assert cmd_check(ap.parse_args(["check", str(path)])) == 1
+    assert "invalid roaring file" in capsys.readouterr().out
+
+
+class TestLiveNode:
+    @pytest.fixture
+    def node(self, tmp_path):
+        from pilosa_tpu.server import Server
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        host = f"127.0.0.1:{port}"
+        c = Config()
+        c.data_dir = str(tmp_path / "data")
+        c.host = host
+        c.cluster_hosts = [host]
+        c.anti_entropy_interval = 3600
+        c.polling_interval = 3600
+        srv = Server(c)
+        srv.open()
+        yield host
+        srv.close()
+
+    def test_import_export_roundtrip(self, node, tmp_path, capsys):
+        csv = tmp_path / "in.csv"
+        csv.write_text(f"1,10\n1,20\n2,{SLICE_WIDTH + 5}\n")
+        assert main(["import", "--host", node, "-i", "i", "-f", "f",
+                     "--create", str(csv)]) == 0
+        out_file = tmp_path / "out.csv"
+        assert main(["export", "--host", node, "-i", "i", "-f", "f",
+                     "-o", str(out_file)]) == 0
+        assert out_file.read_text() == f"1,10\n1,20\n2,{SLICE_WIDTH + 5}\n"
+
+    def test_backup_restore_roundtrip(self, node, tmp_path, capsys):
+        csv = tmp_path / "in.csv"
+        csv.write_text("7,3\n8,9\n")
+        main(["import", "--host", node, "-i", "i", "-f", "f", "--create",
+              str(csv)])
+        tar = tmp_path / "f.tar"
+        assert main(["backup", "--host", node, "-i", "i", "-f", "f",
+                     "-o", str(tar)]) == 0
+        # restore into a second frame on the same node
+        from pilosa_tpu.api import InternalClient
+        InternalClient(node).create_frame("i", "g")
+        assert main(["restore", "--host", node, "-i", "i", "-f", "g",
+                     str(tar)]) == 0
+        out = tmp_path / "g.csv"
+        main(["export", "--host", node, "-i", "i", "-f", "g",
+              "-o", str(out)])
+        assert out.read_text() == "7,3\n8,9\n"
+
+    def test_bench_set_bit(self, node, capsys):
+        assert main(["bench", "--host", node, "--op", "set-bit",
+                     "-n", "20"]) == 0
+        res = json.loads(capsys.readouterr().out)
+        assert res["n"] == 20 and res["ops_per_sec"] > 0

@@ -263,3 +263,51 @@ class TestReceiveMessage:
         s.receive_message(pb.DeleteIndexMessage(index="i"))
         assert s.holder.index("i") is None
         s.holder.close()
+
+
+class TestRegressionsFromReview:
+    def test_empty_remote_row_result_merges(self, cluster2):
+        """An empty Row from a remote node must decode as a Row, not
+        Count(0) (wire kind tag)."""
+        servers, hosts = cluster2
+        cli = InternalClient(hosts[0])
+        cli.create_index("i")
+        cli.create_frame("i", "f")
+        # row 1 exists only in a node0-owned slice; another row forces a
+        # second slice owned by node1 so the fan-out hits both nodes.
+        s_own = {h: None for h in hosts}
+        for s in range(32):
+            owner = servers[0].cluster.fragment_nodes("i", s)[0].host
+            if s_own[owner] is None:
+                s_own[owner] = s
+        q = (f"SetBit(rowID=1, frame=f, columnID="
+             f"{s_own[hosts[0]] * SLICE_WIDTH})"
+             f"SetBit(rowID=2, frame=f, columnID="
+             f"{s_own[hosts[1]] * SLICE_WIDTH})")
+        cli.execute_query(None, "i", q, [], remote=False)
+        for h in hosts:
+            res = InternalClient(h).execute_query(
+                None, "i", "Bitmap(rowID=1, frame=f)", [], remote=False)
+            assert sorted(res[0].columns()) == [s_own[hosts[0]] * SLICE_WIDTH]
+            res = InternalClient(h).execute_query(
+                None, "i", "TopN(frame=f, n=10)", [], remote=False)
+            assert sorted(res[0]) == [(1, 1), (2, 1)]
+
+    def test_sync_tolerates_missing_remote_fragment(self, cluster2):
+        """FragmentSyncer treats a replica without the fragment as empty
+        (reference fragment.go:1345) instead of aborting."""
+        servers, hosts = cluster2
+        cli = InternalClient(hosts[0])
+        cli.create_index("i")
+        cli.create_frame("i", "f")
+        s0, s1 = servers
+        # only node0 has the fragment
+        s0.holder.frame("i", "f").set_bit(1, 3)
+        assert s1.holder.fragment("i", "f", "standard", 0) is None
+        syncer = HolderSyncer(s0.holder, s0.host, s0.cluster,
+                              s0.client.for_host)
+        syncer.sync_fragment("i", "f", "standard", 0)
+        # the consensus bit was pushed to node1
+        res = InternalClient(hosts[1]).execute_query(
+            None, "i", "Bitmap(rowID=1, frame=f)", [0], remote=True)
+        assert sorted(res[0].columns()) == [3]
