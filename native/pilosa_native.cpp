@@ -1,0 +1,160 @@
+// Host-side roaring kernels (the native analog of the reference's
+// roaring/assembly_amd64.s POPCNT kernels, SURVEY.md §2.1: fused
+// popcount-of-{s, s&m, s|m, s^m, s&~m} slices plus the sorted-array
+// container ops the Go version open-codes in roaring.go:1192-1558).
+//
+// Built as a shared library, loaded via ctypes by pilosa_tpu.ops.native
+// with a numpy fallback — the hasAsm()-style runtime dispatch.
+//
+// All bitmap kernels operate on 64-bit words (a bitmap container is
+// 1024 words); array kernels on sorted unique uint32 values.
+
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__GNUC__)
+#define POPCNT64(x) __builtin_popcountll(x)
+#define CTZ64(x) __builtin_ctzll(x)
+#else
+static inline int POPCNT64(uint64_t x) {
+  int n = 0;
+  while (x) { x &= x - 1; ++n; }
+  return n;
+}
+static inline int CTZ64(uint64_t x) {
+  int n = 0;
+  while (!(x & 1)) { x >>= 1; ++n; }
+  return n;
+}
+#endif
+
+extern "C" {
+
+// ---- fused popcount slices (assembly_amd64.s:25-115 analogs) --------------
+
+uint64_t pilosa_popcnt_slice(const uint64_t* s, size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += POPCNT64(s[i]);
+  return total;
+}
+
+uint64_t pilosa_popcnt_and_slice(const uint64_t* s, const uint64_t* m,
+                                 size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += POPCNT64(s[i] & m[i]);
+  return total;
+}
+
+uint64_t pilosa_popcnt_or_slice(const uint64_t* s, const uint64_t* m,
+                                size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += POPCNT64(s[i] | m[i]);
+  return total;
+}
+
+uint64_t pilosa_popcnt_xor_slice(const uint64_t* s, const uint64_t* m,
+                                 size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += POPCNT64(s[i] ^ m[i]);
+  return total;
+}
+
+uint64_t pilosa_popcnt_andnot_slice(const uint64_t* s, const uint64_t* m,
+                                    size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += POPCNT64(s[i] & ~m[i]);
+  return total;
+}
+
+// ---- sorted-array container kernels (roaring.go:1192-1558 analogs) --------
+// Inputs are sorted unique; outputs are sorted unique. `out` must have
+// room for the worst case (na, na+nb, na, na+nb respectively).
+
+size_t pilosa_intersect_sorted_u32(const uint32_t* a, size_t na,
+                                   const uint32_t* b, size_t nb,
+                                   uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) ++i;
+    else if (a[i] > b[j]) ++j;
+    else { out[k++] = a[i]; ++i; ++j; }
+  }
+  return k;
+}
+
+size_t pilosa_intersection_count_sorted_u32(const uint32_t* a, size_t na,
+                                            const uint32_t* b, size_t nb) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) ++i;
+    else if (a[i] > b[j]) ++j;
+    else { ++k; ++i; ++j; }
+  }
+  return k;
+}
+
+size_t pilosa_union_sorted_u32(const uint32_t* a, size_t na,
+                               const uint32_t* b, size_t nb, uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) out[k++] = b[j++];
+    else { out[k++] = a[i]; ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+size_t pilosa_difference_sorted_u32(const uint32_t* a, size_t na,
+                                    const uint32_t* b, size_t nb,
+                                    uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) ++j;
+    else { ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  return k;
+}
+
+size_t pilosa_xor_sorted_u32(const uint32_t* a, size_t na,
+                             const uint32_t* b, size_t nb, uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) out[k++] = b[j++];
+    else { ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+// ---- bitmap <-> values (trailingZeroN scan, roaring.go:1705-1777) ---------
+
+size_t pilosa_bitmap_to_values_u32(const uint64_t* words, size_t n_words,
+                                   uint32_t* out) {
+  size_t k = 0;
+  for (size_t w = 0; w < n_words; ++w) {
+    uint64_t word = words[w];
+    uint32_t base = (uint32_t)(w << 6);
+    while (word) {
+      out[k++] = base + (uint32_t)CTZ64(word);
+      word &= word - 1;
+    }
+  }
+  return k;
+}
+
+// Membership test of sorted values against a bitmap: out_mask[i] = 1 if
+// bit a[i] set. Used by array×bitmap intersect/difference.
+void pilosa_bitmap_contains_u32(const uint64_t* words, const uint32_t* a,
+                                size_t na, uint8_t* out_mask) {
+  for (size_t i = 0; i < na; ++i) {
+    out_mask[i] = (uint8_t)((words[a[i] >> 6] >> (a[i] & 63)) & 1);
+  }
+}
+
+}  // extern "C"

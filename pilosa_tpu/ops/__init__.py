@@ -11,21 +11,31 @@ elementwise dataflow with popcount reductions — one XLA/Pallas launch per
 query batch, never materializing intermediates to HBM.
 """
 
-from .pool import (
-    CONTAINER_WORDS,
-    INVALID_KEY,
-    FragmentPool,
-    build_pool,
-    build_pool_arrays,
-    gather_row,
-    pool_row_counts,
-)
-from .bitops import (
-    count_pair,
-    dense_row_count,
-    popcount_words,
-)
-from .kernels import fused_pair_count, use_pallas
+# pool/bitops/kernels pull in jax; load lazily so the host-only layers
+# (roaring, CLI file tools) can use ops.native without a jax import.
+_LAZY = {
+    "CONTAINER_WORDS": "pool",
+    "INVALID_KEY": "pool",
+    "FragmentPool": "pool",
+    "build_pool": "pool",
+    "build_pool_arrays": "pool",
+    "gather_row": "pool",
+    "pool_row_counts": "pool",
+    "count_pair": "bitops",
+    "dense_row_count": "bitops",
+    "popcount_words": "bitops",
+    "fused_pair_count": "kernels",
+    "use_pallas": "kernels",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "CONTAINER_WORDS",

@@ -1,0 +1,258 @@
+"""ctypes loader + dispatch for the native host kernels.
+
+The analog of the reference's runtime assembly dispatch
+(roaring/assembly_asm.go:20,40-80 hasAsm + function-pointer selection):
+on first import, build (if needed) and load native/libpilosa_native.so;
+every kernel has a numpy fallback so the package works without a C++
+toolchain. `has_native()` reports which path is live;
+`PILOSA_TPU_NO_NATIVE=1` forces the fallback (the reference's
+`go build -tags noasm` escape hatch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libpilosa_native.so")
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_U32P = ctypes.POINTER(ctypes.c_uint32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_FAIL_STAMP = os.path.join(_NATIVE_DIR, "build", ".build_failed")
+
+
+def _src_mtime() -> float:
+    try:
+        return os.path.getmtime(os.path.join(_NATIVE_DIR,
+                                             "pilosa_native.cpp"))
+    except OSError:
+        return 0.0
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    # A previously failed build is cached on disk and only retried when
+    # the source changes, so toolchain-less machines pay the failed
+    # compile once, not per process.
+    try:
+        if os.path.exists(_FAIL_STAMP) and                 float(open(_FAIL_STAMP).read() or 0) == _src_mtime():
+            return False
+    except (OSError, ValueError):
+        pass
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:  # noqa: BLE001 — no toolchain: numpy fallback
+        try:
+            os.makedirs(os.path.dirname(_FAIL_STAMP), exist_ok=True)
+            with open(_FAIL_STAMP, "w") as f:
+                f.write(str(_src_mtime()))
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.pilosa_popcnt_slice.restype = ctypes.c_uint64
+    lib.pilosa_popcnt_slice.argtypes = [_U64P, ctypes.c_size_t]
+    for name in ("and", "or", "xor", "andnot"):
+        fn = getattr(lib, f"pilosa_popcnt_{name}_slice")
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [_U64P, _U64P, ctypes.c_size_t]
+    for name, args in [
+        ("intersect_sorted_u32", [_U32P, ctypes.c_size_t, _U32P,
+                                  ctypes.c_size_t, _U32P]),
+        ("intersection_count_sorted_u32", [_U32P, ctypes.c_size_t, _U32P,
+                                           ctypes.c_size_t]),
+        ("union_sorted_u32", [_U32P, ctypes.c_size_t, _U32P,
+                              ctypes.c_size_t, _U32P]),
+        ("difference_sorted_u32", [_U32P, ctypes.c_size_t, _U32P,
+                                   ctypes.c_size_t, _U32P]),
+        ("xor_sorted_u32", [_U32P, ctypes.c_size_t, _U32P,
+                            ctypes.c_size_t, _U32P]),
+        ("bitmap_to_values_u32", [_U64P, ctypes.c_size_t, _U32P]),
+    ]:
+        fn = getattr(lib, f"pilosa_{name}")
+        fn.restype = ctypes.c_size_t
+        fn.argtypes = args
+    lib.pilosa_bitmap_contains_u32.restype = None
+    lib.pilosa_bitmap_contains_u32.argtypes = [_U64P, _U32P,
+                                               ctypes.c_size_t, _U8P]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    """Deferred load: the (possibly blocking) build+dlopen happens on
+    the first kernel call, not at import (roaring imports this module
+    at its own import time)."""
+    global _lib, _load_attempted
+    if not _load_attempted:
+        _load_attempted = True
+        _lib = _load()
+    return _lib
+
+# ctypes call overhead beats the kernel below these sizes — numpy's SIMD
+# handles small inputs better (measured: numpy wins at 1024-word
+# containers, native wins >=8K words by 2-4x and 10x on value extraction).
+POPCNT_NATIVE_MIN = 8192      # uint64 words
+SORTED_NATIVE_MIN = 2048      # combined array elements
+
+
+def has_native() -> bool:
+    return _get_lib() is not None
+
+
+def _p64(a: np.ndarray):
+    return a.ctypes.data_as(_U64P)
+
+
+def _p32(a: np.ndarray):
+    return a.ctypes.data_as(_U32P)
+
+
+# ---- popcount slices -------------------------------------------------------
+
+def popcnt_slice(s: np.ndarray) -> int:
+    lib = _get_lib()
+    if (lib is not None and s.flags.c_contiguous
+            and len(s) >= POPCNT_NATIVE_MIN):
+        return int(lib.pilosa_popcnt_slice(_p64(s), len(s)))
+    return int(np.bitwise_count(s).sum())
+
+
+def _popcnt_pair(name: str, np_op, s: np.ndarray, m: np.ndarray) -> int:
+    lib = _get_lib()
+    if (lib is not None and s.flags.c_contiguous and m.flags.c_contiguous
+            and len(s) == len(m) and len(s) >= POPCNT_NATIVE_MIN):
+        return int(getattr(lib, f"pilosa_popcnt_{name}_slice")(
+            _p64(s), _p64(m), len(s)))
+    return int(np.bitwise_count(np_op(s, m)).sum())
+
+
+def popcnt_and_slice(s, m) -> int:
+    return _popcnt_pair("and", np.bitwise_and, s, m)
+
+
+def popcnt_or_slice(s, m) -> int:
+    return _popcnt_pair("or", np.bitwise_or, s, m)
+
+
+def popcnt_xor_slice(s, m) -> int:
+    return _popcnt_pair("xor", np.bitwise_xor, s, m)
+
+
+def popcnt_andnot_slice(s, m) -> int:
+    return _popcnt_pair("andnot", lambda a, b: a & ~b, s, m)
+
+
+# ---- sorted-array kernels --------------------------------------------------
+
+def intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _get_lib()
+    if lib is not None and len(a) + len(b) >= SORTED_NATIVE_MIN:
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        b = np.ascontiguousarray(b, dtype=np.uint32)
+        out = np.empty(min(len(a), len(b)), dtype=np.uint32)
+        k = lib.pilosa_intersect_sorted_u32(_p32(a), len(a), _p32(b),
+                                             len(b), _p32(out))
+        return out[:k]
+    return np.intersect1d(a, b, assume_unique=True).astype(np.uint32)
+
+
+def intersection_count_sorted(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _get_lib()
+    if lib is not None and len(a) + len(b) >= SORTED_NATIVE_MIN:
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        b = np.ascontiguousarray(b, dtype=np.uint32)
+        return int(lib.pilosa_intersection_count_sorted_u32(
+            _p32(a), len(a), _p32(b), len(b)))
+    return len(np.intersect1d(a, b, assume_unique=True))
+
+
+def union_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _get_lib()
+    if lib is not None and len(a) + len(b) >= SORTED_NATIVE_MIN:
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        b = np.ascontiguousarray(b, dtype=np.uint32)
+        out = np.empty(len(a) + len(b), dtype=np.uint32)
+        k = lib.pilosa_union_sorted_u32(_p32(a), len(a), _p32(b), len(b),
+                                         _p32(out))
+        return out[:k]
+    return np.union1d(a, b).astype(np.uint32)
+
+
+def difference_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _get_lib()
+    if lib is not None and len(a) + len(b) >= SORTED_NATIVE_MIN:
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        b = np.ascontiguousarray(b, dtype=np.uint32)
+        out = np.empty(len(a), dtype=np.uint32)
+        k = lib.pilosa_difference_sorted_u32(_p32(a), len(a), _p32(b),
+                                              len(b), _p32(out))
+        return out[:k]
+    return np.setdiff1d(a, b, assume_unique=True).astype(np.uint32)
+
+
+def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _get_lib()
+    if lib is not None and len(a) + len(b) >= SORTED_NATIVE_MIN:
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        b = np.ascontiguousarray(b, dtype=np.uint32)
+        out = np.empty(len(a) + len(b), dtype=np.uint32)
+        k = lib.pilosa_xor_sorted_u32(_p32(a), len(a), _p32(b), len(b),
+                                       _p32(out))
+        return out[:k]
+    return np.setxor1d(a, b, assume_unique=True).astype(np.uint32)
+
+
+def bitmap_to_values(words: np.ndarray) -> np.ndarray:
+    """Bitmap words -> sorted uint32 values (trailing-zero scan). The
+    native path requires uint64 input and sizes the output by
+    len(words) (values are < len(words)*64, so any word count is
+    safe); anything else falls back to numpy."""
+    lib = _get_lib()
+    if (lib is not None and words.dtype == np.uint64
+            and words.flags.c_contiguous and len(words) <= (1 << 26)):
+        out = np.empty(len(words) << 6, dtype=np.uint32)
+        k = lib.pilosa_bitmap_to_values_u32(_p64(words), len(words),
+                                            _p32(out))
+        return out[:k].copy()
+    bits = np.unpackbits(np.ascontiguousarray(words).view(np.uint8),
+                         bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint32)
+
+
+def bitmap_contains(words: np.ndarray, a: np.ndarray) -> np.ndarray:
+    """Membership mask of sorted values `a` against bitmap words."""
+    lib = _get_lib()
+    if (lib is not None and words.dtype == np.uint64
+            and words.flags.c_contiguous and len(a) >= SORTED_NATIVE_MIN):
+        a = np.ascontiguousarray(a, dtype=np.uint32)
+        mask = np.empty(len(a), dtype=np.uint8)
+        lib.pilosa_bitmap_contains_u32(_p64(words), _p32(a), len(a),
+                                        mask.ctypes.data_as(_U8P))
+        return mask.astype(bool)
+    return ((words[a >> np.uint32(6)] >> (a.astype(np.uint64)
+                                          & np.uint64(63)))
+            & np.uint64(1)).astype(bool)

@@ -17,6 +17,8 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
+from ..ops import native as _native
+
 # Cardinality threshold at which an array container converts to a bitmap
 # container (reference: roaring/roaring.go:833 ArrayMaxSize).
 ARRAY_MAX_SIZE = 4096
@@ -39,13 +41,14 @@ def values_to_bitmap_words(values: np.ndarray) -> np.ndarray:
 
 
 def bitmap_to_values(words: np.ndarray) -> np.ndarray:
-    """Unpack a 1024-word uint64 bitmap into sorted uint32 values."""
-    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
-    return np.nonzero(bits)[0].astype(_U32)
+    """Unpack a 1024-word uint64 bitmap into sorted uint32 values
+    (native trailing-zero scan when available — ~10x numpy's
+    unpackbits+nonzero; ops/native.py, the assembly-dispatch analog)."""
+    return _native.bitmap_to_values(words)
 
 
 def _popcount_words(words: np.ndarray) -> int:
-    return int(np.bitwise_count(words).sum())
+    return _native.popcnt_slice(words)
 
 
 class Container:
@@ -190,45 +193,45 @@ class Container:
 
     def intersect(self, other: "Container") -> "Container":
         if self.is_array() and other.is_array():
-            out = np.intersect1d(self.array, other.array, assume_unique=True)
-            return Container(array=out.astype(_U32))
+            out = _native.intersect_sorted(self.array, other.array)
+            return Container(array=out)
         if self.is_array() or other.is_array():
             arr, bm = (self, other) if self.is_array() else (other, self)
             a = arr.array
-            mask = (bm.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
-            return Container(array=a[mask.astype(bool)])
+            mask = _native.bitmap_contains(bm.bitmap, a)
+            return Container(array=a[mask])
         return Container(bitmap=self.bitmap & other.bitmap).normalize()
 
     def intersection_count(self, other: "Container") -> int:
         if self.is_array() and other.is_array():
-            return len(np.intersect1d(self.array, other.array, assume_unique=True))
+            return _native.intersection_count_sorted(self.array, other.array)
         if self.is_array() or other.is_array():
             arr, bm = (self, other) if self.is_array() else (other, self)
             a = arr.array
-            mask = (bm.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
+            mask = _native.bitmap_contains(bm.bitmap, a)
             return int(mask.sum())
-        return _popcount_words(self.bitmap & other.bitmap)
+        return _native.popcnt_and_slice(self.bitmap, other.bitmap)
 
     def union(self, other: "Container") -> "Container":
         if self.is_array() and other.is_array():
-            out = np.union1d(self.array, other.array).astype(_U32)
+            out = _native.union_sorted(self.array, other.array)
             return Container(array=out).normalize()
         return Container(bitmap=self.words() | other.words()).normalize()
 
     def difference(self, other: "Container") -> "Container":
         if self.is_array():
             if other.is_array():
-                out = np.setdiff1d(self.array, other.array, assume_unique=True)
-                return Container(array=out.astype(_U32))
+                out = _native.difference_sorted(self.array, other.array)
+                return Container(array=out)
             a = self.array
-            mask = (other.bitmap[a >> np.uint32(6)] >> (a.astype(_U64) & _U64(63))) & _U64(1)
-            return Container(array=a[~mask.astype(bool)])
+            mask = _native.bitmap_contains(other.bitmap, a)
+            return Container(array=a[~mask])
         return Container(bitmap=self.bitmap & ~other.words()).normalize()
 
     def xor(self, other: "Container") -> "Container":
         if self.is_array() and other.is_array():
-            out = np.setxor1d(self.array, other.array, assume_unique=True)
-            return Container(array=out.astype(_U32)).normalize()
+            out = _native.xor_sorted(self.array, other.array)
+            return Container(array=out).normalize()
         return Container(bitmap=self.words() ^ other.words()).normalize()
 
     def check(self) -> list:
