@@ -68,7 +68,11 @@ def _build() -> bool:
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("PILOSA_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_LIB_PATH) and not _build():
+    # Always run make: it is a cheap no-op when the .so is newer than the
+    # source, and rebuilds a stale .so after source edits. A failed build
+    # (no toolchain) still loads a previously built library if present.
+    _build()
+    if not os.path.exists(_LIB_PATH):
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
@@ -135,7 +139,7 @@ def _p32(a: np.ndarray):
 
 def popcnt_slice(s: np.ndarray) -> int:
     lib = _get_lib()
-    if (lib is not None and s.flags.c_contiguous
+    if (lib is not None and s.dtype == np.uint64 and s.flags.c_contiguous
             and len(s) >= POPCNT_NATIVE_MIN):
         return int(lib.pilosa_popcnt_slice(_p64(s), len(s)))
     return int(np.bitwise_count(s).sum())
@@ -143,7 +147,8 @@ def popcnt_slice(s: np.ndarray) -> int:
 
 def _popcnt_pair(name: str, np_op, s: np.ndarray, m: np.ndarray) -> int:
     lib = _get_lib()
-    if (lib is not None and s.flags.c_contiguous and m.flags.c_contiguous
+    if (lib is not None and s.dtype == np.uint64 and m.dtype == np.uint64
+            and s.flags.c_contiguous and m.flags.c_contiguous
             and len(s) == len(m) and len(s) >= POPCNT_NATIVE_MIN):
         return int(getattr(lib, f"pilosa_popcnt_{name}_slice")(
             _p64(s), _p64(m), len(s)))
@@ -247,7 +252,9 @@ def bitmap_contains(words: np.ndarray, a: np.ndarray) -> np.ndarray:
     """Membership mask of sorted values `a` against bitmap words."""
     lib = _get_lib()
     if (lib is not None and words.dtype == np.uint64
-            and words.flags.c_contiguous and len(a) >= SORTED_NATIVE_MIN):
+            and words.flags.c_contiguous and len(a) >= SORTED_NATIVE_MIN
+            and int(a[-1]) >> 6 < len(words)):  # a is sorted; match the
+        # fallback's IndexError domain instead of reading out of bounds
         a = np.ascontiguousarray(a, dtype=np.uint32)
         mask = np.empty(len(a), dtype=np.uint8)
         lib.pilosa_bitmap_contains_u32(_p64(words), _p32(a), len(a),
