@@ -32,6 +32,9 @@ from .parallel.cluster import DEFAULT_PARTITION_N, DEFAULT_REPLICA_N
 DEFAULT_HOST = "localhost:10101"
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
 DEFAULT_POLLING_INTERVAL = 60.0
+# Reference DefaultInternalPort ("14000", config.go:22-31) — the gossip
+# plane binds UDP+TCP here.
+DEFAULT_GOSSIP_PORT = 14000
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")
 _UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
@@ -63,6 +66,12 @@ class Config:
         self.host: str = DEFAULT_HOST
         self.log_path: str = ""
         self.cluster_hosts: List[str] = [DEFAULT_HOST]
+        # Broadcast transport: "http" (POST /internal/message to static
+        # peers), "gossip" (SWIM membership + epidemic broadcast), or
+        # "static" (no broadcast) — reference config.go cluster.type.
+        self.cluster_type: str = "http"
+        self.gossip_port: int = DEFAULT_GOSSIP_PORT
+        self.gossip_seed: str = ""
         self.replica_n: int = DEFAULT_REPLICA_N
         self.partition_n: int = DEFAULT_PARTITION_N
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
@@ -85,6 +94,9 @@ class Config:
         c.log_path = data.get("log-path", c.log_path)
         cl = data.get("cluster", {})
         c.cluster_hosts = list(cl.get("hosts", [])) or [c.host]
+        c.cluster_type = str(cl.get("type", c.cluster_type))
+        c.gossip_port = int(cl.get("gossip-port", c.gossip_port))
+        c.gossip_seed = str(cl.get("gossip-seed", c.gossip_seed))
         c.replica_n = int(cl.get("replicas", c.replica_n))
         c.partition_n = int(cl.get("partitions", c.partition_n))
         if "polling-interval" in cl:
@@ -105,9 +117,12 @@ class Config:
             f'host = "{self.host}"\n'
             f'log-path = "{self.log_path}"\n'
             f"\n[cluster]\n"
+            f'type = "{self.cluster_type}"\n'
             f"replicas = {self.replica_n}\n"
             f"partitions = {self.partition_n}\n"
             f"hosts = [{hosts}]\n"
+            f"gossip-port = {self.gossip_port}\n"
+            f'gossip-seed = "{self.gossip_seed}"\n'
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'

@@ -17,6 +17,7 @@ from .broadcast import (
     NopBroadcaster,
     StaticNodeSet,
 )
+from .gossip import GossipNodeSet
 from .cluster import (
     DEFAULT_PARTITION_N,
     DEFAULT_REPLICA_N,
@@ -61,6 +62,7 @@ __all__ = [
     "default_mesh",
     "plan_writes",
     "Broadcaster",
+    "GossipNodeSet",
     "HTTPBroadcaster",
     "NodeSet",
     "NopBroadcaster",

@@ -1,0 +1,560 @@
+"""SWIM-lite gossip membership + broadcast transport.
+
+The analog of the reference's memberlist integration
+(/root/reference/gossip/gossip.go:34-222): `GossipNodeSet` is at once a
+NodeSet (live member list), a Broadcaster (send_sync direct TCP to every
+member, gossip.go:124-149; send_async epidemic piggyback on UDP probes,
+the TransmitLimitedQueue analog, gossip.go:152-164), and the state-sync
+delegate (TCP push/pull of NodeStatus protobufs, the
+LocalState/MergeRemoteState pair, gossip.go:193-222).
+
+Wire formats (all loopback/DCN host-side — the TPU data plane never
+touches this layer):
+
+- UDP control envelope: JSON `{"t": "ping"|"ack"|"ping-req"|"nack",
+  "seq": int, "from": [api_host, gossip_port], "target": ...,
+  "gossip": [update, ...]}` where each piggybacked update is
+  `{"u": "alive"|"suspect"|"dead", "host": api_host,
+  "addr": [ip, port], "inc": int}` or a user broadcast
+  `{"u": "msg", "b": base64(1-byte-tag + protobuf)}`.
+- TCP stream: 1-byte kind (`S` state push/pull, `B` broadcast) +
+  4-byte big-endian length + payload. `S` payloads are NodeStatus
+  protobufs and the receiver answers with its own; `B` payloads are
+  broadcast-framed messages (wire.marshal_message) and are ack'd with
+  a zero-length frame.
+
+Membership follows SWIM: periodic round-robin probe; a missed direct
+ack triggers indirect probes through `indirect_n` other members; still
+no ack -> SUSPECT, gossiped; unrefuted suspicion times out to DEAD. A
+node hearing itself suspected/declared dead refutes by re-gossiping
+ALIVE with a higher incarnation.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..wire import marshal_message, unmarshal_message
+from .broadcast import Broadcaster, NodeSet
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+_KIND_STATE = b"S"
+_KIND_BROADCAST = b"B"
+
+# Max UDP datagram we ever build; piggyback packing stays under this.
+_MAX_UDP = 1400
+
+
+class _Member:
+    __slots__ = ("host", "addr", "incarnation", "state", "state_time")
+
+    def __init__(self, host: str, addr: Tuple[str, int], incarnation: int = 0,
+                 state: str = ALIVE):
+        self.host = host                  # API host ("ip:port"), the identity
+        self.addr = addr                  # (ip, gossip_port) UDP/TCP addr
+        self.incarnation = incarnation
+        self.state = state
+        self.state_time = time.monotonic()
+
+
+class GossipNodeSet(NodeSet, Broadcaster):
+    """Gossip membership + broadcast plane for one node."""
+
+    def __init__(self, local_host: str, bind: str = "127.0.0.1",
+                 gossip_port: int = 0, seeds: Sequence[Tuple[str, int]] = (),
+                 broadcast_handler=None, status_handler=None,
+                 on_change: Optional[Callable[[List[str]], None]] = None,
+                 probe_interval: float = 1.0, probe_timeout: float = 0.5,
+                 suspicion_mult: float = 4.0, push_pull_interval: float = 30.0,
+                 gossip_fanout: int = 3, indirect_n: int = 2,
+                 retransmit_mult: int = 4, logger=None):
+        self.local_host = local_host
+        self.bind = bind
+        self.seeds = list(seeds)
+        self.broadcast_handler = broadcast_handler
+        self.status_handler = status_handler
+        self.on_change = on_change
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.suspicion_mult = suspicion_mult
+        self.push_pull_interval = push_pull_interval
+        self.gossip_fanout = gossip_fanout
+        self.indirect_n = indirect_n
+        self.retransmit_mult = retransmit_mult
+        self.logger = logger
+
+        self._lock = threading.RLock()
+        self._members: Dict[str, _Member] = {}
+        self._incarnation = 0
+        self._queue: List[List] = []      # [update_dict, transmits_left]
+        self._seen: Dict[str, float] = {}  # broadcast digest -> first-seen
+        self._acks: Dict[int, threading.Event] = {}
+        self._seq = 0
+        self._probe_ring: List[str] = []
+        self._closed = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        self._bind_port = gossip_port
+        self._udp: Optional[socket.socket] = None
+        self._tcp: Optional[socket.socket] = None
+        self.gossip_addr: Optional[Tuple[str, int]] = None
+
+    # -- NodeSet -------------------------------------------------------------
+
+    def nodes(self) -> List[str]:
+        """API hosts of members not known DEAD (self included)."""
+        with self._lock:
+            alive = [m.host for m in self._members.values()
+                     if m.state != DEAD]
+        return sorted(set(alive) | {self.local_host})
+
+    def open(self) -> None:
+        """Bind UDP + TCP on the same port, start daemons, join seeds
+        (gossip.go:63-86)."""
+        # UDP and TCP share one port number. With gossip_port=0 the OS
+        # picks the UDP port and the matching TCP port may be taken by
+        # someone else — retry with a fresh ephemeral pair.
+        for attempt in range(20):
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((self.bind, self._bind_port))
+            self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                self._tcp.bind((self.bind, self._udp.getsockname()[1]))
+                break
+            except OSError:
+                self._udp.close()
+                self._tcp.close()
+                if self._bind_port != 0 or attempt == 19:
+                    raise
+        self._tcp.listen(16)
+        # Blocking accept/recvfrom hold a kernel reference that keeps the
+        # port alive past close(); short timeouts let the loops observe
+        # _closed so a closed node actually goes dark.
+        self._udp.settimeout(0.2)
+        self._tcp.settimeout(0.2)
+        self.gossip_addr = self._udp.getsockname()
+        for name, fn in [("gossip-udp", self._udp_loop),
+                         ("gossip-tcp", self._tcp_loop),
+                         ("gossip-probe", self._probe_loop),
+                         ("gossip-pushpull", self._push_pull_loop)]:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        for addr in self.seeds:
+            self._join(tuple(addr))
+
+    def close(self) -> None:
+        self._closed.set()
+        for s in (self._udp, self._tcp):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- Broadcaster ---------------------------------------------------------
+
+    def send_sync(self, msg) -> None:
+        """Direct TCP delivery to every live member; raises on any
+        failure (gossip.go:124-149)."""
+        data = marshal_message(msg)
+        errors = []
+        for m in self._snapshot_members():
+            try:
+                self._tcp_roundtrip(m.addr, _KIND_BROADCAST, data,
+                                    want_reply=True)
+            except (OSError, ValueError) as e:
+                errors.append(f"{m.host}: {e}")
+        if errors:
+            raise ConnectionError("; ".join(errors))
+
+    def send_async(self, msg) -> None:
+        """Queue for epidemic piggyback on probe traffic
+        (gossip.go:152-164)."""
+        data = marshal_message(msg)
+        self._remember(data)
+        self._enqueue_broadcast(data)
+
+    # -- membership updates (SWIM rules) -------------------------------------
+
+    def _apply_alive(self, host: str, addr: Tuple[str, int], inc: int,
+                     regossip: bool = True):
+        if host == self.local_host:
+            # Someone thinks we (re)joined — nothing to refute.
+            return
+        with self._lock:
+            m = self._members.get(host)
+            if m is None:
+                self._members[host] = _Member(host, addr, inc)
+            elif inc > m.incarnation or (inc == m.incarnation
+                                         and m.state == SUSPECT):
+                m.incarnation, m.state, m.addr = inc, ALIVE, addr
+                m.state_time = time.monotonic()
+            else:
+                return
+        if regossip:
+            self._enqueue_update({"u": ALIVE, "host": host,
+                                  "addr": list(addr), "inc": inc})
+        self._changed()
+
+    def _apply_down(self, kind: str, host: str, inc: int):
+        if host == self.local_host:
+            self._refute()
+            return
+        with self._lock:
+            m = self._members.get(host)
+            if m is None or inc < m.incarnation:
+                return
+            order = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+            if inc == m.incarnation and order[kind] <= order[m.state]:
+                return
+            m.state, m.incarnation = kind, inc
+            m.state_time = time.monotonic()
+            addr = m.addr
+        self._enqueue_update({"u": kind, "host": host, "addr": list(addr),
+                              "inc": inc})
+        self._changed()
+
+    def _refute(self):
+        """We were suspected/declared dead: bump incarnation, gossip
+        ALIVE (memberlist's refutation path)."""
+        with self._lock:
+            self._incarnation += 1
+            inc = self._incarnation
+        self._enqueue_update({"u": ALIVE, "host": self.local_host,
+                              "addr": list(self.gossip_addr), "inc": inc})
+
+    def _changed(self):
+        if self.on_change is not None:
+            try:
+                self.on_change(self.nodes())
+            except Exception:  # noqa: BLE001 — observer must not kill gossip
+                self._log("gossip: on_change callback failed")
+
+    def _snapshot_members(self) -> List[_Member]:
+        with self._lock:
+            return [m for m in self._members.values() if m.state != DEAD]
+
+    # -- broadcast queue -----------------------------------------------------
+
+    def _enqueue_update(self, update: dict):
+        n = max(len(self._members), 1)
+        limit = max(self.retransmit_mult, self.retransmit_mult *
+                    int(1 + (n - 1).bit_length()))
+        with self._lock:
+            # An update about a host invalidates queued older ones.
+            if "host" in update:
+                self._queue = [q for q in self._queue
+                               if q[0].get("host") != update["host"]]
+            self._queue.append([update, limit])
+
+    def _enqueue_broadcast(self, data: bytes):
+        self._enqueue_update({"u": "msg",
+                              "b": base64.b64encode(data).decode()})
+
+    def _remember(self, data: bytes) -> bool:
+        """Dedupe epidemic re-broadcasts. True if seen before."""
+        digest = hashlib.sha1(data).hexdigest()
+        now = time.monotonic()
+        with self._lock:
+            self._seen = {k: v for k, v in self._seen.items()
+                          if now - v < 60.0}
+            if digest in self._seen:
+                return True
+            self._seen[digest] = now
+            return False
+
+    def _take_piggyback(self, budget: int) -> List[dict]:
+        out = []
+        with self._lock:
+            for q in list(self._queue):
+                blob = json.dumps(q[0])
+                if budget - len(blob) < 0:
+                    break
+                budget -= len(blob)
+                out.append(q[0])
+                q[1] -= 1
+                if q[1] <= 0:
+                    self._queue.remove(q)
+        return out
+
+    def _apply_piggyback(self, updates: List[dict]):
+        for u in updates:
+            kind = u.get("u")
+            if kind == ALIVE:
+                self._apply_alive(u["host"], tuple(u["addr"]), int(u["inc"]))
+            elif kind in (SUSPECT, DEAD):
+                self._apply_down(kind, u["host"], int(u["inc"]))
+            elif kind == "msg":
+                data = base64.b64decode(u["b"])
+                if not self._remember(data):
+                    self._deliver(data)
+                    self._enqueue_broadcast(data)  # keep the epidemic going
+
+    def _deliver(self, data: bytes):
+        if self.broadcast_handler is None:
+            return
+        try:
+            self.broadcast_handler.receive_message(unmarshal_message(data))
+        except Exception as e:  # noqa: BLE001 — bad peer message
+            self._log(f"gossip: dropping broadcast: {e}")
+
+    # -- UDP probe plane -----------------------------------------------------
+
+    def _send_udp(self, addr: Tuple[str, int], env: dict):
+        base = dict(env)
+        base["from"] = [self.local_host, self.gossip_addr[1]]
+        head = json.dumps(base)
+        base["gossip"] = self._take_piggyback(_MAX_UDP - len(head) - 64)
+        try:
+            self._udp.sendto(json.dumps(base).encode(), addr)
+        except OSError:
+            pass
+
+    def _udp_loop(self):
+        while not self._closed.is_set():
+            try:
+                data, src = self._udp.recvfrom(65536)
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            try:
+                env = json.loads(data.decode())
+            except ValueError:
+                continue
+            self._handle_udp(env, src)
+
+    def _handle_udp(self, env: dict, src: Tuple[str, int]):
+        frm = env.get("from")
+        if isinstance(frm, list) and len(frm) == 2:
+            # Learning a member from its own traffic: freshest possible.
+            self._apply_alive(str(frm[0]), (src[0], int(frm[1])), 0)
+        self._apply_piggyback(env.get("gossip") or [])
+        t = env.get("t")
+        if t == "ping":
+            self._send_udp(src, {"t": "ack", "seq": env.get("seq")})
+        elif t == "ack":
+            ev = self._acks.get(env.get("seq"))
+            if ev is not None:
+                ev.set()
+        elif t == "ping-req":
+            # Probe the target on the requester's behalf (SWIM indirect).
+            target = env.get("target")
+            seq = env.get("seq")
+            if isinstance(target, list) and len(target) == 2:
+                threading.Thread(
+                    target=self._indirect_probe,
+                    args=((str(target[0]), int(target[1])), seq, src),
+                    daemon=True).start()
+
+    def _indirect_probe(self, target: Tuple[str, int], seq, reply_to):
+        if self._ping(target):
+            self._send_udp(reply_to, {"t": "ack", "seq": seq})
+
+    def _ping(self, addr: Tuple[str, int]) -> bool:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            ev = self._acks[seq] = threading.Event()
+        try:
+            self._send_udp(addr, {"t": "ping", "seq": seq})
+            return ev.wait(self.probe_timeout)
+        finally:
+            self._acks.pop(seq, None)
+
+    def _probe_loop(self):
+        while not self._closed.wait(self.probe_interval):
+            m = self._next_probe_target()
+            if m is not None:
+                self._probe(m)
+            self._expire_suspects()
+
+    def _next_probe_target(self) -> Optional[_Member]:
+        with self._lock:
+            candidates = {h for h, m in self._members.items()
+                          if m.state != DEAD}
+            self._probe_ring = [h for h in self._probe_ring
+                                if h in candidates]
+            if not self._probe_ring:
+                self._probe_ring = list(candidates)
+                random.shuffle(self._probe_ring)
+            if not self._probe_ring:
+                return None
+            return self._members.get(self._probe_ring.pop())
+
+    def _probe(self, m: _Member):
+        if self._ping(m.addr):
+            return
+        # Indirect probes through up to indirect_n other members.
+        with self._lock:
+            others = [x for x in self._members.values()
+                      if x.state == ALIVE and x.host != m.host]
+        random.shuffle(others)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            ev = self._acks[seq] = threading.Event()
+        try:
+            for o in others[:self.indirect_n]:
+                self._send_udp(o.addr, {"t": "ping-req", "seq": seq,
+                                        "target": list(m.addr)})
+            if others[:self.indirect_n] and ev.wait(self.probe_timeout * 2):
+                return
+        finally:
+            self._acks.pop(seq, None)
+        self._log(f"gossip: {m.host} failed probe, suspecting")
+        self._apply_down(SUSPECT, m.host, m.incarnation)
+
+    def _expire_suspects(self):
+        deadline = self.suspicion_mult * self.probe_interval
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for m in self._members.values():
+                if m.state == SUSPECT and now - m.state_time > deadline:
+                    expired.append((m.host, m.incarnation))
+        for host, inc in expired:
+            self._log(f"gossip: suspect {host} timed out, declaring dead")
+            self._apply_down(DEAD, host, inc)
+
+    # -- TCP plane: join / push-pull / sync broadcast ------------------------
+
+    def _tcp_loop(self):
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._tcp.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_tcp, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_tcp(self, conn: socket.socket):
+        with conn:
+            try:
+                conn.settimeout(10.0)
+                kind, payload = _read_frame(conn)
+                if kind == _KIND_STATE:
+                    self._merge_remote_state(payload)
+                    _write_frame(conn, _KIND_STATE, self._local_state())
+                elif kind == _KIND_BROADCAST:
+                    # Sync broadcasts are guaranteed-delivery: always
+                    # apply, never consult the epidemic dedupe cache (a
+                    # legitimately repeated identical message — e.g.
+                    # create/delete/create of the same index — must land).
+                    self._deliver(payload)
+                    _write_frame(conn, _KIND_BROADCAST, b"")
+            except (OSError, ValueError):
+                pass
+
+    def _tcp_roundtrip(self, addr: Tuple[str, int], kind: bytes,
+                       payload: bytes, want_reply: bool) -> bytes:
+        with socket.create_connection(addr, timeout=10.0) as conn:
+            _write_frame(conn, kind, payload)
+            if not want_reply:
+                return b""
+            _, reply = _read_frame(conn)
+            return reply
+
+    def _join(self, addr: Tuple[str, int]):
+        """Initial push/pull with a seed (memberlist join,
+        gossip.go:74)."""
+        try:
+            reply = self._tcp_roundtrip(addr, _KIND_STATE,
+                                        self._local_state(), want_reply=True)
+            self._merge_remote_state(reply)
+        except (OSError, ValueError) as e:
+            self._log(f"gossip: join {addr} failed: {e}")
+
+    def _push_pull_loop(self):
+        while not self._closed.is_set():
+            # Isolated (no members yet, e.g. seed was down at open):
+            # retry the seeds on a fast cadence instead of waiting out
+            # the full push/pull interval.
+            isolated = not self._snapshot_members() and self.seeds
+            delay = (max(self.probe_interval, 0.5) if isolated
+                     else self.push_pull_interval)
+            if self._closed.wait(delay):
+                return
+            members = self._snapshot_members()
+            if members:
+                self._join(random.choice(members).addr)
+            else:
+                for addr in self.seeds:
+                    self._join(tuple(addr))
+
+    def _local_state(self) -> bytes:
+        """JSON {members, status: b64(NodeStatus pb)} — the LocalState
+        payload (gossip.go:193-204)."""
+        with self._lock:
+            members = [{"host": m.host, "addr": list(m.addr),
+                        "inc": m.incarnation, "state": m.state}
+                       for m in self._members.values()]
+        members.append({"host": self.local_host,
+                        "addr": list(self.gossip_addr),
+                        "inc": self._incarnation, "state": ALIVE})
+        status = b""
+        if self.status_handler is not None:
+            try:
+                status = self.status_handler.local_status().SerializeToString()
+            except Exception:  # noqa: BLE001 — status is best-effort
+                pass
+        return json.dumps({"members": members,
+                           "status": base64.b64encode(status).decode()}
+                          ).encode()
+
+    def _merge_remote_state(self, payload: bytes):
+        """MergeRemoteState (gossip.go:206-222)."""
+        state = json.loads(payload.decode())
+        for m in state.get("members", []):
+            if m.get("state") in (SUSPECT, DEAD):
+                self._apply_down(m["state"], m["host"], int(m["inc"]))
+            else:
+                self._apply_alive(m["host"], tuple(m["addr"]),
+                                  int(m["inc"]), regossip=False)
+        status = base64.b64decode(state.get("status") or "")
+        if status and self.status_handler is not None:
+            from ..wire import pb
+            ns = pb.NodeStatus()
+            ns.ParseFromString(status)
+            self.status_handler.handle_remote_status(ns)
+
+    def _log(self, msg: str):
+        if self.logger is not None:
+            self.logger.info(msg)
+
+
+def _read_frame(conn: socket.socket) -> Tuple[bytes, bytes]:
+    head = _read_exact(conn, 5)
+    kind, n = head[:1], struct.unpack(">I", head[1:])[0]
+    if n > (1 << 26):
+        raise ValueError(f"gossip frame too large: {n}")
+    return kind, _read_exact(conn, n)
+
+
+def _read_exact(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ValueError("short read")
+        buf += chunk
+    return buf
+
+
+def _write_frame(conn: socket.socket, kind: bytes, payload: bytes):
+    conn.sendall(kind + struct.pack(">I", len(payload)) + payload)

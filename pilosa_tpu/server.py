@@ -83,13 +83,34 @@ class Server:
         self.host = self.config.host
         self.client = ClusterClient()
 
-        self.node_set = StaticNodeSet(self.config.cluster_hosts)
-        if len(self.config.cluster_hosts) > 1:
+        # Transport selection (reference server/server.go:150-187:
+        # static | http | gossip).
+        ctype = self.config.cluster_type
+        if ctype == "gossip":
+            from .parallel.gossip import GossipNodeSet
+            bind_ip = self.host.partition(":")[0] or "127.0.0.1"
+            seeds = []
+            if self.config.gossip_seed:
+                sh, _, sp = self.config.gossip_seed.partition(":")
+                seeds.append((sh or "127.0.0.1",
+                              int(sp or self.config.gossip_port)))
+            self.node_set = GossipNodeSet(
+                local_host=self.host, bind=bind_ip,
+                gossip_port=self.config.gossip_port, seeds=seeds,
+                broadcast_handler=self, status_handler=self,
+                on_change=self._set_live_hosts, logger=self.logger)
+            self.broadcaster = self.node_set
+        elif ctype == "http" and len(self.config.cluster_hosts) > 1:
+            self.node_set = StaticNodeSet(self.config.cluster_hosts)
             self.broadcaster = HTTPBroadcaster(
                 self.node_set, self.host, self.client.for_host,
                 logger=self.logger)
-        else:
+        elif ctype in ("http", "static"):
+            self.node_set = StaticNodeSet(self.config.cluster_hosts)
             self.broadcaster = NopBroadcaster()
+        else:
+            raise ValueError(f"unknown cluster type: {ctype!r} "
+                             "(want static, http, or gossip)")
         self.holder.broadcaster = self.broadcaster
 
         self.executor = Executor(self.holder, host=self.host,
@@ -125,7 +146,10 @@ class Server:
                 node.host = self.host
             self.executor.host = self.host
             self.handler.host = self.host
+            if hasattr(self.node_set, "local_host"):
+                self.node_set.local_host = self.host
         self._api.start()
+        self.node_set.open()
 
         for name, fn, interval in [
             ("anti-entropy", self._anti_entropy_tick,
@@ -141,9 +165,15 @@ class Server:
 
     def close(self):
         self.closing.close()
+        self.node_set.close()
         if self._api is not None:
             self._api.close()
         self.holder.close()
+
+    def _set_live_hosts(self, hosts):
+        """Gossip membership feed -> cluster liveness
+        (reference Cluster.NodeStates, cluster.go:156-169)."""
+        self.cluster.node_set_hosts = list(hosts)
 
     def _loop(self, fn, interval: float):
         while not self.closing.wait(interval):

@@ -311,3 +311,54 @@ class TestRegressionsFromReview:
         res = InternalClient(hosts[1]).execute_query(
             None, "i", "Bitmap(rowID=1, frame=f)", [0], remote=True)
         assert sorted(res[0].columns()) == [3]
+
+
+class TestGossipCluster:
+    """Two live Server nodes clustered via the gossip transport
+    (reference server/server.go:159-176 gossip wiring)."""
+
+    def _wait(self, fn, timeout=10.0):
+        from tests.test_gossip import wait_until
+        return wait_until(fn, timeout=timeout)
+
+    def test_gossip_schema_broadcast(self, tmp_path):
+        ports = free_ports(2)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        gports = free_ports(2)
+        servers = []
+        for i, h in enumerate(hosts):
+            c = Config()
+            c.data_dir = str(tmp_path / f"gnode{i}")
+            c.host = h
+            c.cluster_hosts = hosts
+            c.cluster_type = "gossip"
+            c.gossip_port = gports[i]
+            if i > 0:
+                c.gossip_seed = f"127.0.0.1:{gports[0]}"
+            c.anti_entropy_interval = 3600
+            c.polling_interval = 3600
+            s = Server(c)
+            s.open()
+            servers.append(s)
+        try:
+            a, b = servers
+            # Membership converges through SWIM probes.
+            assert self._wait(lambda: set(a.node_set.nodes()) == set(hosts))
+            assert self._wait(lambda: set(b.node_set.nodes()) == set(hosts))
+            # Schema changes ride the gossip broadcast plane.
+            InternalClient(hosts[0]).create_index("gi")
+            InternalClient(hosts[0]).create_frame("gi", "gf")
+            assert self._wait(lambda: b.holder.frame("gi", "gf") is not None)
+            # Liveness feeds cluster node states (UP for both).
+            states = a.cluster.node_states()
+            assert all(v == "UP" for v in states.values()), states
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_unknown_cluster_type_rejected(self, tmp_path):
+        c = Config()
+        c.data_dir = str(tmp_path / "bad")
+        c.cluster_type = "gosip"
+        with pytest.raises(ValueError, match="unknown cluster type"):
+            Server(c)
