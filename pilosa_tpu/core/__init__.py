@@ -13,6 +13,13 @@ from .timequantum import (
     views_by_time_range,
 )
 from .row import Row
+from .iterator import (
+    BufIterator,
+    LimitIterator,
+    PairIterator,
+    RoaringIterator,
+    SliceIterator,
+)
 from .cache import LRUCache, RankCache, SimpleCache
 from .attr import AttrStore
 from .fragment import Fragment
@@ -28,6 +35,11 @@ __all__ = [
     "views_by_time",
     "views_by_time_range",
     "Row",
+    "BufIterator",
+    "LimitIterator",
+    "PairIterator",
+    "RoaringIterator",
+    "SliceIterator",
     "LRUCache",
     "RankCache",
     "SimpleCache",

@@ -1,0 +1,145 @@
+"""(row, column) pair iterators over fragment-shaped data.
+
+Parity with /root/reference/iterator.go:24-194: the reference threads an
+`Iterator` interface (Next/Seek over (rowID, columnID) pairs) through
+MergeBlock consensus and CSV export. This build's storage layer is
+vectorized (blocks move as parallel row/col numpy arrays), so these
+iterators are the *compat seam* for code that wants streamed pairs —
+plugins, exports, debugging — not the hot path.
+
+- `PairIterator`   — base interface: seek(row, col) + next() -> (r, c) | None
+- `SliceIterator`  — over parallel row/col arrays (iterator.go:102-143)
+- `RoaringIterator`— over a roaring.Bitmap of linear positions, divmod
+                     by SliceWidth (iterator.go:146-194)
+- `BufIterator`    — single-pair unread buffer (iterator.go:45-99)
+- `LimitIterator`  — stop after N pairs (iterator.go:28-42 analog)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+
+Pair = Optional[Tuple[int, int]]
+
+
+class PairIterator:
+    """Interface: ordered (rowID, columnID) pairs."""
+
+    def seek(self, row: int, col: int) -> None:
+        raise NotImplementedError
+
+    def next(self) -> Pair:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        p = self.next()
+        if p is None:
+            raise StopIteration
+        return p
+
+
+class SliceIterator(PairIterator):
+    """Iterates parallel row/col arrays in (row, col) order
+    (iterator.go:102-143)."""
+
+    def __init__(self, rows: np.ndarray, cols: np.ndarray):
+        rows = np.asarray(rows, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64)
+        if len(rows) != len(cols):
+            raise ValueError("rows and cols must be the same length")
+        order = np.lexsort((cols, rows))
+        self.rows = rows[order]
+        self.cols = cols[order]
+        self.i = 0
+
+    def seek(self, row: int, col: int) -> None:
+        """Position at the first pair >= (row, col) in the row-major
+        order fragments use (fragment.go:1511-1514)."""
+        lo = int(np.searchsorted(self.rows, row, side="left"))
+        hi = int(np.searchsorted(self.rows, row, side="right"))
+        self.i = lo + int(np.searchsorted(self.cols[lo:hi], col,
+                                          side="left"))
+
+    def next(self) -> Pair:
+        if self.i >= len(self.rows):
+            return None
+        p = (int(self.rows[self.i]), int(self.cols[self.i]))
+        self.i += 1
+        return p
+
+
+class RoaringIterator(PairIterator):
+    """Iterates a roaring bitmap of linear fragment positions as
+    (pos // SliceWidth, pos % SliceWidth) pairs (iterator.go:146-194)."""
+
+    def __init__(self, bitmap):
+        self._bitmap = bitmap
+        self._it = iter(bitmap)
+
+    def seek(self, row: int, col: int) -> None:
+        pos = int(row) * SLICE_WIDTH + int(col)
+        self._it = self._bitmap.iterator_from(pos)
+
+    def next(self) -> Pair:
+        v = next(self._it, None)
+        if v is None:
+            return None
+        return divmod(int(v), SLICE_WIDTH)
+
+
+class BufIterator(PairIterator):
+    """Wraps an iterator with a one-pair unread buffer
+    (iterator.go:45-99)."""
+
+    def __init__(self, it: PairIterator):
+        self._it = it
+        self._buf: Pair = None
+        self._have = False
+
+    def seek(self, row: int, col: int) -> None:
+        self._have = False
+        self._it.seek(row, col)
+
+    def next(self) -> Pair:
+        if self._have:
+            self._have = False
+            return self._buf
+        self._buf = self._it.next()
+        return self._buf
+
+    def unread(self) -> None:
+        if self._have:
+            raise RuntimeError("buffer already full")
+        self._have = True
+
+    def peek(self) -> Pair:
+        p = self.next()
+        if p is not None or self._buf is not None:
+            self._have = True
+        return p
+
+
+class LimitIterator(PairIterator):
+    """Yields at most n pairs from the underlying iterator."""
+
+    def __init__(self, it: PairIterator, n: int):
+        self._it = it
+        self._remaining = int(n)
+
+    def seek(self, row: int, col: int) -> None:
+        self._it.seek(row, col)
+
+    def next(self) -> Pair:
+        if self._remaining <= 0:
+            return None
+        p = self._it.next()
+        if p is not None:
+            self._remaining -= 1
+        return p

@@ -211,7 +211,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
 
     def _apply_down(self, kind: str, host: str, inc: int):
         if host == self.local_host:
-            self._refute()
+            self._refute(heard=inc)
             return
         with self._lock:
             m = self._members.get(host)
@@ -227,11 +227,13 @@ class GossipNodeSet(NodeSet, Broadcaster):
                               "inc": inc})
         self._changed()
 
-    def _refute(self):
-        """We were suspected/declared dead: bump incarnation, gossip
-        ALIVE (memberlist's refutation path)."""
+    def _refute(self, heard: int = 0):
+        """We were suspected/declared dead: jump past the accuser's
+        incarnation in one step (a restarted node may hear DEAD@k while
+        its own counter restarted at 0) and gossip ALIVE (memberlist's
+        refutation path)."""
         with self._lock:
-            self._incarnation += 1
+            self._incarnation = max(self._incarnation, heard) + 1
             inc = self._incarnation
         self._enqueue_update({"u": ALIVE, "host": self.local_host,
                               "addr": list(self.gossip_addr), "inc": inc})
@@ -278,11 +280,19 @@ class GossipNodeSet(NodeSet, Broadcaster):
 
     def _take_piggyback(self, budget: int) -> List[dict]:
         out = []
+        max_fit = _MAX_UDP - 128  # largest any single packet can carry
         with self._lock:
             for q in list(self._queue):
                 blob = json.dumps(q[0])
-                if budget - len(blob) < 0:
-                    break
+                if len(blob) > max_fit:
+                    # Can never ride a datagram; dropping it beats
+                    # wedging the queue head forever.
+                    self._queue.remove(q)
+                    self._log("gossip: dropping oversized broadcast "
+                              f"({len(blob)} B) — use send_sync")
+                    continue
+                if len(blob) > budget:
+                    continue  # skip, try smaller queued updates
                 budget -= len(blob)
                 out.append(q[0])
                 q[1] -= 1
@@ -300,7 +310,11 @@ class GossipNodeSet(NodeSet, Broadcaster):
             elif kind == "msg":
                 data = base64.b64decode(u["b"])
                 if not self._remember(data):
-                    self._deliver(data)
+                    # Deliver off the UDP receive thread: a slow handler
+                    # must not stall ping/ack processing (which would get
+                    # this node falsely suspected).
+                    threading.Thread(target=self._deliver, args=(data,),
+                                     daemon=True).start()
                     self._enqueue_broadcast(data)  # keep the epidemic going
 
     def _deliver(self, data: bytes):

@@ -1,12 +1,13 @@
 """Cross-cutting utilities: validation, errors, stats."""
 
 from .validation import validate_label, validate_name
-from .stats import ExpvarStats, MultiStats, NopStats, StatsClient
+from .stats import ExpvarStats, MultiStats, NopStats, StatsClient, StatsDStats
 
 __all__ = [
     "validate_label",
     "validate_name",
     "ExpvarStats",
+    "StatsDStats",
     "MultiStats",
     "NopStats",
     "StatsClient",

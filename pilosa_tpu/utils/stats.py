@@ -108,3 +108,98 @@ class MultiStats(StatsClient):
     def timing(self, name, value_us):
         for c in self.clients:
             c.timing(name, value_us)
+
+
+class StatsDStats(StatsClient):
+    """Buffered dogstatsd UDP client (parity with the reference's
+    DataDog statsd backend, /root/reference/datadog/datadog.go:47-115).
+
+    Wire format: `name:value|type|#tag1,tag2\n`, batched up to
+    `max_payload` bytes per datagram and flushed on overflow, on a
+    `flush_interval` timer tick (piggybacked on writes, no timer
+    thread), and on close(). Emission is best-effort: a dead agent
+    never raises into the caller.
+    """
+
+    def __init__(self, addr=("127.0.0.1", 8125), prefix: str = "pilosa.",
+                 tags: Optional[Iterable[str]] = None, max_payload: int = 1432,
+                 flush_interval: float = 1.0, parent=None):
+        import socket
+        import time as _time
+        self.addr = tuple(addr)
+        self.prefix = prefix
+        self.tags = tuple(tags or ())
+        self.max_payload = max_payload
+        self.flush_interval = flush_interval
+        if parent is None:
+            self._lock = threading.Lock()
+            self._buf: list = []
+            self._buf_len = 0
+            self._last_flush = _time.monotonic()
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        else:
+            self._lock = parent._lock
+            self._buf = parent._buf
+            self._sock = parent._sock
+            self._root = parent._root
+            return
+        self._root = self
+
+    def with_tags(self, *tags: str) -> "StatsDStats":
+        child = StatsDStats(self.addr, self.prefix, self.tags + tags,
+                            self.max_payload, self.flush_interval,
+                            parent=self._root)
+        return child
+
+    def _emit(self, name: str, value, kind: str):
+        line = f"{self.prefix}{name}:{value}|{kind}"
+        if self.tags:
+            line += "|#" + ",".join(self.tags)
+        root = self._root
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            if root._buf_len + len(root._buf) + len(line) > self.max_payload:
+                root._flush_locked()
+            root._buf.append(line)
+            root._buf_len += len(line)
+            if now - root._last_flush >= self.flush_interval:
+                root._flush_locked()
+                root._last_flush = now
+
+    def _flush_locked(self):
+        if not self._buf:
+            return
+        payload = "\n".join(self._buf).encode()
+        self._buf.clear()
+        self._buf_len = 0
+        try:
+            self._sock.sendto(payload, self.addr)
+        except OSError:
+            pass
+
+    def flush(self):
+        with self._lock:
+            self._root._flush_locked()
+
+    def close(self):
+        self.flush()
+        try:
+            self._root._sock.close()
+        except OSError:
+            pass
+
+    def count(self, name, value=1):
+        self._emit(name, value, "c")
+
+    def gauge(self, name, value):
+        self._emit(name, value, "g")
+
+    def histogram(self, name, value):
+        self._emit(name, value, "h")
+
+    def set(self, name, value):
+        self._emit(name, value, "s")
+
+    def timing(self, name, value_us):
+        self._emit(name, value_us / 1000.0, "ms")

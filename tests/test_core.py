@@ -417,3 +417,53 @@ def test_fragment_top_requested_ids_exact_after_clear(frag):
     frag.cache.recalculate()  # threshold_value becomes 1
     frag.clear_bit(2, 1)      # cache.add(2, 0) is gated out
     assert frag.top(TopOptions(row_ids=[2])) == []
+
+
+class TestPairIterators:
+    """core/iterator.py — the (row,col) pair iterator compat seam
+    (reference iterator.go:24-194)."""
+
+    def _slice_it(self):
+        import numpy as np
+        from pilosa_tpu.core.iterator import SliceIterator
+        rows = np.array([2, 0, 1, 0, 1], dtype=np.uint64)
+        cols = np.array([9, 5, 1, 3, 8], dtype=np.uint64)
+        return SliceIterator(rows, cols)
+
+    def test_slice_iterator_sorted_order(self):
+        assert list(self._slice_it()) == [(0, 3), (0, 5), (1, 1), (1, 8),
+                                          (2, 9)]
+
+    def test_slice_iterator_seek(self):
+        it = self._slice_it()
+        it.seek(1, 2)
+        assert it.next() == (1, 8)
+        it.seek(0, 0)
+        assert it.next() == (0, 3)
+        it.seek(3, 0)
+        assert it.next() is None
+
+    def test_roaring_iterator_divmod(self):
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.core.iterator import RoaringIterator
+        from pilosa_tpu.roaring import Bitmap
+        b = Bitmap([3, SLICE_WIDTH + 7, 2 * SLICE_WIDTH])
+        it = RoaringIterator(b)
+        assert list(it) == [(0, 3), (1, 7), (2, 0)]
+        it.seek(1, 0)
+        assert it.next() == (1, 7)
+
+    def test_buf_iterator_unread_peek(self):
+        from pilosa_tpu.core.iterator import BufIterator
+        it = BufIterator(self._slice_it())
+        assert it.peek() == (0, 3)
+        assert it.next() == (0, 3)   # peek did not consume
+        assert it.next() == (0, 5)
+        it.unread()
+        assert it.next() == (0, 5)   # unread replays
+        with_pairs = list(it)
+        assert with_pairs == [(1, 1), (1, 8), (2, 9)]
+
+    def test_limit_iterator(self):
+        from pilosa_tpu.core.iterator import LimitIterator
+        assert list(LimitIterator(self._slice_it(), 2)) == [(0, 3), (0, 5)]

@@ -362,3 +362,51 @@ class TestGossipCluster:
         c.cluster_type = "gosip"
         with pytest.raises(ValueError, match="unknown cluster type"):
             Server(c)
+
+
+class TestStatsD:
+    """Dogstatsd backend (reference datadog/datadog.go analog)."""
+
+    def _recv_lines(self, sock, timeout=3.0):
+        sock.settimeout(timeout)
+        data, _ = sock.recvfrom(65536)
+        return data.decode().split("\n")
+
+    def test_wire_format_and_tags(self):
+        from pilosa_tpu.utils import StatsDStats
+        agent = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        agent.bind(("127.0.0.1", 0))
+        st = StatsDStats(addr=agent.getsockname(), flush_interval=9999)
+        tagged = st.with_tags("index:i", "frame:f")
+        st.count("setBit", 2)
+        tagged.gauge("maxSlice", 7)
+        tagged.timing("query", 1500)
+        st.flush()
+        lines = self._recv_lines(agent)
+        assert "pilosa.setBit:2|c" in lines
+        assert "pilosa.maxSlice:7|g|#index:i,frame:f" in lines
+        assert "pilosa.query:1.5|ms|#index:i,frame:f" in lines
+        st.close()
+        agent.close()
+
+    def test_overflow_flushes(self):
+        from pilosa_tpu.utils import StatsDStats
+        agent = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        agent.bind(("127.0.0.1", 0))
+        st = StatsDStats(addr=agent.getsockname(), max_payload=64,
+                         flush_interval=9999)
+        for i in range(20):
+            st.count(f"metric{i}")
+        lines = self._recv_lines(agent)
+        assert all(len("\n".join(lines)) <= 64 for _ in [0])
+        assert lines[0] == "pilosa.metric0:1|c"
+        st.close()
+        agent.close()
+
+    def test_dead_agent_never_raises(self):
+        from pilosa_tpu.utils import StatsDStats
+        st = StatsDStats(addr=("127.0.0.1", 1))  # nothing listens
+        for i in range(100):
+            st.count("x")
+        st.flush()
+        st.close()
