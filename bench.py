@@ -1,14 +1,20 @@
-"""Benchmark: Count(Intersect(row_a, row_b)) over a ~1B-column index.
+"""Benchmark harness for the five BASELINE.json configs.
 
-The BASELINE.json north-star config: two fully-populated rows spanning
-960 slices (960 * 2^20 = 1,006,632,960 columns), fused
-intersect+popcount on device (pilosa_tpu.parallel.mesh) vs the host
-CPU popcount path (numpy bitwise_count over the same container words —
-the stand-in for the reference's amd64 POPCNT assembly,
+Headline (stdout, ONE JSON line): Count(Intersect(row_a, row_b)) over a
+~1B-column index — two fully-populated rows spanning 960 slices
+(960 * 2^20 = 1,006,632,960 columns), fused intersect+popcount on
+device (pilosa_tpu.parallel.mesh) vs the host CPU popcount path (the
+native C++ kernel standing in for the reference's amd64 POPCNT assembly,
 /root/reference/roaring/assembly_amd64.s popcntAndSlice).
 
-Prints ONE JSON line: {"metric", "value" (queries/sec), "unit",
-"vs_baseline" (device QPS / host-CPU QPS)}.
+All five configs (written to BENCH_DETAILS.json):
+  1. count_bitmap      — Count(Bitmap(row)), single fragment
+  2. nary_single_slice — Union/Intersect/Difference over 8 rows, 1 slice
+  3. topn              — TopN(n=100) over a multi-row index
+  4. range_views       — union-count over 4 time-quantum view rows
+                         (the device shape of Range(), time.go:95-167)
+  5. mapreduce_count   — multi-slice Intersect+Count over the full mesh
+                         (the headline)
 """
 
 import json
@@ -17,13 +23,13 @@ import time
 import numpy as np
 
 
-def build_index(num_slices: int, seed: int = 7):
-    """Directly build the stacked (S, 32, 2048) pool: rows 0 and 1 fully
-    dense containers of random words (content doesn't affect op cost)."""
+def build_index(num_slices: int, num_rows: int = 2, seed: int = 7):
+    """Stacked (S, num_rows*16, 2048) pool: every row a fully dense
+    container run of random words (content doesn't affect op cost)."""
     from pilosa_tpu.ops.pool import CONTAINER_WORDS, ROW_SPAN
 
     rng = np.random.default_rng(seed)
-    cap = 2 * ROW_SPAN  # rows 0 and 1
+    cap = num_rows * ROW_SPAN
     keys = np.broadcast_to(
         np.arange(cap, dtype=np.int32), (num_slices, cap)).copy()
     words = rng.integers(0, 2**32, size=(num_slices, cap, CONTAINER_WORDS),
@@ -31,34 +37,55 @@ def build_index(num_slices: int, seed: int = 7):
     return keys, words
 
 
-def bench_device(keys, words, iters: int):
+def _device_index(keys, words, mesh):
     import jax
-
-    from pilosa_tpu.parallel import ShardedIndex, compile_mesh_count, default_mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = default_mesh()
-    sharding = NamedSharding(mesh, P("slices"))
-    index = ShardedIndex(
-        keys=jax.device_put(keys, sharding),
-        words=jax.device_put(words, sharding),
-    )
-    fn = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
-    ids = np.int32([0, 1])
+    from pilosa_tpu.parallel import ShardedIndex
 
-    out = fn(index, ids)  # compile + warmup
-    jax.block_until_ready(out)
-    # Block per call: pipelined dispatch overstates throughput through
-    # the remote-TPU relay (acks can land before execution completes).
-    times = []
+    sharding = NamedSharding(mesh, P("slices"))
+    return ShardedIndex(keys=jax.device_put(keys, sharding),
+                        words=jax.device_put(words, sharding))
+
+
+def _sustained(fn, iters, warm=True):
+    """Sustained mean seconds/call: chain each call's scalar into an
+    accumulator and force ONE host readback of the chained value at the
+    end. Through the remote-TPU relay, per-call block_until_ready can
+    ack before execution completes (understating latency) while a
+    per-call value fetch pays a fixed ~75 ms readback-poll cadence
+    (overstating it); the dependency chain makes every execution
+    contribute to the fetched result, so total/N is trustworthy. The
+    price is that only the MEAN is measurable, not a true p50 — keys
+    are named mean_ms accordingly."""
+    if warm:
+        fn()  # compile + warm
+    t0 = time.perf_counter()
+    acc = None
     for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(index, ids)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    dt = times[len(times) // 2]  # median
-    return int(out), dt
+        out = fn()
+        acc = out if acc is None else acc + out
+    acc_host = int(acc)  # forces completion of the whole chain
+    dt = (time.perf_counter() - t0) / iters
+    return acc_host, dt
+
+
+def bench_tree(index, mesh, tree, num_leaves, ids, iters):
+    from pilosa_tpu.parallel import compile_mesh_count
+
+    fn = compile_mesh_count(mesh, tree, num_leaves)
+    ids = np.int32(ids)
+    first = int(fn(index, ids))  # compile + warm + correctness value
+    _, dt = _sustained(lambda: fn(index, ids), iters, warm=False)
+    return first, dt
+
+
+def bench_topn(index, mesh, num_rows, k, iters):
+    from pilosa_tpu.parallel import compile_mesh_topn
+
+    fn = compile_mesh_topn(mesh, num_rows, k)
+    _, dt = _sustained(lambda: fn(index)[0].sum(), iters)
+    return dt
 
 
 def bench_host(words, iters: int):
@@ -69,7 +96,8 @@ def bench_host(words, iters: int):
     from pilosa_tpu.ops.pool import ROW_SPAN
 
     wa = np.ascontiguousarray(words[:, :ROW_SPAN, :]).reshape(-1).view(np.uint64)
-    wb = np.ascontiguousarray(words[:, ROW_SPAN:, :]).reshape(-1).view(np.uint64)
+    wb = np.ascontiguousarray(
+        words[:, ROW_SPAN:2 * ROW_SPAN, :]).reshape(-1).view(np.uint64)
     total = native.popcnt_and_slice(wa, wb)  # warmup
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -81,24 +109,69 @@ def bench_host(words, iters: int):
 def main():
     import jax
 
-    num_slices = 960  # 960 * 2^20 = 1,006,632,960 columns
-    on_tpu = jax.default_backend() == "tpu"
-    if not on_tpu:
-        num_slices = 96  # CI/CPU smoke: keep the shape, shrink the scale
+    from pilosa_tpu.parallel import default_mesh
 
+    on_tpu = jax.default_backend() == "tpu"
+    num_slices = 960 if on_tpu else 96  # CPU smoke keeps the shape
+    iters = 100 if on_tpu else 3
+    details = {}
+    mesh = default_mesh()
+
+    # -- headline (config 5): 1B-column multi-slice Intersect+Count ----------
     keys, words = build_index(num_slices)
-    dev_count, dev_dt = bench_device(keys, words, iters=30 if on_tpu else 3)
+    index = _device_index(keys, words, mesh)
+    dev_count, dev_dt = bench_tree(
+        index, mesh, ["and", ["leaf"], ["leaf"]], 2, [0, 1], iters)
     host_count, host_dt = bench_host(words, iters=3)
     # Device count is an int32 sum; compare against the two's-complement
     # wrap of the host total.
-    assert dev_count == int(np.int32(np.uint64(host_count))), (dev_count, host_count)
+    assert dev_count == int(np.int32(np.uint64(host_count))), (
+        dev_count, host_count)
+    details["mapreduce_count"] = {
+        "qps": 1.0 / dev_dt, "mean_ms": dev_dt * 1e3,
+        "cols": num_slices << 20, "host_cpu_qps": 1.0 / host_dt,
+        "vs_host": host_dt / dev_dt}
 
-    qps = 1.0 / dev_dt
+    # -- config 1: Count(Bitmap(row)) single fragment ------------------------
+    _, dt = bench_tree(index, mesh, ["leaf"], 1, [0], iters)
+    details["count_bitmap"] = {"qps": 1.0 / dt, "mean_ms": dt * 1e3}
+
+    # -- config 2: Union / Intersect / Difference over 8 rows, 1 slice -------
+    k8, w8 = build_index(1, num_rows=8, seed=11)
+    mesh1 = default_mesh(1)
+    idx8 = _device_index(k8, w8, mesh1)
+    for name, op in [("union", "or"), ("intersect", "and"),
+                     ("difference", "andnot")]:
+        tree = [op] + [["leaf"]] * 8
+        _, dt = bench_tree(idx8, mesh1, tree, 8, list(range(8)), iters)
+        details[f"nary_{name}_8rows"] = {"qps": 1.0 / dt, "mean_ms": dt * 1e3}
+
+    # -- config 3: TopN(n=100) over a multi-row index ------------------------
+    topn_slices = 64 if on_tpu else 8  # multiple of the 8-device v5e-8 mesh
+    topn_rows = 128
+    kt, wt = build_index(topn_slices, num_rows=topn_rows, seed=13)
+    mesh_t = default_mesh()
+    idxt = _device_index(kt, wt, mesh_t)
+    dt = bench_topn(idxt, mesh_t, num_rows=topn_rows, k=100, iters=iters)
+    details["topn_n100"] = {"mean_ms": dt * 1e3, "rows": topn_rows,
+                            "slices": topn_slices}
+
+    # -- config 4: Range() time-quantum views (union of 4 view rows) ---------
+    tree = ["or"] + [["leaf"]] * 4
+    _, dt = bench_tree(idxt, mesh_t, tree, 4, [0, 1, 2, 3], iters)
+    details["range_4views"] = {"qps": 1.0 / dt, "mean_ms": dt * 1e3}
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump({k: {kk: round(vv, 4) for kk, vv in v.items()}
+                   for k, v in details.items()}, f, indent=2)
+        f.write("\n")
+
+    qps = details["mapreduce_count"]["qps"]
     result = {
         "metric": f"intersect_count_{num_slices << 20}cols_qps",
         "value": round(qps, 2),
         "unit": "queries/sec",
-        "vs_baseline": round(host_dt / dev_dt, 2),
+        "vs_baseline": round(details["mapreduce_count"]["vs_host"], 2),
     }
     print(json.dumps(result))
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import queue
 import random
 import socket
 import struct
@@ -54,6 +55,11 @@ _KIND_BROADCAST = b"B"
 
 # Max UDP datagram we ever build; piggyback packing stays under this.
 _MAX_UDP = 1400
+# An update larger than this can never ride a datagram (chosen well
+# under every packet's real piggyback budget, which is _MAX_UDP minus a
+# <=200-byte envelope head); it is dropped at piggyback-scan time with a
+# pointer at send_sync, instead of lingering unsendable in the queue.
+_MAX_UPDATE = 1000
 
 
 class _Member:
@@ -102,6 +108,10 @@ class GossipNodeSet(NodeSet, Broadcaster):
         self._acks: Dict[int, threading.Event] = {}
         self._seq = 0
         self._probe_ring: List[str] = []
+        # Handoff queue for epidemic broadcasts (memberlist's pattern):
+        # one consumer thread applies them in arrival order, keeping the
+        # UDP loop free for ping/ack and bounding handler concurrency.
+        self._delivery_q: "queue.Queue[bytes]" = queue.Queue(maxsize=1024)
         self._closed = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -148,7 +158,8 @@ class GossipNodeSet(NodeSet, Broadcaster):
         for name, fn in [("gossip-udp", self._udp_loop),
                          ("gossip-tcp", self._tcp_loop),
                          ("gossip-probe", self._probe_loop),
-                         ("gossip-pushpull", self._push_pull_loop)]:
+                         ("gossip-pushpull", self._push_pull_loop),
+                         ("gossip-deliver", self._deliver_loop)]:
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -280,11 +291,10 @@ class GossipNodeSet(NodeSet, Broadcaster):
 
     def _take_piggyback(self, budget: int) -> List[dict]:
         out = []
-        max_fit = _MAX_UDP - 128  # largest any single packet can carry
         with self._lock:
             for q in list(self._queue):
                 blob = json.dumps(q[0])
-                if len(blob) > max_fit:
+                if len(blob) > _MAX_UPDATE:
                     # Can never ride a datagram; dropping it beats
                     # wedging the queue head forever.
                     self._queue.remove(q)
@@ -310,12 +320,24 @@ class GossipNodeSet(NodeSet, Broadcaster):
             elif kind == "msg":
                 data = base64.b64decode(u["b"])
                 if not self._remember(data):
-                    # Deliver off the UDP receive thread: a slow handler
+                    # Hand off to the delivery thread: a slow handler
                     # must not stall ping/ack processing (which would get
-                    # this node falsely suspected).
-                    threading.Thread(target=self._deliver, args=(data,),
-                                     daemon=True).start()
+                    # this node falsely suspected), and one consumer
+                    # preserves arrival order.
+                    try:
+                        self._delivery_q.put_nowait(data)
+                    except queue.Full:
+                        self._log("gossip: delivery queue full, "
+                                  "dropping broadcast")
                     self._enqueue_broadcast(data)  # keep the epidemic going
+
+    def _deliver_loop(self):
+        while not self._closed.is_set():
+            try:
+                data = self._delivery_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._deliver(data)
 
     def _deliver(self, data: bytes):
         if self.broadcast_handler is None:

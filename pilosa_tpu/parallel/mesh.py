@@ -180,7 +180,10 @@ def compile_mesh_topn(mesh: Mesh, num_rows: int, k: int):
     """Jit an EXACT TopN: global per-row popcounts + replicated top_k.
 
     Returns fn(sharded_index) -> (counts (k,) int32, dense_row_ids (k,)).
+    A k beyond the row count clamps (TopN(n) with n > rows returns
+    every row, executor.go:273-310 semantics).
     """
+    k = min(k, num_rows)
     one_slice = partial(_row_counts_one_slice, num_rows)
 
     def per_shard(keys, words):
