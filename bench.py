@@ -59,7 +59,7 @@ def _sustained(fn, iters, warm=True):
     price is that only the MEAN is measurable, not a true p50 — keys
     are named mean_ms accordingly."""
     if warm:
-        fn()  # compile + warm
+        int(fn())  # compile + warm, readback so the device is idle at t0
     t0 = time.perf_counter()
     acc = None
     for _ in range(iters):

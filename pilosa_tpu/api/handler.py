@@ -48,6 +48,7 @@ import binascii
 import io
 import json
 import re
+import threading
 from datetime import datetime, timezone
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
@@ -78,15 +79,28 @@ VERSION = "0.1.0"
 
 _WEBUI_PAGE = """<!doctype html>
 <html><head><title>pilosa-tpu console</title><style>
-body{font-family:monospace;margin:2em;max-width:60em}
-textarea,input{font-family:monospace;width:100%}
+body{font-family:monospace;margin:2em;max-width:72em}
+textarea,input{font-family:monospace;width:100%;box-sizing:border-box}
 pre{background:#f4f4f4;padding:1em;overflow:auto}
+.cols{display:flex;gap:2em}.cols>div{flex:1;min-width:0}
+h2{font-size:1em;border-bottom:1px solid #ccc}
+button{font-family:monospace}
 </style></head><body>
 <h1>pilosa-tpu</h1>
+<div class="cols">
+<div>
+<h2>query</h2>
 <p>index: <input id="idx" value="i"></p>
 <p><textarea id="q" rows="4">Count(Bitmap(id=1, frame=general))</textarea></p>
-<p><button onclick="run()">query</button></p>
+<p><button onclick="run()">run</button>
+   <button onclick="refresh()">refresh schema/status</button></p>
 <pre id="out"></pre>
+</div>
+<div>
+<h2>schema</h2><pre id="schema"></pre>
+<h2>cluster</h2><pre id="status"></pre>
+</div>
+</div>
 <script>
 async function run(){
   const r = await fetch('/index/'+document.getElementById('idx').value+'/query',
@@ -94,6 +108,16 @@ async function run(){
   document.getElementById('out').textContent =
     JSON.stringify(await r.json(), null, 2);
 }
+async function refresh(){
+  for (const [path, el] of [['/schema','schema'],['/status','status']]) {
+    try {
+      const r = await fetch(path);
+      document.getElementById(el).textContent =
+        JSON.stringify(await r.json(), null, 2);
+    } catch (e) { document.getElementById(el).textContent = String(e); }
+  }
+}
+refresh();
 </script></body></html>"""
 
 
@@ -195,6 +219,7 @@ class Handler:
         r("GET", r"/status", self._get_status)
         r("GET", r"/version", self._get_version)
         r("GET", r"/debug/vars", self._get_expvar)
+        r("GET", r"/debug/pprof", self._get_pprof)
         r("POST", r"/internal/message", self._post_internal_message)
         r("GET", r"/internal/status", self._get_internal_status)
 
@@ -257,6 +282,21 @@ class Handler:
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
         return _json_resp(snap)
+
+    def _get_pprof(self, pv, params, headers, body) -> Response:
+        """Thread stack dump — the analog of the reference's
+        /debug/pprof goroutine profile (handler.go:30,99)."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+            out.extend(ln.rstrip()
+                       for ln in traceback.format_stack(frame))
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        ("\n".join(out) + "\n").encode())
 
     def _get_hosts(self, pv, params, headers, body) -> Response:
         nodes = self.cluster.nodes if self.cluster else []

@@ -55,11 +55,13 @@ _KIND_BROADCAST = b"B"
 
 # Max UDP datagram we ever build; piggyback packing stays under this.
 _MAX_UDP = 1400
-# An update larger than this can never ride a datagram (chosen well
-# under every packet's real piggyback budget, which is _MAX_UDP minus a
-# <=200-byte envelope head); it is dropped at piggyback-scan time with a
-# pointer at send_sync, instead of lingering unsendable in the queue.
-_MAX_UPDATE = 1000
+# An update larger than this can never ride a datagram (every packet's
+# real budget is _MAX_UDP minus its envelope head); it is dropped at
+# piggyback-scan time with a pointer at send_sync. Updates under the
+# limit that still never fit (unusually large envelope heads) are
+# dropped after _MAX_SKIPS fruitless scans instead of lingering forever.
+_MAX_UPDATE = 1200
+_MAX_SKIPS = 50
 
 
 class _Member:
@@ -103,7 +105,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
         self._lock = threading.RLock()
         self._members: Dict[str, _Member] = {}
         self._incarnation = 0
-        self._queue: List[List] = []      # [update_dict, transmits_left]
+        self._queue: List[List] = []  # [update_dict, transmits_left, skips]
         self._seen: Dict[str, float] = {}  # broadcast digest -> first-seen
         self._acks: Dict[int, threading.Event] = {}
         self._seq = 0
@@ -271,7 +273,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
             if "host" in update:
                 self._queue = [q for q in self._queue
                                if q[0].get("host") != update["host"]]
-            self._queue.append([update, limit])
+            self._queue.append([update, limit, 0])
 
     def _enqueue_broadcast(self, data: bytes):
         self._enqueue_update({"u": "msg",
@@ -302,7 +304,12 @@ class GossipNodeSet(NodeSet, Broadcaster):
                               f"({len(blob)} B) — use send_sync")
                     continue
                 if len(blob) > budget:
-                    continue  # skip, try smaller queued updates
+                    q[2] += 1  # skip, try smaller queued updates
+                    if q[2] > _MAX_SKIPS:
+                        self._queue.remove(q)
+                        self._log("gossip: dropping never-fitting "
+                                  f"broadcast ({len(blob)} B)")
+                    continue
                 budget -= len(blob)
                 out.append(q[0])
                 q[1] -= 1
@@ -327,8 +334,16 @@ class GossipNodeSet(NodeSet, Broadcaster):
                     try:
                         self._delivery_q.put_nowait(data)
                     except queue.Full:
+                        # Forget the digest so a peer's retransmit can
+                        # retry delivery here — otherwise this node
+                        # silently diverges while the epidemic converges
+                        # everywhere else.
+                        with self._lock:
+                            self._seen.pop(
+                                hashlib.sha1(data).hexdigest(), None)
                         self._log("gossip: delivery queue full, "
                                   "dropping broadcast")
+                        continue
                     self._enqueue_broadcast(data)  # keep the epidemic going
 
     def _deliver_loop(self):

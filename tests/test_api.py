@@ -289,3 +289,18 @@ class TestBroadcastSends:
         from pilosa_tpu.wire import unmarshal_message
         m = unmarshal_message(data)
         assert m.index == "i"
+
+
+class TestDebugRoutes:
+    def test_pprof_thread_dump(self, env):
+        _, handler = env
+        resp = handler.handle("GET", "/debug/pprof", {}, b"")
+        assert resp.status == 200
+        assert "--- thread MainThread" in resp.body.decode()
+
+    def test_webui_serves_console(self, env):
+        _, handler = env
+        resp = handler.handle("GET", "/", {}, b"")
+        assert resp.status == 200
+        assert b"pilosa-tpu" in resp.body
+        assert b"/schema" in resp.body
