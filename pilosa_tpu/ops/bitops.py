@@ -38,3 +38,22 @@ def count_pair(a: jax.Array, b: jax.Array, op: str = "and") -> jax.Array:
 def dense_row_count(row: jax.Array) -> jax.Array:
     """Bit count of one materialized dense row block."""
     return popcount_words(row)
+
+
+def fold_tree(tree, leaf_fn):
+    """Fold a numbered op-shape tree (plan._tree_signature) over
+    `leaf_fn(leaf_index) -> block`, combining with the n-ary bitwise
+    semantics shared by every backend (XLA eval_tree, the Pallas
+    tree-count kernel). One combiner, so backends cannot drift."""
+    if tree[0] == "leaf":
+        return leaf_fn(tree[1])
+    vals = [fold_tree(c, leaf_fn) for c in tree[1:]]
+    acc = vals[0]
+    for v in vals[1:]:
+        if tree[0] == "and":
+            acc = acc & v
+        elif tree[0] == "or":
+            acc = acc | v
+        else:  # andnot
+            acc = acc & ~v
+    return acc

@@ -145,26 +145,79 @@ def _apply_writes_one_slice(words, slot, word, mask):
 
 # -- fused count over the mesh ----------------------------------------------
 
-def compile_mesh_count(mesh: Mesh, tree_shape, num_leaves: int):
+def _leaf_container_indices(keys, idxs):
+    """Per-leaf container locations for a shard's pool.
+
+    keys: (S, cap) sorted pool keys; idxs: (L,) leaf dense-row ids.
+    Returns idx (L, S, 16) int32 clipped container positions and
+    hit (L, S, 16) int32 presence mask — the searchsorted half of
+    gather_row (ops/pool.py), hoisted out so a kernel can stream the
+    containers directly."""
+    num_leaves = idxs.shape[0]
+    targets = (idxs[:, None] * ROW_SPAN
+               + jnp.arange(ROW_SPAN, dtype=jnp.int32)[None, :])  # (L, 16)
+    flat = targets.reshape(-1)
+
+    def one(k):
+        i = jnp.searchsorted(k, flat).astype(jnp.int32)
+        i = jnp.clip(i, 0, k.shape[0] - 1)
+        return i, (k[i] == flat).astype(jnp.int32)
+
+    idx, hit = jax.vmap(one)(keys)           # (S, L*16) each
+    shape = (keys.shape[0], num_leaves, ROW_SPAN)
+    return (idx.reshape(shape).transpose(1, 0, 2),
+            hit.reshape(shape).transpose(1, 0, 2))
+
+
+def compile_mesh_count(mesh: Mesh, tree_shape, num_leaves: int,
+                       backend: Optional[str] = None):
     """Jit a Count over a bitmap-op tree for a mesh-sharded index.
 
     Returns fn(sharded_index, leaf_dense_ids (num_leaves,) int32) -> int32
-    replicated global count. Per-shard: evaluate the tree on every local
-    slice (vmap), popcount-sum, then psum over the slice axis (ICI).
+    replicated global count, psum'd over the slice axis (ICI).
+
+    backend: "xla" = vmapped gather + fused XLA combine, "pallas" =
+    fused in-kernel container streaming (ops/kernels.tree_count_pallas),
+    "pallas_interpret" = the Pallas kernel in interpret mode
+    (differential tests on CPU). None = auto: the
+    PILOSA_TPU_COUNT_BACKEND env var if set, else "xla" — Pallas
+    compilation hangs through the single-chip axon relay this rig
+    benches on, so it is opt-in until validated on direct-attached TPUs.
     """
     sig = json.dumps(_tree_signature(tree_shape))
     tree = json.loads(sig)
-    one_slice = partial(_count_one_slice, tree, num_leaves)
+    if backend is None:
+        import os
+        backend = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+    if backend not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown count backend: {backend!r} "
+                         "(want xla, pallas, or pallas_interpret)")
 
-    def per_shard(keys, words, idxs):
-        counts = jax.vmap(one_slice, in_axes=(0, 0, None))(keys, words, idxs)
-        return lax.psum(counts.sum(), SLICE_AXIS)
+    if backend == "xla":
+        one_slice = partial(_count_one_slice, tree, num_leaves)
+
+        def per_shard(keys, words, idxs):
+            counts = jax.vmap(one_slice, in_axes=(0, 0, None))(
+                keys, words, idxs)
+            return lax.psum(counts.sum(), SLICE_AXIS)
+    else:
+        from ..ops.kernels import tree_count_pallas
+        interpret = backend == "pallas_interpret"
+
+        def per_shard(keys, words, idxs):
+            idx, hit = _leaf_container_indices(keys, idxs)
+            count = tree_count_pallas(words, idx, hit, tree,
+                                      interpret=interpret)
+            return lax.psum(count, SLICE_AXIS)
 
     fn = jax.shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(SLICE_AXIS), P(SLICE_AXIS), P()),
         out_specs=P(),
+        # pallas_call can't annotate how its output varies over mesh
+        # axes, which the VMA checker requires (backend != "xla").
+        check_vma=(backend == "xla"),
     )
 
     @jax.jit

@@ -52,21 +52,15 @@ def eval_tree(tree, leaves):
     """Evaluate a nested op-shape list over leaf (pool, dense_idx) pairs,
     returning the combined (16, 2048) uint32 block. Shared by the
     per-slice jitted path here and the mesh-sharded path
-    (parallel.mesh)."""
-    if tree[0] == "leaf":
-        pool, dense_idx = leaves[tree[1]]
+    (parallel.mesh); the combiner itself is ops.bitops.fold_tree, the
+    same fold the Pallas tree-count kernel uses."""
+    from ..ops.bitops import fold_tree
+
+    def leaf(i):
+        pool, dense_idx = leaves[i]
         return gather_row(pool, dense_idx)
-    vals = [eval_tree(c, leaves) for c in tree[1:]]
-    op = tree[0]
-    acc = vals[0]
-    for v in vals[1:]:
-        if op == "and":
-            acc = acc & v
-        elif op == "or":
-            acc = acc | v
-        else:  # andnot
-            acc = acc & ~v
-    return acc
+
+    return fold_tree(tree, leaf)
 
 
 @functools.lru_cache(maxsize=256)
