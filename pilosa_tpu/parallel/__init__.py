@@ -42,6 +42,7 @@ _MESH_NAMES = (
     "compile_mesh_topn",
     "default_mesh",
     "plan_writes",
+    "sharded_index_from_holder",
 )
 
 
@@ -61,6 +62,7 @@ __all__ = [
     "compile_mesh_topn",
     "default_mesh",
     "plan_writes",
+    "sharded_index_from_holder",
     "Broadcaster",
     "GossipNodeSet",
     "HTTPBroadcaster",

@@ -385,3 +385,46 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (SLICE_AXIS,))
+
+
+def sharded_index_from_holder(holder, index: str, frame: str,
+                              view: str = "standard",
+                              mesh: Optional[Mesh] = None,
+                              max_slice: Optional[int] = None):
+    """Stage a live frame view's fragments into a mesh-sharded device
+    index.
+
+    The H2D bridge between the host data model (Holder > ... > Fragment,
+    reference fragment.go mmap-resident storage) and the device
+    execution path: every slice 0..max_slice of (index, frame, view) is
+    stacked into one ShardedIndex (absent fragments become empty
+    shards), sharded over the mesh's slice axis. Returns
+    (ShardedIndex, row_ids, num_slices); row_ids translates real row
+    ids to the dense indices compile_mesh_count/compile_mesh_topn use.
+
+    This is the explicit-staging answer to the reference's O(1) mmap
+    open (SURVEY.md §7 hard parts): call it once per epoch of queries,
+    not per query, and re-stage after bulk writes.
+
+    Only LOCALLY-present fragments are staged: the default max_slice is
+    the highest local fragment of (frame, view) — not Index.max_slice(),
+    which includes peer-owned slices that would stage as silent zero
+    shards on a clustered holder. For a cluster-wide device index,
+    stage per node and reduce, or pass max_slice explicitly after
+    fetching remote fragments. A view with no fragments yet stages one
+    empty shard; a missing index or frame raises KeyError.
+    """
+    idx_obj = holder.index(index)
+    if idx_obj is None:
+        raise KeyError(f"index not found: {index}")
+    if idx_obj.frame(frame) is None:
+        raise KeyError(f"frame not found: {index}/{frame}")
+    if max_slice is None:
+        v = holder.view(index, frame, view)
+        max_slice = max(v.fragments.keys(), default=0) if v is not None else 0
+    bitmaps = []
+    for s in range(max_slice + 1):
+        frag = holder.fragment(index, frame, view, s)
+        bitmaps.append(None if frag is None else frag.storage)
+    sharded, row_ids = build_sharded_index(bitmaps, mesh)
+    return sharded, row_ids, len(bitmaps)

@@ -204,3 +204,43 @@ def test_pallas_tree_count_matches_xla(mesh):
         a = int(xla(idx, np.int32(ids)))
         b = int(pls(idx, np.int32(ids)))
         assert a == b, (tree, ids, a, b)
+
+
+def test_sharded_index_from_holder(mesh, tmp_path):
+    """H2D staging bridge: a live Holder's fragments -> ShardedIndex,
+    device counts match the host executor."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.parallel.mesh import sharded_index_from_holder
+
+    holder = Holder(str(tmp_path / "h2d"))
+    holder.open()
+    try:
+        idx = holder.create_index_if_not_exists("i")
+        frame = idx.create_frame_if_not_exists("f")
+        want = {7: set(), 9: set()}
+        rng = np.random.default_rng(5)
+        for row in want:
+            for col in rng.choice(5 * SLICE_WIDTH, 400, replace=False):
+                frame.set_bit(row, int(col))
+                want[row].add(int(col))
+
+        sharded, row_ids, n = sharded_index_from_holder(
+            holder, "i", "f", mesh=mesh)
+        assert n == 5
+
+        def dense(r):
+            return int(np.searchsorted(row_ids, np.uint64(r)))
+
+        pair = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
+        got = int(pair(sharded, np.int32([dense(7), dense(9)])))
+        assert got == len(want[7] & want[9])
+        leaf = compile_mesh_count(mesh, ["leaf"], 1)
+        assert int(leaf(sharded, np.int32([dense(9)]))) == len(want[9])
+        # Unknown index or frame raises; a typo can't silently stage
+        # an all-empty index.
+        with pytest.raises(KeyError):
+            sharded_index_from_holder(holder, "nope", "f", mesh=mesh)
+        with pytest.raises(KeyError):
+            sharded_index_from_holder(holder, "i", "typo", mesh=mesh)
+    finally:
+        holder.close()

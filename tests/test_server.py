@@ -410,3 +410,76 @@ class TestStatsD:
             st.count("x")
         st.flush()
         st.close()
+
+
+class TestQuickProperty:
+    """Randomized SetBit consistency through the full node — the analog
+    of the reference's testing/quick property test
+    (server/server_test.go TestMain_Set_Quick)."""
+
+    def test_random_setbits_consistent(self, tmp_path):
+        import random
+
+        rng = random.Random(0xC0FFEE)
+        port = free_ports(1)[0]
+        host = f"127.0.0.1:{port}"
+        c = Config()
+        c.data_dir = str(tmp_path / "quick")
+        c.host = host
+        c.cluster_hosts = [host]
+        c.anti_entropy_interval = 3600
+        c.polling_interval = 3600
+        s = Server(c)
+        s.open()
+        try:
+            cli = InternalClient(host)
+            cli.create_index("q")
+            cli.create_frame("q", "f")
+            # Random writes across rows, slices, duplicates included.
+            want = {}
+            for _ in range(300):
+                row = rng.randrange(4)
+                col = rng.randrange(3 * SLICE_WIDTH)
+                want.setdefault(row, set()).add(col)
+                q = f"SetBit(rowID={row}, frame=f, columnID={col})"
+                cli.execute_query(None, "q", q, [], remote=False)
+            # And some clears.
+            for row in list(want):
+                drop = set(rng.sample(sorted(want[row]),
+                                      k=len(want[row]) // 5))
+                want[row] -= drop
+                for col in drop:
+                    cli.execute_query(
+                        None, "q",
+                        f"ClearBit(rowID={row}, frame=f, columnID={col})",
+                        [], remote=False)
+
+            def check():
+                for row, cols in want.items():
+                    res = cli.execute_query(
+                        None, "q", f"Bitmap(rowID={row}, frame=f)", [],
+                        remote=False)
+                    assert sorted(res[0].columns()) == sorted(cols), row
+                res = cli.execute_query(None, "q", "TopN(frame=f, n=10)",
+                                        [], remote=False)
+                expect = sorted(((r, len(cs)) for r, cs in want.items()
+                                 if cs), key=lambda p: (-p[1], p[0]))
+                assert res[0] == expect
+
+            check()
+        finally:
+            s.close()
+
+        # Persistence: a fresh server over the same data dir agrees
+        # (snapshot + WAL replay, fragment Reopen pattern).
+        s2 = Server(c)
+        s2.open(port=port)
+        try:
+            cli = InternalClient(host)
+            for row, cols in want.items():
+                res = cli.execute_query(
+                    None, "q", f"Bitmap(rowID={row}, frame=f)", [],
+                    remote=False)
+                assert sorted(res[0].columns()) == sorted(cols), row
+        finally:
+            s2.close()
