@@ -40,6 +40,7 @@ _MESH_NAMES = (
     "compile_mesh_count",
     "compile_mesh_step",
     "compile_mesh_topn",
+    "connect_distributed",
     "default_mesh",
     "plan_writes",
     "sharded_index_from_holder",
@@ -60,6 +61,7 @@ __all__ = [
     "compile_mesh_count",
     "compile_mesh_step",
     "compile_mesh_topn",
+    "connect_distributed",
     "default_mesh",
     "plan_writes",
     "sharded_index_from_holder",

@@ -399,8 +399,10 @@ def sharded_index_from_holder(holder, index: str, frame: str,
     execution path: every slice 0..max_slice of (index, frame, view) is
     stacked into one ShardedIndex (absent fragments become empty
     shards), sharded over the mesh's slice axis. Returns
-    (ShardedIndex, row_ids, num_slices); row_ids translates real row
-    ids to the dense indices compile_mesh_count/compile_mesh_topn use.
+    (ShardedIndex, row_ids, staged_slices): row_ids translates real row
+    ids to the dense indices compile_mesh_count/compile_mesh_topn use;
+    staged_slices is the UNPADDED slice count (the returned
+    sharded.num_slices is padded up to a mesh-axis multiple).
 
     This is the explicit-staging answer to the reference's O(1) mmap
     open (SURVEY.md §7 hard parts): call it once per epoch of queries,
@@ -421,10 +423,41 @@ def sharded_index_from_holder(holder, index: str, frame: str,
         raise KeyError(f"frame not found: {index}/{frame}")
     if max_slice is None:
         v = holder.view(index, frame, view)
-        max_slice = max(v.fragments.keys(), default=0) if v is not None else 0
+        max_slice = v.max_slice() if v is not None else 0
     bitmaps = []
     for s in range(max_slice + 1):
         frag = holder.fragment(index, frame, view, s)
         bitmaps.append(None if frag is None else frag.storage)
     sharded, row_ids = build_sharded_index(bitmaps, mesh)
     return sharded, row_ids, len(bitmaps)
+
+
+def connect_distributed(coordinator_address: Optional[str] = None,
+                        num_processes: Optional[int] = None,
+                        process_id: Optional[int] = None) -> int:
+    """Join this host to the multi-host JAX runtime (the data plane's
+    answer to the reference's multi-node HTTP query fan-out).
+
+    After every participating host calls this, jax.devices() — and so
+    default_mesh() — spans ALL hosts' chips: the same compile_mesh_*
+    computations shard over the global slice axis, with psum riding ICI
+    within a pod slice and DCN across hosts, no application-level RPC.
+    The host-side control plane (schema broadcast, membership — gossip
+    or HTTP) stays as-is; only bulk query compute moves to the global
+    mesh. Arguments default to the JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID environment variables (read
+    here — jax itself only honors the first), then to JAX's own
+    TPU/Slurm/MPI cluster auto-detection.
+
+    Returns this process's index. Call once, before any backend use.
+    """
+    import os
+
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()

@@ -244,3 +244,32 @@ def test_sharded_index_from_holder(mesh, tmp_path):
             sharded_index_from_holder(holder, "i", "typo", mesh=mesh)
     finally:
         holder.close()
+
+
+def test_connect_distributed_single_process():
+    """connect_distributed joins a (1-process) distributed runtime; run
+    in a subprocess because jax.distributed state is process-global."""
+    import subprocess
+    import sys
+
+    import socket
+
+    with socket.socket() as s_:
+        s_.bind(("127.0.0.1", 0))
+        port = s_.getsockname()[1]
+    code = (
+        "import os\n"
+        "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from pilosa_tpu.parallel import connect_distributed, default_mesh\n"
+        f"pid = connect_distributed('localhost:{port}', 1, 0)\n"
+        "assert pid == 0, pid\n"
+        "assert default_mesh().size >= 1\n"
+        "print('distributed ok')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120,
+                       env={**__import__('os').environ,
+                            "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    assert r.returncode == 0, r.stderr
+    assert "distributed ok" in r.stdout
