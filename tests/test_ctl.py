@@ -153,3 +153,59 @@ class TestLiveNode:
                      "-n", "20"]) == 0
         res = json.loads(capsys.readouterr().out)
         assert res["n"] == 20 and res["ops_per_sec"] > 0
+
+
+def test_server_command_full_binary(tmp_path):
+    """Boot the real `server` subcommand as a child process, query it
+    over HTTP, and shut it down with SIGTERM (the reference's
+    MustRunMain full-binary integration, server/server_test.go)."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    host = f"127.0.0.1:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # Log to a file, not a pipe: an undrained pipe can fill and block
+    # the server mid-request.
+    log = tempfile.NamedTemporaryFile(mode="w+", suffix=".log", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.ctl.main", "server",
+         "-d", str(tmp_path / "data"), "-b", host],
+        env=env, stdout=log, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 60
+        version = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{host}/version", timeout=2) as r:
+                    version = json.loads(r.read())["version"]
+                break
+            except OSError:
+                if proc.poll() is not None:
+                    log.seek(0)
+                    raise AssertionError(f"server died: {log.read()}")
+                time.sleep(0.2)
+        assert version, "server never came up"
+        body = b'SetBit(rowID=1, frame=f, columnID=2)'
+        for path in ("/index/bin", "/index/bin/frame/f"):
+            req = urllib.request.Request(
+                f"http://{host}{path}", data=b"{}", method="POST")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+        req = urllib.request.Request(
+            f"http://{host}/index/bin/query", data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read()) == {"results": [True]}
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
