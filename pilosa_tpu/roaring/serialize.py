@@ -94,6 +94,15 @@ def read_bitmap(data: bytes) -> Bitmap:
     if cookie != COOKIE:
         raise ValueError("invalid roaring file")
 
+    # Validate the whole header region up front: a truncated or
+    # corrupt file must surface as ValueError, not struct.error /
+    # numpy buffer errors (reference UnmarshalBinary bounds behavior).
+    ops_offset = HEADER_SIZE + key_n * 12
+    if ops_offset + key_n * 4 > len(data):
+        raise ValueError(
+            f"truncated roaring file: {key_n} containers declared, "
+            f"{len(data)} bytes")
+
     b = Bitmap()
     ns = []
     for i in range(key_n):
@@ -101,21 +110,22 @@ def read_bitmap(data: bytes) -> Bitmap:
         b.keys.append(key)
         ns.append(n_minus_1 + 1)
 
-    ops_offset = HEADER_SIZE + key_n * 12
     end = ops_offset + key_n * 4
     for i in range(key_n):
         (offset,) = struct.unpack_from("<I", data, ops_offset + i * 4)
-        if offset >= len(data):
-            raise ValueError(f"offset out of bounds: off={offset}, len={len(data)}")
         n = ns[i]
+        size = n * 4 if n <= ARRAY_MAX_SIZE else BITMAP_N * 8
+        if offset + size > len(data):
+            raise ValueError(
+                f"offset out of bounds: off={offset}+{size}, "
+                f"len={len(data)}")
         if n <= ARRAY_MAX_SIZE:
             arr = np.frombuffer(data, dtype="<u4", count=n, offset=offset)
             b.containers.append(Container(array=arr.astype(np.uint32)))
-            end = offset + n * 4
         else:
             words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=offset)
             b.containers.append(Container(bitmap=words.astype(np.uint64)))
-            end = offset + BITMAP_N * 8
+        end = offset + size
 
     for typ, value in read_ops(data[end:]):
         if typ == 0:

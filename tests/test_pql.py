@@ -114,3 +114,68 @@ def test_small_float_roundtrip():
     s = str(q.calls[0])
     assert "e" not in s and "E" not in s
     assert parse_string(s).calls[0].args["x"] == 1e-05
+
+
+class TestParserFuzz:
+    """Random input must never crash the parser — only ParseError is an
+    acceptable failure (reference pql grammar robustness)."""
+
+    def test_random_garbage_never_crashes(self):
+        import random
+
+        from pilosa_tpu.pql import ParseError, Parser
+
+        rng = random.Random(1234)
+        alphabet = "abz019_-=(),[]\"' \t\n.<>%$"
+        for _ in range(500):
+            s = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randrange(0, 40)))
+            try:
+                Parser(s).parse()
+            except ParseError:
+                pass
+
+    def test_mutated_valid_queries(self):
+        import random
+
+        from pilosa_tpu.pql import ParseError, Parser
+
+        rng = random.Random(77)
+        base = ('TopN(frame="f", n=5, field="x", filters=["a", 1])'
+                'Count(Intersect(Bitmap(rowID=1, frame="f"),'
+                ' Bitmap(rowID=2, frame="f")))')
+        for _ in range(300):
+            chars = list(base)
+            for _ in range(rng.randrange(1, 4)):
+                i = rng.randrange(len(chars))
+                op = rng.randrange(3)
+                if op == 0:
+                    del chars[i]
+                elif op == 1:
+                    chars.insert(i, rng.choice("(),=[]\"x9 "))
+                else:
+                    chars[i] = rng.choice("(),=[]\"x9 ")
+            try:
+                Parser("".join(chars)).parse()
+            except ParseError:
+                pass
+
+    def test_roundtrip_through_string(self):
+        """Canonical String() re-parses to the same canonical form (the
+        remote-execution re-serialization invariant, pql/ast.go:121)."""
+        from pilosa_tpu.pql import Parser
+
+        qs = [
+            'Bitmap(rowID=1, frame="f")',
+            'TopN(frame="f", n=3, field="x", filters=["a", 2, true])',
+            'Count(Union(Bitmap(rowID=1, frame="f"),'
+            ' Difference(Bitmap(rowID=2, frame="f"),'
+            ' Bitmap(rowID=3, frame="f"))))',
+            'SetBit(rowID=9, frame="f", columnID=100)',
+            'Range(rowID=1, frame="f", start="2017-04-01T00:00",'
+            ' end="2017-05-01T00:00")',
+        ]
+        for s in qs:
+            once = str(Parser(s).parse())
+            twice = str(Parser(once).parse())
+            assert once == twice, s

@@ -172,3 +172,41 @@ def test_slice_range_huge_values():
     b = Bitmap([hi, hi + 70000])
     got = b.slice_range(1 << 63, (1 << 63) + (1 << 17))
     assert [int(v) for v in got] == [hi, hi + 70000]
+
+
+class TestSerializeFuzz:
+    def test_random_bitmaps_roundtrip(self):
+        """Random bitmaps (mixed array/bitmap containers, container
+        boundaries, max values) survive to_bytes/from_bytes byte-exactly
+        in content."""
+        import random
+
+        from pilosa_tpu.roaring import Bitmap
+
+        rng = random.Random(31337)
+        for trial in range(25):
+            n = rng.randrange(0, 3000)
+            style = rng.randrange(3)
+            if style == 0:      # uniform sparse -> array containers
+                vals = rng.sample(range(1 << 22), k=min(n, 1 << 21))
+            elif style == 1:    # clustered dense -> bitmap containers
+                base = rng.randrange(1 << 20)
+                vals = [base + i for i in range(n)]
+            else:               # container-boundary straddles
+                vals = [((i % 7) << 16) - 2 + (i % 5) for i in range(n)
+                        if ((i % 7) << 16) - 2 + (i % 5) >= 0]
+            b = Bitmap(vals)
+            b2 = Bitmap.from_bytes(b.to_bytes())
+            assert b2.count() == b.count(), trial
+            assert list(b2.slice()) == list(b.slice()), trial
+            assert not b2.check(), trial
+
+    def test_truncated_files_error_cleanly(self):
+        from pilosa_tpu.roaring import Bitmap
+
+        data = Bitmap([1, 2, 1 << 17]).to_bytes()
+        for cut in (0, 1, 3, 7, len(data) // 2, len(data) - 1):
+            try:
+                Bitmap.from_bytes(data[:cut])
+            except (ValueError, EOFError):
+                pass  # clean error, not a crash/garbage bitmap
