@@ -128,7 +128,26 @@ def main():
 
     from pilosa_tpu.parallel import default_mesh
 
-    on_tpu = jax.default_backend() == "tpu"
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError as e:
+        # TPU relay down (backend init raised). Re-exec on CPU so the
+        # harness still gets its one JSON line instead of a stack trace.
+        import os
+        import sys
+
+        if os.environ.get("PILOSA_TPU_BENCH_REEXEC"):
+            raise
+        _progress(f"TPU backend unavailable ({e}); re-running on CPU")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PILOSA_TPU_BENCH_REEXEC="1")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
     num_slices = 960 if on_tpu else 96  # CPU smoke keeps the shape
     iters = 50 if on_tpu else 3
     details = {}
