@@ -17,11 +17,13 @@ from __future__ import annotations
 
 import bisect
 import fcntl
+import functools
 import hashlib
 import json
 import os
 import tarfile
 import io
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -53,6 +55,15 @@ class TopOptions:
         self.tanimoto_threshold = tanimoto_threshold
 
 
+def _locked(fn):
+    """Run a Fragment method under its reentrant mutex."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._mu:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class Fragment:
     """One (frame, view, slice) of data."""
 
@@ -70,6 +81,11 @@ class Fragment:
         self.row_attr_store = row_attr_store
         self.stats = stats
 
+        # Serializes storage/cache/WAL access across the threaded HTTP
+        # server and the executor's per-slice pool (reference
+        # Fragment.mu, fragment.go:69). Reentrant: set_bit -> snapshot
+        # and top -> row re-enter.
+        self._mu = threading.RLock()
         self.storage = Bitmap()
         self.op_n = 0
         self.max_op_n = MAX_OP_N
@@ -90,6 +106,7 @@ class Fragment:
     def cache_path(self) -> str:
         return self.path + ".cache"
 
+    @_locked
     def open(self):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         # Exclusive advisory lock (reference fragment.go:191).
@@ -114,6 +131,7 @@ class Fragment:
         self.storage.op_writer = self._op_file
         self._load_cache()
 
+    @_locked
     def close(self):
         self.flush_cache()
         if self._op_file is not None:
@@ -127,6 +145,7 @@ class Fragment:
 
     # -- reads -------------------------------------------------------------
 
+    @_locked
     def row(self, row_id: int) -> Row:
         """Materialize one row as a slice-local segment (fragment.go:332-367)."""
         cached = self._row_cache.get(row_id)
@@ -139,16 +158,25 @@ class Fragment:
         self._row_cache[row_id] = r
         return r
 
+    @_locked
     def count(self) -> int:
         return self.storage.count()
 
+    @_locked
     def max_row_id(self) -> int:
         return self.storage.max() // SLICE_WIDTH
 
     def for_each_bit(self):
-        """Yield (rowID, absolute columnID) pairs (fragment.go:471-488)."""
+        """Yield (rowID, absolute columnID) pairs (fragment.go:471-488).
+
+        Snapshots the positions under the mutex first — decorating a
+        generator would release the lock before iteration starts, and
+        concurrent writers mutate the container lists mid-walk."""
         base = self.slice * SLICE_WIDTH
-        for pos in self.storage:
+        with self._mu:
+            positions = self.storage.slice()
+        for pos in positions:
+            pos = int(pos)
             yield pos // SLICE_WIDTH, base + (pos % SLICE_WIDTH)
 
     # -- writes ------------------------------------------------------------
@@ -156,6 +184,7 @@ class Fragment:
     def _pos(self, row_id: int, column_id: int) -> int:
         return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
 
+    @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """Set a bit; WAL-append, maybe snapshot, update caches.
         Returns True if the bit was newly set (fragment.go:371-413)."""
@@ -168,6 +197,7 @@ class Fragment:
         self._increment_op_n()
         return changed
 
+    @_locked
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         changed = self.storage.remove(self._pos(row_id, column_id))
         self._mark_dirty(row_id)
@@ -194,6 +224,7 @@ class Fragment:
         if self.op_n > self.max_op_n:
             self.snapshot()
 
+    @_locked
     def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]):
         """Bulk import: WAL-detached adds + forced snapshot
         (fragment.go:922-989)."""
@@ -213,6 +244,7 @@ class Fragment:
         self.cache.invalidate()
         self.snapshot()
 
+    @_locked
     def snapshot(self):
         """Atomically rewrite the file: write temp, fsync, rename, reopen
         WAL (fragment.go:992-1057)."""
@@ -250,6 +282,7 @@ class Fragment:
         pairs.sort(key=lambda p: (-p[1], p[0]))
         return pairs
 
+    @_locked
     def top(self, opt: TopOptions) -> List[Tuple[int, int]]:
         """Top rows by count (reference fragment.go:493-625), including
         src-intersection recount, min-threshold, attr filters, and the
@@ -321,6 +354,7 @@ class Fragment:
     def _block_of(self, pos: int) -> int:
         return pos // (HASH_BLOCK_SIZE * SLICE_WIDTH)
 
+    @_locked
     def blocks(self) -> List[Tuple[int, bytes]]:
         """[(block_id, sha1)] for all non-empty 100-row blocks
         (fragment.go:703-767). Only blocks with live containers are
@@ -346,18 +380,21 @@ class Fragment:
             out.append((blk, digest))
         return out
 
+    @_locked
     def checksum(self) -> bytes:
         h = hashlib.sha1()
         for _, c in self.blocks():
             h.update(c)
         return h.digest()
 
+    @_locked
     def block_data(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
         """(rowIDs, slice-local columnIDs) for one block (fragment.go:783-794)."""
         lo = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
         vals = self.storage.slice_range(lo, lo + HASH_BLOCK_SIZE * SLICE_WIDTH)
         return vals // SLICE_WIDTH, vals % SLICE_WIDTH
 
+    @_locked
     def merge_block(self, block_id: int, data: List[Tuple[np.ndarray, np.ndarray]]):
         """Majority-consensus merge of one block across replicas
         (fragment.go:796-920). `data` holds each remote's (rowIDs, colIDs).
@@ -399,6 +436,7 @@ class Fragment:
 
     # -- cache persistence ---------------------------------------------------
 
+    @_locked
     def flush_cache(self):
         """Persist cache pairs as JSON (analog of the protobuf `.cache`
         file, fragment.go:1073-1093)."""
@@ -431,6 +469,7 @@ class Fragment:
             self.cache.bulk_add(int(id_), self.row(int(id_)).count())
         self.cache.recalculate()
 
+    @_locked
     def rebuild_cache(self):
         """Recompute all row counts from storage (crash recovery path)."""
         row_span = SLICE_WIDTH >> 16  # containers per row; keep jax out of host paths
@@ -443,6 +482,7 @@ class Fragment:
 
     # -- backup/restore ------------------------------------------------------
 
+    @_locked
     def write_to_tar(self, fileobj):
         """Stream data+cache as a tar archive (fragment.go:1095-1153)."""
         with tarfile.open(fileobj=fileobj, mode="w|") as tar:
@@ -459,6 +499,7 @@ class Fragment:
             info.mtime = int(time.time())
             tar.addfile(info, io.BytesIO(cache))
 
+    @_locked
     def read_from_tar(self, fileobj):
         """Restore from a tar archive produced by write_to_tar
         (fragment.go:1155-1266)."""
@@ -478,6 +519,7 @@ class Fragment:
     # -- device compute image ------------------------------------------------
 
     @property
+    @_locked
     def pool(self):
         """(FragmentPool, row_ids) device image, rebuilt when dirty."""
         if self._pool_dirty or self._pool is None:

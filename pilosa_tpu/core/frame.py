@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from datetime import datetime
 from typing import Dict, Optional, Sequence
 
@@ -45,6 +46,7 @@ class Frame:
         self.stats = stats
         self.broadcaster = broadcaster
         self.views: Dict[str, View] = {}
+        self._create_mu = threading.RLock()
         self.row_attr_store = AttrStore(os.path.join(path, "attrs.db"))
 
     # -- lifecycle ---------------------------------------------------------
@@ -122,12 +124,14 @@ class Frame:
         return self.views.get(name)
 
     def create_view_if_not_exists(self, name: str) -> View:
-        v = self.views.get(name)
-        if v is None:
-            v = self._new_view(name)
-            v.open()
-            self.views[name] = v
-        return v
+        with self._create_mu:
+            v = self.views.get(name)
+            if v is None:
+                v = self._new_view(name)
+                v.open()
+                # Copy-on-write: readers iterate views without the lock.
+                self.views = {**self.views, name: v}
+            return v
 
     def max_slice(self) -> int:
         return max((v.max_slice() for v in self.views.values()), default=0)

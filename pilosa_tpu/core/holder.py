@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from typing import Dict, List, Optional
 
 from ..errors import IndexExistsError
@@ -22,6 +23,9 @@ class Holder:
         self.stats = stats or NopStats()
         self.broadcaster = broadcaster
         self.indexes: Dict[str, Index] = {}
+        # Guards check-then-act index creation/deletion under the
+        # threaded HTTP server (reference Holder.mu).
+        self._create_mu = threading.RLock()
 
     def open(self):
         os.makedirs(self.path, exist_ok=True)
@@ -53,27 +57,36 @@ class Holder:
         )
 
     def create_index(self, name: str, **options) -> Index:
-        if name in self.indexes:
-            raise IndexExistsError()
-        return self._create_index(name, **options)
+        with self._create_mu:
+            if name in self.indexes:
+                raise IndexExistsError()
+            return self._create_index(name, **options)
 
     def create_index_if_not_exists(self, name: str, **options) -> Index:
-        idx = self.indexes.get(name)
-        if idx is not None:
-            return idx
-        return self._create_index(name, **options)
+        with self._create_mu:
+            idx = self.indexes.get(name)
+            if idx is not None:
+                return idx
+            return self._create_index(name, **options)
 
     def _create_index(self, name: str, **options) -> Index:
         idx = self._new_index(name, **options)
         idx.open()
-        self.indexes[name] = idx
+        # Copy-on-write: readers iterate self.indexes without the lock.
+        self.indexes = {**self.indexes, name: idx}
         return idx
 
     def delete_index(self, name: str):
-        idx = self.indexes.pop(name, None)
-        if idx is not None:
-            idx.close()
-            shutil.rmtree(idx.path, ignore_errors=True)
+        # close+rmtree stay under the lock: releasing it between the pop
+        # and the rmtree lets a racing create_index reuse the path and
+        # have its fresh directory deleted from under it.
+        with self._create_mu:
+            rest = dict(self.indexes)
+            idx = rest.pop(name, None)
+            self.indexes = rest
+            if idx is not None:
+                idx.close()
+                shutil.rmtree(idx.path, ignore_errors=True)
 
     # -- navigation ---------------------------------------------------------
 

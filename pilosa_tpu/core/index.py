@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 from typing import Dict, Optional
 
 from ..errors import FrameExistsError
@@ -33,6 +34,7 @@ class Index:
         self.stats = stats
         self.broadcaster = broadcaster
         self.frames: Dict[str, Frame] = {}
+        self._create_mu = threading.RLock()
         self.column_attr_store = AttrStore(os.path.join(path, "attrs.db"))
         self.remote_max_slice = 0
         self.remote_max_inverse_slice = 0
@@ -118,29 +120,35 @@ class Index:
         )
 
     def create_frame(self, name: str, **options) -> Frame:
-        if name in self.frames:
-            raise FrameExistsError()
-        return self._create_frame(name, **options)
+        with self._create_mu:
+            if name in self.frames:
+                raise FrameExistsError()
+            return self._create_frame(name, **options)
 
     def create_frame_if_not_exists(self, name: str, **options) -> Frame:
-        f = self.frames.get(name)
-        if f is not None:
-            return f
-        return self._create_frame(name, **options)
+        with self._create_mu:
+            f = self.frames.get(name)
+            if f is not None:
+                return f
+            return self._create_frame(name, **options)
 
     def _create_frame(self, name: str, **options) -> Frame:
         # A frame inherits the index's default time quantum (index.go:354-432).
         options.setdefault("time_quantum", str(self.time_quantum))
         frame = self._new_frame(name, **options)
         frame.open()
-        self.frames[name] = frame
+        # Copy-on-write: readers iterate self.frames without the lock.
+        self.frames = {**self.frames, name: frame}
         return frame
 
     def delete_frame(self, name: str):
-        f = self.frames.pop(name, None)
-        if f is not None:
-            f.close()
-            shutil.rmtree(f.path, ignore_errors=True)
+        with self._create_mu:
+            rest = dict(self.frames)
+            f = rest.pop(name, None)
+            self.frames = rest
+            if f is not None:
+                f.close()
+                shutil.rmtree(f.path, ignore_errors=True)
 
     def to_dict(self) -> dict:
         return {

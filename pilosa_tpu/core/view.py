@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 from typing import Dict, Optional
 
 from .. import SLICE_WIDTH
@@ -41,6 +42,7 @@ class View:
         self.stats = stats
         self.broadcaster = broadcaster
         self.fragments: Dict[int, Fragment] = {}
+        self._create_mu = threading.RLock()
 
     @property
     def fragments_path(self) -> str:
@@ -71,7 +73,9 @@ class View:
             stats=self.stats.with_tags(f"slice:{slice_}") if self.stats else None,
         )
         frag.open()
-        self.fragments[slice_] = frag
+        # Copy-on-write: readers (max_slice, query fan-out) iterate
+        # fragments without the lock.
+        self.fragments = {**self.fragments, slice_: frag}
         return frag
 
     def fragment(self, slice_: int) -> Optional[Fragment]:
@@ -81,11 +85,13 @@ class View:
         return max(self.fragments, default=0)
 
     def create_fragment_if_not_exists(self, slice_: int) -> Fragment:
-        frag = self.fragments.get(slice_)
-        if frag is not None:
-            return frag
-        is_new_max = self.fragments and slice_ > self.max_slice() or not self.fragments and slice_ > 0
-        frag = self._open_fragment(slice_)
+        with self._create_mu:
+            frag = self.fragments.get(slice_)
+            if frag is not None:
+                return frag
+            is_new_max = (self.fragments and slice_ > self.max_slice()
+                          or not self.fragments and slice_ > 0)
+            frag = self._open_fragment(slice_)
         if is_new_max and self.broadcaster is not None:
             from ..wire import pb
             self.broadcaster.send_async(pb.CreateSliceMessage(
