@@ -7,7 +7,7 @@ Subcommands (reference cmd/*.go + ctl/*.go, SURVEY.md §2.6):
     export    frame -> CSV on stdout
     backup    frame view -> local tar archive
     restore   local tar archive -> cluster
-    bench     set-bit / intersect-count micro-benchmarks
+    bench     set-bit / intersect-count / topn micro-benchmarks
     check     offline consistency check of fragment data files
     inspect   per-container stats dump of a data file
     sort      sort an import CSV in fragment/position order
@@ -267,6 +267,21 @@ def cmd_bench(args) -> int:
         for _ in range(args.n):
             client.execute_query(None, args.index, q, [], remote=False)
         dt = time.perf_counter() - t0
+    elif args.op == "topn":
+        # Seed rows with skewed counts so the rank cache has real work
+        # (BASELINE config: TopN(frame, n) with rank cache).
+        for r in range(min(args.max_row_id, 32)):
+            cols = rng.sample(range(args.max_column_id),
+                              k=min(10 + 30 * r, args.max_column_id))
+            pql = "".join(
+                f"SetBit({args.row_label}={r}, frame='{args.frame}',"
+                f" {args.column_label}={c})" for c in cols)
+            client.execute_query(None, args.index, pql, [], remote=False)
+        q = f"TopN(frame='{args.frame}', n=100)"
+        t0 = time.perf_counter()
+        for _ in range(args.n):
+            client.execute_query(None, args.index, q, [], remote=False)
+        dt = time.perf_counter() - t0
     else:
         print(f"unknown bench op: {args.op}", file=sys.stderr)
         return 1
@@ -395,7 +410,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-i", "--index", default="bench")
     p.add_argument("-f", "--frame", default="general")
     p.add_argument("--op", default="set-bit",
-                   choices=["set-bit", "intersect-count"])
+                   choices=["set-bit", "intersect-count", "topn"])
     p.add_argument("-n", type=int, default=1000)
     p.add_argument("--max-row-id", type=int, default=1000)
     p.add_argument("--max-column-id", type=int, default=1000)

@@ -154,6 +154,13 @@ class TestLiveNode:
         res = json.loads(capsys.readouterr().out)
         assert res["n"] == 20 and res["ops_per_sec"] > 0
 
+    def test_bench_topn(self, node, capsys):
+        assert main(["bench", "--host", node, "--op", "topn",
+                     "-n", "5", "--max-row-id", "8",
+                     "--max-column-id", "500"]) == 0
+        res = json.loads(capsys.readouterr().out)
+        assert res["op"] == "topn" and res["ops_per_sec"] > 0
+
 
 def test_server_command_full_binary(tmp_path):
     """Boot the real `server` subcommand as a child process, query it
