@@ -123,31 +123,56 @@ def _progress(msg):
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
+def _cpu_reexec_env():
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PILOSA_TPU_BENCH_REEXEC="1")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
 def main():
+    import os
+    import sys
+    import threading
+
     import jax
 
     from pilosa_tpu.parallel import default_mesh
 
+    # TPU backend init through a sick relay can HANG rather than raise,
+    # which no except-clause can catch — watchdog-exec to CPU instead of
+    # waiting forever.
+    init_done = threading.Event()
+    if not os.environ.get("PILOSA_TPU_BENCH_REEXEC"):
+        timeout_s = float(os.environ.get("PILOSA_TPU_INIT_TIMEOUT", "600"))
+
+        def watchdog():
+            if not init_done.wait(timeout_s):
+                _progress(f"TPU init exceeded {timeout_s:.0f}s; "
+                          "re-running on CPU")
+                os.execve(sys.executable,
+                          [sys.executable, os.path.abspath(__file__)],
+                          _cpu_reexec_env())
+
+        threading.Thread(target=watchdog, daemon=True).start()
+
     try:
         on_tpu = jax.default_backend() == "tpu"
+        init_done.set()
     except RuntimeError as e:
         # TPU relay down (backend init raised). Re-exec on CPU so the
         # harness still gets its one JSON line instead of a stack trace.
-        import os
-        import sys
-
         if os.environ.get("PILOSA_TPU_BENCH_REEXEC"):
             raise
         _progress(f"TPU backend unavailable ({e}); re-running on CPU")
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   PILOSA_TPU_BENCH_REEXEC="1")
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        flags = env.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
+                  [sys.executable, os.path.abspath(__file__)],
+                  _cpu_reexec_env())
     num_slices = 960 if on_tpu else 96  # CPU smoke keeps the shape
     iters = 50 if on_tpu else 3
     details = {}

@@ -244,6 +244,21 @@ def cmd_bench(args) -> int:
     client.create_frame(args.index, args.frame)
     rng = random.Random(1)
 
+    def seed_row(row_id: int, k: int):
+        """Batch-set k random columns on one row."""
+        cols = rng.sample(range(args.max_column_id),
+                          k=min(k, args.max_column_id))
+        pql = "".join(
+            f"SetBit({args.row_label}={row_id}, frame='{args.frame}',"
+            f" {args.column_label}={c})" for c in cols)
+        client.execute_query(None, args.index, pql, [], remote=False)
+
+    def timed_queries(q: str) -> float:
+        t0 = time.perf_counter()
+        for _ in range(args.n):
+            client.execute_query(None, args.index, q, [], remote=False)
+        return time.perf_counter() - t0
+
     if args.op == "set-bit":
         t0 = time.perf_counter()
         for i in range(args.n):
@@ -254,34 +269,17 @@ def cmd_bench(args) -> int:
         dt = time.perf_counter() - t0
     elif args.op == "intersect-count":
         for r in (1, 2):
-            cols = rng.sample(range(args.max_column_id), k=min(
-                1000, args.max_column_id))
-            pql = "".join(
-                f"SetBit({args.row_label}={r}, frame='{args.frame}',"
-                f" {args.column_label}={c})" for c in cols)
-            client.execute_query(None, args.index, pql, [], remote=False)
-        q = (f"Count(Intersect(Bitmap({args.row_label}=1, "
-             f"frame='{args.frame}'), Bitmap({args.row_label}=2, "
-             f"frame='{args.frame}')))")
-        t0 = time.perf_counter()
-        for _ in range(args.n):
-            client.execute_query(None, args.index, q, [], remote=False)
-        dt = time.perf_counter() - t0
+            seed_row(r, 1000)
+        dt = timed_queries(
+            f"Count(Intersect(Bitmap({args.row_label}=1, "
+            f"frame='{args.frame}'), Bitmap({args.row_label}=2, "
+            f"frame='{args.frame}')))")
     elif args.op == "topn":
         # Seed rows with skewed counts so the rank cache has real work
         # (BASELINE config: TopN(frame, n) with rank cache).
         for r in range(min(args.max_row_id, 32)):
-            cols = rng.sample(range(args.max_column_id),
-                              k=min(10 + 30 * r, args.max_column_id))
-            pql = "".join(
-                f"SetBit({args.row_label}={r}, frame='{args.frame}',"
-                f" {args.column_label}={c})" for c in cols)
-            client.execute_query(None, args.index, pql, [], remote=False)
-        q = f"TopN(frame='{args.frame}', n=100)"
-        t0 = time.perf_counter()
-        for _ in range(args.n):
-            client.execute_query(None, args.index, q, [], remote=False)
-        dt = time.perf_counter() - t0
+            seed_row(r, 10 + 30 * r)
+        dt = timed_queries(f"TopN(frame='{args.frame}', n=100)")
     else:
         print(f"unknown bench op: {args.op}", file=sys.stderr)
         return 1
