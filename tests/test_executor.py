@@ -256,3 +256,35 @@ class TestDistributed:
         # Local write applied + forwarded to the other replica once.
         assert list(holder.fragment("i", "general", "standard", 0).row(1)) == [0]
         assert calls == [("host1", 'SetBit(columnID=0, frame="general", rowID=1)')]
+
+
+class TestDeviceTopN:
+    def test_topn_device_matches_host(self, holder):
+        """Plain TopN takes the exact device path (pool_row_counts);
+        results must match the host rank-cache path, including
+        thresholds and ties."""
+        bits = []
+        for r, k in [(1, 7), (2, 12), (3, 3), (9, 12)]:
+            bits += [(r, c * 131) for c in range(k)]
+        bits += [(5, SLICE_WIDTH + 1), (5, SLICE_WIDTH + 2)]
+        seed(holder, bits=bits)
+        host = make_executor(holder, use_device=False)
+        dev = make_executor(holder, use_device=True)
+        for pql in (
+            "TopN(frame=general, n=3)",
+            "TopN(frame=general, n=100)",
+            "TopN(frame=general, n=2, threshold=4)",
+        ):
+            assert q(dev, "i", pql)[0] == q(host, "i", pql)[0], pql
+
+    def test_topn_filters_keep_host_path(self, holder):
+        """Attr-filtered TopN needs the host attr store; the device gate
+        must not hijack it."""
+        seed(holder, bits=[(1, 0), (1, 5), (2, 7)])
+        f = holder.frame("i", "general")
+        f.row_attr_store.set_attrs(1, {"cat": "x"})
+        f.row_attr_store.set_attrs(2, {"cat": "y"})
+        dev = make_executor(holder, use_device=True)
+        res = q(dev, "i", 'TopN(frame=general, n=5, field="cat",'
+                          ' filters=["x"])')[0]
+        assert res == [(1, 2)]
