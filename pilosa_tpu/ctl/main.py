@@ -444,5 +444,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
 
-if __name__ == "__main__":
+def main_entry() -> None:
+    """console_scripts entry point (pyproject [project.scripts])."""
     sys.exit(main())
+
+
+if __name__ == "__main__":
+    main_entry()
