@@ -288,3 +288,38 @@ class TestDeviceTopN:
         res = q(dev, "i", 'TopN(frame=general, n=5, field="cat",'
                           ' filters=["x"])')[0]
         assert res == [(1, 2)]
+
+
+class TestDeviceTreeFuzz:
+    def test_random_trees_device_matches_host(self, holder):
+        """Randomized op-tree differential: Count over random
+        Intersect/Union/Difference trees, fused device plan vs host
+        roaring (the executor-level analog of the kernel differential
+        suite)."""
+        import random
+
+        rng = random.Random(4242)
+        rows = list(range(1, 9))
+        bits = []
+        for r in rows:
+            k = rng.randrange(0, 200)
+            cols = rng.sample(range(2 * SLICE_WIDTH), k=k)
+            bits += [(r, c) for c in cols]
+        bits.append((1, 0))  # rows 1 always exists
+        seed(holder, bits=bits)
+        host = make_executor(holder, use_device=False)
+        dev = make_executor(holder, use_device=True)
+
+        def gen_tree(depth):
+            if depth == 0 or rng.random() < 0.4:
+                return f"Bitmap(rowID={rng.choice(rows + [777])})"
+            op = rng.choice(["Intersect", "Union", "Difference"])
+            n = rng.randrange(2, 4)
+            children = ", ".join(gen_tree(depth - 1) for _ in range(n))
+            return f"{op}({children})"
+
+        for _ in range(40):
+            pql = f"Count({gen_tree(rng.randrange(1, 4))})"
+            a = q(dev, "i", pql)[0]
+            b = q(host, "i", pql)[0]
+            assert a == b, (pql, a, b)
