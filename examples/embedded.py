@@ -1,0 +1,59 @@
+"""Embedded pilosa-tpu: the engine as a library, no HTTP server.
+
+Builds an index on disk, writes bits through the data model, runs PQL
+through the executor (fused device Count path when a TPU is live), and
+stages the frame onto the device mesh for collective queries.
+
+Run:  python examples/embedded.py /tmp/embedded-demo
+"""
+
+import sys
+import tempfile
+
+import numpy as np
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.pql import Parser
+
+
+def main(data_dir: str) -> None:
+    holder = Holder(data_dir)
+    holder.open()
+    try:
+        idx = holder.create_index_if_not_exists("analytics")
+        frame = idx.create_frame_if_not_exists("clicks")
+
+        # (row=ad id, column=user id); bits span two slices.
+        for ad, users in {3: [1, 7, 9, 1_050_000], 5: [7, 9, 2_000_000]}.items():
+            for u in users:
+                frame.set_bit(ad, u)
+
+        ex = Executor(holder)
+        def pql(q):
+            return ex.execute("analytics", Parser(q).parse())
+
+        print("ad 3 viewers:", pql("Bitmap(rowID=3, frame=clicks)")[0].columns().tolist())
+        print("both ads:", pql(
+            "Count(Intersect(Bitmap(rowID=3, frame=clicks),"
+            " Bitmap(rowID=5, frame=clicks)))")[0])
+        print("top ads:", pql("TopN(frame=clicks, n=10)")[0])
+
+        # Device mesh staging: shard the frame's slices over every
+        # local accelerator and Count with an ICI psum.
+        from pilosa_tpu.parallel import (
+            compile_mesh_count, default_mesh, sharded_index_from_holder)
+
+        mesh = default_mesh()
+        sharded, row_ids, n = sharded_index_from_holder(
+            holder, "analytics", "clicks", mesh=mesh)
+        dense = int(np.searchsorted(row_ids, np.uint64(3)))
+        fn = compile_mesh_count(mesh, ["leaf"], 1)
+        print(f"mesh Count(Bitmap(3)) over {mesh.size} device(s):",
+              int(fn(sharded, np.int32([dense]))))
+    finally:
+        holder.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp())

@@ -216,3 +216,22 @@ def test_server_command_full_binary(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_embedded_example_runs(tmp_path):
+    """examples/embedded.py runs end-to-end on the virtual mesh."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "embedded.py"),
+         str(tmp_path / "demo")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "both ads: 2" in r.stdout
+    assert "top ads: [(3, 4), (5, 3)]" in r.stdout
