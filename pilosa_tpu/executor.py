@@ -112,6 +112,10 @@ class Executor:
         # None = auto (device path when available); False = host roaring only.
         self.use_device = use_device
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        # Separate pool for per-slice fan-out: _mapper submits node-level
+        # tasks to _pool that block on slice-level results, so sharing
+        # one bounded pool could deadlock with every worker waiting.
+        self._slice_pool = ThreadPoolExecutor(max_workers=max_workers)
 
     # -- top level -----------------------------------------------------------
 
@@ -657,10 +661,27 @@ class Executor:
         return results[0] if results else None
 
     def _mapper_local(self, slices: Sequence[int], map_fn, reduce_fn):
-        """Local per-slice map + reduce (executor.go:1200-1236). reduce_fn
-        must handle prev=None by allocating a fresh accumulator — results
-        never alias fragment row caches."""
+        """Local per-slice map + reduce (executor.go:1200-1236 runs a
+        goroutine per slice; here the map fans out on the dedicated
+        _slice_pool — NOT self._pool, see __init__ — and the reduce
+        folds results in slice order, so the output is deterministic
+        regardless of completion order). reduce_fn must handle prev=None
+        by allocating a fresh accumulator — results never alias fragment
+        row caches."""
+        slices = list(slices)
         result = None
-        for slice_ in slices:
-            result = reduce_fn(result, map_fn(slice_))
+        if len(slices) <= 1:
+            for slice_ in slices:
+                result = reduce_fn(result, map_fn(slice_))
+            return result
+        futures = [self._slice_pool.submit(map_fn, s) for s in slices]
+        try:
+            for fut in futures:
+                result = reduce_fn(result, fut.result())
+        except BaseException:
+            # Don't leave orphaned slice tasks burning pool workers
+            # while the node-failure re-split re-executes these slices.
+            for fut in futures:
+                fut.cancel()
+            raise
         return result
