@@ -273,3 +273,27 @@ def test_connect_distributed_single_process():
                             "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
     assert r.returncode == 0, r.stderr
     assert "distributed ok" in r.stdout
+
+
+def test_sharded_index_from_holder_inverse_view(mesh, tmp_path):
+    """The H2D bridge stages any view — here the inverse orientation
+    (column-major rows, view.go:31-34), counted on device."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.parallel.mesh import sharded_index_from_holder
+
+    holder = Holder(str(tmp_path / "inv"))
+    holder.open()
+    try:
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f", inverse_enabled=True)
+        # (row r, col c) -> inverse fragment holds (c, r).
+        for r, c in [(1, 10), (2, 10), (3, 10), (1, 11)]:
+            f.set_bit(r, c)
+        sharded, row_ids, n = sharded_index_from_holder(
+            holder, "i", "f", view="inverse", mesh=mesh)
+        # Inverse rows are column ids; column 10 has 3 bits.
+        dense = int(np.searchsorted(row_ids, np.uint64(10)))
+        fn = compile_mesh_count(mesh, ["leaf"], 1)
+        assert int(fn(sharded, np.int32([dense]))) == 3
+    finally:
+        holder.close()
