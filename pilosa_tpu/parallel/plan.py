@@ -83,25 +83,41 @@ class CountPlan:
     def __init__(self, holder, index: str, shape, leaves: List[tuple]):
         self.holder = holder
         self.index = index
-        # leaves: [(frame_name, row_id)] in depth-first order.
+        # leaves: [(frame, view, row_id, required)] in depth-first
+        # order. required=False leaves (Range's time views) contribute
+        # an empty block when the fragment is absent; a missing
+        # required fragment sends the slice to the host path.
         self.leaves = leaves
         self._sig = json.dumps(_tree_signature(shape))
         self._fn = _compiled_count(self._sig)
 
     def count_slice(self, slice_: int) -> Optional[int]:
-        leaf_args = []
-        for frame, row_id in self.leaves:
-            frag = self.holder.fragment(self.index, frame, VIEW_STANDARD, slice_)
+        staged = []
+        fallback_pool = None
+        for frame, view, row_id, required in self.leaves:
+            frag = self.holder.fragment(self.index, frame, view, slice_)
             if frag is None:
-                return None
+                if required:
+                    return None
+                staged.append(None)
+                continue
             pool, row_ids = frag.pool
+            fallback_pool = (pool, row_ids)
             i = int(np.searchsorted(row_ids, np.uint64(row_id)))
             if i >= len(row_ids) or row_ids[i] != np.uint64(row_id):
                 # Absent row: any dense index past the live keys gathers
                 # all-zero (pool.py gather_row hit-mask).
                 i = len(row_ids)
-            leaf_args.append((pool, jnp.int32(i)))
-        return int(self._fn(tuple(leaf_args)))
+            staged.append((pool, jnp.int32(i)))
+        if fallback_pool is None:
+            return 0  # every leaf optional and absent
+        # Absent optional fragments gather all-zero from any real pool
+        # via an out-of-range dense index.
+        pool, row_ids = fallback_pool
+        leaf_args = tuple(
+            arg if arg is not None else (pool, jnp.int32(len(row_ids)))
+            for arg in staged)
+        return int(self._fn(leaf_args))
 
 
 def _lower_tree(holder, index: str, c, leaves: List[tuple]):
@@ -123,8 +139,10 @@ def _lower_tree(holder, index: str, c, leaves: List[tuple]):
             return None
         if not row_ok or col_ok:
             return None  # inverse/invalid → host path
-        leaves.append((frame, row_id))
+        leaves.append((frame, VIEW_STANDARD, row_id, True))
         return ["leaf"]
+    if c.name == "Range":
+        return _lower_range(holder, index, c, leaves)
     op = _TREE_OPS.get(c.name)
     if op is None or not c.children:
         return None
@@ -137,9 +155,48 @@ def _lower_tree(holder, index: str, c, leaves: List[tuple]):
     return [op] + parts
 
 
+def _lower_range(holder, index: str, c, leaves: List[tuple]):
+    """Range(frame, <row>, start, end) → OR over its time-quantum view
+    leaves (executor.go:490-546 semantics: absent view fragments are
+    empty, not errors — the leaves are optional)."""
+    from ..core import views_by_time_range
+    from ..executor import DEFAULT_FRAME, parse_time
+
+    idx = holder.index(index)
+    if idx is None:
+        return None
+    frame = c.args.get("frame") or DEFAULT_FRAME
+    f = idx.frame(frame)
+    if f is None:
+        return None
+    try:
+        row_id, ok = c.uint_arg(f.row_label)
+    except TypeError:
+        return None  # invalid arg type → host path owns error reporting
+    start, end = c.args.get("start"), c.args.get("end")
+    if not ok or not isinstance(start, str) or not isinstance(end, str):
+        return None
+    try:
+        views = views_by_time_range(VIEW_STANDARD, parse_time(start),
+                                    parse_time(end), f.time_quantum)
+    except ValueError:
+        return None
+    if not views or len(views) > 32:
+        # No quantum → host path (returns empty). A very wide unaligned
+        # cover (fine quanta) would jit a huge fused OR and churn the
+        # compile cache; incremental host unions win there.
+        return None
+    for v in views:
+        leaves.append((frame, v, row_id, False))
+    if len(views) == 1:
+        return ["leaf"]
+    return ["or"] + [["leaf"]] * len(views)
+
+
 def compile_count_plan(holder, index: str, tree) -> Optional[CountPlan]:
     """Compile Count's child tree for fused device eval; None when the
-    tree doesn't qualify (Range, inverse views, unknown frames, ...)."""
+    tree doesn't qualify (inverse views, unknown frames, non-integer
+    args, over-wide Range covers, ...)."""
     leaves: List[tuple] = []
     shape = _lower_tree(holder, index, tree, leaves)
     if shape is None or shape == ["leaf"] and not leaves:
