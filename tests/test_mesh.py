@@ -297,3 +297,14 @@ def test_sharded_index_from_holder_inverse_view(mesh, tmp_path):
         assert int(fn(sharded, np.int32([dense]))) == 3
     finally:
         holder.close()
+
+
+def test_single_device_mesh():
+    """Everything works on a 1-device mesh (no collectives needed, but
+    the same shard_map path compiles)."""
+    mesh1 = default_mesh(1)
+    bitmaps = make_bitmaps(2, {0: [(1, 5)], 1: [(1, 7), (2, 7)]})
+    idx, row_ids = build_sharded_index(bitmaps, mesh1)
+    fn = compile_mesh_count(mesh1, ["leaf"], 1)
+    dense = int(np.searchsorted(row_ids, np.uint64(1)))
+    assert int(fn(idx, np.int32([dense]))) == 2
