@@ -49,6 +49,7 @@ import io
 import json
 import re
 import threading
+import time
 from datetime import datetime, timezone
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
@@ -425,8 +426,19 @@ class Handler:
 
         try:
             q = Parser(query).parse()
+            t0 = time.monotonic()
             results = self.executor.execute(
                 index, q, slices or None, ExecOptions(remote=remote))
+            # Per-call-name query stats, visible at /debug/vars
+            # (observability parity: reference tag-scoped StatsClient,
+            # stats.go:33-54). Remote fan-out legs are skipped so a
+            # clustered query counts once, at its coordinator.
+            if not remote:
+                dt_us = int((time.monotonic() - t0) * 1e6)
+                tagged = self.stats.with_tags(f"index:{index}")
+                for call in q.calls:
+                    tagged.count(f"query.{call.name}", 1)
+                tagged.timing("query", dt_us)
         except PilosaError as e:
             return self._query_error(e, headers)
         except ParseError as e:

@@ -304,3 +304,17 @@ class TestDebugRoutes:
         assert resp.status == 200
         assert b"pilosa-tpu" in resp.body
         assert b"/schema" in resp.body
+
+
+class TestQueryStats:
+    def test_query_counts_and_timing(self, env):
+        holder, handler = env
+        seed(handler)
+        r = post(handler, "/index/i/query",
+                 b"Count(Bitmap(rowID=1, frame=f))"
+                 b"SetBit(rowID=9, frame=f, columnID=5)")
+        assert r.status == 200, r.body
+        snap = handler.stats.snapshot()
+        assert snap.get("index:i,query.Count") == 1
+        assert snap.get("index:i,query.SetBit") == 1
+        assert "index:i,query.us.sum" in snap
