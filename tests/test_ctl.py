@@ -236,3 +236,69 @@ def test_embedded_example_runs(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "both ads: 2" in r.stdout
     assert "top ads: [(3, 4), (5, 3)]" in r.stdout
+
+
+def test_server_kill9_durability(tmp_path):
+    """Acked SetBits survive a SIGKILL (no clean shutdown): the WAL's
+    unbuffered 13-byte ops are the durability point (reference
+    roaring.go:617-628), replayed on reopen."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    host = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    log = tempfile.NamedTemporaryFile(mode="w+", suffix=".log", delete=False)
+    data_dir = str(tmp_path / "kdata")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.ctl.main", "server",
+         "-d", data_dir, "-b", host],
+        env=env, stdout=log, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(f"http://{host}/version", timeout=2)
+                break
+            except OSError:
+                assert proc.poll() is None, "server died"
+                time.sleep(0.2)
+        for path, body in [("/index/k", b"{}"), ("/index/k/frame/f", b"{}")]:
+            req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+        pql = "".join(f"SetBit(rowID=1, frame=f, columnID={c})"
+                      for c in (3, 9, 1_048_580))
+        req = urllib.request.Request(f"http://{host}/index/k/query",
+                                     data=pql.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert b"true" in r.read()
+        proc.send_signal(signal.SIGKILL)  # no flush, no close
+        proc.wait(timeout=15)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    from pilosa_tpu.core import Holder
+
+    holder = Holder(data_dir)
+    holder.open()
+    try:
+        cols = []
+        for sl in (0, 1):
+            frag = holder.fragment("k", "f", "standard", sl)
+            if frag is not None:
+                cols += [c for _, c in frag.for_each_bit()]
+        assert sorted(cols) == [3, 9, 1_048_580]
+    finally:
+        holder.close()
