@@ -99,6 +99,23 @@ class Fragment:
         self._pool = None
         self._pool_row_ids = None
         self._pool_dirty = True
+        self._pool_keys_host = None
+        self._pool_gen = 0
+
+        # Mutation log for incremental device-image maintenance: device
+        # consumers (the fragment's own pool, the mesh serving layer)
+        # record the generation they staged at and later ask
+        # log_since(gen) for the bits written since — applying them as a
+        # device scatter instead of re-uploading the whole pool
+        # (SURVEY.md §7 "mutation on device": host-buffered batches,
+        # device scatter). Entries: (op 0=set/1=clear, pos, churn) where
+        # churn means the container SET changed (new container created /
+        # emptied container removed) — a churned pool must rebuild, a
+        # scatter can't add or drop key slots.
+        self.generation = 0
+        self._log: List[Tuple[int, int, bool]] = []
+        self._log_base = 0
+        self._log_limit = 8192
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -188,7 +205,10 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """Set a bit; WAL-append, maybe snapshot, update caches.
         Returns True if the bit was newly set (fragment.go:371-413)."""
-        changed = self.storage.add(self._pos(row_id, column_id))
+        pos = self._pos(row_id, column_id)
+        churn = self.storage._find_key(pos >> 16) < 0
+        changed = self.storage.add(pos)
+        self._log_append(0, pos, churn)
         self._mark_dirty(row_id)
         if changed:
             self.cache.add(row_id, self.row(row_id).count())
@@ -199,7 +219,10 @@ class Fragment:
 
     @_locked
     def clear_bit(self, row_id: int, column_id: int) -> bool:
-        changed = self.storage.remove(self._pos(row_id, column_id))
+        pos = self._pos(row_id, column_id)
+        changed = self.storage.remove(pos)
+        churn = changed and self.storage._find_key(pos >> 16) < 0
+        self._log_append(1, pos, churn)
         self._mark_dirty(row_id)
         if changed:
             self.cache.add(row_id, self.row(row_id).count())
@@ -208,8 +231,35 @@ class Fragment:
         self._increment_op_n()
         return changed
 
+    # -- mutation log (device-image maintenance) -----------------------------
+
+    def _log_append(self, op: int, pos: int, churn: bool):
+        self.generation += 1
+        self._log.append((op, pos, churn))
+        if len(self._log) > self._log_limit:
+            drop = len(self._log) - self._log_limit
+            del self._log[:drop]
+            self._log_base += drop
+
+    def _log_reset(self):
+        """Wholesale storage replacement (import, restore): consumers at
+        any earlier generation must rebuild."""
+        self.generation += 1
+        self._log.clear()
+        self._log_base = self.generation
+
+    @_locked
+    def log_since(self, gen: int) -> Optional[List[Tuple[int, int, bool]]]:
+        """Mutations after generation `gen`, or None when the log no
+        longer reaches back that far (pruned/reset → rebuild)."""
+        if gen < self._log_base or gen > self.generation:
+            return None
+        return self._log[gen - self._log_base:]
+
     def _mark_dirty(self, row_id: Optional[int]):
         self._pool_dirty = True
+        if row_id is None:
+            self._log_reset()
         self.checksums.pop(
             -1 if row_id is None else row_id // HASH_BLOCK_SIZE, None
         )
@@ -521,10 +571,62 @@ class Fragment:
     @property
     @_locked
     def pool(self):
-        """(FragmentPool, row_ids) device image, rebuilt when dirty."""
-        if self._pool_dirty or self._pool is None:
-            from ..ops import build_pool
+        """(FragmentPool, row_ids) device image.
 
-            self._pool, self._pool_row_ids = build_pool(self.storage)
+        Maintained INCREMENTALLY: writes that stay inside existing
+        containers are folded from the mutation log into one device
+        scatter (ops.pool.apply_pool_mutations) — the pool re-upload
+        the reference avoids via mmap (fragment.go:371-413) is avoided
+        here by never leaving the device. Only container churn (new
+        container / emptied container / bulk import) forces a rebuild.
+        """
+        if not self._pool_dirty and self._pool is not None:
+            return self._pool, self._pool_row_ids
+        if self._pool is not None and self._try_pool_update():
             self._pool_dirty = False
+            return self._pool, self._pool_row_ids
+
+        import jax
+
+        from ..ops import FragmentPool, build_pool_arrays
+
+        keys, words, n, row_ids = build_pool_arrays(self.storage)
+        self._pool = FragmentPool(
+            keys=jax.device_put(keys), words=jax.device_put(words),
+            n=jax.device_put(n))
+        self._pool_keys_host = keys
+        self._pool_row_ids = row_ids
+        self._pool_gen = self.generation
+        self._pool_dirty = False
         return self._pool, self._pool_row_ids
+
+    def _try_pool_update(self) -> bool:
+        """Apply logged writes to the existing device pool via scatter.
+        False when the log was pruned, churned, or targets rows outside
+        the staged dense table — the caller rebuilds."""
+        entries = self.log_since(self._pool_gen)
+        if entries is None or any(e[2] for e in entries):
+            return False
+        if not entries:
+            return True
+        # Fold to final per-bit state (last op wins).
+        final = {}
+        for op, pos, _ in entries:
+            final[pos] = op == 0
+        from ..ops.pool import (
+            apply_pool_mutations,
+            pad_mutation_plan,
+            plan_slice_mutations,
+        )
+
+        pos = np.fromiter(final.keys(), dtype=np.uint64, count=len(final))
+        val = np.fromiter(final.values(), dtype=bool, count=len(final))
+        try:
+            plan = plan_slice_mutations(
+                self._pool_keys_host, self._pool_row_ids, pos, val)
+        except KeyError:
+            return False
+        batch = pad_mutation_plan(plan, self._pool.capacity)
+        self._pool = apply_pool_mutations(self._pool, *batch)
+        self._pool_gen = self.generation
+        return True

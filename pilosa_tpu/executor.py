@@ -116,6 +116,12 @@ class Executor:
         # tasks to _pool that block on slice-level results, so sharing
         # one bounded pool could deadlock with every worker waiting.
         self._slice_pool = ThreadPoolExecutor(max_workers=max_workers)
+        # Mesh serving layer (parallel/serve.py): created on first use
+        # when the device backend is on. Count/TopN slice batches route
+        # through it as ONE shard_map'd collective; the per-slice paths
+        # below remain the fallback.
+        self._mesh_mgr = None
+        self._mesh_mgr_failed = False
 
     # -- top level -----------------------------------------------------------
 
@@ -322,15 +328,69 @@ class Executor:
         def reduce_fn(prev, v):
             return (prev or 0) + v
 
-        result = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        result = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
+                                  batch_fn=self._mesh_count_batch(index, child))
         return int(result or 0)
+
+    def mesh_manager(self):
+        """The mesh serving layer, or None when the device backend is
+        off or its construction failed (no devices, import error)."""
+        if self._mesh_mgr is not None:
+            return self._mesh_mgr
+        if self._mesh_mgr_failed or not self._device_backend_on():
+            return None
+        try:
+            from .parallel.serve import MeshManager
+
+            self._mesh_mgr = MeshManager(self.holder)
+        except Exception:  # noqa: BLE001 — device layer unavailable
+            self._mesh_mgr_failed = True
+            return None
+        return self._mesh_mgr
+
+    def _batch_num_slices(self, index: str, batch_slices) -> int:
+        idx = self.holder.index(index)
+        top = max(batch_slices) if batch_slices else 0
+        if idx is not None:
+            top = max(top, idx.max_slice())
+        return top + 1
+
+    def _mesh_count_batch(self, index: str, tree: Call):
+        """A batch_fn serving a whole slice set as one mesh collective,
+        or None when the tree/backend doesn't qualify."""
+        mgr = self.mesh_manager()
+        if mgr is None:
+            return None
+        from .parallel.plan import _lower_tree
+
+        leaves: list = []
+        shape = _lower_tree(self.holder, index, tree, leaves)
+        if shape is None or not leaves:
+            return None
+
+        def batch_fn(batch_slices):
+            try:
+                return mgr.count(index, shape, leaves, batch_slices,
+                                 self._batch_num_slices(index, batch_slices))
+            except Exception:  # noqa: BLE001 — any device failure → host path
+                return None
+
+        return batch_fn
 
     def _device_backend_on(self) -> bool:
         """use_device: True forces the device path, False forces host
-        roaring, None = auto (device when a TPU backend is live)."""
+        roaring, None = auto — the PILOSA_TPU_USE_DEVICE env var if set
+        (1/true/0/false), else device when a TPU backend is live."""
         if self.use_device is False:
             return False
         if self.use_device is None:
+            import os
+
+            env = os.environ.get("PILOSA_TPU_USE_DEVICE", "").strip().lower()
+            if env in ("1", "true", "yes", "on"):
+                return True
+            if env in ("0", "false", "no", "off"):
+                return False
             import jax
 
             return jax.default_backend() == "tpu"
@@ -373,9 +433,37 @@ class Executor:
         def reduce_fn(prev, v):
             return add_to_pairs(prev or [], v)
 
-        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
+        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
+                                 batch_fn=self._mesh_top_n_batch(index, c)) or []
         pairs.sort(key=lambda p: (-p[1], p[0]))
         return pairs
+
+    def _mesh_top_n_batch(self, index: str, c: Call):
+        """A batch_fn serving plain TopN (and its exact ids phase 2) as
+        one masked row-count collective; None when the call needs host
+        state (src intersection, attr filters, tanimoto)."""
+        mgr = self.mesh_manager()
+        if mgr is None or c.children or c.args.get("filters"):
+            return None
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto:
+            return None
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        n, _ = c.uint_arg("n")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+
+        def batch_fn(batch_slices):
+            try:
+                return mgr.top_n(
+                    index, frame, VIEW_STANDARD, batch_slices,
+                    self._batch_num_slices(index, batch_slices),
+                    0 if row_ids else n, row_ids,
+                    min_threshold or MIN_THRESHOLD)
+            except Exception:  # noqa: BLE001 — any device failure → host path
+                return None
+
+        return batch_fn
 
     def execute_top_n_slice(self, index: str, c: Call, slice_: int) -> List[tuple]:
         """One slice of TopN (executor.go:333-396)."""
@@ -605,11 +693,16 @@ class Executor:
         return m
 
     def _map_reduce(self, index: str, slices: Sequence[int], c: Call,
-                    opt: ExecOptions, map_fn, reduce_fn):
+                    opt: ExecOptions, map_fn, reduce_fn, batch_fn=None):
         """Cluster-wide map + reduce with node-failure re-split
-        (executor.go:1103-1163)."""
+        (executor.go:1103-1163).
+
+        batch_fn, when given, serves a whole LOCAL slice batch in one
+        device collective (the mesh serving path); a None return falls
+        back to the per-slice map_fn fan-out. Remote nodes always go
+        through the RPC path — each runs its own batch_fn on arrival."""
         if self.cluster is None or not self.cluster.nodes:
-            return self._mapper_local(slices, map_fn, reduce_fn)
+            return self._mapper_local(slices, map_fn, reduce_fn, batch_fn)
 
         if opt.remote:
             # Already forwarded: restrict to the local node.
@@ -617,17 +710,18 @@ class Executor:
         else:
             nodes = list(self.cluster.nodes)
 
-        return self._mapper(nodes, index, slices, c, opt, map_fn, reduce_fn)
+        return self._mapper(nodes, index, slices, c, opt, map_fn, reduce_fn,
+                            batch_fn)
 
     def _mapper(self, nodes, index: str, slices: Sequence[int], c: Call,
-                opt: ExecOptions, map_fn, reduce_fn):
+                opt: ExecOptions, map_fn, reduce_fn, batch_fn=None):
         m = self._slices_by_node(nodes, index, slices)
 
         futures = {}
         for node, node_slices in m.items():
             if node.host == self.host:
                 fut = self._pool.submit(self._mapper_local, node_slices,
-                                        map_fn, reduce_fn)
+                                        map_fn, reduce_fn, batch_fn)
             elif not opt.remote:
                 fut = self._pool.submit(self._exec_remote_one, node, index, c,
                                         node_slices, opt)
@@ -649,7 +743,7 @@ class Executor:
                     remaining = [n for n in nodes if n is not node]
                     try:
                         v = self._mapper(remaining, index, node_slices, c,
-                                         opt, map_fn, reduce_fn)
+                                         opt, map_fn, reduce_fn, batch_fn)
                     except SliceUnavailableError:
                         raise err
                 result = reduce_fn(result, v)
@@ -660,15 +754,24 @@ class Executor:
         results = self._exec_remote(node, index, Query(calls=[c]), slices, opt)
         return results[0] if results else None
 
-    def _mapper_local(self, slices: Sequence[int], map_fn, reduce_fn):
+    def _mapper_local(self, slices: Sequence[int], map_fn, reduce_fn,
+                      batch_fn=None):
         """Local per-slice map + reduce (executor.go:1200-1236 runs a
         goroutine per slice; here the map fans out on the dedicated
         _slice_pool — NOT self._pool, see __init__ — and the reduce
         folds results in slice order, so the output is deterministic
         regardless of completion order). reduce_fn must handle prev=None
         by allocating a fresh accumulator — results never alias fragment
-        row caches."""
+        row caches.
+
+        When batch_fn serves the whole batch (mesh path), its result
+        feeds reduce_fn directly — one device collective replaces the
+        per-slice fan-out."""
         slices = list(slices)
+        if batch_fn is not None and slices:
+            v = batch_fn(slices)
+            if v is not None:
+                return reduce_fn(None, v)
         result = None
         if len(slices) <= 1:
             for slice_ in slices:

@@ -116,6 +116,97 @@ def gather_row(pool: FragmentPool, dense_row) -> jax.Array:
     return jnp.where(hit[:, None], rows, jnp.uint32(0))
 
 
+def plan_slice_mutations(keys_row: np.ndarray, row_ids: np.ndarray,
+                         pos: np.ndarray, val: np.ndarray):
+    """Fold one slice's mutations into a (slot, word, set_mask,
+    clear_mask) scatter plan against an existing pool image.
+
+    pos: slice-local linear positions (row*2^20 + col%2^20); val: the
+    FINAL bit value for each pos (callers fold their write log first so
+    a set-then-clear nets to one clear — device scatter order is
+    unspecified, final-state folding makes it irrelevant). Targets are
+    grouped per (container slot, word): a word receiving both sets and
+    clears gets both masks in ONE entry, so the device's
+    (cur & ~clear) | set is exact. This is the device-side half of
+    SetBit/ClearBit (reference fragment.go:371-459) — batched scatter
+    instead of a full pool re-upload.
+
+    keys_row: the pool's sorted (INVALID_KEY-padded) key array;
+    row_ids: the pool's dense row table. Returns unpadded 1-D arrays.
+    Raises KeyError when a set targets a row/container absent from the
+    pool (stale image — caller rebuilds); clears of absent containers
+    are dropped (nothing to clear, matching roaring remove of a missing
+    container key).
+    """
+    pos = np.asarray(pos, dtype=np.uint64)
+    val = np.asarray(val, dtype=bool)
+    rows = pos >> np.uint64(20)
+    dense = np.searchsorted(row_ids, rows)
+    if len(row_ids):
+        known_row = (dense < len(row_ids)) & (
+            row_ids[np.minimum(dense, len(row_ids) - 1)] == rows)
+    else:
+        known_row = np.zeros(len(pos), dtype=bool)
+    key = (dense * ROW_SPAN
+           + ((pos >> np.uint64(16)) & np.uint64(15)).astype(np.int64)
+           ).astype(np.int32)
+    sl = np.searchsorted(keys_row, key).astype(np.int64)
+    known = known_row & (sl < keys_row.shape[0]) & (
+        keys_row[np.minimum(sl, keys_row.shape[0] - 1)] == key)
+    if np.any(val & ~known):
+        raise KeyError("set targets a container absent from the pool image")
+    sl, pos, val = sl[known], pos[known], val[known]
+    wd = ((pos & np.uint64(0xFFFF)) >> np.uint64(5)).astype(np.int32)
+    bit = np.uint32(1) << (pos & np.uint64(31)).astype(np.uint32)
+
+    flat = sl * CONTAINER_WORDS + wd
+    order = np.argsort(flat, kind="stable")
+    flat, sl, wd, bit, val = (flat[order], sl[order], wd[order], bit[order],
+                              val[order])
+    uniq, start = np.unique(flat, return_index=True)
+    set_mask = np.zeros(len(uniq), dtype=np.uint32)
+    clear_mask = np.zeros(len(uniq), dtype=np.uint32)
+    group = np.searchsorted(uniq, flat)
+    np.bitwise_or.at(set_mask, group[val], bit[val])
+    np.bitwise_or.at(clear_mask, group[~val], bit[~val])
+    return (sl[start].astype(np.int32), wd[start], set_mask, clear_mask)
+
+
+def pad_mutation_plan(plan, capacity: int, min_batch: int = 8):
+    """Pad a plan_slice_mutations result to a power-of-two batch.
+
+    Padding entries use slot = capacity — out of bounds, so the jitted
+    scatter drops them (mode="drop"): a no-op encoded without colliding
+    with any real target. Power-of-two padding means jit recompiles on
+    batch-size doubling, not on every distinct batch size.
+    """
+    sl, wd, sm, cm = plan
+    b = min_batch
+    while b < len(sl):
+        b *= 2
+    slot = np.full(b, capacity, dtype=np.int32)
+    word = np.zeros(b, dtype=np.int32)
+    set_mask = np.zeros(b, dtype=np.uint32)
+    clear_mask = np.zeros(b, dtype=np.uint32)
+    n = len(sl)
+    slot[:n], word[:n], set_mask[:n], clear_mask[:n] = sl, wd, sm, cm
+    return slot, word, set_mask, clear_mask
+
+
+@jax.jit
+def apply_pool_mutations(pool: FragmentPool, slot, word, set_mask,
+                         clear_mask) -> FragmentPool:
+    """Scatter a folded mutation batch into one pool's words.
+
+    Targets are unique (plan_slice_mutations) and padding rides
+    out-of-bounds slots dropped by the scatter, so the update is exact
+    for mixed sets and clears.
+    """
+    cur = pool.words[slot, word]
+    upd = (cur & ~clear_mask) | set_mask
+    return pool._replace(words=pool.words.at[slot, word].set(upd, mode="drop"))
+
+
 @partial(jax.jit, static_argnames=("num_rows",))
 def pool_row_counts(pool: FragmentPool, num_rows: int) -> jax.Array:
     """Per-dense-row bit counts over the whole pool: popcount each

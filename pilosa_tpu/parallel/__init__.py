@@ -36,27 +36,44 @@ _MESH_NAMES = (
     "SLICE_AXIS",
     "ShardedIndex",
     "build_sharded_index",
+    "combine_count",
     "compile_mesh_apply_writes",
     "compile_mesh_count",
     "compile_mesh_step",
     "compile_mesh_topn",
+    "compile_serve_apply_writes",
+    "compile_serve_count",
+    "compile_serve_row_counts",
     "connect_distributed",
     "default_mesh",
+    "pack_mutation_batches",
     "plan_writes",
     "sharded_index_from_holder",
 )
+
+_SERVE_NAMES = ("MeshManager", "StagedView")
 
 
 def __getattr__(name):
     if name in _MESH_NAMES:
         from . import mesh
         return getattr(mesh, name)
+    if name in _SERVE_NAMES:
+        from . import serve
+        return getattr(serve, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
+    "MeshManager",
+    "StagedView",
     "SLICE_AXIS",
     "ShardedIndex",
     "build_sharded_index",
+    "combine_count",
+    "compile_serve_apply_writes",
+    "compile_serve_count",
+    "compile_serve_row_counts",
+    "pack_mutation_batches",
     "compile_mesh_apply_writes",
     "compile_mesh_count",
     "compile_mesh_step",

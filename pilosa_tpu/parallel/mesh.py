@@ -379,6 +379,172 @@ def compile_mesh_step(mesh: Mesh, tree_shape, num_leaves: int,
     return run
 
 
+# -- serving-path kernels ----------------------------------------------------
+#
+# The compile_serve_* family is what the query Executor calls when an
+# HTTP query reaches a node (the TPU answer to the reference's
+# goroutine-per-slice local fan-out, executor.go:1200-1236): one
+# shard_map'd computation evaluates every locally-owned slice, with a
+# per-slice ownership mask so the same staged index serves any slice
+# subset, and psum reductions ride ICI. Counts come back as two int32
+# limbs (lo16/hi) combined host-side — a dense multi-B-column index
+# overflows a single int32 accumulator (the JAX default config has no
+# device int64), so the device never sums raw counts across slices.
+
+
+def combine_count(lo, hi) -> int:
+    """Host-side combine of the (lo, hi) int32 count limbs."""
+    return (int(hi) << 16) + int(lo)
+
+
+def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
+    """Jit a masked Count over a bitmap-op tree with PER-LEAF pools.
+
+    Unlike compile_mesh_count (one pool for every leaf), each leaf
+    gathers from its own ShardedIndex — a served tree may span frames
+    and time-quantum views. Returns
+      fn(indexes: tuple[ShardedIndex] per leaf, leaf_ids (L,) int32,
+         mask (S,) int32) -> (lo, hi) int32 limbs
+    where mask selects the slices this node serves (1 = count, 0 =
+    skip); combine with combine_count. Per-slice counts are uint32
+    (safe to 2^32 bits/slice); the lo-limb sum is int32-safe to 32k
+    slices (~34T columns).
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+
+    def one_slice(keys_t, words_t, idxs):
+        leaves = tuple(
+            (FragmentPool(keys=keys_t[i], words=words_t[i], n=jnp.int32(0)),
+             idxs[i])
+            for i in range(num_leaves))
+        blk = eval_tree(tree, leaves)
+        return lax.population_count(blk).sum(dtype=jnp.uint32)
+
+    def per_shard(keys_t, words_t, idxs, mask):
+        counts = jax.vmap(one_slice, in_axes=(0, 0, None))(
+            keys_t, words_t, idxs)
+        counts = jnp.where(mask != 0, counts, jnp.uint32(0))
+        lo = lax.psum((counts & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
+                      SLICE_AXIS)
+        hi = lax.psum((counts >> 16).astype(jnp.int32).sum(), SLICE_AXIS)
+        return lo, hi
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * num_leaves,
+                  P(), P(SLICE_AXIS)),
+        out_specs=(P(), P()),
+    )
+
+    @jax.jit
+    def run(indexes, leaf_ids, mask):
+        keys_t = tuple(ix.keys for ix in indexes)
+        words_t = tuple(ix.words for ix in indexes)
+        return fn(keys_t, words_t, leaf_ids, mask)
+
+    return run
+
+
+def compile_serve_row_counts(mesh: Mesh, num_rows: int):
+    """Jit masked global per-row counts for one sharded view.
+
+    Returns fn(index: ShardedIndex, mask (S,) int32) ->
+    (lo, hi) (num_rows,) int32 limb arrays; combine as
+    (hi.astype(int64) << 16) + lo on the host. This is the device half
+    of served TopN: the host applies threshold / candidate-id / n
+    semantics to the exact totals (reference fragment.go:493-625 +
+    executor.go:273-310 collapse into one collective + a host sort).
+    """
+    one = partial(_row_counts_one_slice, num_rows)
+
+    def per_shard(keys, words, mask):
+        local = jax.vmap(one)(keys, words)  # (S_local, R) int32
+        local = jnp.where(mask[:, None] != 0, local, 0)
+        lo = lax.psum((local & 0xFFFF).sum(axis=0), SLICE_AXIS)
+        hi = lax.psum((local >> 16).sum(axis=0), SLICE_AXIS)
+        return lo, hi
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS), P(SLICE_AXIS), P(SLICE_AXIS)),
+        out_specs=(P(), P()),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex, mask):
+        return fn(index.keys, index.words, mask)
+
+    return run
+
+
+def pack_mutation_batches(per_slice, num_slices: int, capacity: int):
+    """Stack per-slice plan_slice_mutations outputs into padded (S, B)
+    batch arrays for compile_serve_apply_writes.
+
+    per_slice: {slice_id: (slot, word, set_mask, clear_mask)}. Padding
+    entries use slot = capacity — out of bounds, so the device scatter
+    drops them (mode="drop"), which is how a no-op is encoded without
+    colliding with a real target. B is padded to a power of two so jit
+    recompiles on batch-size doubling, not every batch.
+    """
+    widest = max((len(v[0]) for v in per_slice.values()), default=0)
+    b = 8
+    while b < widest:
+        b *= 2
+    slot = np.full((num_slices, b), capacity, dtype=np.int32)
+    word = np.zeros((num_slices, b), dtype=np.int32)
+    set_mask = np.zeros((num_slices, b), dtype=np.uint32)
+    clear_mask = np.zeros((num_slices, b), dtype=np.uint32)
+    for si, (sl, wd, sm, cm) in per_slice.items():
+        n = len(sl)
+        slot[si, :n] = sl
+        word[si, :n] = wd
+        set_mask[si, :n] = sm
+        clear_mask[si, :n] = cm
+    return slot, word, set_mask, clear_mask
+
+
+def _mutate_one_slice(words, slot, word, set_mask, clear_mask):
+    cur = words[slot, word]
+    upd = (cur & ~clear_mask) | set_mask
+    return words.at[slot, word].set(upd, mode="drop")
+
+
+def compile_serve_apply_writes(mesh: Mesh):
+    """Jit the scatter of folded set/clear batches into sharded pools.
+
+    fn(index, slot, word, set_mask, clear_mask) -> updated ShardedIndex.
+    Targets are unique per slice (plan_slice_mutations) and padding
+    rides out-of-bounds slots dropped by the scatter, so the update is
+    exact for mixed sets and clears — the device-side half of SetBit /
+    ClearBit (reference fragment.go:371-459), applied as one batched
+    scatter per refresh instead of a full pool re-upload.
+    """
+
+    def per_shard(keys, words, slot, word, set_mask, clear_mask):
+        return keys, jax.vmap(_mutate_one_slice)(
+            words, slot, word, set_mask, clear_mask)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS),) * 6,
+        out_specs=(P(SLICE_AXIS), P(SLICE_AXIS)),
+    )
+
+    @jax.jit
+    def run(index: ShardedIndex, slot, word, set_mask, clear_mask):
+        keys, words = fn(index.keys, index.words, slot, word,
+                         set_mask, clear_mask)
+        return ShardedIndex(keys=keys, words=words)
+
+    return run
+
+
 def default_mesh(n_devices: Optional[int] = None) -> Mesh:
     """A 1-D mesh over the first n (default: all) local devices."""
     devs = jax.devices()

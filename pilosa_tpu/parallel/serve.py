@@ -1,0 +1,330 @@
+"""Mesh serving layer: the bridge from the query Executor to the
+device mesh.
+
+This is what makes the shard_map+psum engine the SERVING path rather
+than a library demo: a MeshManager owns staged device images of live
+holder views and the Executor routes whole slice batches through it —
+one jitted collective per query instead of the reference's
+goroutine-per-slice fan-out (executor.go:1200-1236) or this codebase's
+per-slice thread-pool fallback (parallel/plan.py).
+
+Staging and maintenance:
+  - A (index, frame, view) is staged once via build_sharded_index and
+    then maintained INCREMENTALLY: each Fragment keeps a mutation log
+    (core/fragment.py log_since), and refresh() folds the bits written
+    since the staged generation into one device scatter
+    (compile_serve_apply_writes). Only container churn — a container
+    created or emptied, or a bulk import — forces a restage, matching
+    the reference's cheap mmap mutation (fragment.go:371-413) without
+    ever re-uploading the pool.
+  - Queries carry a per-slice ownership mask, so one staged index
+    serves any slice subset (the cluster's slicesByNode split,
+    executor.go:1087-1101) and non-owned slices contribute nothing to
+    the psum.
+
+Counts are returned as Python ints combined from (lo, hi) int32 limbs
+(mesh.combine_count) — no int32 saturation at 2^31 set bits.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.pool import plan_slice_mutations
+from .mesh import (
+    build_sharded_index,
+    combine_count,
+    compile_serve_apply_writes,
+    compile_serve_count,
+    compile_serve_row_counts,
+    default_mesh,
+    pack_mutation_batches,
+)
+from .plan import _tree_signature
+
+
+class StagedView:
+    """One (index, frame, view)'s staged device image + bookkeeping."""
+
+    __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
+                 "num_slices")
+
+    def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
+        self.sharded = sharded            # ShardedIndex (device, padded S)
+        self.row_ids = row_ids            # (R,) uint64 dense row table
+        self.keys_host = keys_host        # (S_padded, cap) int32 host copy
+        self.slice_gens = slice_gens      # per-slice staged generation;
+        #                                   None = staged as absent
+        self.num_slices = num_slices      # unpadded staged slice count
+
+    @property
+    def padded_slices(self) -> int:
+        return self.sharded.num_slices
+
+
+class MeshManager:
+    """Stages holder views onto the device mesh and serves queries.
+
+    Thread-safe: staging/refresh runs under one lock; the compiled
+    query functions operate on immutable jax arrays, so serving needs
+    no lock once a StagedView snapshot is taken. All public query
+    methods return None on any device-path failure so the caller can
+    fall back to the host path.
+    """
+
+    def __init__(self, holder, mesh=None):
+        self.holder = holder
+        self._mesh = mesh
+        self._mu = threading.RLock()
+        self._views: Dict[Tuple[str, str, str], StagedView] = {}
+        self._count_fns: Dict[Tuple[str, int], object] = {}
+        self._rowcount_fns: Dict[int, object] = {}
+        self._apply_fn = None
+        # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
+        # observability): counts of staged/incremental refreshes and
+        # served device queries, plus cumulative timings.
+        self.stats = {
+            "stage": 0, "incremental": 0, "count": 0, "topn": 0,
+            "fallback": 0, "stage_us": 0, "query_us": 0,
+        }
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = default_mesh()
+        return self._mesh
+
+    # -- staging -------------------------------------------------------------
+
+    def _snapshot_fragments(self, index: str, frame: str, view: str,
+                            num_slices: int):
+        """COW-clone each fragment's storage under its lock, with the
+        generation captured atomically alongside."""
+        bitmaps, gens = [], []
+        for s in range(num_slices):
+            frag = self.holder.fragment(index, frame, view, s)
+            if frag is None:
+                bitmaps.append(None)
+                gens.append(None)
+                continue
+            with frag._mu:
+                bitmaps.append(frag.storage.clone())
+                gens.append(frag.generation)
+        return bitmaps, gens
+
+    def _stage(self, key, num_slices: int) -> StagedView:
+        index, frame, view = key
+        t0 = time.monotonic()
+        bitmaps, gens = self._snapshot_fragments(index, frame, view,
+                                                 num_slices)
+        sharded, row_ids = build_sharded_index(bitmaps, self.mesh)
+        sv = StagedView(
+            sharded=sharded,
+            row_ids=row_ids,
+            keys_host=np.asarray(sharded.keys),
+            slice_gens=gens,
+            num_slices=num_slices,
+        )
+        self._views[key] = sv
+        self.stats["stage"] += 1
+        self.stats["stage_us"] += int((time.monotonic() - t0) * 1e6)
+        return sv
+
+    def refresh(self, index: str, frame: str, view: str,
+                num_slices: int) -> Optional[StagedView]:
+        """Return an up-to-date StagedView, restaging or incrementally
+        scatter-updating as needed. None when the view can't be staged
+        (missing index/frame)."""
+        idx = self.holder.index(index)
+        if idx is None or idx.frame(frame) is None:
+            return None
+        key = (index, frame, view)
+        with self._mu:
+            sv = self._views.get(key)
+            if sv is None or sv.num_slices != num_slices:
+                return self._stage(key, num_slices)
+
+            pending: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+            new_gens = list(sv.slice_gens)
+            for s in range(num_slices):
+                frag = self.holder.fragment(index, frame, view, s)
+                staged_gen = sv.slice_gens[s]
+                if frag is None:
+                    if staged_gen is None:
+                        continue
+                    return self._stage(key, num_slices)  # fragment deleted
+                if staged_gen is None:
+                    return self._stage(key, num_slices)  # fragment appeared
+                with frag._mu:
+                    gen = frag.generation
+                    if gen == staged_gen:
+                        continue
+                    entries = frag.log_since(staged_gen)
+                if entries is None or any(e[2] for e in entries):
+                    return self._stage(key, num_slices)
+                final: Dict[int, bool] = {}
+                for op, pos, _ in entries:
+                    final[pos] = op == 0
+                pending[s] = (
+                    np.fromiter(final.keys(), dtype=np.uint64,
+                                count=len(final)),
+                    np.fromiter(final.values(), dtype=bool,
+                                count=len(final)),
+                )
+                new_gens[s] = gen
+
+            if not pending:
+                return sv
+            per_slice = {}
+            try:
+                for s, (pos, val) in pending.items():
+                    per_slice[s] = plan_slice_mutations(
+                        sv.keys_host[s], sv.row_ids, pos, val)
+            except KeyError:
+                return self._stage(key, num_slices)
+            batches = pack_mutation_batches(
+                per_slice, sv.padded_slices, sv.keys_host.shape[1])
+            if self._apply_fn is None:
+                self._apply_fn = compile_serve_apply_writes(self.mesh)
+            sv.sharded = self._apply_fn(sv.sharded, *batches)
+            sv.slice_gens = new_gens
+            self.stats["incremental"] += 1
+            return sv
+
+    def invalidate(self, index: Optional[str] = None):
+        """Drop staged views (all, or one index's)."""
+        with self._mu:
+            if index is None:
+                self._views.clear()
+            else:
+                for key in [k for k in self._views if k[0] == index]:
+                    del self._views[key]
+
+    # -- serving -------------------------------------------------------------
+
+    def _mask_for(self, sv: StagedView, slices: Sequence[int]):
+        mask = np.zeros(sv.padded_slices, dtype=np.int32)
+        for s in slices:
+            if s >= sv.num_slices:
+                return None  # staged image doesn't cover the request
+            mask[s] = 1
+        return mask
+
+    def count(self, index: str, shape, leaves, slices: Sequence[int],
+              num_slices: int) -> Optional[int]:
+        """Serve Count over a lowered bitmap-op tree: one shard_map'd
+        fused eval + psum across the requested slices. `shape`/`leaves`
+        come from plan._lower_tree: leaves are (frame, view, row_id,
+        required) in depth-first order; each leaf gathers from its own
+        staged view (trees may span frames and time-quantum views)."""
+        t0 = time.monotonic()
+        with self._mu:
+            staged: Dict[Tuple[str, str], StagedView] = {}
+            for frame, view, _row_id, _req in leaves:
+                vkey = (frame, view)
+                if vkey not in staged:
+                    sv = self.refresh(index, frame, view, num_slices)
+                    if sv is None:
+                        self.stats["fallback"] += 1
+                        return None
+                    staged[vkey] = sv
+        first = next(iter(staged.values()))
+        mask = self._mask_for(first, slices)
+        if mask is None:
+            self.stats["fallback"] += 1
+            return None
+
+        indexes, ids = [], []
+        for frame, view, row_id, _req in leaves:
+            sv = staged[(frame, view)]
+            indexes.append(sv.sharded)
+            i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
+            if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
+                i = len(sv.row_ids)  # absent row gathers all-zero
+            ids.append(i)
+
+        sig = json.dumps(_tree_signature(shape))
+        fkey = (sig, len(leaves))
+        fn = self._count_fns.get(fkey)
+        if fn is None:
+            fn = compile_serve_count(self.mesh, json.loads(sig), len(leaves))
+            self._count_fns[fkey] = fn
+        lo, hi = fn(tuple(indexes), np.asarray(ids, dtype=np.int32), mask)
+        total = combine_count(lo, hi)
+        self.stats["count"] += 1
+        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        return total
+
+    def row_counts(self, index: str, frame: str, view: str,
+                   slices: Sequence[int], num_slices: int):
+        """Exact per-row counts over the requested slices: one masked
+        popcount + segment-sum + psum. Returns (row_ids, counts int64)
+        or None. num_rows pads to a power of two so growing row spaces
+        recompile on doubling only."""
+        t0 = time.monotonic()
+        with self._mu:
+            sv = self.refresh(index, frame, view, num_slices)
+        if sv is None:
+            self.stats["fallback"] += 1
+            return None
+        mask = self._mask_for(sv, slices)
+        if mask is None:
+            self.stats["fallback"] += 1
+            return None
+        if len(sv.row_ids) == 0:
+            return sv.row_ids, np.zeros(0, dtype=np.int64)
+        padded = 1 << (len(sv.row_ids) - 1).bit_length()
+        fn = self._rowcount_fns.get(padded)
+        if fn is None:
+            fn = compile_serve_row_counts(self.mesh, padded)
+            self._rowcount_fns[padded] = fn
+        lo, hi = fn(sv.sharded, mask)
+        n = len(sv.row_ids)
+        counts = ((np.asarray(hi[:n], dtype=np.int64) << 16)
+                  + np.asarray(lo[:n], dtype=np.int64))
+        self.stats["topn"] += 1
+        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        return sv.row_ids, counts
+
+    def top_n(self, index: str, frame: str, view: str,
+              slices: Sequence[int], num_slices: int, n: int,
+              row_ids: Sequence[int], min_threshold: int
+              ) -> Optional[List[Tuple[int, int]]]:
+        """Serve a plain TopN (no src / attr filters / tanimoto — the
+        executor keeps those on the host path): exact device counts,
+        host-side threshold/candidate/n semantics. With `row_ids` this
+        is also TopN's exact phase 2 (executor.go:273-310).
+
+        Deliberate deviation from the reference: `threshold` filters
+        the EXACT node-local totals, not each slice's partial count.
+        The reference applies MinThreshold inside every fragment
+        (fragment.go:522-614), so a row spread thinly across slices can
+        vanish even when its true count clears the threshold — an
+        artifact of its per-fragment scan, not a semantic goal. The
+        device path has the exact totals in hand and filters on those.
+        """
+        out = self.row_counts(index, frame, view, slices, num_slices)
+        if out is None:
+            return None
+        all_rows, counts = out
+        if row_ids:
+            want = np.asarray(sorted(row_ids), dtype=np.uint64)
+            i = np.searchsorted(all_rows, want)
+            ok = (i < len(all_rows))
+            ok &= all_rows[np.minimum(i, max(len(all_rows) - 1, 0))] == want
+            pairs = [(int(r), int(counts[j]))
+                     for r, j in zip(want[ok], i[ok])
+                     if counts[j] >= max(min_threshold, 1)]
+            pairs.sort(key=lambda p: (-p[1], p[0]))
+            return pairs
+        keep = np.nonzero(counts >= max(min_threshold, 1))[0]
+        order = np.lexsort((all_rows[keep], -counts[keep]))
+        if n:
+            order = order[:n]
+        keep = keep[order]
+        return [(int(all_rows[j]), int(counts[j])) for j in keep]

@@ -1,0 +1,323 @@
+"""Mesh serving layer tests: HTTP-facing queries must run the
+shard_map+psum engine (parallel/serve.py), with incremental device-image
+maintenance on writes.
+
+Model: the reference's distributed-executor tests
+(/root/reference/executor_test.go) assert what the fan-out DOES; here we
+additionally assert which ENGINE served it — the per-slice fallback is
+poisoned so only the mesh path can answer.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.pql import parse_string
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def seed(holder, index="i", frame="general", bits=()):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    for row, col in bits:
+        f.set_bit(row, col)
+    return f
+
+
+def q(executor, index, pql):
+    return executor.execute(index, parse_string(pql))
+
+
+def poison_per_slice(monkeypatch):
+    """Make the per-slice device fallback unusable so a passing query
+    proves the mesh path served it."""
+    from pilosa_tpu.parallel.plan import CountPlan
+
+    def boom(self, slice_):
+        raise AssertionError("per-slice path used; mesh path expected")
+
+    monkeypatch.setattr(CountPlan, "count_slice", boom)
+
+
+class TestServedCount:
+    BITS = [
+        (10, 0), (10, 1), (10, SLICE_WIDTH + 2), (10, 65536 + 7),
+        (11, 1), (11, SLICE_WIDTH + 2), (11, 99999),
+        (12, 2 * SLICE_WIDTH + 5),
+    ]
+
+    def test_count_serves_via_mesh(self, holder, monkeypatch):
+        seed(holder, bits=self.BITS)
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        for pql in (
+            "Count(Bitmap(rowID=10))",
+            "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=11)))",
+            "Count(Union(Bitmap(rowID=10), Bitmap(rowID=11), Bitmap(rowID=12)))",
+            "Count(Difference(Bitmap(rowID=10), Bitmap(rowID=11)))",
+        ):
+            assert q(e, "i", pql) == q(host, "i", pql)
+        mgr = e.mesh_manager()
+        assert mgr.stats["count"] == 4
+        # Same (index, frame, view): staged once, reused across queries.
+        assert mgr.stats["stage"] == 1
+
+    def test_count_absent_row_is_zero(self, holder, monkeypatch):
+        seed(holder, bits=self.BITS)
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=999))") == [0]
+        assert q(e, "i", "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=999)))") \
+            == [0]
+
+    def test_count_multi_frame_tree(self, holder, monkeypatch):
+        seed(holder, frame="f1", bits=[(1, 0), (1, 5), (1, SLICE_WIDTH + 3)])
+        seed(holder, frame="f2", bits=[(1, 5), (1, 7), (1, SLICE_WIDTH + 3)])
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        pql = ("Count(Intersect(Bitmap(rowID=1, frame=f1), "
+               "Bitmap(rowID=1, frame=f2)))")
+        assert q(e, "i", pql) == [2]
+        mgr = e.mesh_manager()
+        assert mgr.stats["count"] == 1
+        assert mgr.stats["stage"] == 2  # one per frame view
+
+    def test_count_range_time_views(self, holder):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general", time_quantum="YMD")
+        from datetime import datetime
+
+        f.set_bit(1, 3, datetime(2017, 4, 2, 9, 0))
+        f.set_bit(1, SLICE_WIDTH + 8, datetime(2017, 4, 3, 9, 0))
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = ("Count(Range(rowID=1, frame=general, "
+               "start=\"2017-04-01T00:00\", end=\"2017-04-30T00:00\"))")
+        assert q(e, "i", pql) == q(host, "i", pql) == [2]
+        assert e.mesh_manager().stats["count"] == 1
+
+
+class TestIncrementalWrites:
+    def test_writes_apply_without_restage(self, holder, monkeypatch):
+        f = seed(holder, bits=[(10, c) for c in range(64)]
+                 + [(11, c) for c in range(0, 64, 2)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=11)))") \
+            == [32]
+        mgr = e.mesh_manager()
+        assert mgr.stats["stage"] == 1
+
+        # Bits into EXISTING containers: scatter, not restage.
+        for c in range(64, 96):
+            f.set_bit(10, c)
+            f.set_bit(11, c)
+        assert q(e, "i", "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=11)))") \
+            == [64]
+        assert mgr.stats["stage"] == 1
+        assert mgr.stats["incremental"] == 1
+
+        # clear_bit also rides the scatter (clears one shared column).
+        f.clear_bit(10, 0)
+        assert q(e, "i", "Count(Intersect(Bitmap(rowID=10), Bitmap(rowID=11)))") \
+            == [63]
+        assert mgr.stats["stage"] == 1
+        assert mgr.stats["incremental"] == 2
+
+    def test_container_churn_restages(self, holder):
+        f = seed(holder, bits=[(10, 0), (11, 0)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=10))") == [1]
+        mgr = e.mesh_manager()
+        assert mgr.stats["stage"] == 1
+        # A new row means a new container — scatter can't add key slots.
+        f.set_bit(99, 5)
+        assert q(e, "i", "Count(Bitmap(rowID=99))") == [1]
+        assert mgr.stats["stage"] == 2
+
+    def test_new_slice_restages(self, holder):
+        f = seed(holder, bits=[(10, 0)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=10))") == [1]
+        f.set_bit(10, 3 * SLICE_WIDTH + 1)  # grows the slice space
+        assert q(e, "i", "Count(Bitmap(rowID=10))") == [2]
+        assert e.mesh_manager().stats["stage"] == 2
+
+    def test_set_then_clear_folds_to_final_state(self, holder):
+        f = seed(holder, bits=[(10, c) for c in range(8)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=10))") == [8]
+        f.set_bit(10, 9)
+        f.clear_bit(10, 9)   # same word set then cleared
+        f.clear_bit(10, 0)
+        f.set_bit(10, 0)     # same word cleared then set
+        assert q(e, "i", "Count(Bitmap(rowID=10))") == [8]
+
+
+class TestServedTopN:
+    def seed_rows(self, holder, rows=40, frame="general"):
+        rng = np.random.default_rng(3)
+        f = seed(holder, frame=frame)
+        for r in range(rows):
+            cols = rng.choice(SLICE_WIDTH * 2, size=r + 1, replace=False)
+            for c in cols:
+                f.set_bit(r, int(c))
+        return f
+
+    def test_topn_matches_host(self, holder):
+        self.seed_rows(holder)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        for pql in ("TopN(frame=general, n=5)",
+                    "TopN(frame=general)"):
+            assert q(e, "i", pql) == q(host, "i", pql)
+        assert e.mesh_manager().stats["topn"] > 0
+
+    def test_topn_threshold_filters_exact_totals(self, holder):
+        """Deviation from the reference (documented in serve.top_n):
+        threshold applies to exact totals, so every row with true count
+        >= 20 survives — the host path drops rows whose PER-SLICE count
+        dips under the threshold (fragment.go:522-614 artifact)."""
+        self.seed_rows(holder)  # row r has exactly r+1 bits
+        e = Executor(holder, use_device=True)
+        out = q(e, "i", "TopN(frame=general, n=10, threshold=20)")[0]
+        assert out == [(r, r + 1) for r in range(39, 29, -1)]
+
+    def test_topn_ids_exact_phase(self, holder):
+        self.seed_rows(holder)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = "TopN(frame=general, ids=[3, 17, 39])"
+        assert q(e, "i", pql) == q(host, "i", pql)
+
+    def test_topn_filters_stay_on_host(self, holder):
+        f = self.seed_rows(holder, rows=6)
+        f.row_attr_store.set_attrs(3, {"cat": "x"})
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = 'TopN(frame=general, n=5, field="cat", filters=["x"])'
+        assert q(e, "i", pql) == q(host, "i", pql)
+        assert e.mesh_manager().stats["topn"] == 0
+
+
+class TestFragmentPoolIncremental:
+    def test_set_bits_skip_rebuild(self, holder, monkeypatch):
+        f = seed(holder, bits=[(1, c) for c in range(16)])
+        frag = holder.fragment("i", "general", "standard", 0)
+        _ = frag.pool  # initial build
+
+        import pilosa_tpu.ops.pool as pool_mod
+
+        calls = {"n": 0}
+        orig = pool_mod.build_pool_arrays
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        # core/fragment resolves build_pool_arrays through pilosa_tpu.ops'
+        # lazy __getattr__, which re-reads the pool module each time — so
+        # patching the pool module is sufficient.
+        monkeypatch.setattr(pool_mod, "build_pool_arrays", counting)
+
+        for c in range(16, 48):
+            f.set_bit(1, c)
+        pool, row_ids = frag.pool
+        assert calls["n"] == 0  # scatter path, no rebuild
+
+        from pilosa_tpu.ops.pool import pool_row_counts
+
+        counts = np.asarray(pool_row_counts(pool, len(row_ids)))
+        assert counts[0] == 48
+
+    def test_churn_rebuilds(self, holder):
+        f = seed(holder, bits=[(1, 0)])
+        frag = holder.fragment("i", "general", "standard", 0)
+        _ = frag.pool
+        f.set_bit(2, 70000)  # new container
+        pool, row_ids = frag.pool
+        assert list(row_ids) == [1, 2]
+
+    def test_clear_to_empty_rebuilds(self, holder):
+        f = seed(holder, bits=[(1, 0), (2, 70000)])
+        frag = holder.fragment("i", "general", "standard", 0)
+        _ = frag.pool
+        f.clear_bit(2, 70000)  # container emptied → removed
+        pool, row_ids = frag.pool
+        assert list(row_ids) == [1]
+
+
+class TestWideCount:
+    def test_count_limbs_exceed_int32(self):
+        """A dense multi-slice count past 2^31 must not saturate
+        (VERDICT r1 item 9). 2056 slices x 2^20 dense bits = 2.156e9."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pilosa_tpu.ops.pool import CONTAINER_WORDS, ROW_SPAN
+        from pilosa_tpu.parallel import (
+            ShardedIndex,
+            combine_count,
+            compile_serve_count,
+            default_mesh,
+        )
+
+        s = 2056
+        mesh = default_mesh()
+        keys = np.broadcast_to(np.arange(ROW_SPAN, dtype=np.int32),
+                               (s, ROW_SPAN)).copy()
+        words = np.full((s, ROW_SPAN, CONTAINER_WORDS), 0xFFFFFFFF,
+                        dtype=np.uint32)
+        sharding = NamedSharding(mesh, P("slices"))
+        index = ShardedIndex(keys=jax.device_put(keys, sharding),
+                             words=jax.device_put(words, sharding))
+        fn = compile_serve_count(mesh, ["leaf"], 1)
+        lo, hi = fn((index,), np.int32([0]), np.ones(s, dtype=np.int32))
+        assert combine_count(lo, hi) == s * (1 << 20)
+        # Masking half the slices halves the count.
+        mask = np.zeros(s, dtype=np.int32)
+        mask[: s // 2] = 1
+        lo, hi = fn((index,), np.int32([0]), mask)
+        assert combine_count(lo, hi) == (s // 2) * (1 << 20)
+
+
+class TestPlanSliceMutations:
+    def test_mixed_set_clear_same_word(self):
+        from pilosa_tpu.ops.pool import plan_slice_mutations
+
+        keys = np.array([0, 1], dtype=np.int32)  # row 0, containers 0-1
+        row_ids = np.array([0], dtype=np.uint64)
+        pos = np.array([0, 1, 2], dtype=np.uint64)  # same word 0
+        val = np.array([True, False, True])
+        slot, word, sm, cm = plan_slice_mutations(keys, row_ids, pos, val)
+        assert len(slot) == 1 and slot[0] == 0 and word[0] == 0
+        assert sm[0] == 0b101 and cm[0] == 0b010
+
+    def test_set_missing_container_raises(self):
+        from pilosa_tpu.ops.pool import plan_slice_mutations
+
+        keys = np.array([0], dtype=np.int32)
+        row_ids = np.array([0], dtype=np.uint64)
+        with pytest.raises(KeyError):
+            plan_slice_mutations(keys, row_ids,
+                                 np.array([70000], dtype=np.uint64),
+                                 np.array([True]))
+
+    def test_clear_missing_container_dropped(self):
+        from pilosa_tpu.ops.pool import plan_slice_mutations
+
+        keys = np.array([0], dtype=np.int32)
+        row_ids = np.array([0], dtype=np.uint64)
+        slot, word, sm, cm = plan_slice_mutations(
+            keys, row_ids, np.array([70000], dtype=np.uint64),
+            np.array([False]))
+        assert len(slot) == 0
