@@ -609,18 +609,14 @@ class Fragment:
             return False
         if not entries:
             return True
-        # Fold to final per-bit state (last op wins).
-        final = {}
-        for op, pos, _ in entries:
-            final[pos] = op == 0
         from ..ops.pool import (
             apply_pool_mutations,
+            fold_log_entries,
             pad_mutation_plan,
             plan_slice_mutations,
         )
 
-        pos = np.fromiter(final.keys(), dtype=np.uint64, count=len(final))
-        val = np.fromiter(final.values(), dtype=bool, count=len(final))
+        pos, val = fold_log_entries(entries)
         try:
             plan = plan_slice_mutations(
                 self._pool_keys_host, self._pool_row_ids, pos, val)

@@ -413,8 +413,15 @@ class Executor:
         row_ids, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
 
-        pairs = self._execute_top_n_slices(index, c, slices, opt)
+        exact = [False]
+        pairs = self._execute_top_n_slices(index, c, slices, opt, exact)
         if not pairs or row_ids or opt.remote:
+            return pairs
+        if exact[0]:
+            # Phase 1 was served by the mesh path with exact global
+            # counts — the reference needs phase 2 only because its
+            # phase 1 is rank-cache-approximate; a recount would run
+            # the identical collective again.
             return pairs
 
         # Phase 2: exact re-count of candidate ids, only at the coordinator.
@@ -426,15 +433,29 @@ class Executor:
         return trimmed
 
     def _execute_top_n_slices(self, index: str, c: Call, slices: Sequence[int],
-                              opt: ExecOptions) -> List[tuple]:
+                              opt: ExecOptions,
+                              exact: Optional[list] = None) -> List[tuple]:
         def map_fn(slice_):
             return self.execute_top_n_slice(index, c, slice_)
 
         def reduce_fn(prev, v):
             return add_to_pairs(prev or [], v)
 
+        batch_fn = self._mesh_top_n_batch(index, c)
+        single_node = self.cluster is None or not self.cluster.nodes
+        if batch_fn is not None and exact is not None and single_node:
+            inner = batch_fn
+
+            def batch_fn(batch_slices):
+                v = inner(batch_slices)
+                if v is not None:
+                    # Device counts cover every requested slice of the
+                    # only node — already exact.
+                    exact[0] = True
+                return v
+
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
-                                 batch_fn=self._mesh_top_n_batch(index, c)) or []
+                                 batch_fn=batch_fn) or []
         pairs.sort(key=lambda p: (-p[1], p[0]))
         return pairs
 

@@ -92,6 +92,13 @@ def fused_pair_count(a, b, op: str = "and", *, force_pallas: bool | None = None,
 # scalar-prefetched index maps (the Pallas block-sparse pattern), so
 # each container is read once and nothing intermediate is written.
 
+# Container words viewed as (sublanes, lanes) for the TPU tiling rules:
+# a Pallas block's minor two dims must be (8k, 128k)-aligned, so a
+# 2048-word container streams as a (16, 128) tile.
+_SUBLANES = 16
+_LANES = 128
+
+
 def _tree_count_kernel(tree, num_leaves, idx_ref, hit_ref, *refs):
     o_ref = refs[num_leaves]
     s = pl.program_id(0)
@@ -102,7 +109,7 @@ def _tree_count_kernel(tree, num_leaves, idx_ref, hit_ref, *refs):
         o_ref[0, 0] = jnp.int32(0)
 
     def leaf(i):
-        blk = refs[i][0, 0, :]
+        blk = refs[i][0, 0, :, :]
         keep = hit_ref[i, s, j] != 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
@@ -110,24 +117,25 @@ def _tree_count_kernel(tree, num_leaves, idx_ref, hit_ref, *refs):
         lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
 
 
-def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):
-    """Fused popcount(eval_tree) over one shard's container pool.
+# SMEM budget for one pallas_call's scalar-prefetch tables: the
+# (L, S, 16) idx+hit tables live in SMEM (1 MB/core) — at 960 slices
+# and 2 leaves they overflow it (observed: "Used 1.88M of 1.00M smem"),
+# so larger shards run slice slabs, each its own kernel launch. A
+# 2-leaf/256-slice slab (128 KB of tables) compiles with headroom; the
+# slab size scales down with leaf count to hold that table budget.
+_PREFETCH_SLICES_PER_LEAF = 512
 
-    words: (S, cap, 2048) uint32 — the local slices' pools.
-    idx:   (L, S, 16) int32 — per leaf/slice/sub-key container index
-           into `cap` (clipped; garbage where hit == 0).
-    hit:   (L, S, 16) int32 — 1 where the container is really present.
-    tree:  nested op list with numbered leaves (plan._tree_signature).
 
-    Returns the shard's total count as a scalar int32.
-    """
-    num_leaves, s_n, r_n = idx.shape
+def _tree_count_call(words4, idx, hit, tree, num_leaves, interpret):
+    """One pallas_call over (S, cap, 16, 128) words with (L, S, 16)
+    prefetch tables."""
+    s_n, r_n = idx.shape[1], idx.shape[2]
 
     def leaf_spec(leaf):
         return pl.BlockSpec(
-            (1, 1, CONTAINER_WORDS),
+            (1, 1, _SUBLANES, _LANES),
             lambda s, j, idx_ref, hit_ref, leaf=leaf: (
-                s, idx_ref[leaf, s, j], 0))
+                s, idx_ref[leaf, s, j], 0, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -140,5 +148,50 @@ def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(idx, hit, *([words] * num_leaves))
+    )(idx, hit, *([words4] * num_leaves))
     return out[0, 0]
+
+
+def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):
+    """Fused popcount(eval_tree) over one shard's container pool.
+
+    words: (S, cap, 2048) uint32 — the local slices' pools.
+    idx:   (L, S, 16) int32 — per leaf/slice/sub-key container index
+           into `cap` (clipped; garbage where hit == 0).
+    hit:   (L, S, 16) int32 — 1 where the container is really present.
+    tree:  nested op list with numbered leaves (plan._tree_signature).
+
+    Returns the shard's total count as a scalar int32. Shards whose
+    prefetch tables exceed the SMEM budget run fixed-size slice slabs
+    via lax.scan plus one remainder call — a fixed slab (not a divisor
+    of S) so a prime slice count can't degrade to per-slice launches.
+    """
+    num_leaves, s_n, r_n = idx.shape
+    cap = words.shape[1]
+    # (S, cap, 16, 128): per-container blocks whose minor dims satisfy
+    # the TPU (8, 128) tiling constraint — (1, 1, 2048) blocks do not.
+    words4 = words.reshape(s_n, cap, _SUBLANES, _LANES)
+
+    chunk = max(1, _PREFETCH_SLICES_PER_LEAF // num_leaves)
+    if s_n <= chunk:
+        return _tree_count_call(words4, idx, hit, tree, num_leaves, interpret)
+
+    c, rem = divmod(s_n, chunk)
+    main = c * chunk
+    words_r = words4[:main].reshape(c, chunk, cap, _SUBLANES, _LANES)
+    idx_r = idx[:, :main].reshape(num_leaves, c, chunk, r_n).transpose(
+        1, 0, 2, 3)
+    hit_r = hit[:, :main].reshape(num_leaves, c, chunk, r_n).transpose(
+        1, 0, 2, 3)
+
+    def body(acc, xs):
+        w, ix, ht = xs
+        return acc + _tree_count_call(w, ix, ht, tree, num_leaves,
+                                      interpret), None
+
+    acc, _ = lax.scan(body, jnp.int32(0), (words_r, idx_r, hit_r))
+    if rem:
+        acc = acc + _tree_count_call(words4[main:], idx[:, main:],
+                                     hit[:, main:], tree, num_leaves,
+                                     interpret)
+    return acc

@@ -116,6 +116,29 @@ def gather_row(pool: FragmentPool, dense_row) -> jax.Array:
     return jnp.where(hit[:, None], rows, jnp.uint32(0))
 
 
+def fold_log_entries(entries):
+    """Fold a fragment mutation log (op, pos, churn) into final per-bit
+    state: (pos uint64, val bool) arrays with last-op-wins semantics.
+    Shared by the per-fragment pool update and the mesh serving layer —
+    device scatter order is unspecified, so both apply FINAL states,
+    never op sequences."""
+    final = {}
+    for op, pos, _ in entries:
+        final[pos] = op == 0
+    return (np.fromiter(final.keys(), dtype=np.uint64, count=len(final)),
+            np.fromiter(final.values(), dtype=bool, count=len(final)))
+
+
+def scatter_words(words, slot, word, set_mask, clear_mask):
+    """(cur & ~clear) | set at unique (slot, word) targets; padding
+    rides out-of-bounds slots dropped by mode="drop". The single
+    scatter shared by apply_pool_mutations and the mesh apply-writes
+    path."""
+    cur = words[slot, word]
+    upd = (cur & ~clear_mask) | set_mask
+    return words.at[slot, word].set(upd, mode="drop")
+
+
 def plan_slice_mutations(keys_row: np.ndarray, row_ids: np.ndarray,
                          pos: np.ndarray, val: np.ndarray):
     """Fold one slice's mutations into a (slot, word, set_mask,
@@ -202,9 +225,8 @@ def apply_pool_mutations(pool: FragmentPool, slot, word, set_mask,
     out-of-bounds slots dropped by the scatter, so the update is exact
     for mixed sets and clears.
     """
-    cur = pool.words[slot, word]
-    upd = (cur & ~clear_mask) | set_mask
-    return pool._replace(words=pool.words.at[slot, word].set(upd, mode="drop"))
+    return pool._replace(
+        words=scatter_words(pool.words, slot, word, set_mask, clear_mask))
 
 
 @partial(jax.jit, static_argnames=("num_rows",))

@@ -48,6 +48,7 @@ _MESH_NAMES = (
     "default_mesh",
     "pack_mutation_batches",
     "plan_writes",
+    "resolve_row_indices",
     "sharded_index_from_holder",
 )
 

@@ -392,58 +392,108 @@ def compile_mesh_step(mesh: Mesh, tree_shape, num_leaves: int,
 # device int64), so the device never sums raw counts across slices.
 
 
-def combine_count(lo, hi) -> int:
-    """Host-side combine of the (lo, hi) int32 count limbs."""
-    return (int(hi) << 16) + int(lo)
+def combine_count(limbs) -> int:
+    """Host-side combine of a (2,) [lo, hi] int32 limb array.
+
+    The limbs travel as ONE device array, not two scalars: each scalar
+    fetch through a remote-TPU relay pays a full readback round trip
+    (~70 ms observed), so the device packs both limbs before the host
+    reads anything."""
+    limbs = np.asarray(limbs)
+    return (int(limbs[1]) << 16) + int(limbs[0])
+
+
+def resolve_row_indices(keys_host: np.ndarray, dense_id: int):
+    """Host-side row → container-location resolution for the serving
+    count path.
+
+    keys_host: (S, cap) sorted int32 pool keys (INVALID_KEY padded).
+    Returns (idx (S, 16) int32 WITHIN-SLICE container indices in
+    [0, cap) and hit (S, 16) uint32). Indices are within-slice — not
+    flat — because inside shard_map each shard only holds its local
+    slice block; the kernel adds its own local base (a global flat
+    index would only be right on a 1-device mesh).
+
+    This work lives on the HOST deliberately: an in-program vmapped
+    searchsorted measured ~2.2 ms/query on a 960-slice pool on real TPU
+    hardware vs ~0.1 ms of vectorized numpy here, and the result only
+    changes when the pool's key layout changes (restage), so the
+    serving layer caches the device copies per (view, row). One
+    searchsorted over slice-offset int64 keys resolves every slice at
+    once; a clipped miss lands on an arbitrary in-range container, but
+    hit=0 multiplies that gather to zero.
+    """
+    s, cap = keys_host.shape
+    off = (np.arange(s, dtype=np.int64) << 33)[:, None]
+    k64 = (keys_host.astype(np.int64) + off).reshape(-1)
+    t = dense_id * ROW_SPAN + np.arange(ROW_SPAN, dtype=np.int64)
+    t64 = (t[None, :] + off).reshape(-1)
+    i = np.searchsorted(k64, t64)
+    i = np.minimum(i, s * cap - 1)
+    hit = (k64[i] == t64).astype(np.uint32)
+    within = np.clip(i.reshape(s, ROW_SPAN)
+                     - (np.arange(s, dtype=np.int64) * cap)[:, None],
+                     0, cap - 1)
+    return within.astype(np.int32), hit.reshape(s, ROW_SPAN)
 
 
 def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
-    """Jit a masked Count over a bitmap-op tree with PER-LEAF pools.
+    """Jit a masked Count over a bitmap-op tree with PER-LEAF pools and
+    HOST-RESOLVED container indices.
 
-    Unlike compile_mesh_count (one pool for every leaf), each leaf
-    gathers from its own ShardedIndex — a served tree may span frames
-    and time-quantum views. Returns
-      fn(indexes: tuple[ShardedIndex] per leaf, leaf_ids (L,) int32,
-         mask (S,) int32) -> (lo, hi) int32 limbs
-    where mask selects the slices this node serves (1 = count, 0 =
-    skip); combine with combine_count. Per-slice counts are uint32
-    (safe to 2^32 bits/slice); the lo-limb sum is int32-safe to 32k
-    slices (~34T columns).
+    Each leaf is one flat gather from its own view's pool — a served
+    tree may span frames and time-quantum views. Returns
+      fn(words_t: tuple per leaf of (S, cap_i, 2048) sharded words,
+         idx_t:   tuple per leaf of (S, 16) int32 flat gather indices
+                  (resolve_row_indices, cached on device by the caller),
+         hit_t:   tuple per leaf of (S, 16) uint32 presence masks,
+         mask (S,) int32 slice-ownership mask)
+      -> (lo, hi) int32 limbs; combine with combine_count.
+
+    Per-slice counts are uint32 (safe to 2^32 bits/slice); the lo-limb
+    sum is int32-safe to 32k slices (~34T columns). On real v5e
+    hardware this shape measured 2.9 ms for a 960-slice (1B-column)
+    Intersect+Count vs 5.1 ms for the in-program-searchsorted variant
+    and 13.5 ms for the per-slice vmap it replaces. Returns one (2,)
+    [lo, hi] array (see combine_count).
     """
     sig = json.dumps(_tree_signature(tree_shape))
     tree = json.loads(sig)
+    from ..ops.bitops import fold_tree
 
-    def one_slice(keys_t, words_t, idxs):
-        leaves = tuple(
-            (FragmentPool(keys=keys_t[i], words=words_t[i], n=jnp.int32(0)),
-             idxs[i])
-            for i in range(num_leaves))
-        blk = eval_tree(tree, leaves)
-        return lax.population_count(blk).sum(dtype=jnp.uint32)
+    def per_shard(words_t, idx_t, hit_t, mask):
+        s_l = words_t[0].shape[0]
 
-    def per_shard(keys_t, words_t, idxs, mask):
-        counts = jax.vmap(one_slice, in_axes=(0, 0, None))(
-            keys_t, words_t, idxs)
-        counts = jnp.where(mask != 0, counts, jnp.uint32(0))
-        lo = lax.psum((counts & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
+        def leaf(i):
+            w = words_t[i]
+            cap_l = w.shape[1]
+            wflat = w.reshape(w.shape[0] * cap_l, w.shape[2])
+            base = (jnp.arange(w.shape[0], dtype=jnp.int32) * cap_l)[:, None]
+            blk = wflat[(idx_t[i] + base).reshape(-1)]
+            return blk * hit_t[i].reshape(-1)[:, None]
+
+        pc = lax.population_count(fold_tree(tree, leaf))  # (S*16, 2048)
+        per_slice = pc.sum(axis=1, dtype=jnp.uint32).reshape(
+            s_l, ROW_SPAN).sum(axis=1, dtype=jnp.uint32)
+        per_slice = jnp.where(mask != 0, per_slice, jnp.uint32(0))
+        lo = lax.psum((per_slice & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
                       SLICE_AXIS)
-        hi = lax.psum((counts >> 16).astype(jnp.int32).sum(), SLICE_AXIS)
-        return lo, hi
+        hi = lax.psum((per_slice >> 16).astype(jnp.int32).sum(), SLICE_AXIS)
+        return jnp.stack([lo, hi])
 
     fn = jax.shard_map(
         per_shard,
         mesh=mesh,
         in_specs=((P(SLICE_AXIS),) * num_leaves,
                   (P(SLICE_AXIS),) * num_leaves,
-                  P(), P(SLICE_AXIS)),
-        out_specs=(P(), P()),
+                  (P(SLICE_AXIS),) * num_leaves,
+                  P(SLICE_AXIS)),
+        out_specs=P(),
     )
 
     @jax.jit
-    def run(indexes, leaf_ids, mask):
-        keys_t = tuple(ix.keys for ix in indexes)
-        words_t = tuple(ix.words for ix in indexes)
-        return fn(keys_t, words_t, leaf_ids, mask)
+    def run(words_t, idx_t, hit_t, mask):
+        return fn(words_t, idx_t, hit_t, mask)
 
     return run
 
@@ -451,12 +501,13 @@ def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
 def compile_serve_row_counts(mesh: Mesh, num_rows: int):
     """Jit masked global per-row counts for one sharded view.
 
-    Returns fn(index: ShardedIndex, mask (S,) int32) ->
-    (lo, hi) (num_rows,) int32 limb arrays; combine as
-    (hi.astype(int64) << 16) + lo on the host. This is the device half
-    of served TopN: the host applies threshold / candidate-id / n
-    semantics to the exact totals (reference fragment.go:493-625 +
-    executor.go:273-310 collapse into one collective + a host sort).
+    Returns fn(index: ShardedIndex, mask (S,) int32) -> one (2, num_rows)
+    int32 limb array; combine as (out[1].astype(int64) << 16) + out[0]
+    on the host (one array = one relay readback, like combine_count).
+    This is the device half of served TopN: the host applies threshold /
+    candidate-id / n semantics to the exact totals (reference
+    fragment.go:493-625 + executor.go:273-310 collapse into one
+    collective + a host sort).
     """
     one = partial(_row_counts_one_slice, num_rows)
 
@@ -465,13 +516,13 @@ def compile_serve_row_counts(mesh: Mesh, num_rows: int):
         local = jnp.where(mask[:, None] != 0, local, 0)
         lo = lax.psum((local & 0xFFFF).sum(axis=0), SLICE_AXIS)
         hi = lax.psum((local >> 16).sum(axis=0), SLICE_AXIS)
-        return lo, hi
+        return jnp.stack([lo, hi])
 
     fn = jax.shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(SLICE_AXIS), P(SLICE_AXIS), P(SLICE_AXIS)),
-        out_specs=(P(), P()),
+        out_specs=P(),
     )
 
     @jax.jit
@@ -508,12 +559,6 @@ def pack_mutation_batches(per_slice, num_slices: int, capacity: int):
     return slot, word, set_mask, clear_mask
 
 
-def _mutate_one_slice(words, slot, word, set_mask, clear_mask):
-    cur = words[slot, word]
-    upd = (cur & ~clear_mask) | set_mask
-    return words.at[slot, word].set(upd, mode="drop")
-
-
 def compile_serve_apply_writes(mesh: Mesh):
     """Jit the scatter of folded set/clear batches into sharded pools.
 
@@ -525,8 +570,10 @@ def compile_serve_apply_writes(mesh: Mesh):
     scatter per refresh instead of a full pool re-upload.
     """
 
+    from ..ops.pool import scatter_words
+
     def per_shard(keys, words, slot, word, set_mask, clear_mask):
-        return keys, jax.vmap(_mutate_one_slice)(
+        return keys, jax.vmap(scatter_words)(
             words, slot, word, set_mask, clear_mask)
 
     fn = jax.shard_map(

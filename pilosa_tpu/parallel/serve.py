@@ -35,8 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..ops.pool import plan_slice_mutations
+from ..ops.pool import fold_log_entries, plan_slice_mutations
 from .mesh import (
+    SLICE_AXIS,
     build_sharded_index,
     combine_count,
     compile_serve_apply_writes,
@@ -44,6 +45,7 @@ from .mesh import (
     compile_serve_row_counts,
     default_mesh,
     pack_mutation_batches,
+    resolve_row_indices,
 )
 from .plan import _tree_signature
 
@@ -52,7 +54,7 @@ class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
-                 "num_slices")
+                 "num_slices", "idx_cache")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -61,6 +63,13 @@ class StagedView:
         self.slice_gens = slice_gens      # per-slice staged generation;
         #                                   None = staged as absent
         self.num_slices = num_slices      # unpadded staged slice count
+        # dense_id -> (flat_idx, hit) device arrays (resolve_row_indices
+        # output). Valid as long as the key layout is — incremental
+        # word scatters don't touch it; a restage builds a fresh
+        # StagedView, so the cache dies with the stale keys. Uploading
+        # these per query measured ~6 ms through the TPU relay; cached,
+        # a repeat-row query pays nothing.
+        self.idx_cache: Dict[int, tuple] = {}
 
     @property
     def padded_slices(self) -> int:
@@ -85,6 +94,7 @@ class MeshManager:
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._rowcount_fns: Dict[int, object] = {}
         self._apply_fn = None
+        self._mask_cache: Dict[bytes, object] = {}
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
         # served device queries, plus cumulative timings.
@@ -167,15 +177,7 @@ class MeshManager:
                     entries = frag.log_since(staged_gen)
                 if entries is None or any(e[2] for e in entries):
                     return self._stage(key, num_slices)
-                final: Dict[int, bool] = {}
-                for op, pos, _ in entries:
-                    final[pos] = op == 0
-                pending[s] = (
-                    np.fromiter(final.keys(), dtype=np.uint64,
-                                count=len(final)),
-                    np.fromiter(final.values(), dtype=bool,
-                                count=len(final)),
-                )
+                pending[s] = fold_log_entries(entries)
                 new_gens[s] = gen
 
             if not pending:
@@ -223,8 +225,13 @@ class MeshManager:
         required) in depth-first order; each leaf gathers from its own
         staged view (trees may span frames and time-quantum views)."""
         t0 = time.monotonic()
+        # All staging state (refresh, words snapshot, idx/mask caches)
+        # is read and mutated under _mu: a concurrent refresh() swaps
+        # sv.sharded in place, and a query that read one leaf's words
+        # before the swap and another after would mix two generations
+        # of the same view. Only the compiled call runs unlocked.
         with self._mu:
-            staged: Dict[Tuple[str, str], StagedView] = {}
+            staged: Dict[Tuple[str, str], tuple] = {}
             for frame, view, _row_id, _req in leaves:
                 vkey = (frame, view)
                 if vkey not in staged:
@@ -232,21 +239,24 @@ class MeshManager:
                     if sv is None:
                         self.stats["fallback"] += 1
                         return None
-                    staged[vkey] = sv
-        first = next(iter(staged.values()))
-        mask = self._mask_for(first, slices)
-        if mask is None:
-            self.stats["fallback"] += 1
-            return None
+                    staged[vkey] = (sv, sv.sharded.words)
+            first = next(iter(staged.values()))[0]
+            mask = self._mask_for(first, slices)
+            if mask is None:
+                self.stats["fallback"] += 1
+                return None
 
-        indexes, ids = [], []
-        for frame, view, row_id, _req in leaves:
-            sv = staged[(frame, view)]
-            indexes.append(sv.sharded)
-            i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
-            if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
-                i = len(sv.row_ids)  # absent row gathers all-zero
-            ids.append(i)
+            words_t, idx_t, hit_t = [], [], []
+            for frame, view, row_id, _req in leaves:
+                sv, words = staged[(frame, view)]
+                i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
+                if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
+                    i = len(sv.row_ids)  # absent row: resolver yields hit=0
+                flat_idx, hit = self._leaf_arrays(sv, i)
+                words_t.append(words)
+                idx_t.append(flat_idx)
+                hit_t.append(hit)
+            dev_mask = self._device_mask(mask)
 
         sig = json.dumps(_tree_signature(shape))
         fkey = (sig, len(leaves))
@@ -254,11 +264,49 @@ class MeshManager:
         if fn is None:
             fn = compile_serve_count(self.mesh, json.loads(sig), len(leaves))
             self._count_fns[fkey] = fn
-        lo, hi = fn(tuple(indexes), np.asarray(ids, dtype=np.int32), mask)
-        total = combine_count(lo, hi)
+        total = combine_count(fn(tuple(words_t), tuple(idx_t), tuple(hit_t),
+                                 dev_mask))
         self.stats["count"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return total
+
+    # Bound on cached (row -> gather indices) entries per staged view:
+    # each costs 2 * S * 16 * 4 bytes of HBM (~120 KB at 960 slices).
+    _IDX_CACHE_MAX = 1024
+
+    def _leaf_arrays(self, sv: StagedView, dense_id: int):
+        """Device (idx, hit) for one leaf row, cached per view.
+        Call under _mu — the eviction below is not otherwise safe."""
+        cached = sv.idx_cache.get(dense_id)
+        if cached is not None:
+            return cached
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        flat_idx, hit = resolve_row_indices(sv.keys_host, dense_id)
+        sharding = NamedSharding(self.mesh, P(SLICE_AXIS))
+        out = (jax.device_put(flat_idx, sharding),
+               jax.device_put(hit, sharding))
+        if len(sv.idx_cache) >= self._IDX_CACHE_MAX:
+            sv.idx_cache.pop(next(iter(sv.idx_cache)))
+        sv.idx_cache[dense_id] = out
+        return out
+
+    def _device_mask(self, mask: np.ndarray):
+        """Slice-ownership masks are few (one per cluster split) and
+        reused every query — cache the device copies. Call under _mu."""
+        key = mask.tobytes()
+        cached = self._mask_cache.get(key)
+        if cached is not None:
+            return cached
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dev = jax.device_put(mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
+        if len(self._mask_cache) >= 64:
+            self._mask_cache.pop(next(iter(self._mask_cache)))
+        self._mask_cache[key] = dev
+        return dev
 
     def row_counts(self, index: str, frame: str, view: str,
                    slices: Sequence[int], num_slices: int):
@@ -269,24 +317,26 @@ class MeshManager:
         t0 = time.monotonic()
         with self._mu:
             sv = self.refresh(index, frame, view, num_slices)
-        if sv is None:
-            self.stats["fallback"] += 1
-            return None
-        mask = self._mask_for(sv, slices)
-        if mask is None:
-            self.stats["fallback"] += 1
-            return None
-        if len(sv.row_ids) == 0:
-            return sv.row_ids, np.zeros(0, dtype=np.int64)
-        padded = 1 << (len(sv.row_ids) - 1).bit_length()
-        fn = self._rowcount_fns.get(padded)
-        if fn is None:
-            fn = compile_serve_row_counts(self.mesh, padded)
-            self._rowcount_fns[padded] = fn
-        lo, hi = fn(sv.sharded, mask)
+            if sv is None:
+                self.stats["fallback"] += 1
+                return None
+            sharded = sv.sharded  # snapshot before releasing _mu
+            mask = self._mask_for(sv, slices)
+            if mask is None:
+                self.stats["fallback"] += 1
+                return None
+            if len(sv.row_ids) == 0:
+                return sv.row_ids, np.zeros(0, dtype=np.int64)
+            padded = 1 << (len(sv.row_ids) - 1).bit_length()
+            fn = self._rowcount_fns.get(padded)
+            if fn is None:
+                fn = compile_serve_row_counts(self.mesh, padded)
+                self._rowcount_fns[padded] = fn
+            dev_mask = self._device_mask(mask)
+        limbs = np.asarray(fn(sharded, dev_mask))
         n = len(sv.row_ids)
-        counts = ((np.asarray(hi[:n], dtype=np.int64) << 16)
-                  + np.asarray(lo[:n], dtype=np.int64))
+        counts = ((limbs[1, :n].astype(np.int64) << 16)
+                  + limbs[0, :n].astype(np.int64))
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return sv.row_ids, counts

@@ -271,6 +271,8 @@ class TestWideCount:
             default_mesh,
         )
 
+        from pilosa_tpu.parallel import resolve_row_indices
+
         s = 2056
         mesh = default_mesh()
         keys = np.broadcast_to(np.arange(ROW_SPAN, dtype=np.int32),
@@ -280,14 +282,49 @@ class TestWideCount:
         sharding = NamedSharding(mesh, P("slices"))
         index = ShardedIndex(keys=jax.device_put(keys, sharding),
                              words=jax.device_put(words, sharding))
+        flat_idx, hit = resolve_row_indices(keys, 0)
+        assert hit.all()
         fn = compile_serve_count(mesh, ["leaf"], 1)
-        lo, hi = fn((index,), np.int32([0]), np.ones(s, dtype=np.int32))
-        assert combine_count(lo, hi) == s * (1 << 20)
+        args = ((index.words,), (jax.device_put(flat_idx, sharding),),
+                (jax.device_put(hit, sharding),))
+        assert combine_count(fn(*args, np.ones(s, dtype=np.int32))) \
+            == s * (1 << 20)
         # Masking half the slices halves the count.
         mask = np.zeros(s, dtype=np.int32)
         mask[: s // 2] = 1
-        lo, hi = fn((index,), np.int32([0]), mask)
-        assert combine_count(lo, hi) == (s // 2) * (1 << 20)
+        assert combine_count(fn(*args, mask)) == (s // 2) * (1 << 20)
+
+
+class TestPallasChunking:
+    def test_slab_scan_with_remainder_matches(self, monkeypatch):
+        """Prime-ish slice counts run fixed slabs + a remainder call —
+        results must match the unchunked kernel (and numpy)."""
+        import pilosa_tpu.ops.kernels as kernels
+
+        rng = np.random.default_rng(5)
+        S, cap, L = 5, 4, 2
+        from pilosa_tpu.ops.pool import CONTAINER_WORDS
+
+        words = rng.integers(0, 2**32, size=(S, cap, CONTAINER_WORDS),
+                             dtype=np.uint32)
+        idx = rng.integers(0, cap, size=(L, S, 16), dtype=np.int32)
+        hit = rng.integers(0, 2, size=(L, S, 16), dtype=np.int32)
+        tree = ["and", ["leaf", 0], ["leaf", 1]]
+
+        import jax.numpy as jnp
+
+        full = int(kernels.tree_count_pallas(
+            jnp.asarray(words), jnp.asarray(idx), jnp.asarray(hit), tree,
+            interpret=True))
+        monkeypatch.setattr(kernels, "_PREFETCH_SLICES_PER_LEAF", 4)
+        chunked = int(kernels.tree_count_pallas(
+            jnp.asarray(words), jnp.asarray(idx), jnp.asarray(hit), tree,
+            interpret=True))  # chunk=2: 2 slabs + remainder of 1
+        blocks = [np.where(hit[l][:, :, None] != 0,
+                           words[np.arange(S)[:, None], idx[l]], 0)
+                  for l in range(L)]
+        want = int(np.bitwise_count(blocks[0] & blocks[1]).sum())
+        assert full == chunked == want
 
 
 class TestPlanSliceMutations:
