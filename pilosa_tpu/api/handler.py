@@ -282,6 +282,11 @@ class Handler:
 
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        # Mesh serving-layer counters (stage/incremental/count/topn/
+        # fallback + cumulative timings) — SURVEY.md §5 observability.
+        mesh = getattr(self.executor, "device_stats", None)
+        if mesh:
+            snap = dict(snap, mesh=dict(mesh))
         return _json_resp(snap)
 
     def _get_pprof(self, pv, params, headers, body) -> Response:
@@ -351,6 +356,8 @@ class Handler:
 
     def _delete_index(self, pv, params, headers, body) -> Response:
         self.holder.delete_index(pv["index"])
+        if hasattr(self.executor, "invalidate_device_index"):
+            self.executor.invalidate_device_index(pv["index"])
         if self.broadcaster is not None:
             self.broadcaster.send_sync(
                 pb.DeleteIndexMessage(index=pv["index"]))
@@ -387,6 +394,8 @@ class Handler:
         if idx is None:
             raise IndexNotFoundError()
         idx.delete_frame(pv["frame"])
+        if hasattr(self.executor, "invalidate_device_index"):
+            self.executor.invalidate_device_index(pv["index"])
         if self.broadcaster is not None:
             self.broadcaster.send_sync(pb.DeleteFrameMessage(
                 index=pv["index"], frame=pv["frame"]))

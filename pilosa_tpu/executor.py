@@ -122,6 +122,11 @@ class Executor:
         # below remain the fallback.
         self._mesh_mgr = None
         self._mesh_mgr_failed = False
+        # Guards lazy construction: two concurrent first queries must
+        # not each build a manager and stage duplicate device images.
+        import threading
+
+        self._mesh_mgr_lock = threading.Lock()
 
     # -- top level -----------------------------------------------------------
 
@@ -316,11 +321,33 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
 
-        device_plan = self._device_plan_for(index, child)
+        # Lower the tree ONCE; both device paths share it. The
+        # per-slice CountPlan is only built if the mesh batch declines
+        # (it compiles per-slice jits the batch path never uses).
+        lowered = None
+        if self._device_backend_on():
+            from .parallel.plan import _lower_tree
+
+            leaves: list = []
+            shape = _lower_tree(self.holder, index, child, leaves)
+            if shape is not None and leaves:
+                lowered = (shape, leaves)
+
+        plan_cell: list = []
+
+        def slice_plan():
+            if not plan_cell:
+                from .parallel.plan import CountPlan
+
+                plan_cell.append(
+                    CountPlan(self.holder, index, *lowered)
+                    if lowered is not None else None)
+            return plan_cell[0]
 
         def map_fn(slice_):
-            if device_plan is not None:
-                n = device_plan.count_slice(slice_)
+            plan = slice_plan()
+            if plan is not None:
+                n = plan.count_slice(slice_)
                 if n is not None:
                     return n
             return self.execute_bitmap_call_slice(index, child, slice_).count()
@@ -328,8 +355,9 @@ class Executor:
         def reduce_fn(prev, v):
             return (prev or 0) + v
 
-        result = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
-                                  batch_fn=self._mesh_count_batch(index, child))
+        result = self._map_reduce(
+            index, slices, c, opt, map_fn, reduce_fn,
+            batch_fn=self._mesh_count_batch(index, lowered))
         return int(result or 0)
 
     def mesh_manager(self):
@@ -339,14 +367,31 @@ class Executor:
             return self._mesh_mgr
         if self._mesh_mgr_failed or not self._device_backend_on():
             return None
-        try:
-            from .parallel.serve import MeshManager
+        with self._mesh_mgr_lock:
+            if self._mesh_mgr is not None or self._mesh_mgr_failed:
+                return self._mesh_mgr
+            try:
+                from .parallel.serve import MeshManager
 
-            self._mesh_mgr = MeshManager(self.holder)
-        except Exception:  # noqa: BLE001 — device layer unavailable
-            self._mesh_mgr_failed = True
-            return None
+                self._mesh_mgr = MeshManager(self.holder)
+            except Exception:  # noqa: BLE001 — device layer unavailable
+                self._mesh_mgr_failed = True
+                return None
         return self._mesh_mgr
+
+    def invalidate_device_index(self, index: Optional[str] = None):
+        """Drop staged device images for an index (or all). Called by
+        the API layer on index/frame deletion — the object-identity
+        check in refresh() also catches this, but dropping eagerly
+        frees device HBM immediately."""
+        if self._mesh_mgr is not None:
+            self._mesh_mgr.invalidate(index)
+
+    @property
+    def device_stats(self):
+        """Mesh serving-layer counters for /debug/vars, or None when no
+        manager has been built (never forces construction)."""
+        return self._mesh_mgr.stats if self._mesh_mgr is not None else None
 
     def _batch_num_slices(self, index: str, batch_slices) -> int:
         idx = self.holder.index(index)
@@ -355,18 +400,16 @@ class Executor:
             top = max(top, idx.max_slice())
         return top + 1
 
-    def _mesh_count_batch(self, index: str, tree: Call):
+    def _mesh_count_batch(self, index: str, lowered):
         """A batch_fn serving a whole slice set as one mesh collective,
-        or None when the tree/backend doesn't qualify."""
+        or None when the tree/backend doesn't qualify. `lowered` is the
+        (shape, leaves) pair from plan._lower_tree."""
+        if lowered is None:
+            return None
         mgr = self.mesh_manager()
         if mgr is None:
             return None
-        from .parallel.plan import _lower_tree
-
-        leaves: list = []
-        shape = _lower_tree(self.holder, index, tree, leaves)
-        if shape is None or not leaves:
-            return None
+        shape, leaves = lowered
 
         def batch_fn(batch_slices):
             try:
@@ -395,15 +438,6 @@ class Executor:
 
             return jax.default_backend() == "tpu"
         return True
-
-    def _device_plan_for(self, index: str, tree: Call):
-        """Compile a pure bitmap-op tree for fused device eval; None when
-        the tree or backend doesn't qualify."""
-        if not self._device_backend_on():
-            return None
-        from .parallel.plan import compile_count_plan
-
-        return compile_count_plan(self.holder, index, tree)
 
     # -- TopN ----------------------------------------------------------------
 

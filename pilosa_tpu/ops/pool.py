@@ -195,18 +195,25 @@ def plan_slice_mutations(keys_row: np.ndarray, row_ids: np.ndarray,
     return (sl[start].astype(np.int32), wd[start], set_mask, clear_mask)
 
 
-def pad_mutation_plan(plan, capacity: int, min_batch: int = 8):
-    """Pad a plan_slice_mutations result to a power-of-two batch.
+def mutation_batch_width(n: int, min_batch: int = 8) -> int:
+    """Power-of-two batch width >= n: jit recompiles on batch-size
+    doubling, not on every distinct batch size."""
+    b = min_batch
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_mutation_plan(plan, capacity: int, width: int = None):
+    """Pad a plan_slice_mutations result to `width` (default: the
+    power-of-two of its own length).
 
     Padding entries use slot = capacity — out of bounds, so the jitted
     scatter drops them (mode="drop"): a no-op encoded without colliding
-    with any real target. Power-of-two padding means jit recompiles on
-    batch-size doubling, not on every distinct batch size.
+    with any real target.
     """
     sl, wd, sm, cm = plan
-    b = min_batch
-    while b < len(sl):
-        b *= 2
+    b = mutation_batch_width(len(sl)) if width is None else width
     slot = np.full(b, capacity, dtype=np.int32)
     word = np.zeros(b, dtype=np.int32)
     set_mask = np.zeros(b, dtype=np.uint32)

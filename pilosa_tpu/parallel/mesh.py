@@ -536,27 +536,22 @@ def pack_mutation_batches(per_slice, num_slices: int, capacity: int):
     """Stack per-slice plan_slice_mutations outputs into padded (S, B)
     batch arrays for compile_serve_apply_writes.
 
-    per_slice: {slice_id: (slot, word, set_mask, clear_mask)}. Padding
-    entries use slot = capacity — out of bounds, so the device scatter
-    drops them (mode="drop"), which is how a no-op is encoded without
-    colliding with a real target. B is padded to a power of two so jit
-    recompiles on batch-size doubling, not every batch.
+    per_slice: {slice_id: (slot, word, set_mask, clear_mask)}. The
+    no-op/width scheme is ops.pool's (pad_mutation_plan): padding rides
+    out-of-bounds slots, B is the shared power-of-two width of the
+    widest slice's plan.
     """
+    from ..ops.pool import mutation_batch_width, pad_mutation_plan
+
     widest = max((len(v[0]) for v in per_slice.values()), default=0)
-    b = 8
-    while b < widest:
-        b *= 2
-    slot = np.full((num_slices, b), capacity, dtype=np.int32)
-    word = np.zeros((num_slices, b), dtype=np.int32)
-    set_mask = np.zeros((num_slices, b), dtype=np.uint32)
-    clear_mask = np.zeros((num_slices, b), dtype=np.uint32)
-    for si, (sl, wd, sm, cm) in per_slice.items():
-        n = len(sl)
-        slot[si, :n] = sl
-        word[si, :n] = wd
-        set_mask[si, :n] = sm
-        clear_mask[si, :n] = cm
-    return slot, word, set_mask, clear_mask
+    b = mutation_batch_width(widest)
+    empty = pad_mutation_plan(
+        (np.zeros(0, np.int32), np.zeros(0, np.int32),
+         np.zeros(0, np.uint32), np.zeros(0, np.uint32)), capacity, b)
+    rows = [per_slice.get(si) for si in range(num_slices)]
+    padded = [pad_mutation_plan(r, capacity, b) if r is not None else empty
+              for r in rows]
+    return tuple(np.stack([p[i] for p in padded]) for i in range(4))
 
 
 def compile_serve_apply_writes(mesh: Mesh):

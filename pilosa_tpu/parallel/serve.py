@@ -60,7 +60,7 @@ class StagedView:
         self.sharded = sharded            # ShardedIndex (device, padded S)
         self.row_ids = row_ids            # (R,) uint64 dense row table
         self.keys_host = keys_host        # (S_padded, cap) int32 host copy
-        self.slice_gens = slice_gens      # per-slice staged generation;
+        self.slice_gens = slice_gens      # per-slice (fragment, gen);
         #                                   None = staged as absent
         self.num_slices = num_slices      # unpadded staged slice count
         # dense_id -> (flat_idx, hit) device arrays (resolve_row_indices
@@ -114,7 +114,11 @@ class MeshManager:
     def _snapshot_fragments(self, index: str, frame: str, view: str,
                             num_slices: int):
         """COW-clone each fragment's storage under its lock, with the
-        generation captured atomically alongside."""
+        generation captured atomically alongside. slice_gens entries are
+        (fragment, generation) — the OBJECT is part of the staleness
+        check, because a deleted-and-recreated index yields new Fragment
+        objects whose generations are incomparable with the staged
+        ones."""
         bitmaps, gens = [], []
         for s in range(num_slices):
             frag = self.holder.fragment(index, frame, view, s)
@@ -124,7 +128,7 @@ class MeshManager:
                 continue
             with frag._mu:
                 bitmaps.append(frag.storage.clone())
-                gens.append(frag.generation)
+                gens.append((frag, frag.generation))
         return bitmaps, gens
 
     def _stage(self, key, num_slices: int) -> StagedView:
@@ -163,13 +167,17 @@ class MeshManager:
             new_gens = list(sv.slice_gens)
             for s in range(num_slices):
                 frag = self.holder.fragment(index, frame, view, s)
-                staged_gen = sv.slice_gens[s]
+                staged = sv.slice_gens[s]
                 if frag is None:
-                    if staged_gen is None:
+                    if staged is None:
                         continue
                     return self._stage(key, num_slices)  # fragment deleted
-                if staged_gen is None:
-                    return self._stage(key, num_slices)  # fragment appeared
+                if staged is None or staged[0] is not frag:
+                    # New fragment object (appeared, or the index was
+                    # deleted and recreated): generations from a
+                    # different object are meaningless — restage.
+                    return self._stage(key, num_slices)
+                staged_gen = staged[1]
                 with frag._mu:
                     gen = frag.generation
                     if gen == staged_gen:
@@ -178,7 +186,7 @@ class MeshManager:
                 if entries is None or any(e[2] for e in entries):
                     return self._stage(key, num_slices)
                 pending[s] = fold_log_entries(entries)
-                new_gens[s] = gen
+                new_gens[s] = (frag, gen)
 
             if not pending:
                 return sv
@@ -217,19 +225,16 @@ class MeshManager:
             mask[s] = 1
         return mask
 
-    def count(self, index: str, shape, leaves, slices: Sequence[int],
-              num_slices: int) -> Optional[int]:
-        """Serve Count over a lowered bitmap-op tree: one shard_map'd
-        fused eval + psum across the requested slices. `shape`/`leaves`
-        come from plan._lower_tree: leaves are (frame, view, row_id,
-        required) in depth-first order; each leaf gathers from its own
-        staged view (trees may span frames and time-quantum views)."""
-        t0 = time.monotonic()
-        # All staging state (refresh, words snapshot, idx/mask caches)
-        # is read and mutated under _mu: a concurrent refresh() swaps
-        # sv.sharded in place, and a query that read one leaf's words
-        # before the swap and another after would mix two generations
-        # of the same view. Only the compiled call runs unlocked.
+    def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
+                    num_slices: int):
+        """Build the compiled serving-count invocation: a zero-arg
+        callable returning the (2,) [lo, hi] device limbs, or None when
+        the request can't be served. All staging state (refresh, words
+        snapshot, idx/mask caches) is read and mutated under _mu: a
+        concurrent refresh() swaps sv.sharded in place, and a query
+        that read one leaf's words before the swap and another after
+        would mix two generations of the same view. Only the compiled
+        call itself runs unlocked."""
         with self._mu:
             staged: Dict[Tuple[str, str], tuple] = {}
             for frame, view, _row_id, _req in leaves:
@@ -264,8 +269,21 @@ class MeshManager:
         if fn is None:
             fn = compile_serve_count(self.mesh, json.loads(sig), len(leaves))
             self._count_fns[fkey] = fn
-        total = combine_count(fn(tuple(words_t), tuple(idx_t), tuple(hit_t),
-                                 dev_mask))
+        words_t, idx_t, hit_t = tuple(words_t), tuple(idx_t), tuple(hit_t)
+        return lambda: fn(words_t, idx_t, hit_t, dev_mask)
+
+    def count(self, index: str, shape, leaves, slices: Sequence[int],
+              num_slices: int) -> Optional[int]:
+        """Serve Count over a lowered bitmap-op tree: one shard_map'd
+        fused eval + psum across the requested slices. `shape`/`leaves`
+        come from plan._lower_tree: leaves are (frame, view, row_id,
+        required) in depth-first order; each leaf gathers from its own
+        staged view (trees may span frames and time-quantum views)."""
+        t0 = time.monotonic()
+        call = self._count_call(index, shape, leaves, slices, num_slices)
+        if call is None:
+            return None
+        total = combine_count(call())
         self.stats["count"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return total
@@ -308,13 +326,10 @@ class MeshManager:
         self._mask_cache[key] = dev
         return dev
 
-    def row_counts(self, index: str, frame: str, view: str,
-                   slices: Sequence[int], num_slices: int):
-        """Exact per-row counts over the requested slices: one masked
-        popcount + segment-sum + psum. Returns (row_ids, counts int64)
-        or None. num_rows pads to a power of two so growing row spaces
-        recompile on doubling only."""
-        t0 = time.monotonic()
+    def _row_counts_call(self, index: str, frame: str, view: str,
+                         slices: Sequence[int], num_slices: int):
+        """(row_ids, zero-arg callable -> (2, padded) limbs) or None;
+        see _count_call for the locking contract."""
         with self._mu:
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
@@ -326,20 +341,35 @@ class MeshManager:
                 self.stats["fallback"] += 1
                 return None
             if len(sv.row_ids) == 0:
-                return sv.row_ids, np.zeros(0, dtype=np.int64)
+                return sv.row_ids, None
             padded = 1 << (len(sv.row_ids) - 1).bit_length()
             fn = self._rowcount_fns.get(padded)
             if fn is None:
                 fn = compile_serve_row_counts(self.mesh, padded)
                 self._rowcount_fns[padded] = fn
             dev_mask = self._device_mask(mask)
-        limbs = np.asarray(fn(sharded, dev_mask))
-        n = len(sv.row_ids)
+        return sv.row_ids, (lambda: fn(sharded, dev_mask))
+
+    def row_counts(self, index: str, frame: str, view: str,
+                   slices: Sequence[int], num_slices: int):
+        """Exact per-row counts over the requested slices: one masked
+        popcount + segment-sum + psum. Returns (row_ids, counts int64)
+        or None. num_rows pads to a power of two so growing row spaces
+        recompile on doubling only."""
+        t0 = time.monotonic()
+        out = self._row_counts_call(index, frame, view, slices, num_slices)
+        if out is None:
+            return None
+        row_ids, call = out
+        if call is None:
+            return row_ids, np.zeros(0, dtype=np.int64)
+        limbs = np.asarray(call())
+        n = len(row_ids)
         counts = ((limbs[1, :n].astype(np.int64) << 16)
                   + limbs[0, :n].astype(np.int64))
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
-        return sv.row_ids, counts
+        return row_ids, counts
 
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,

@@ -256,6 +256,24 @@ class TestMiscRoutes:
         _, h = env
         assert h.handle("GET", "/debug/vars").status == 200
 
+    def test_debug_vars_mesh_stats(self, tmp_path):
+        """Mesh serving-layer counters appear under "mesh" once the
+        device path has served a query (SURVEY.md §5 observability)."""
+        holder = Holder(str(tmp_path / "data"))
+        holder.open()
+        try:
+            ex = Executor(holder, use_device=True)
+            handler = Handler(holder, ex)
+            assert post(handler, "/index/i").status == 200
+            assert post(handler, "/index/i/frame/f").status == 200
+            post(handler, "/index/i/query",
+                 body=b"SetBit(frame=f, rowID=1, columnID=2)")
+            post(handler, "/index/i/query", body=b"Count(Bitmap(rowID=1, frame=f))")
+            mesh = handler.handle("GET", "/debug/vars").json()["mesh"]
+            assert mesh["count"] == 1 and mesh["stage"] == 1
+        finally:
+            holder.close()
+
     def test_not_found(self, env):
         _, h = env
         assert h.handle("GET", "/nope").status == 404

@@ -163,6 +163,25 @@ class TestIncrementalWrites:
         assert q(e, "i", "Count(Bitmap(rowID=10))") == [8]
 
 
+class TestDeleteRecreate:
+    def test_recreated_index_restages(self, holder):
+        """Generations are only comparable on the SAME Fragment object:
+        a deleted-and-recreated index must restage, never scatter a new
+        fragment's log onto the old device image."""
+        seed(holder, bits=[(1, c) for c in range(40)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [40]
+        holder.delete_index("i")
+        e.invalidate_device_index("i")
+        f = seed(holder, bits=[(1, c) for c in range(7)])
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [7]
+        # And without the eager invalidate, object identity still catches
+        # the swap: delete/recreate again, no invalidate call this time.
+        holder.delete_index("i")
+        seed(holder, bits=[(1, c) for c in range(3)])
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [3]
+
+
 class TestServedTopN:
     def seed_rows(self, holder, rows=40, frame="general"):
         rng = np.random.default_rng(3)
