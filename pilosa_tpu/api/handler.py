@@ -220,6 +220,7 @@ class Handler:
         r("GET", r"/status", self._get_status)
         r("GET", r"/version", self._get_version)
         r("GET", r"/debug/vars", self._get_expvar)
+        r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
         r("GET", r"/debug/pprof", self._get_pprof)
         r("POST", r"/internal/message", self._post_internal_message)
         r("GET", r"/internal/status", self._get_internal_status)
@@ -288,6 +289,40 @@ class Handler:
         if mesh:
             snap = dict(snap, mesh=dict(mesh))
         return _json_resp(snap)
+
+    def _get_cpu_profile(self, pv, params, headers, body) -> Response:
+        """Sampling CPU profile across ALL threads — the analog of the
+        reference's /debug/pprof/profile (net/http/pprof). Samples
+        sys._current_frames() at ~100 Hz for ?seconds=N (default 2,
+        max 30) and returns collapsed stacks ("frame;frame;frame N"),
+        ready for flamegraph.pl / speedscope. A sampler beats cProfile
+        here: cProfile instruments only its own thread, while queries
+        run on executor pool threads."""
+        import sys
+        import time as _time
+        from collections import Counter
+
+        seconds = min(float(params.get("seconds", "2") or 2), 30.0)
+        interval = 0.01
+        stacks: Counter = Counter()
+        me = threading.get_ident()
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            for tid, frame in list(sys._current_frames().items()):
+                if tid == me:
+                    continue
+                parts = []
+                f = frame
+                while f is not None:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                                 f"{code.co_name}")
+                    f = f.f_back
+                stacks[";".join(reversed(parts))] += 1
+            _time.sleep(interval)
+        out = "".join(f"{stack} {n}\n" for stack, n in stacks.most_common())
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        out.encode())
 
     def _get_pprof(self, pv, params, headers, body) -> Response:
         """Thread stack dump — the analog of the reference's

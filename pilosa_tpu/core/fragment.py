@@ -64,6 +64,22 @@ def _locked(fn):
     return wrapper
 
 
+def _loaded(fn):
+    """_locked + demand-load: parse the storage file on first touch.
+
+    The reference gets O(1) fragment open via mmap attach
+    (fragment.go:211-229); the host-python analog is lazy parsing — a
+    cold server open takes the flock and defers the read, so startup
+    on a many-GB data dir is O(schema), and the first query (or the
+    background warm thread) pays the parse (SURVEY.md §7 cold-start)."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._mu:
+            self.ensure_loaded()
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class Fragment:
     """One (frame, view, slice) of data."""
 
@@ -93,6 +109,8 @@ class Fragment:
         self.checksums: Dict[int, bytes] = {}
         self._op_file = None
         self._lock_file = None
+        self._pending_load = True
+        self._loading = False
         self._row_cache: Dict[int, Row] = {}
 
         # Device compute image (built lazily; see `pool`).
@@ -124,7 +142,10 @@ class Fragment:
         return self.path + ".cache"
 
     @_locked
-    def open(self):
+    def open(self, lazy: bool = False):
+        """Acquire the flock; parse now, or on first touch when `lazy`
+        (the holder's directory scan opens every fragment lazily so a
+        cold start is O(schema), not O(data))."""
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         # Exclusive advisory lock (reference fragment.go:191).
         self._lock_file = open(self.path + ".lock", "w")
@@ -134,19 +155,42 @@ class Fragment:
             self._lock_file.close()
             self._lock_file = None
             raise RuntimeError(f"fragment locked by another process: {self.path}")
-        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
-            with open(self.path, "rb") as f:
-                self.storage = Bitmap.from_bytes(f.read())
-            self.op_n = self.storage.op_n
-        else:
-            with open(self.path, "wb") as f:
-                self.storage.write_to(f)
-        # Unbuffered: each 13-byte op reaches the OS immediately — the
-        # durability point (reference appends straight to the fd,
-        # roaring.go:617-628; a buffered handle would lose ops on crash).
-        self._op_file = open(self.path, "ab", buffering=0)
-        self.storage.op_writer = self._op_file
-        self._load_cache()
+        if lazy and os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            self._pending_load = True
+            return
+        self.ensure_loaded()
+
+    def ensure_loaded(self):
+        """Parse the storage file + attach the WAL + load the cache if
+        not yet done. Callers hold _mu (all public paths do).
+
+        _pending_load clears only on FULL success: a corrupt file must
+        raise on every touch, never leave the fragment looking loaded-
+        but-empty — acked writes would miss the WAL and the next
+        snapshot would overwrite the real data with the empty image.
+        The separate _loading flag breaks the _load_cache →
+        rebuild_cache → row() re-entry, not the retry."""
+        if not self._pending_load or self._loading:
+            return
+        self._loading = True
+        try:
+            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+                with open(self.path, "rb") as f:
+                    self.storage = Bitmap.from_bytes(f.read())
+                self.op_n = self.storage.op_n
+            else:
+                with open(self.path, "wb") as f:
+                    self.storage.write_to(f)
+            # Unbuffered: each 13-byte op reaches the OS immediately —
+            # the durability point (reference appends straight to the
+            # fd, roaring.go:617-628; a buffered handle would lose ops
+            # on crash).
+            self._op_file = open(self.path, "ab", buffering=0)
+            self.storage.op_writer = self._op_file
+            self._load_cache()
+            self._pending_load = False
+        finally:
+            self._loading = False
 
     @_locked
     def close(self):
@@ -162,7 +206,7 @@ class Fragment:
 
     # -- reads -------------------------------------------------------------
 
-    @_locked
+    @_loaded
     def row(self, row_id: int) -> Row:
         """Materialize one row as a slice-local segment (fragment.go:332-367)."""
         cached = self._row_cache.get(row_id)
@@ -175,11 +219,11 @@ class Fragment:
         self._row_cache[row_id] = r
         return r
 
-    @_locked
+    @_loaded
     def count(self) -> int:
         return self.storage.count()
 
-    @_locked
+    @_loaded
     def max_row_id(self) -> int:
         return self.storage.max() // SLICE_WIDTH
 
@@ -191,6 +235,7 @@ class Fragment:
         concurrent writers mutate the container lists mid-walk."""
         base = self.slice * SLICE_WIDTH
         with self._mu:
+            self.ensure_loaded()
             positions = self.storage.slice()
         for pos in positions:
             pos = int(pos)
@@ -201,7 +246,7 @@ class Fragment:
     def _pos(self, row_id: int, column_id: int) -> int:
         return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
 
-    @_locked
+    @_loaded
     def set_bit(self, row_id: int, column_id: int) -> bool:
         """Set a bit; WAL-append, maybe snapshot, update caches.
         Returns True if the bit was newly set (fragment.go:371-413)."""
@@ -217,7 +262,7 @@ class Fragment:
         self._increment_op_n()
         return changed
 
-    @_locked
+    @_loaded
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         pos = self._pos(row_id, column_id)
         changed = self.storage.remove(pos)
@@ -274,7 +319,7 @@ class Fragment:
         if self.op_n > self.max_op_n:
             self.snapshot()
 
-    @_locked
+    @_loaded
     def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]):
         """Bulk import: WAL-detached adds + forced snapshot
         (fragment.go:922-989)."""
@@ -294,7 +339,7 @@ class Fragment:
         self.cache.invalidate()
         self.snapshot()
 
-    @_locked
+    @_loaded
     def snapshot(self):
         """Atomically rewrite the file: write temp, fsync, rename, reopen
         WAL (fragment.go:992-1057)."""
@@ -312,8 +357,18 @@ class Fragment:
         self.op_n = 0
         self._op_file = open(self.path, "ab", buffering=0)
         self.storage.op_writer = self._op_file
+        elapsed = time.monotonic() - start
         if self.stats:
-            self.stats.timing("snapshot_us", int((time.monotonic() - start) * 1e6))
+            self.stats.timing("snapshot_us", int(elapsed * 1e6))
+        if elapsed > 0.1:
+            # Slow-snapshot visibility (the reference's track() logging,
+            # fragment.go:1012-1020) — a write stall a client felt.
+            import logging
+
+            logging.getLogger("pilosa_tpu.fragment").info(
+                "slow snapshot: %s (%s/%s/%d) took %.0f ms",
+                self.path, self.frame, self.view, self.slice,
+                elapsed * 1e3)
 
     # -- TopN ---------------------------------------------------------------
 
@@ -332,7 +387,7 @@ class Fragment:
         pairs.sort(key=lambda p: (-p[1], p[0]))
         return pairs
 
-    @_locked
+    @_loaded
     def top(self, opt: TopOptions) -> List[Tuple[int, int]]:
         """Top rows by count (reference fragment.go:493-625), including
         src-intersection recount, min-threshold, attr filters, and the
@@ -404,7 +459,7 @@ class Fragment:
     def _block_of(self, pos: int) -> int:
         return pos // (HASH_BLOCK_SIZE * SLICE_WIDTH)
 
-    @_locked
+    @_loaded
     def blocks(self) -> List[Tuple[int, bytes]]:
         """[(block_id, sha1)] for all non-empty 100-row blocks
         (fragment.go:703-767). Only blocks with live containers are
@@ -430,21 +485,21 @@ class Fragment:
             out.append((blk, digest))
         return out
 
-    @_locked
+    @_loaded
     def checksum(self) -> bytes:
         h = hashlib.sha1()
         for _, c in self.blocks():
             h.update(c)
         return h.digest()
 
-    @_locked
+    @_loaded
     def block_data(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
         """(rowIDs, slice-local columnIDs) for one block (fragment.go:783-794)."""
         lo = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
         vals = self.storage.slice_range(lo, lo + HASH_BLOCK_SIZE * SLICE_WIDTH)
         return vals // SLICE_WIDTH, vals % SLICE_WIDTH
 
-    @_locked
+    @_loaded
     def merge_block(self, block_id: int, data: List[Tuple[np.ndarray, np.ndarray]]):
         """Majority-consensus merge of one block across replicas
         (fragment.go:796-920). `data` holds each remote's (rowIDs, colIDs).
@@ -490,6 +545,8 @@ class Fragment:
     def flush_cache(self):
         """Persist cache pairs as JSON (analog of the protobuf `.cache`
         file, fragment.go:1073-1093)."""
+        if self._pending_load:
+            return  # never touched: cache on disk is still current
         try:
             pairs = self.cache.top() or [(i, self.cache.get(i)) for i in self.cache.ids()]
             tmp = self.cache_path + ".tmp"
@@ -519,7 +576,7 @@ class Fragment:
             self.cache.bulk_add(int(id_), self.row(int(id_)).count())
         self.cache.recalculate()
 
-    @_locked
+    @_loaded
     def rebuild_cache(self):
         """Recompute all row counts from storage (crash recovery path)."""
         row_span = SLICE_WIDTH >> 16  # containers per row; keep jax out of host paths
@@ -532,7 +589,7 @@ class Fragment:
 
     # -- backup/restore ------------------------------------------------------
 
-    @_locked
+    @_loaded
     def write_to_tar(self, fileobj):
         """Stream data+cache as a tar archive (fragment.go:1095-1153)."""
         with tarfile.open(fileobj=fileobj, mode="w|") as tar:
@@ -549,7 +606,7 @@ class Fragment:
             info.mtime = int(time.time())
             tar.addfile(info, io.BytesIO(cache))
 
-    @_locked
+    @_loaded
     def read_from_tar(self, fileobj):
         """Restore from a tar archive produced by write_to_tar
         (fragment.go:1155-1266)."""
@@ -569,7 +626,7 @@ class Fragment:
     # -- device compute image ------------------------------------------------
 
     @property
-    @_locked
+    @_loaded
     def pool(self):
         """(FragmentPool, row_ids) device image.
 

@@ -120,3 +120,29 @@ class Holder:
                 for view in frame.views.values():
                     for frag in view.fragments.values():
                         frag.flush_cache()
+
+    def warm(self, stop=None):
+        """Load every lazily-opened fragment (background prefetch after
+        a cold start: first queries hit warm storage instead of paying
+        the parse; SURVEY.md §7 async prefetch). `stop` is an optional
+        threading.Event checked between fragments so server shutdown
+        isn't blocked behind a multi-GB warm."""
+        for idx in list(self.indexes.values()):
+            for frame in idx.frames.values():
+                for view in frame.views.values():
+                    for frag in view.fragments.values():
+                        if stop is not None and stop.is_set():
+                            return
+                        try:
+                            with frag._mu:
+                                frag.ensure_loaded()
+                        except Exception as e:  # noqa: BLE001
+                            # One bad fragment (corrupt file, concurrent
+                            # index delete) must not kill the warm
+                            # thread; the fragment raises again, loudly,
+                            # on first real touch.
+                            import logging
+
+                            logging.getLogger("pilosa_tpu.holder").warning(
+                                "warm: %s failed to load: %s",
+                                frag.path, e)

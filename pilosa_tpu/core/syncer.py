@@ -34,6 +34,10 @@ class Closing:
     def closed(self) -> bool:
         return self._event.is_set()
 
+    def is_set(self) -> bool:
+        """threading.Event-compatible alias (Holder.warm stop flag)."""
+        return self._event.is_set()
+
     def wait(self, timeout: float) -> bool:
         return self._event.wait(timeout)
 

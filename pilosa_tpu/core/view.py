@@ -53,14 +53,17 @@ class View:
         for fname in sorted(os.listdir(self.fragments_path)):
             if not _FRAGMENT_FILE_RE.match(fname):
                 continue
-            self._open_fragment(int(fname))
+            # Lazy: the scan takes each fragment's flock but defers the
+            # parse to first touch, so a cold server open is O(schema)
+            # (the reference's mmap-attach analog, fragment.go:211-229).
+            self._open_fragment(int(fname), lazy=True)
 
     def close(self):
         for f in self.fragments.values():
             f.close()
         self.fragments.clear()
 
-    def _open_fragment(self, slice_: int) -> Fragment:
+    def _open_fragment(self, slice_: int, lazy: bool = False) -> Fragment:
         frag = Fragment(
             path=os.path.join(self.fragments_path, str(slice_)),
             index=self.index,
@@ -72,7 +75,7 @@ class View:
             row_attr_store=self.row_attr_store,
             stats=self.stats.with_tags(f"slice:{slice_}") if self.stats else None,
         )
-        frag.open()
+        frag.open(lazy=lazy)
         # Copy-on-write: readers (max_slice, query fan-out) iterate
         # fragments without the lock.
         self.fragments = {**self.fragments, slice_: frag}

@@ -635,7 +635,12 @@ def sharded_index_from_holder(holder, index: str, frame: str,
     bitmaps = []
     for s in range(max_slice + 1):
         frag = holder.fragment(index, frame, view, s)
-        bitmaps.append(None if frag is None else frag.storage)
+        if frag is None:
+            bitmaps.append(None)
+            continue
+        with frag._mu:
+            frag.ensure_loaded()  # lazily-opened fragments parse here
+            bitmaps.append(frag.storage)
     sharded, row_ids = build_sharded_index(bitmaps, mesh)
     return sharded, row_ids, len(bitmaps)
 

@@ -127,6 +127,7 @@ class MeshManager:
                 gens.append(None)
                 continue
             with frag._mu:
+                frag.ensure_loaded()  # lazily-opened: parse before staging
                 bitmaps.append(frag.storage.clone())
                 gens.append((frag, frag.generation))
         return bitmaps, gens
@@ -387,6 +388,16 @@ class MeshManager:
         vanish even when its true count clears the threshold — an
         artifact of its per-fragment scan, not a semantic goal. The
         device path has the exact totals in hand and filters on those.
+
+        Why no rank cache here (cf. reference cache.go RankCache): the
+        cache exists to bound a per-row host walk — on device there is
+        no per-row walk. Per-row counts are ONE fused pass over the
+        pool (popcount + segment-sum + psum), the same HBM traffic as
+        a single Count, regardless of row count; `n` and `threshold`
+        cost nothing until the host-side sort of the (R,) totals. With
+        incremental write scatters keeping the image warm, a TopN after
+        writes pays no re-upload either — the two costs the rank cache
+        amortizes on the host both vanish.
         """
         out = self.row_counts(index, frame, view, slices, num_slices)
         if out is None:

@@ -163,6 +163,15 @@ class Server:
             t.start()
             self._threads.append(t)
 
+        # Background warm: Holder.open defers fragment parsing (O(schema)
+        # cold start); this prefetches storage so early queries don't
+        # each pay a first-touch parse (SURVEY.md §7 async prefetch).
+        t = threading.Thread(
+            target=self.holder.warm, name="warm",
+            args=(self.closing,), daemon=True)
+        t.start()
+        self._threads.append(t)
+
     def close(self):
         self.closing.close()
         self.node_set.close()

@@ -1,0 +1,56 @@
+"""Child process for the two-process jax.distributed test
+(test_mesh.py::test_connect_distributed_two_process).
+
+Each of two processes brings 2 local virtual CPU devices; after
+connect_distributed the global mesh spans 4 devices across both
+processes, and one compile_mesh_count psum must agree everywhere.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.parallel import (
+        build_sharded_index,
+        compile_mesh_count,
+        default_mesh,
+    )
+    from pilosa_tpu.roaring import Bitmap
+
+    from pilosa_tpu.parallel import connect_distributed
+
+    connect_distributed(f"127.0.0.1:{port}", nprocs, pid)
+    n_global = len(jax.devices())
+    assert n_global == 4, n_global
+
+    mesh = default_mesh()
+    bitmaps = []
+    for s in range(4):
+        b = Bitmap()
+        b.add(0 * SLICE_WIDTH + s)
+        b.add(1 * SLICE_WIDTH + s)
+        bitmaps.append(b)
+    index, row_ids = build_sharded_index(bitmaps, mesh)
+
+    import numpy as np
+
+    fn = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
+    count = int(fn(index, np.int32([0, 1])))
+    print(f"RESULT {pid} {count}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -256,6 +256,28 @@ class TestMiscRoutes:
         _, h = env
         assert h.handle("GET", "/debug/vars").status == 200
 
+    def test_cpu_profile(self, env):
+        """Sampling profiler returns collapsed stacks of live threads."""
+        import threading
+        import time as _time
+
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                _time.sleep(0.001)
+
+        t = threading.Thread(target=spin, name="profilee", daemon=True)
+        t.start()
+        try:
+            _, h = env
+            r = h.handle("GET", "/debug/pprof/profile",
+                         params={"seconds": "0.3"})
+            assert r.status == 200
+            assert b"spin" in r.body or b"sleep" in r.body or b";" in r.body
+        finally:
+            stop.set()
+
     def test_debug_vars_mesh_stats(self, tmp_path):
         """Mesh serving-layer counters appear under "mesh" once the
         device path has served a query (SURVEY.md §5 observability)."""

@@ -325,6 +325,75 @@ def test_holder_roundtrip(tmp_path):
         h2.close()
 
 
+def test_holder_cold_open_is_lazy(tmp_path, monkeypatch):
+    """Reopening a data dir must not parse any fragment file (O(schema)
+    cold start, the mmap-attach analog, reference fragment.go:211-229);
+    the first touch loads, and Holder.warm loads the rest."""
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    fr = idx.create_frame("f")
+    for s in range(4):
+        fr.set_bit(1, s * SLICE_WIDTH + 3)
+    h.close()
+
+    import pilosa_tpu.core.fragment as fragment_mod
+
+    calls = {"n": 0}
+    orig = fragment_mod.Bitmap.from_bytes
+
+    def counting(data):
+        calls["n"] += 1
+        return orig(data)
+
+    monkeypatch.setattr(fragment_mod.Bitmap, "from_bytes",
+                        staticmethod(counting))
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    try:
+        assert calls["n"] == 0  # nothing parsed at open
+        assert len(h2.frame("i", "f").view("standard").fragments) == 4
+        # First touch parses exactly that fragment.
+        assert h2.fragment("i", "f", "standard", 2).count() == 1
+        assert calls["n"] == 1
+        # Background warm loads the rest; flush_cache on never-loaded
+        # fragments must not force a parse either.
+        h2.flush_caches()
+        assert calls["n"] == 1
+        h2.warm()
+        assert calls["n"] == 4
+        assert h2.fragment("i", "f", "standard", 0).count() == 1
+    finally:
+        h2.close()
+
+
+def test_lazy_corrupt_fragment_raises_on_every_touch(tmp_path):
+    """A corrupt storage file under lazy open must raise on EVERY touch
+    — never degrade to a silently-empty fragment whose next snapshot
+    would overwrite the real data."""
+    h = Holder(str(tmp_path))
+    h.open()
+    h.create_index("i").create_frame("f").set_bit(1, 2)
+    h.close()
+
+    frag_path = tmp_path / "i" / "f" / "standard" / "fragments" / "0"
+    data = bytearray(frag_path.read_bytes())
+    data[0] ^= 0xFF  # break the cookie
+    frag_path.write_bytes(bytes(data))
+
+    h2 = Holder(str(tmp_path))
+    h2.open()  # lazy: corruption not seen yet
+    try:
+        frag = h2.fragment("i", "f", "standard", 0)
+        with pytest.raises(Exception):
+            frag.count()
+        with pytest.raises(Exception):  # still pending, still loud
+            frag.set_bit(3, 4)
+        h2.warm()  # must survive the bad fragment (logged, not fatal)
+    finally:
+        h2.close()
+
+
 def test_frame_import_with_inverse(tmp_path):
     f = Frame(str(tmp_path / "f"), "i", "f", inverse_enabled=True)
     f.open()

@@ -249,3 +249,152 @@ class TestReviewRegressions:
                 a.close()
         finally:
             b.close()
+
+
+# -- adversarial network conditions ------------------------------------------
+#
+# The reference delegates liveness to battle-tested memberlist
+# (gossip/gossip.go:34-222); this from-scratch SWIM must earn the same
+# trust under loss, duplication, delay, and partition. Faults inject at
+# the _send_udp seam (every ping/ack/ping-req/piggyback goes through
+# it), so both directions of a conversation see the same lossy world.
+
+
+def inject_udp_faults(g, rng, drop=0.0, dup=False, max_delay=0.0):
+    """Wrap g._send_udp with probabilistic drop / duplicate / delay."""
+    import threading
+
+    orig = g._send_udp
+
+    def faulty(addr, env):
+        if rng.random() < drop:
+            return
+        copies = 2 if dup else 1
+        for _ in range(copies):
+            if max_delay:
+                threading.Timer(rng.random() * max_delay, orig,
+                                args=(addr, env)).start()
+            else:
+                orig(addr, env)
+
+    g._send_udp = faulty
+    return orig
+
+
+def partition(g, peers):
+    """Cut g off from `peers` on BOTH planes (UDP sends and TCP
+    roundtrips); returns a heal() function."""
+    import random as _random
+
+    addrs = {p.gossip_addr for p in peers}
+    orig_udp = g._send_udp
+    orig_tcp = g._tcp_roundtrip
+
+    def dead_udp(addr, env):
+        if tuple(addr) in addrs:
+            return
+        orig_udp(addr, env)
+
+    def dead_tcp(addr, kind, payload, want_reply=False):
+        if tuple(addr) in addrs:
+            raise OSError("partitioned")
+        return orig_tcp(addr, kind, payload, want_reply)
+
+    g._send_udp = dead_udp
+    g._tcp_roundtrip = dead_tcp
+
+    def heal():
+        g._send_udp = orig_udp
+        g._tcp_roundtrip = orig_tcp
+
+    return heal
+
+
+class TestAdversarial:
+    def _cluster(self, n=3, **kw):
+        import random
+
+        rng = random.Random(7)
+        nodes = []
+        a, ha = make_node("hostA", **kw)
+        nodes.append((a, ha))
+        for i in range(1, n):
+            g, h = make_node(f"host{chr(65 + i)}",
+                             seeds=[a.gossip_addr], **kw)
+            nodes.append((g, h))
+        assert wait_until(
+            lambda: all(len(g.nodes()) == n for g, _ in nodes))
+        return nodes, rng
+
+    def test_broadcast_survives_30pct_loss(self):
+        nodes, rng = self._cluster(3)
+        try:
+            for g, _ in nodes:
+                inject_udp_faults(g, rng, drop=0.3)
+            nodes[0][0].send_async(pb.CreateIndexMessage(index="lossy"))
+            # Epidemic retransmit (retransmit_mult budget) must push the
+            # broadcast through 30% loss to every node.
+            assert wait_until(lambda: all(
+                any(getattr(m, "index", "") == "lossy" for m in h.messages)
+                for _, h in nodes[1:]), timeout=10.0)
+        finally:
+            for g, _ in nodes:
+                g.close()
+
+    def test_membership_converges_under_loss(self):
+        """30% loss causes false suspicions; refutation + incarnation
+        bumps must keep (or bring) every member ALIVE — nobody ends up
+        permanently DEAD in a fully-connected lossy cluster."""
+        nodes, rng = self._cluster(3)
+        try:
+            for g, _ in nodes:
+                inject_udp_faults(g, rng, drop=0.3)
+            time.sleep(1.5)  # dozens of lossy probe rounds
+            assert wait_until(lambda: all(
+                len(g.nodes()) == 3 for g, _ in nodes), timeout=10.0)
+        finally:
+            for g, _ in nodes:
+                g.close()
+
+    def test_duplicated_and_delayed_packets(self):
+        """Duplication + up-to-50ms reordering delays: broadcasts still
+        deliver exactly once (digest dedup) and membership holds."""
+        nodes, rng = self._cluster(3)
+        try:
+            for g, _ in nodes:
+                inject_udp_faults(g, rng, dup=True, max_delay=0.05)
+            nodes[1][0].send_async(pb.CreateIndexMessage(index="dupidx"))
+            assert wait_until(lambda: all(
+                any(getattr(m, "index", "") == "dupidx" for m in h.messages)
+                for i, (_, h) in enumerate(nodes) if i != 1), timeout=10.0)
+            time.sleep(0.5)  # let duplicates keep arriving
+            for i, (g, h) in enumerate(nodes):
+                got = [m for m in h.messages
+                       if getattr(m, "index", "") == "dupidx"]
+                if i != 1:
+                    assert len(got) == 1, (i, len(got))
+                assert len(g.nodes()) == 3
+        finally:
+            for g, _ in nodes:
+                g.close()
+
+    def test_partition_dead_then_rejoin(self):
+        """Full partition: survivors declare the cut node DEAD
+        (suspicion timeout); after healing, push-pull state exchange
+        tells the node it was declared dead, it refutes with a higher
+        incarnation, and membership reconverges to 3."""
+        nodes, _ = self._cluster(3, push_pull_interval=0.3)
+        (ga, ha), (gb, hb), (gc, hc) = nodes
+        try:
+            heal = partition(gc, [ga, gb])
+            # Survivors converge on C being dead; C suspects both peers.
+            # Generous timeouts: the suite runs this under full-machine
+            # load where probe rounds stretch well past their nominals.
+            assert wait_until(lambda: len(ga.nodes()) == 2
+                              and len(gb.nodes()) == 2, timeout=30.0)
+            heal()
+            assert wait_until(lambda: all(
+                len(g.nodes()) == 3 for g, _ in nodes), timeout=30.0)
+        finally:
+            for g, _ in nodes:
+                g.close()

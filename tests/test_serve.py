@@ -163,6 +163,28 @@ class TestIncrementalWrites:
         assert q(e, "i", "Count(Bitmap(rowID=10))") == [8]
 
 
+class TestColdStartServing:
+    def test_lazy_holder_stages_loaded_data(self, tmp_path):
+        """A cold-reopened holder defers fragment parsing; staging must
+        force the load — not ship empty pools to the device."""
+        from pilosa_tpu.core import Holder
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        seed(h, bits=[(1, 5), (1, SLICE_WIDTH + 9), (2, 5)])
+        h.close()
+
+        h2 = Holder(str(tmp_path / "d"))
+        h2.open()  # lazy: nothing parsed yet
+        try:
+            e = Executor(h2, use_device=True)
+            assert q(e, "i", "Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))") \
+                == [1]
+            assert e.mesh_manager().stats["count"] == 1
+        finally:
+            h2.close()
+
+
 class TestDeleteRecreate:
     def test_recreated_index_restages(self, holder):
         """Generations are only comparable on the SAME Fragment object:
@@ -217,6 +239,45 @@ class TestServedTopN:
         host = Executor(holder, use_device=False)
         pql = "TopN(frame=general, ids=[3, 17, 39])"
         assert q(e, "i", pql) == q(host, "i", pql)
+
+    def test_topn_large_row_space_differential(self, holder):
+        """Thousands of rows with mixed container forms: the one-pass
+        device TopN must match the host path's exact recount (VERDICT
+        r1 item 8: differential vs Fragment.top at large row counts)."""
+        from pilosa_tpu.roaring.bitmap import Bitmap, Container
+
+        rng = np.random.default_rng(11)
+        f = seed(holder)
+        view = f.create_view_if_not_exists("standard")
+        for s in range(2):
+            frag = view.create_fragment_if_not_exists(s)
+            b = Bitmap()
+            for r in range(3000):
+                if rng.random() < 0.2:
+                    continue
+                n = int(rng.integers(1, 600))
+                vals = np.sort(rng.choice(65536, size=n, replace=False)
+                               ).astype(np.uint32)
+                b.keys.append(r * 16)
+                b.containers.append(Container(array=vals))
+            with frag._mu:
+                b.op_writer = None
+                frag.storage = b
+                frag._mark_dirty(None)
+            frag.rebuild_cache()
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        # n=0 disables the host's per-slice candidate cut, so the host
+        # list is exact and fully comparable. For bounded n the device
+        # must equal the exact top-n — the host's own n=50 answer can
+        # MISS a globally-high row that sat below each slice's top-50
+        # (the reference's phase-1 approximation, executor.go:273-310).
+        exact = q(host, "i", "TopN(frame=general)")[0]
+        assert q(e, "i", "TopN(frame=general)")[0] == exact
+        for n in (50, 7):
+            dev = q(e, "i", f"TopN(frame=general, n={n})")[0]
+            assert dev == exact[:n]
+        assert e.mesh_manager().stats["topn"] > 0
 
     def test_topn_filters_stay_on_host(self, holder):
         f = self.seed_rows(holder, rows=6)
