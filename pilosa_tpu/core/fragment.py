@@ -203,6 +203,10 @@ class Fragment:
             fcntl.flock(self._lock_file, fcntl.LOCK_UN)
             self._lock_file.close()
             self._lock_file = None
+        # A reopened fragment must re-parse and re-attach the WAL —
+        # a stale loaded flag would leave op_writer detached and
+        # silently drop acked writes on the floor.
+        self._pending_load = True
 
     # -- reads -------------------------------------------------------------
 

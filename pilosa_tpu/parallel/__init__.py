@@ -43,6 +43,7 @@ _MESH_NAMES = (
     "compile_mesh_topn",
     "compile_serve_apply_writes",
     "compile_serve_count",
+    "compile_serve_count_batch",
     "compile_serve_row_counts",
     "connect_distributed",
     "default_mesh",
@@ -73,6 +74,7 @@ __all__ = [
     "combine_count",
     "compile_serve_apply_writes",
     "compile_serve_count",
+    "compile_serve_count_batch",
     "compile_serve_row_counts",
     "pack_mutation_batches",
     "compile_mesh_apply_writes",

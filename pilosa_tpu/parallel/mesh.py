@@ -498,6 +498,70 @@ def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
     return run
 
 
+def compile_serve_count_batch(mesh: Mesh, tree_shape, num_leaves: int,
+                              batch: int):
+    """Batched compile_serve_count: `batch` independent queries of the
+    same tree shape evaluate in ONE device program.
+
+    Dispatch and readback dominate small-query latency (measured
+    ~1.6 ms/call through the TPU relay; 960-slice Intersect+Count went
+    310 QPS single → 583 QPS at batch 16), so the serving layer
+    coalesces concurrent same-shape queries (serve.MeshManager batch
+    loop) and amortizes the floor. Returns
+      fn(words_t (L,), idx_flat (batch*L,), hit_flat (batch*L,),
+         mask (S,)) -> (2, batch) [lo, hi] limb columns
+    where idx_flat/hit_flat are row-major [b][l] per-leaf (S, 16)
+    arrays (resolve_row_indices outputs).
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    from ..ops.bitops import fold_tree
+
+    def per_shard(words_t, idx_flat, hit_flat, mask):
+        s_l = words_t[0].shape[0]
+
+        def one(b):
+            def leaf(i):
+                w = words_t[i]
+                cap_l = w.shape[1]
+                wflat = w.reshape(w.shape[0] * cap_l, w.shape[2])
+                base = (jnp.arange(w.shape[0], dtype=jnp.int32)
+                        * cap_l)[:, None]
+                idx = idx_flat[b * num_leaves + i]
+                hit = hit_flat[b * num_leaves + i]
+                blk = wflat[(idx + base).reshape(-1)]
+                return blk * hit.reshape(-1)[:, None]
+
+            pc = lax.population_count(fold_tree(tree, leaf))
+            return pc.sum(axis=1, dtype=jnp.uint32).reshape(
+                s_l, ROW_SPAN).sum(axis=1, dtype=jnp.uint32)
+
+        per_slice = jnp.stack([one(b) for b in range(batch)])  # (B, S_l)
+        per_slice = jnp.where(mask[None, :] != 0, per_slice, jnp.uint32(0))
+        lo = lax.psum(
+            (per_slice & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
+            SLICE_AXIS)
+        hi = lax.psum((per_slice >> 16).astype(jnp.int32).sum(axis=1),
+                      SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * (batch * num_leaves),
+                  (P(SLICE_AXIS),) * (batch * num_leaves),
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def run(words_t, idx_flat, hit_flat, mask):
+        return fn(words_t, idx_flat, hit_flat, mask)
+
+    return run
+
+
 def compile_serve_row_counts(mesh: Mesh, num_rows: int):
     """Jit masked global per-row counts for one sharded view.
 

@@ -29,6 +29,7 @@ Counts are returned as Python ints combined from (lo, hi) int32 limbs
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -42,6 +43,7 @@ from .mesh import (
     combine_count,
     compile_serve_apply_writes,
     compile_serve_count,
+    compile_serve_count_batch,
     compile_serve_row_counts,
     default_mesh,
     pack_mutation_batches,
@@ -76,6 +78,24 @@ class StagedView:
         return self.sharded.num_slices
 
 
+class _CountRequest:
+    """One pending count in the dynamic batch queue."""
+
+    __slots__ = ("args", "done", "result", "error")
+
+    def __init__(self, sig, words_t, idx_t, hit_t, dev_mask):
+        self.args = (sig, words_t, idx_t, hit_t, dev_mask)
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+    def group_key(self):
+        """Batchable together: same tree shape, same underlying pools
+        (object identity — same staging generation), same mask."""
+        sig, words_t, _idx, _hit, dev_mask = self.args
+        return (sig, tuple(id(w) for w in words_t), id(dev_mask))
+
+
 class MeshManager:
     """Stages holder views onto the device mesh and serves queries.
 
@@ -92,15 +112,18 @@ class MeshManager:
         self._mu = threading.RLock()
         self._views: Dict[Tuple[str, str, str], StagedView] = {}
         self._count_fns: Dict[Tuple[str, int], object] = {}
+        self._batch_fns: Dict[tuple, object] = {}
         self._rowcount_fns: Dict[int, object] = {}
         self._apply_fn = None
         self._mask_cache: Dict[bytes, object] = {}
+        self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
+        self._batch_thread: Optional[threading.Thread] = None
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
         # served device queries, plus cumulative timings.
         self.stats = {
             "stage": 0, "incremental": 0, "count": 0, "topn": 0,
-            "fallback": 0, "stage_us": 0, "query_us": 0,
+            "batched": 0, "fallback": 0, "stage_us": 0, "query_us": 0,
         }
 
     @property
@@ -226,16 +249,15 @@ class MeshManager:
             mask[s] = 1
         return mask
 
-    def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
+    def _count_args(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
-        """Build the compiled serving-count invocation: a zero-arg
-        callable returning the (2,) [lo, hi] device limbs, or None when
-        the request can't be served. All staging state (refresh, words
-        snapshot, idx/mask caches) is read and mutated under _mu: a
-        concurrent refresh() swaps sv.sharded in place, and a query
-        that read one leaf's words before the swap and another after
-        would mix two generations of the same view. Only the compiled
-        call itself runs unlocked."""
+        """Resolve a count request to device arrays:
+        (sig, words_t, idx_t, hit_t, dev_mask) or None. All staging
+        state (refresh, words snapshot, idx/mask caches) is read and
+        mutated under _mu: a concurrent refresh() swaps sv.sharded in
+        place, and a query that read one leaf's words before the swap
+        and another after would mix two generations of the same view.
+        Only compiled calls run unlocked."""
         with self._mu:
             staged: Dict[Tuple[str, str], tuple] = {}
             for frame, view, _row_id, _req in leaves:
@@ -265,13 +287,100 @@ class MeshManager:
             dev_mask = self._device_mask(mask)
 
         sig = json.dumps(_tree_signature(shape))
-        fkey = (sig, len(leaves))
+        return (sig, tuple(words_t), tuple(idx_t), tuple(hit_t), dev_mask)
+
+    def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
+                    num_slices: int):
+        """A zero-arg callable running ONE compiled (unbatched) serving
+        count, returning the (2,) [lo, hi] limbs — the benchmarking
+        entry for the engine rate without queueing/readback."""
+        prepared = self._count_args(index, shape, leaves, slices, num_slices)
+        if prepared is None:
+            return None
+        sig, words_t, idx_t, hit_t, dev_mask = prepared
+        fkey = (sig, len(idx_t))
         fn = self._count_fns.get(fkey)
         if fn is None:
-            fn = compile_serve_count(self.mesh, json.loads(sig), len(leaves))
+            fn = compile_serve_count(self.mesh, json.loads(sig), len(idx_t))
             self._count_fns[fkey] = fn
-        words_t, idx_t, hit_t = tuple(words_t), tuple(idx_t), tuple(hit_t)
         return lambda: fn(words_t, idx_t, hit_t, dev_mask)
+
+    # -- dynamic batching -----------------------------------------------------
+
+    # Queries coalesced into one device program, max. Compile cost grows
+    # with the unroll, and 16 already amortizes the dispatch floor ~10x.
+    _MAX_BATCH = 16
+
+    def _ensure_batch_thread(self):
+        if self._batch_thread is None:
+            with self._mu:
+                if self._batch_thread is None:
+                    t = threading.Thread(target=self._batch_loop,
+                                         name="mesh-count-batch", daemon=True)
+                    t.start()
+                    self._batch_thread = t
+
+    def _batch_loop(self):
+        """Drain-and-group: take everything queued while the device was
+        busy (no timed window — a lone request runs immediately), group
+        by compatible shape, execute each group as one program."""
+        while True:
+            first = self._batch_q.get()
+            reqs = [first]
+            while len(reqs) < self._MAX_BATCH:
+                try:
+                    reqs.append(self._batch_q.get_nowait())
+                except queue.Empty:
+                    break
+            groups: Dict[tuple, List[_CountRequest]] = {}
+            for r in reqs:
+                groups.setdefault(r.group_key(), []).append(r)
+            for group in groups.values():
+                try:
+                    self._run_count_group(group)
+                except Exception as e:  # noqa: BLE001 — fail the group only
+                    for r in group:
+                        r.error = e
+                        r.done.set()
+
+    def _run_count_group(self, group: List["_CountRequest"]):
+        import numpy as _np
+
+        b = len(group)
+        if b == 1:
+            sig, words_t, idx_t, hit_t, dev_mask = group[0].args
+            fkey = (sig, len(idx_t))
+            fn = self._count_fns.get(fkey)
+            if fn is None:
+                fn = compile_serve_count(self.mesh, json.loads(sig),
+                                         len(idx_t))
+                self._count_fns[fkey] = fn
+            group[0].result = combine_count(fn(words_t, idx_t, hit_t,
+                                               dev_mask))
+            group[0].done.set()
+            return
+
+        sig, words_t, _, _, dev_mask = group[0].args
+        num_leaves = len(group[0].args[2])
+        from ..ops.pool import mutation_batch_width
+
+        b_pad = min(mutation_batch_width(b, min_batch=2), self._MAX_BATCH)
+        fkey = (sig, num_leaves, b_pad)
+        fn = self._batch_fns.get(fkey)
+        if fn is None:
+            fn = compile_serve_count_batch(self.mesh, json.loads(sig),
+                                           num_leaves, b_pad)
+            self._batch_fns[fkey] = fn
+        padded = group + [group[-1]] * (b_pad - b)
+        idx_flat = tuple(r.args[2][i] for r in padded
+                         for i in range(num_leaves))
+        hit_flat = tuple(r.args[3][i] for r in padded
+                         for i in range(num_leaves))
+        limbs = _np.asarray(fn(words_t, idx_flat, hit_flat, dev_mask))
+        self.stats["batched"] += b
+        for j, r in enumerate(group):
+            r.result = (int(limbs[1, j]) << 16) + int(limbs[0, j])
+            r.done.set()
 
     def count(self, index: str, shape, leaves, slices: Sequence[int],
               num_slices: int) -> Optional[int]:
@@ -279,15 +388,32 @@ class MeshManager:
         fused eval + psum across the requested slices. `shape`/`leaves`
         come from plan._lower_tree: leaves are (frame, view, row_id,
         required) in depth-first order; each leaf gathers from its own
-        staged view (trees may span frames and time-quantum views)."""
+        staged view (trees may span frames and time-quantum views).
+
+        Concurrent same-shape counts COALESCE: the request goes through
+        the batch loop, which drains whatever queued while the device
+        was busy and runs up to _MAX_BATCH queries as one program.
+        Dispatch+readback dominate a single query (~1.6 ms + ~70 ms
+        through the TPU relay), so batching multiplies concurrent
+        throughput (measured 310 → 583 QPS at batch 16 on a 1B-column
+        index) while a lone request runs immediately."""
         t0 = time.monotonic()
-        call = self._count_call(index, shape, leaves, slices, num_slices)
-        if call is None:
+        prepared = self._count_args(index, shape, leaves, slices, num_slices)
+        if prepared is None:
             return None
-        total = combine_count(call())
+        req = _CountRequest(*prepared)
+        self._ensure_batch_thread()
+        self._batch_q.put(req)
+        req.done.wait()
+        if req.error is not None:
+            # Fresh exception per waiter: up to 16 threads share one
+            # group error, and re-raising the same instance concurrently
+            # races on its __traceback__.
+            raise RuntimeError(
+                f"batched device count failed: {req.error}") from req.error
         self.stats["count"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
-        return total
+        return req.result
 
     # Bound on cached (row -> gather indices) entries per staged view:
     # each costs 2 * S * 16 * 4 bytes of HBM (~120 KB at 960 slices).

@@ -174,6 +174,12 @@ class Server:
 
     def close(self):
         self.closing.close()
+        # Join the warm thread BEFORE holder.close(): a warm mid-load
+        # after close would reopen a WAL fd on a fragment whose flock
+        # was just released (leaked fd + unprotected writer).
+        for t in self._threads:
+            if t.name == "warm":
+                t.join(timeout=10)
         self.node_set.close()
         if self._api is not None:
             self._api.close()

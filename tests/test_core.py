@@ -367,6 +367,31 @@ def test_holder_cold_open_is_lazy(tmp_path, monkeypatch):
         h2.close()
 
 
+def test_fragment_reopen_reattaches_wal(tmp_path):
+    """open → write → close → open on the SAME Fragment object must
+    re-parse and re-attach the WAL: writes after the reopen have to be
+    durable (a stale loaded flag would leave op_writer detached)."""
+    from pilosa_tpu.core.fragment import Fragment
+
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(1, 10)
+    f.close()
+    f.open()
+    assert f.storage.op_writer is not None
+    f.set_bit(2, 20)  # must reach the WAL
+    f.close()
+
+    g = Fragment(path, "i", "f", "standard", 0)
+    g.open()
+    try:
+        assert g.count() == 2
+        assert list(g.row(2)) == [20]
+    finally:
+        g.close()
+
+
 def test_lazy_corrupt_fragment_raises_on_every_touch(tmp_path):
     """A corrupt storage file under lazy open must raise on EVERY touch
     — never degrade to a silently-empty fragment whose next snapshot

@@ -375,6 +375,77 @@ class TestWideCount:
         assert combine_count(fn(*args, mask)) == (s // 2) * (1 << 20)
 
 
+class TestDynamicBatching:
+    def seed_many_rows(self, holder):
+        bits = []
+        for r in range(12):
+            bits += [(r, c) for c in range(0, (r + 1) * 3)]
+            bits += [(r, SLICE_WIDTH + c) for c in range(0, r + 1)]
+        return seed(holder, bits=bits)
+
+    def test_count_group_matches_individual(self, holder):
+        """A coalesced batch program returns the same counts as the
+        unbatched path, including the power-of-two pad entries."""
+        self.seed_many_rows(holder)
+        e = Executor(holder, use_device=True)
+        mgr = e.mesh_manager()
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.parallel.serve import _CountRequest
+        from pilosa_tpu.pql import parse_string
+
+        host = Executor(holder, use_device=False)
+        group, want = [], []
+        for a, b in [(0, 1), (2, 3), (4, 11)]:
+            pql = f"Count(Intersect(Bitmap(rowID={a}), Bitmap(rowID={b})))"
+            tree = parse_string(pql).calls[0].children[0]
+            leaves = []
+            shape = _lower_tree(holder, "i", tree, leaves)
+            assert shape is not None
+            prepared = mgr._count_args("i", shape, leaves, [0, 1], 2)
+            assert prepared is not None
+            group.append(_CountRequest(*prepared))
+            want.append(host.execute("i", parse_string(pql))[0])
+        mgr._run_count_group(group)
+        got = [r.result for r in group]
+        assert got == want
+        assert mgr.stats["batched"] == 3
+
+    def test_concurrent_counts_coalesce_correctly(self, holder):
+        """Many threads hammering Count: every result must be exact
+        regardless of how the batch loop groups them."""
+        import threading as th
+
+        self.seed_many_rows(holder)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        from pilosa_tpu.pql import parse_string
+
+        pairs = [(a, (a + 1) % 12) for a in range(12)]
+        want = {p: host.execute(
+            "i", parse_string(f"Count(Intersect(Bitmap(rowID={p[0]}), "
+                              f"Bitmap(rowID={p[1]})))"))[0]
+            for p in pairs}
+        results, errors = {}, []
+
+        def worker(p):
+            try:
+                q_ = parse_string(f"Count(Intersect(Bitmap(rowID={p[0]}), "
+                                  f"Bitmap(rowID={p[1]})))")
+                for _ in range(3):
+                    results.setdefault(p, []).append(e.execute("i", q_)[0])
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        threads = [th.Thread(target=worker, args=(p,)) for p in pairs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        for p, vals in results.items():
+            assert vals == [want[p]] * 3, (p, vals, want[p])
+
+
 class TestPallasChunking:
     def test_slab_scan_with_remainder_matches(self, monkeypatch):
         """Prime-ish slice counts run fixed slabs + a remainder call —
