@@ -80,44 +80,136 @@ VERSION = "0.1.0"
 
 _WEBUI_PAGE = """<!doctype html>
 <html><head><title>pilosa-tpu console</title><style>
-body{font-family:monospace;margin:2em;max-width:72em}
-textarea,input{font-family:monospace;width:100%;box-sizing:border-box}
-pre{background:#f4f4f4;padding:1em;overflow:auto}
-.cols{display:flex;gap:2em}.cols>div{flex:1;min-width:0}
-h2{font-size:1em;border-bottom:1px solid #ccc}
-button{font-family:monospace}
+body{font-family:monospace;margin:1.5em;max-width:100em;background:#fff;color:#222}
+textarea,input,select{font-family:monospace;box-sizing:border-box}
+textarea,input{width:100%}
+pre{background:#f4f4f4;padding:.8em;overflow:auto;margin:.4em 0}
+.cols{display:flex;gap:1.5em}.cols>div{flex:1;min-width:0}
+h1{font-size:1.3em}h2{font-size:1em;border-bottom:1px solid #ccc;margin:.8em 0 .4em}
+button{font-family:monospace;margin-right:.4em;cursor:pointer}
+table{border-collapse:collapse;margin:.4em 0}
+td,th{border:1px solid #ccc;padding:.15em .6em;text-align:right}
+th{background:#eee}
+.tree span{cursor:pointer;color:#035;text-decoration:underline}
+.tree ul{margin:.1em 0 .1em 1.2em;padding:0;list-style:none}
+#hist div{cursor:pointer;color:#035;white-space:nowrap;overflow:hidden;text-overflow:ellipsis}
+.err{color:#a00}.dim{color:#777}
 </style></head><body>
-<h1>pilosa-tpu</h1>
+<h1>pilosa-tpu <span class="dim" id="ver"></span></h1>
 <div class="cols">
-<div>
+<div style="flex:1.4">
 <h2>query</h2>
-<p>index: <input id="idx" value="i"></p>
-<p><textarea id="q" rows="4">Count(Bitmap(id=1, frame=general))</textarea></p>
+<p>index: <input id="idx" value="i" style="width:12em">
+<label><input type="checkbox" id="auto" style="width:auto"> auto-refresh</label></p>
+<p><textarea id="q" rows="4">Count(Bitmap(rowID=1, frame=general))</textarea></p>
 <p><button onclick="run()">run</button>
-   <button onclick="refresh()">refresh schema/status</button></p>
-<pre id="out"></pre>
+   <button onclick="refresh()">refresh</button>
+   <span class="dim" id="took"></span></p>
+<div id="result"></div>
+<h2>history</h2><div id="hist"></div>
+<h2>examples</h2><div id="hist2">
+<div onclick="setQ(this)">Count(Intersect(Bitmap(rowID=1, frame=general), Bitmap(rowID=2, frame=general)))</div>
+<div onclick="setQ(this)">TopN(frame=general, n=10)</div>
+<div onclick="setQ(this)">SetBit(rowID=1, frame=general, columnID=7)</div>
+<div onclick="setQ(this)">Range(rowID=1, frame=general, start=&quot;2017-01-01T00:00&quot;, end=&quot;2018-01-01T00:00&quot;)</div>
+</div>
 </div>
 <div>
-<h2>schema</h2><pre id="schema"></pre>
+<h2>schema</h2><div id="schema" class="tree"></div>
 <h2>cluster</h2><pre id="status"></pre>
+</div>
+<div>
+<h2>stats (/debug/vars)</h2><div id="vars"></div>
 </div>
 </div>
 <script>
-async function run(){
-  const r = await fetch('/index/'+document.getElementById('idx').value+'/query',
-    {method:'POST', body:document.getElementById('q').value});
-  document.getElementById('out').textContent =
-    JSON.stringify(await r.json(), null, 2);
-}
-async function refresh(){
-  for (const [path, el] of [['/schema','schema'],['/status','status']]) {
-    try {
-      const r = await fetch(path);
-      document.getElementById(el).textContent =
-        JSON.stringify(await r.json(), null, 2);
-    } catch (e) { document.getElementById(el).textContent = String(e); }
+const $ = id => document.getElementById(id);
+function setQ(el){ $('q').value = el.textContent; }
+function esc(s){ const d=document.createElement('div'); d.textContent=s; return d.innerHTML; }
+
+function renderResult(results){
+  const out = $('result'); out.innerHTML = '';
+  for (const r of results) {
+    if (Array.isArray(r) && r.length && r[0] && 'id' in r[0]) {  // TopN pairs
+      let h = '<table><tr><th>row</th><th>count</th></tr>';
+      for (const p of r) h += `<tr><td>${p.id}</td><td>${p.count}</td></tr>`;
+      out.innerHTML += h + '</table>';
+    } else if (r && typeof r === 'object' && 'bits' in r) {      // Bitmap row
+      out.innerHTML += `<pre>count=${r.bits.length} attrs=${esc(JSON.stringify(r.attrs||{}))}\n` +
+        esc(JSON.stringify(r.bits.slice(0, 2048))) +
+        (r.bits.length > 2048 ? ' …' : '') + '</pre>';
+    } else {
+      out.innerHTML += '<pre>' + esc(JSON.stringify(r, null, 2)) + '</pre>';
+    }
   }
 }
+
+let history = [];
+async function run(){
+  const q = $('q').value, t0 = performance.now();
+  try {
+    const r = await fetch('/index/'+$('idx').value+'/query', {method:'POST', body:q});
+    const js = await r.json();
+    $('took').textContent = (performance.now()-t0).toFixed(1)+' ms';
+    if (js.error) { $('result').innerHTML = '<pre class="err">'+esc(js.error)+'</pre>'; }
+    else renderResult(js.results || []);
+    if (!history.length || history[0] !== q) {
+      history.unshift(q); history = history.slice(0, 10);
+      $('hist').innerHTML = history.map(h =>
+        `<div onclick="setQ(this)">${esc(h)}</div>`).join('');
+    }
+  } catch (e) { $('result').innerHTML = '<pre class="err">'+esc(String(e))+'</pre>'; }
+  refresh();
+}
+
+function schemaTree(indexes){
+  let h = '<ul>';
+  for (const ix of indexes || []) {
+    h += `<li><span onclick="$('idx').value='${ix.name}'">${esc(ix.name)}</span><ul>`;
+    for (const f of ix.frames || []) {
+      const views = (f.views || []).join(', ');
+      h += `<li><span onclick="pick('${ix.name}','${f.name}')">${esc(f.name)}</span>` +
+           ` <span class="dim" style="text-decoration:none;cursor:default">[${esc(views)}]</span></li>`;
+    }
+    h += '</ul></li>';
+  }
+  return h + '</ul>';
+}
+function pick(ix, frame){
+  $('idx').value = ix;
+  $('q').value = `TopN(frame=${frame}, n=10)`;
+}
+
+function varsTables(v){
+  // top level is a flat scalar map (ExpvarStats counters) plus nested
+  // sections like "mesh" — render scalars as one table, objects as
+  // their own tables.
+  let h = '', flat = '';
+  for (const [k, val] of Object.entries(v)) {
+    if (typeof val === 'object' && val !== null) {
+      h += `<table><tr><th colspan=2>${esc(k)}</th></tr>`;
+      for (const [kk, vv] of Object.entries(val))
+        h += `<tr><td style="text-align:left">${esc(kk)}</td><td>${esc(JSON.stringify(vv))}</td></tr>`;
+      h += '</table>';
+    } else {
+      flat += `<tr><td style="text-align:left">${esc(k)}</td><td>${esc(JSON.stringify(val))}</td></tr>`;
+    }
+  }
+  if (flat) h = `<table><tr><th colspan=2>counters</th></tr>${flat}</table>` + h;
+  return h || '<pre class="dim">(empty)</pre>';
+}
+
+async function refresh(){
+  try { $('ver').textContent = 'v' + (await (await fetch('/version')).json()).version; } catch(e){}
+  try { $('schema').innerHTML = schemaTree((await (await fetch('/schema')).json()).indexes); }
+  catch (e) { $('schema').textContent = String(e); }
+  try { $('status').textContent =
+        JSON.stringify(await (await fetch('/status')).json(), null, 2); }
+  catch (e) { $('status').textContent = String(e); }
+  try { $('vars').innerHTML = varsTables(await (await fetch('/debug/vars')).json()); }
+  catch (e) { $('vars').textContent = String(e); }
+}
+setInterval(() => { if ($('auto').checked) refresh(); }, 2000);
 refresh();
 </script></body></html>"""
 

@@ -2,8 +2,9 @@
 
 The reference Count path materializes the intersection, then counts it
 (executor.go:567-597 over roaring intersect kernels). Here a pure
-bitmap-op tree — Bitmap / Intersect / Union / Difference over standard
-views — compiles to ONE XLA computation per slice: gather each leaf row
+bitmap-op tree — Bitmap (row on the standard view, column on the
+inverse view) / Intersect / Union / Difference / Range — compiles to
+ONE XLA computation per slice: gather each leaf row
 as a (16, 2048) uint32 block from the fragment's HBM pool, combine
 elementwise, popcount-reduce. No intermediate row ever hits HBM; this is
 the "small compiler from pql.Call trees to jitted functions with a cache
@@ -27,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.pool import gather_row
-from ..core.view import VIEW_STANDARD
+from ..core.view import VIEW_INVERSE, VIEW_STANDARD
 
 # Call names evaluable on device, keyed to bitwise combiners.
 _TREE_OPS = {"Intersect": "and", "Union": "or", "Difference": "andnot"}
@@ -134,13 +135,20 @@ def _lower_tree(holder, index: str, c, leaves: List[tuple]):
             return None
         try:
             row_id, row_ok = c.uint_arg(f.row_label)
-            _, col_ok = c.uint_arg(idx.column_label)
+            col_id, col_ok = c.uint_arg(idx.column_label)
         except TypeError:
             return None
-        if not row_ok or col_ok:
-            return None  # inverse/invalid → host path
-        leaves.append((frame, VIEW_STANDARD, row_id, True))
-        return ["leaf"]
+        if row_ok and not col_ok:
+            leaves.append((frame, VIEW_STANDARD, row_id, True))
+            return ["leaf"]
+        if col_ok and not row_ok and f.inverse_enabled:
+            # Bitmap(columnID=..) reads the inverse view; the slice set
+            # stays whatever the caller mapped (matching the host path,
+            # which fetches the inverse fragment per mapped slice —
+            # executor.go:420-465 semantics).
+            leaves.append((frame, VIEW_INVERSE, col_id, True))
+            return ["leaf"]
+        return None  # both/neither/disabled-inverse → host path
     if c.name == "Range":
         return _lower_range(holder, index, c, leaves)
     op = _TREE_OPS.get(c.name)
@@ -195,8 +203,9 @@ def _lower_range(holder, index: str, c, leaves: List[tuple]):
 
 def compile_count_plan(holder, index: str, tree) -> Optional[CountPlan]:
     """Compile Count's child tree for fused device eval; None when the
-    tree doesn't qualify (inverse views, unknown frames, non-integer
-    args, over-wide Range covers, ...)."""
+    tree doesn't qualify (unknown frames, non-integer args, a Bitmap
+    with both/neither of row and column args, columnID without
+    inverse_enabled, over-wide Range covers, ...)."""
     leaves: List[tuple] = []
     shape = _lower_tree(holder, index, tree, leaves)
     if shape is None or shape == ["leaf"] and not leaves:

@@ -92,6 +92,23 @@ class TestServedCount:
         assert mgr.stats["count"] == 1
         assert mgr.stats["stage"] == 2  # one per frame view
 
+    def test_count_inverse_view(self, holder, monkeypatch):
+        """Bitmap(columnID=..) leaves lower onto the inverse view and
+        serve through the mesh, matching the host path."""
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general", inverse_enabled=True)
+        for row, col in [(1, 7), (2, 7), (3, 7), (2, 9), (3, 9)]:
+            f.set_bit(row, col)
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        for pql in (
+            "Count(Bitmap(columnID=7))",
+            "Count(Intersect(Bitmap(columnID=7), Bitmap(columnID=9)))",
+        ):
+            assert q(e, "i", pql) == q(host, "i", pql)
+        assert e.mesh_manager().stats["count"] == 2
+
     def test_count_range_time_views(self, holder):
         idx = holder.create_index_if_not_exists("i")
         f = idx.create_frame_if_not_exists("general", time_quantum="YMD")
