@@ -92,7 +92,7 @@ td,th{border:1px solid #ccc;padding:.15em .6em;text-align:right}
 th{background:#eee}
 .tree span{cursor:pointer;color:#035;text-decoration:underline}
 .tree ul{margin:.1em 0 .1em 1.2em;padding:0;list-style:none}
-#hist div{cursor:pointer;color:#035;white-space:nowrap;overflow:hidden;text-overflow:ellipsis}
+#hist div,#hist2 div{cursor:pointer;color:#035;white-space:nowrap;overflow:hidden;text-overflow:ellipsis}
 .err{color:#a00}.dim{color:#777}
 </style></head><body>
 <h1>pilosa-tpu <span class="dim" id="ver"></span></h1>

@@ -56,13 +56,18 @@ class ShardedIndex(NamedTuple):
 
 
 def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
-                        capacity: Optional[int] = None):
+                        capacity: Optional[int] = None,
+                        with_host_keys: bool = False):
     """Stack per-slice host bitmaps into a ShardedIndex.
 
     bitmaps[s] is the slice-s roaring Bitmap (or None for an absent
     fragment). Returns (ShardedIndex, row_ids): row_ids is the GLOBAL
     sorted uint64 row-id table shared by all shards. The slice count is
-    padded up to a multiple of the mesh axis size.
+    padded up to a multiple of the mesh axis size. with_host_keys=True
+    appends the packed (S_padded, cap) int32 numpy keys to the return —
+    consumers needing them must take this copy, NOT np.asarray the
+    device keys, which fails on a multi-process mesh (non-addressable
+    shards).
     """
     n_dev = mesh.shape[SLICE_AXIS] if mesh is not None else 1
     s = max(1, len(bitmaps))
@@ -97,6 +102,8 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
             keys=jax.device_put(idx.keys, sharding),
             words=jax.device_put(idx.words, sharding),
         )
+    if with_host_keys:
+        return idx, row_ids, keys
     return idx, row_ids
 
 

@@ -160,11 +160,12 @@ class MeshManager:
         t0 = time.monotonic()
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
-        sharded, row_ids = build_sharded_index(bitmaps, self.mesh)
+        sharded, row_ids, keys_host = build_sharded_index(
+            bitmaps, self.mesh, with_host_keys=True)
         sv = StagedView(
             sharded=sharded,
             row_ids=row_ids,
-            keys_host=np.asarray(sharded.keys),
+            keys_host=keys_host,
             slice_gens=gens,
             num_slices=num_slices,
         )

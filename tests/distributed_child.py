@@ -12,6 +12,7 @@ import sys
 
 def main():
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "mesh"
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))  # repo root
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -26,15 +27,17 @@ def main():
     from pilosa_tpu.parallel import (
         build_sharded_index,
         compile_mesh_count,
+        connect_distributed,
         default_mesh,
     )
     from pilosa_tpu.roaring import Bitmap
 
-    from pilosa_tpu.parallel import connect_distributed
-
     connect_distributed(f"127.0.0.1:{port}", nprocs, pid)
     n_global = len(jax.devices())
     assert n_global == 4, n_global
+
+    if mode == "spmd":
+        return spmd_serving(pid)
 
     mesh = default_mesh()
     bitmaps = []
@@ -50,6 +53,45 @@ def main():
     fn = compile_mesh_count(mesh, ["and", ["leaf"], ["leaf"]], 2)
     count = int(fn(index, np.int32([0, 1])))
     print(f"RESULT {pid} {count}", flush=True)
+
+
+def spmd_serving(pid: int):
+    """Replicated-data SPMD serving: each process owns an identical
+    holder; rank 0 drives counts through parallel.spmd.SpmdServer,
+    rank 1 follows broadcast descriptors."""
+    import tempfile
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.parallel.plan import _lower_tree
+    from pilosa_tpu.parallel.spmd import SpmdServer
+    from pilosa_tpu.pql import parse_string
+
+    holder = Holder(tempfile.mkdtemp(prefix=f"spmd{pid}_"))
+    holder.open()
+    idx = holder.create_index_if_not_exists("i")
+    frame = idx.create_frame_if_not_exists("general")
+    for s in range(4):
+        frame.set_bit(0, s * SLICE_WIDTH + s)
+        frame.set_bit(1, s * SLICE_WIDTH + s)
+        frame.set_bit(1, s * SLICE_WIDTH + s + 7)
+
+    srv = SpmdServer(holder)
+    if pid == 0:
+        tree = parse_string(
+            "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        ).calls[0].children[0]
+        leaves = []
+        shape = _lower_tree(holder, "i", tree, leaves)
+        assert shape is not None
+        n1 = srv.count("i", shape, leaves, list(range(4)), 4)
+        n2 = srv.count("i", shape, leaves, [0, 2], 4)  # masked subset
+        srv.stop()
+        print(f"RESULT 0 {n1}:{n2}", flush=True)
+    else:
+        srv.run_worker()
+        print("RESULT 1 worker-done", flush=True)
+    holder.close()
 
 
 if __name__ == "__main__":

@@ -325,6 +325,54 @@ def test_connect_distributed_two_process():
     assert counts == [4, 4], outs
 
 
+def test_spmd_serving_two_process():
+    """Replicated-data SPMD serving: rank 0 drives Count collectives
+    through parallel.spmd.SpmdServer (descriptor broadcast over the
+    device fabric), rank 1 follows — queries execute over the GLOBAL
+    4-device mesh spanning both processes, including a masked slice
+    subset. Skipped when the runtime refuses multi-process CPU."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    import pytest
+
+    with socket.socket() as s_:
+        s_.bind(("127.0.0.1", 0))
+        port = s_.getsockname()[1]
+    child = os.path.join(os.path.dirname(__file__), "distributed_child.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, str(pid), "2", str(port), "spmd"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("two-process jax.distributed timed out on this runtime")
+    if any(rc != 0 for rc, _, _ in outs):
+        detail = "\n".join(e[-800:] for _, _, e in outs)
+        if "RESULT" not in (outs[0][1] + outs[1][1]):
+            pytest.skip(f"multi-process CPU runtime unavailable:\n{detail}")
+        raise AssertionError(detail)
+    rank0 = next(line for _, out, _ in outs
+                 for line in out.splitlines() if line.startswith("RESULT 0"))
+    # rows 0 and 1 intersect in 1 column per slice: 4 slices -> 4,
+    # masked to slices {0, 2} -> 2.
+    assert rank0.split()[2] == "4:2", outs
+    assert any("worker-done" in out for _, out, _ in outs), outs
+
+
 def test_sharded_index_from_holder_inverse_view(mesh, tmp_path):
     """The H2D bridge stages any view — here the inverse orientation
     (column-major rows, view.go:31-34), counted on device."""
