@@ -65,6 +65,9 @@ class Config:
         self.data_dir: str = "~/.pilosa_tpu"
         self.host: str = DEFAULT_HOST
         self.log_path: str = ""
+        # Device serving path: "auto" (on when a TPU backend is live,
+        # overridable by PILOSA_TPU_USE_DEVICE), "on", or "off".
+        self.use_device: str = "auto"
         self.cluster_hosts: List[str] = [DEFAULT_HOST]
         # Broadcast transport: "http" (POST /internal/message to static
         # peers), "gossip" (SWIM membership + epidemic broadcast), or
@@ -92,6 +95,7 @@ class Config:
         c.data_dir = data.get("data-dir", c.data_dir)
         c.host = data.get("host", c.host)
         c.log_path = data.get("log-path", c.log_path)
+        c.use_device = str(data.get("use-device", c.use_device))
         cl = data.get("cluster", {})
         c.cluster_hosts = list(cl.get("hosts", [])) or [c.host]
         c.cluster_type = str(cl.get("type", c.cluster_type))
@@ -109,6 +113,21 @@ class Config:
     def expanded_data_dir(self) -> str:
         return os.path.expanduser(self.data_dir)
 
+    def use_device_flag(self):
+        """Executor use_device arg: None = auto, True/False = forced.
+        Unrecognized values raise — a typo ("onn") silently falling
+        back to auto would leave an operator believing the device path
+        is forced while the host fallback serves."""
+        v = self.use_device.strip().lower()
+        if v in ("on", "true", "1", "yes"):
+            return True
+        if v in ("off", "false", "0", "no"):
+            return False
+        if v in ("auto", ""):
+            return None
+        raise ValueError(
+            f"use-device must be auto/on/off, got {self.use_device!r}")
+
     def to_toml(self) -> str:
         """Default-config printer (`pilosa config`, ctl/config.go)."""
         hosts = ", ".join(f'"{h}"' for h in self.cluster_hosts)
@@ -116,6 +135,7 @@ class Config:
             f'data-dir = "{self.data_dir}"\n'
             f'host = "{self.host}"\n'
             f'log-path = "{self.log_path}"\n'
+            f'use-device = "{self.use_device}"\n'
             f"\n[cluster]\n"
             f'type = "{self.cluster_type}"\n'
             f"replicas = {self.replica_n}\n"

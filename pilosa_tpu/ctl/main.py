@@ -67,6 +67,11 @@ def build_config(args) -> Config:
         cfg.cluster_hosts = [h.strip() for h in args.hosts.split(",")]
     if getattr(args, "replicas", None):
         cfg.replica_n = args.replicas
+    env_dev = _env("use_device")
+    if env_dev:
+        cfg.use_device = env_dev
+    if getattr(args, "use_device", None):
+        cfg.use_device = args.use_device
     return cfg
 
 
@@ -373,6 +378,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-b", "--bind", help="host:port to listen on")
     p.add_argument("--hosts", help="comma-separated cluster hosts")
     p.add_argument("--replicas", type=int)
+    p.add_argument("--use-device", choices=["auto", "on", "off"],
+                   help="device serving path (default: auto — on when a "
+                        "TPU backend is live; PILOSA_TPU_USE_DEVICE also "
+                        "overrides auto)")
     p.add_argument("--log-path", default="")
     p.set_defaults(fn=cmd_server)
 

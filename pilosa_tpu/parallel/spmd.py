@@ -60,12 +60,19 @@ class SpmdServer:
     the same GLOBAL mesh (the default after connect_distributed)."""
 
     def __init__(self, holder, mesh=None):
+        import threading
+
         import jax
 
         from .serve import MeshManager
 
         self.rank = jax.process_index()
         self.manager = MeshManager(holder, mesh=mesh)
+        # Serializes descriptor broadcast + gate + execute: the HTTP
+        # front-end is threaded, and two interleaved
+        # broadcast_one_to_all collectives from rank 0 would pair
+        # nondeterministically with the workers' sequential loop.
+        self._mu = threading.Lock()
 
     # -- rank 0 --------------------------------------------------------------
 
@@ -81,13 +88,15 @@ class SpmdServer:
             "slices": list(map(int, slices)),
             "num_slices": int(num_slices),
         }
-        self._broadcast(desc)
-        return self._execute(desc)
+        with self._mu:
+            self._broadcast(desc)
+            return self._execute(desc)
 
     def stop(self):
         """Release every worker loop. Rank 0 only."""
         assert self.rank == 0
-        self._broadcast({"op": _OP_STOP})
+        with self._mu:
+            self._broadcast({"op": _OP_STOP})
 
     # -- all ranks -----------------------------------------------------------
 
@@ -120,29 +129,52 @@ class SpmdServer:
         return _decode(out)
 
     def _execute(self, desc: dict) -> Optional[int]:
-        """Resolve, AGREE, then execute.
+        """Resolve, AGREE on the program, then execute.
 
-        Resolution can fail on one rank alone (replicated data dirs
-        momentarily out of sync, fallback path taken): if that rank
-        skipped the psum while the others entered it, the whole mesh
-        would hang. So every rank first resolves locally, then an
-        allgather of ready-flags decides — the collective runs only
-        when EVERY rank resolved; otherwise all skip together."""
+        Resolution can fail — or succeed with a DIFFERENT program — on
+        one rank alone (replicated data dirs momentarily out of sync: a
+        lagging replica stages a different pool capacity). A rank
+        skipping the psum, or entering it with mismatched shapes, hangs
+        the whole mesh. So every rank resolves locally, then an
+        allgather compares PROGRAM FINGERPRINTS (tree signature + every
+        staged array shape, deterministically hashed): the collective
+        runs only when every rank resolved the identical program;
+        otherwise all skip together."""
+        import zlib
+
         from jax.experimental import multihost_utils
 
         from .mesh import combine_count
 
         leaves = [tuple(leaf) for leaf in desc["leaves"]]
         try:
-            call = self.manager._count_call(
+            prepared = self.manager._count_args(
                 desc["index"], desc["shape"], leaves, desc["slices"],
                 desc["num_slices"])
         except Exception:  # noqa: BLE001 — counted as not-ready below
-            call = None
-        ready = multihost_utils.process_allgather(
-            np.int32(0 if call is None else 1))
-        if not bool(np.all(ready)):
+            prepared = None
+        if prepared is None:
+            fp = np.int64(0)
+        else:
+            sig, words_t, idx_t, hit_t, mask = prepared
+            shapes = ([tuple(w.shape) for w in words_t]
+                      + [tuple(i.shape) for i in idx_t]
+                      + [tuple(mask.shape)])
+            blob = json.dumps([sig, shapes]).encode()
+            # NOT hash(): Python string hashing is per-process salted.
+            fp = np.int64(zlib.crc32(blob) + 1)
+        fps = multihost_utils.process_allgather(fp)
+        if int(fp) == 0 or not bool(np.all(fps == fps[0])):
             return None  # every rank skips: no divergent collective
         # Past the gate, all ranks run the identical program; a runtime
         # failure here hits every rank symmetrically.
-        return combine_count(call())
+        sig, words_t, idx_t, hit_t, mask = prepared
+        fkey = (sig, len(idx_t))
+        fn = self.manager._count_fns.get(fkey)
+        if fn is None:
+            from .mesh import compile_serve_count
+
+            fn = compile_serve_count(self.manager.mesh, json.loads(sig),
+                                     len(idx_t))
+            self.manager._count_fns[fkey] = fn
+        return combine_count(fn(words_t, idx_t, hit_t, mask))

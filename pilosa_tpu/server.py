@@ -114,7 +114,8 @@ class Server:
         self.holder.broadcaster = self.broadcaster
 
         self.executor = Executor(self.holder, host=self.host,
-                                 cluster=self.cluster, client=self.client)
+                                 cluster=self.cluster, client=self.client,
+                                 use_device=self.config.use_device_flag())
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
