@@ -309,6 +309,43 @@ def main():
         details["mapreduce_count"]["host_cpu_qps"] and
         (bsz / bdt) / details["mapreduce_count"]["host_cpu_qps"])
 
+    # write-then-Count: a bit into an existing container folds into the
+    # staged image as one scatter; compare against a forced full
+    # restage (what every write cost before incremental maintenance —
+    # VERDICT r1 item 4: write latency must not scale with pool size).
+    _progress("write-then-count")
+    frag0 = h.fragment("i", "general", "standard", 0)
+
+    def timed_write_count(invalidate: bool, n: int):
+        total = 0.0
+        for k in range(n):
+            # State-neutral write pair into existing container 0 (the
+            # dense words hold random bits — end where we started).
+            col = 1 + k
+            if frag0.storage.contains(frag0._pos(0, col)):
+                frag0.clear_bit(0, col)
+                frag0.set_bit(0, col)
+            else:
+                frag0.set_bit(0, col)
+                frag0.clear_bit(0, col)
+            if invalidate:
+                mgr.invalidate("i")
+            t0 = time.perf_counter()
+            mgr.count("i", shape, leaves, list(range(num_slices)),
+                      num_slices)
+            total += time.perf_counter() - t0
+        return total / n
+
+    timed_write_count(False, 1)  # warm the scatter-apply compile
+    inc_dt = timed_write_count(False, 5 if on_tpu else 2)
+    restage_dt = timed_write_count(True, 2 if on_tpu else 1)
+    details["write_then_count"] = {
+        "incremental_ms": inc_dt * 1e3, "restage_ms": restage_dt * 1e3,
+        "restage_over_incremental": restage_dt / inc_dt}
+    # restore the measured state
+    mgr.invalidate("i")
+    mgr.count("i", shape, leaves, list(range(num_slices)), num_slices)
+
     # executor-level per-call rate (includes per-query relay readback)
     n_exec = 10 if on_tpu else 3
     q = parse_string(pql)

@@ -60,6 +60,20 @@ def parse_duration(s) -> float:
     return total
 
 
+def parse_use_device(value: str):
+    """Shared use-device token parse (config, env, Executor auto):
+    True/False = forced on/off, None = auto. Raises ValueError on
+    anything else so a typo can't silently change serving behavior."""
+    v = (value or "").strip().lower()
+    if v in ("on", "true", "1", "yes"):
+        return True
+    if v in ("off", "false", "0", "no"):
+        return False
+    if v in ("auto", ""):
+        return None
+    raise ValueError(f"use-device must be auto/on/off, got {value!r}")
+
+
 class Config:
     def __init__(self):
         self.data_dir: str = "~/.pilosa_tpu"
@@ -118,15 +132,7 @@ class Config:
         Unrecognized values raise — a typo ("onn") silently falling
         back to auto would leave an operator believing the device path
         is forced while the host fallback serves."""
-        v = self.use_device.strip().lower()
-        if v in ("on", "true", "1", "yes"):
-            return True
-        if v in ("off", "false", "0", "no"):
-            return False
-        if v in ("auto", ""):
-            return None
-        raise ValueError(
-            f"use-device must be auto/on/off, got {self.use_device!r}")
+        return parse_use_device(self.use_device)
 
     def to_toml(self) -> str:
         """Default-config printer (`pilosa config`, ctl/config.go)."""

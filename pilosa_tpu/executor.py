@@ -423,17 +423,29 @@ class Executor:
     def _device_backend_on(self) -> bool:
         """use_device: True forces the device path, False forces host
         roaring, None = auto — the PILOSA_TPU_USE_DEVICE env var if set
-        (1/true/0/false), else device when a TPU backend is live."""
+        (on/off/auto etc., config.parse_use_device), else device when a
+        TPU backend is live. An unparseable env value warns once and
+        falls back to auto rather than failing every query."""
         if self.use_device is False:
             return False
         if self.use_device is None:
             import os
 
-            env = os.environ.get("PILOSA_TPU_USE_DEVICE", "").strip().lower()
-            if env in ("1", "true", "yes", "on"):
-                return True
-            if env in ("0", "false", "no", "off"):
-                return False
+            from .config import parse_use_device
+
+            try:
+                forced = parse_use_device(
+                    os.environ.get("PILOSA_TPU_USE_DEVICE", ""))
+            except ValueError as e:
+                if not getattr(self, "_warned_env", False):
+                    self._warned_env = True
+                    import logging
+
+                    logging.getLogger("pilosa_tpu.executor").warning(
+                        "ignoring PILOSA_TPU_USE_DEVICE: %s", e)
+                forced = None
+            if forced is not None:
+                return forced
             import jax
 
             return jax.default_backend() == "tpu"

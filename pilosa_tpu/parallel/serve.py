@@ -290,6 +290,16 @@ class MeshManager:
         sig = json.dumps(_tree_signature(shape))
         return (sig, tuple(words_t), tuple(idx_t), tuple(hit_t), dev_mask)
 
+    def _count_fn(self, sig: str, num_leaves: int):
+        """Get-or-compile the unbatched serving-count program — the ONE
+        place the (sig, num_leaves) cache key lives."""
+        fkey = (sig, num_leaves)
+        fn = self._count_fns.get(fkey)
+        if fn is None:
+            fn = compile_serve_count(self.mesh, json.loads(sig), num_leaves)
+            self._count_fns[fkey] = fn
+        return fn
+
     def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
         """A zero-arg callable running ONE compiled (unbatched) serving
@@ -299,11 +309,7 @@ class MeshManager:
         if prepared is None:
             return None
         sig, words_t, idx_t, hit_t, dev_mask = prepared
-        fkey = (sig, len(idx_t))
-        fn = self._count_fns.get(fkey)
-        if fn is None:
-            fn = compile_serve_count(self.mesh, json.loads(sig), len(idx_t))
-            self._count_fns[fkey] = fn
+        fn = self._count_fn(sig, len(idx_t))
         return lambda: fn(words_t, idx_t, hit_t, dev_mask)
 
     # -- dynamic batching -----------------------------------------------------
@@ -350,12 +356,7 @@ class MeshManager:
         b = len(group)
         if b == 1:
             sig, words_t, idx_t, hit_t, dev_mask = group[0].args
-            fkey = (sig, len(idx_t))
-            fn = self._count_fns.get(fkey)
-            if fn is None:
-                fn = compile_serve_count(self.mesh, json.loads(sig),
-                                         len(idx_t))
-                self._count_fns[fkey] = fn
+            fn = self._count_fn(sig, len(idx_t))
             group[0].result = combine_count(fn(words_t, idx_t, hit_t,
                                                dev_mask))
             group[0].done.set()

@@ -68,6 +68,10 @@ class SpmdServer:
 
         self.rank = jax.process_index()
         self.manager = MeshManager(holder, mesh=mesh)
+        # AOT-compiled programs keyed by (sig, shapes): compilation must
+        # happen BEFORE the agreement gate (see _execute), and jit only
+        # compiles at first call — lower().compile() forces it eagerly.
+        self._compiled: dict = {}
         # Serializes descriptor broadcast + gate + execute: the HTTP
         # front-end is threaded, and two interleaved
         # broadcast_one_to_all collectives from rank 0 would pair
@@ -147,20 +151,35 @@ class SpmdServer:
         from .mesh import combine_count
 
         leaves = [tuple(leaf) for leaf in desc["leaves"]]
+        compiled = None
         try:
             prepared = self.manager._count_args(
                 desc["index"], desc["shape"], leaves, desc["slices"],
                 desc["num_slices"])
+            if prepared is not None:
+                # Compile BEFORE the gate (jit compiles at first CALL,
+                # so force it with AOT lowering): a per-rank compile
+                # failure must read as not-ready so every rank skips —
+                # compiling after agreement would let warm-cached peers
+                # enter the psum while this rank bails.
+                sig, words_t, idx_t, hit_t, mask = prepared
+                shapes = tuple(
+                    [tuple(w.shape) for w in words_t]
+                    + [tuple(i.shape) for i in idx_t]
+                    + [tuple(mask.shape)])
+                ckey = (sig, shapes)
+                compiled = self._compiled.get(ckey)
+                if compiled is None:
+                    fn = self.manager._count_fn(sig, len(idx_t))
+                    compiled = fn.lower(words_t, idx_t, hit_t,
+                                        mask).compile()
+                    self._compiled[ckey] = compiled
         except Exception:  # noqa: BLE001 — counted as not-ready below
-            prepared = None
-        if prepared is None:
+            compiled = None
+        if compiled is None:
             fp = np.int64(0)
         else:
-            sig, words_t, idx_t, hit_t, mask = prepared
-            shapes = ([tuple(w.shape) for w in words_t]
-                      + [tuple(i.shape) for i in idx_t]
-                      + [tuple(mask.shape)])
-            blob = json.dumps([sig, shapes]).encode()
+            blob = json.dumps([sig, list(shapes)]).encode()
             # NOT hash(): Python string hashing is per-process salted.
             fp = np.int64(zlib.crc32(blob) + 1)
         fps = multihost_utils.process_allgather(fp)
@@ -168,13 +187,4 @@ class SpmdServer:
             return None  # every rank skips: no divergent collective
         # Past the gate, all ranks run the identical program; a runtime
         # failure here hits every rank symmetrically.
-        sig, words_t, idx_t, hit_t, mask = prepared
-        fkey = (sig, len(idx_t))
-        fn = self.manager._count_fns.get(fkey)
-        if fn is None:
-            from .mesh import compile_serve_count
-
-            fn = compile_serve_count(self.manager.mesh, json.loads(sig),
-                                     len(idx_t))
-            self.manager._count_fns[fkey] = fn
-        return combine_count(fn(words_t, idx_t, hit_t, mask))
+        return combine_count(compiled(words_t, idx_t, hit_t, mask))

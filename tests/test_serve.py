@@ -392,6 +392,69 @@ class TestWideCount:
         assert combine_count(fn(*args, mask)) == (s // 2) * (1 << 20)
 
 
+class TestConcurrentWriteQueryFuzz:
+    def test_racing_writes_and_counts_converge(self, holder):
+        """Random set/clear bits racing served counts: in-flight
+        queries may see any prefix of the writes, but after quiescing,
+        the device totals must equal the host's exactly (staleness or
+        double-application in the refresh/scatter path would diverge)."""
+        import threading as th
+
+        rng = np.random.default_rng(17)
+        f = seed(holder, bits=[(r, c) for r in range(4) for c in range(40)])
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        from pilosa_tpu.pql import parse_string
+
+        queries = [parse_string(
+            f"Count(Intersect(Bitmap(rowID={a}), Bitmap(rowID={b})))")
+            for a, b in [(0, 1), (1, 2), (2, 3)]]
+        stop = th.Event()
+        errors = []
+
+        def writer(seed_):
+            rng_ = np.random.default_rng(seed_)  # Generator isn't thread-safe
+            try:
+                while not stop.is_set():
+                    r = int(rng_.integers(0, 4))
+                    c = int(rng_.integers(0, 128))  # stays in container 0
+                    if rng_.random() < 0.7:
+                        f.set_bit(r, c)
+                    else:
+                        f.clear_bit(r, c)
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        def reader(seed_):
+            rng_ = np.random.default_rng(seed_)
+            try:
+                while not stop.is_set():
+                    q_ = queries[int(rng_.integers(0, len(queries)))]
+                    v = e.execute("i", q_)[0]
+                    assert isinstance(v, int) and v >= 0
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        threads = [th.Thread(target=writer, args=(21,)),
+                   th.Thread(target=writer, args=(22,)),
+                   th.Thread(target=reader, args=(23,)),
+                   th.Thread(target=reader, args=(24,))]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        # Quiesced: served results must now match the host exactly.
+        for q_ in queries:
+            assert e.execute("i", q_)[0] == host.execute("i", q_)[0]
+        mgr = e.mesh_manager()
+        assert mgr.stats["count"] > 0
+
+
 class TestDynamicBatching:
     def seed_many_rows(self, holder):
         bits = []
