@@ -356,6 +356,39 @@ def main():
     details["serving_executor_qps"] = {
         "qps": 1.0 / exec_dt, "mean_ms": exec_dt * 1e3}
 
+    # concurrent clients: 16 threads through executor.execute() — the
+    # dynamic batcher coalesces their queries, so the per-batch device
+    # readback amortizes across waiters (what a client POOL sees, vs
+    # the serial per-call number above).
+    _progress("headline: 16 concurrent clients")
+    import threading as _th
+
+    n_cli, per_cli = 16, (6 if on_tpu else 2)
+
+    def run_pool():
+        barrier = _th.Barrier(n_cli + 1)
+
+        def client():
+            barrier.wait()
+            for _ in range(per_cli):
+                assert e.execute("i", q)[0] == dev_count
+
+        threads = [_th.Thread(target=client) for _ in range(n_cli)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    run_pool()  # warm: compiles the batch-width programs
+    conc_dt = run_pool()
+    details["serving_concurrent16_qps"] = {
+        "qps": n_cli * per_cli / conc_dt,
+        "clients": n_cli,
+        "batched_total": e.mesh_manager().stats["batched"]}
+
     # -- config 1: Count(Bitmap(row)) ----------------------------------------
     _progress("count_bitmap")
     first, call1 = serve_count_call(e, "i", "Count(Bitmap(rowID=0))",
