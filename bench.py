@@ -367,11 +367,15 @@ def main():
 
     def run_pool():
         barrier = _th.Barrier(n_cli + 1)
+        errors = []
 
         def client():
             barrier.wait()
-            for _ in range(per_cli):
-                assert e.execute("i", q)[0] == dev_count
+            try:
+                for _ in range(per_cli):
+                    assert e.execute("i", q)[0] == dev_count
+            except Exception as err:  # noqa: BLE001 — fail the bench
+                errors.append(err)
 
         threads = [_th.Thread(target=client) for _ in range(n_cli)]
         for t in threads:
@@ -380,7 +384,10 @@ def main():
         t0 = time.perf_counter()
         for t in threads:
             t.join()
-        return time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        # A dead client finishing early would overstate QPS silently.
+        assert not errors, errors
+        return dt
 
     run_pool()  # warm: compiles the batch-width programs
     conc_dt = run_pool()
