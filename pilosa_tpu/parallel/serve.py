@@ -78,6 +78,13 @@ class StagedView:
         return self.sharded.num_slices
 
 
+def _reraise_shared(what: str, err: BaseException):
+    """Raise a FRESH exception wrapping a shared one: many threads can
+    hold the same failed-group/in-flight error, and re-raising one
+    instance concurrently races on its __traceback__."""
+    raise RuntimeError(f"{what} failed: {err}") from err
+
+
 class _CountRequest:
     """One pending count in the dynamic batch queue."""
 
@@ -119,8 +126,11 @@ class MeshManager:
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
         self._batch_thread: Optional[threading.Thread] = None
         # In-flight row-count executions shared by identical concurrent
-        # callers: key -> [done_event, result, error]
+        # callers: key -> [done_event, result, error]. Own tiny lock —
+        # piggybacking on _mu would make waiter wakeup wait behind an
+        # unrelated multi-second stage/refresh.
         self._inflight: Dict[tuple, list] = {}
+        self._inflight_mu = threading.Lock()
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
         # served device queries, plus cumulative timings.
@@ -435,11 +445,7 @@ class MeshManager:
         self._batch_q.put(req)
         req.done.wait()
         if req.error is not None:
-            # Fresh exception per waiter: up to 16 threads share one
-            # group error, and re-raising the same instance concurrently
-            # races on its __traceback__.
-            raise RuntimeError(
-                f"batched device count failed: {req.error}") from req.error
+            _reraise_shared("batched device count", req.error)
         self.stats["count"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return req.result
@@ -511,7 +517,7 @@ class MeshManager:
         key = (id(sharded.words), id(dev_mask), padded)
 
         def call():
-            with self._mu:
+            with self._inflight_mu:
                 pending = self._inflight.get(key)
                 if pending is None:
                     pending = [threading.Event(), None, None]
@@ -521,13 +527,10 @@ class MeshManager:
                     leader = False
             if not leader:
                 pending[0].wait()
-                self.stats["inflight_shared"] += 1
+                with self._inflight_mu:
+                    self.stats["inflight_shared"] += 1
                 if pending[2] is not None:
-                    # Fresh exception per waiter: re-raising the shared
-                    # instance concurrently races on its __traceback__.
-                    raise RuntimeError(
-                        f"shared row-count failed: {pending[2]}"
-                    ) from pending[2]
+                    _reraise_shared("shared row-count", pending[2])
                 return pending[1]
             try:
                 # Device array, not np: dispatch is async (waiters and
@@ -542,7 +545,7 @@ class MeshManager:
                 pending[2] = e
                 raise
             finally:
-                with self._mu:
+                with self._inflight_mu:
                     self._inflight.pop(key, None)
                 pending[0].set()
 
