@@ -391,10 +391,14 @@ def main():
 
     run_pool()  # warm: compiles the batch-width programs
     conc_dt = run_pool()
+    stats = e.mesh_manager().stats
     details["serving_concurrent16_qps"] = {
         "qps": n_cli * per_cli / conc_dt,
         "clients": n_cli,
-        "batched_total": e.mesh_manager().stats["batched"]}
+        # identical concurrent queries collapse (deduped); distinct
+        # ones coalesce into batch programs (batched)
+        "batched_total": stats["batched"],
+        "deduped_total": stats["deduped"]}
 
     # -- config 1: Count(Bitmap(row)) ----------------------------------------
     _progress("count_bitmap")

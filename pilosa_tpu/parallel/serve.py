@@ -136,8 +136,8 @@ class MeshManager:
         # served device queries, plus cumulative timings.
         self.stats = {
             "stage": 0, "incremental": 0, "count": 0, "topn": 0,
-            "batched": 0, "inflight_shared": 0, "fallback": 0,
-            "stage_us": 0, "query_us": 0,
+            "batched": 0, "deduped": 0, "inflight_shared": 0,
+            "fallback": 0, "stage_us": 0, "query_us": 0,
         }
 
     @property
@@ -381,6 +381,7 @@ class MeshManager:
             else:
                 uniq[key] = r
         group = list(uniq.values())
+        self.stats["deduped"] += len(dups)
 
         def _propagate():
             for r, key in dups:
