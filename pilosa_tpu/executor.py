@@ -506,15 +506,29 @@ class Executor:
         return pairs
 
     def _mesh_top_n_batch(self, index: str, c: Call):
-        """A batch_fn serving plain TopN (and its exact ids phase 2) as
-        one masked row-count collective; None when the call needs host
-        state (src intersection, attr filters, tanimoto)."""
+        """A batch_fn serving TopN (and its exact ids phase 2) as one
+        masked row-count collective — including a src bitmap child,
+        which evaluates on device (serve.row_counts_src); None when the
+        call needs host state (attr filters, tanimoto, a non-lowerable
+        src tree)."""
         mgr = self.mesh_manager()
-        if mgr is None or c.children or c.args.get("filters"):
+        if mgr is None or c.args.get("filters"):
             return None
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
         if tanimoto:
             return None
+        src = None
+        if c.children:
+            if len(c.children) > 1:
+                return None
+            from .parallel.plan import _lower_tree
+
+            src_leaves: list = []
+            src_shape = _lower_tree(self.holder, index, c.children[0],
+                                    src_leaves)
+            if src_shape is None or not src_leaves:
+                return None
+            src = (src_shape, src_leaves)
         frame = c.args.get("frame") or DEFAULT_FRAME
         n, _ = c.uint_arg("n")
         row_ids, _ = c.uint_slice_arg("ids")
@@ -526,7 +540,7 @@ class Executor:
                     index, frame, VIEW_STANDARD, batch_slices,
                     self._batch_num_slices(index, batch_slices),
                     0 if row_ids else n, row_ids,
-                    min_threshold or MIN_THRESHOLD)
+                    min_threshold or MIN_THRESHOLD, src=src)
             except Exception:  # noqa: BLE001 — any device failure → host path
                 return None
 

@@ -45,6 +45,7 @@ _MESH_NAMES = (
     "compile_serve_count",
     "compile_serve_count_batch",
     "compile_serve_row_counts",
+    "compile_serve_row_counts_src",
     "connect_distributed",
     "default_mesh",
     "pack_mutation_batches",
@@ -76,6 +77,7 @@ __all__ = [
     "compile_serve_count",
     "compile_serve_count_batch",
     "compile_serve_row_counts",
+    "compile_serve_row_counts_src",
     "pack_mutation_batches",
     "compile_mesh_apply_writes",
     "compile_mesh_count",

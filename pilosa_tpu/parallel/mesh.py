@@ -569,6 +569,75 @@ def compile_serve_count_batch(mesh: Mesh, tree_shape, num_leaves: int,
     return run
 
 
+def compile_serve_row_counts_src(mesh: Mesh, tree_shape, num_leaves: int,
+                                 num_rows: int):
+    """Jit masked per-row SRC-INTERSECTION counts: |row ∩ src| for
+    every row of one view, where src is a lowered bitmap-op tree
+    (reference TopN src semantics, fragment.go:564-608 — there a
+    host loop re-intersecting rows one by one; here ONE fused pass).
+
+    Returns fn(keys (S, cap), words (S, cap, 2048) — the TopN view's
+    pool — src_words_t/src_idx_t/src_hit_t (per src leaf, as in
+    compile_serve_count), mask (S,)) -> (2, num_rows) limb array.
+    Each container ANDs against the src block of its own sub-key
+    (key mod 16), then popcounts segment-sum by dense row.
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    from ..ops.bitops import fold_tree
+
+    def per_shard(keys, words, src_words_t, src_idx_t, src_hit_t, mask):
+        s_l, cap_l = keys.shape
+
+        def leaf(i):
+            w = src_words_t[i]
+            c = w.shape[1]
+            wflat = w.reshape(w.shape[0] * c, w.shape[2])
+            base = (jnp.arange(w.shape[0], dtype=jnp.int32) * c)[:, None]
+            blk = wflat[(src_idx_t[i] + base).reshape(-1)]
+            return blk * src_hit_t[i].reshape(-1)[:, None]
+
+        src_blk = fold_tree(tree, leaf).reshape(
+            s_l, ROW_SPAN, CONTAINER_WORDS)
+
+        valid = keys != INVALID_KEY
+        sub = jnp.where(valid, keys % ROW_SPAN, 0)          # (S, cap)
+        # Per-container src sub-block: gather (S, cap, W) from
+        # (S, 16, W) — XLA fuses this into the AND+popcount consumer.
+        src_per_container = jnp.take_along_axis(
+            src_blk, sub[:, :, None], axis=1)
+        pc = lax.population_count(words & src_per_container).sum(
+            axis=2, dtype=jnp.int32)                         # (S, cap)
+        dense = jnp.where(valid, keys // ROW_SPAN, num_rows)
+        pc = jnp.where(valid & (mask[:, None] != 0), pc, 0)
+
+        def one_slice(pc_row, dense_row):
+            return jax.ops.segment_sum(pc_row, dense_row,
+                                       num_segments=num_rows + 1)[:num_rows]
+
+        local = jax.vmap(one_slice)(pc, dense)               # (S, R)
+        lo = lax.psum((local & 0xFFFF).sum(axis=0), SLICE_AXIS)
+        hi = lax.psum((local >> 16).sum(axis=0), SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS), P(SLICE_AXIS),
+                  (P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * num_leaves,
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def run(keys, words, src_words_t, src_idx_t, src_hit_t, mask):
+        return fn(keys, words, src_words_t, src_idx_t, src_hit_t, mask)
+
+    return run
+
+
 def compile_serve_row_counts(mesh: Mesh, num_rows: int):
     """Jit masked global per-row counts for one sharded view.
 

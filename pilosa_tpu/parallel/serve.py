@@ -45,6 +45,7 @@ from .mesh import (
     compile_serve_count,
     compile_serve_count_batch,
     compile_serve_row_counts,
+    compile_serve_row_counts_src,
     default_mesh,
     pack_mutation_batches,
     resolve_row_indices,
@@ -121,6 +122,7 @@ class MeshManager:
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._batch_fns: Dict[tuple, object] = {}
         self._rowcount_fns: Dict[int, object] = {}
+        self._rowcount_src_fns: Dict[tuple, object] = {}
         self._apply_fn = None
         self._mask_cache: Dict[bytes, object] = {}
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
@@ -274,35 +276,47 @@ class MeshManager:
         and another after would mix two generations of the same view.
         Only compiled calls run unlocked."""
         with self._mu:
-            staged: Dict[Tuple[str, str], tuple] = {}
-            for frame, view, _row_id, _req in leaves:
-                vkey = (frame, view)
-                if vkey not in staged:
-                    sv = self.refresh(index, frame, view, num_slices)
-                    if sv is None:
-                        self.stats["fallback"] += 1
-                        return None
-                    staged[vkey] = (sv, sv.sharded.words)
-            first = next(iter(staged.values()))[0]
+            out = self._stage_leaves(index, leaves, num_slices)
+            if out is None:
+                return None
+            words_t, idx_t, hit_t, first = out
             mask = self._mask_for(first, slices)
             if mask is None:
                 self.stats["fallback"] += 1
                 return None
-
-            words_t, idx_t, hit_t = [], [], []
-            for frame, view, row_id, _req in leaves:
-                sv, words = staged[(frame, view)]
-                i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
-                if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
-                    i = len(sv.row_ids)  # absent row: resolver yields hit=0
-                flat_idx, hit = self._leaf_arrays(sv, i)
-                words_t.append(words)
-                idx_t.append(flat_idx)
-                hit_t.append(hit)
             dev_mask = self._device_mask(mask)
 
         sig = json.dumps(_tree_signature(shape))
-        return (sig, tuple(words_t), tuple(idx_t), tuple(hit_t), dev_mask)
+        return (sig, words_t, idx_t, hit_t, dev_mask)
+
+    def _stage_leaves(self, index: str, leaves, num_slices: int):
+        """Stage every leaf's (frame, view) and resolve its row into
+        cached device gather arrays. Call under _mu (staging snapshot
+        consistency — see _count_args). Returns
+        (words_t, idx_t, hit_t, first_staged_view) or None; an absent
+        row maps to the past-the-end dense sentinel, which the resolver
+        turns into hit=0 everywhere. Shared by the Count path and the
+        TopN src path so absent-row/staging semantics can't diverge."""
+        staged: Dict[Tuple[str, str], tuple] = {}
+        words_t, idx_t, hit_t = [], [], []
+        for frame, view, row_id, _req in leaves:
+            vkey = (frame, view)
+            if vkey not in staged:
+                sv = self.refresh(index, frame, view, num_slices)
+                if sv is None:
+                    self.stats["fallback"] += 1
+                    return None
+                staged[vkey] = (sv, sv.sharded.words)
+            sv, words = staged[vkey]
+            i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
+            if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
+                i = len(sv.row_ids)  # absent row: resolver yields hit=0
+            flat_idx, hit = self._leaf_arrays(sv, i)
+            words_t.append(words)
+            idx_t.append(flat_idx)
+            hit_t.append(hit)
+        first = next(iter(staged.values()))[0]
+        return tuple(words_t), tuple(idx_t), tuple(hit_t), first
 
     def _count_fn(self, sig: str, num_leaves: int):
         """Get-or-compile the unbatched serving-count program — the ONE
@@ -516,41 +530,41 @@ class MeshManager:
                 self._rowcount_fns[padded] = fn
             dev_mask = self._device_mask(mask)
         key = (id(sharded.words), id(dev_mask), padded)
+        return sv.row_ids, (
+            lambda: self._single_flight(key, lambda: fn(sharded, dev_mask)))
 
-        def call():
+    def _single_flight(self, key: tuple, compute):
+        """Share one in-flight device execution among identical
+        concurrent callers. Returns compute()'s DEVICE array — dispatch
+        is async (callers block only when they fetch the value, and jax
+        caches the fetched host copy on the array), so benchmarks can
+        still chain outputs without a per-call sync."""
+        with self._inflight_mu:
+            pending = self._inflight.get(key)
+            if pending is None:
+                pending = [threading.Event(), None, None]
+                self._inflight[key] = pending
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            pending[0].wait()
             with self._inflight_mu:
-                pending = self._inflight.get(key)
-                if pending is None:
-                    pending = [threading.Event(), None, None]
-                    self._inflight[key] = pending
-                    leader = True
-                else:
-                    leader = False
-            if not leader:
-                pending[0].wait()
-                with self._inflight_mu:
-                    self.stats["inflight_shared"] += 1
-                if pending[2] is not None:
-                    _reraise_shared("shared row-count", pending[2])
-                return pending[1]
-            try:
-                # Device array, not np: dispatch is async (waiters and
-                # callers block only when they fetch the value, and jax
-                # caches the fetched host copy on the array), so
-                # benchmarks can still chain device outputs without a
-                # per-call sync.
-                out = fn(sharded, dev_mask)
-                pending[1] = out
-                return out
-            except Exception as e:
-                pending[2] = e
-                raise
-            finally:
-                with self._inflight_mu:
-                    self._inflight.pop(key, None)
-                pending[0].set()
-
-        return sv.row_ids, call
+                self.stats["inflight_shared"] += 1
+            if pending[2] is not None:
+                _reraise_shared("shared device query", pending[2])
+            return pending[1]
+        try:
+            out = compute()
+            pending[1] = out
+            return out
+        except Exception as e:
+            pending[2] = e
+            raise
+        finally:
+            with self._inflight_mu:
+                self._inflight.pop(key, None)
+            pending[0].set()
 
     def row_counts(self, index: str, frame: str, view: str,
                    slices: Sequence[int], num_slices: int):
@@ -573,14 +587,66 @@ class MeshManager:
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return row_ids, counts
 
+    def row_counts_src(self, index: str, frame: str, view: str,
+                       src_shape, src_leaves, slices: Sequence[int],
+                       num_slices: int):
+        """Exact per-row SRC-INTERSECTION counts: the src bitmap-op
+        tree evaluates per slice and ANDs against every row in one
+        fused pass (the device form of the reference's per-row
+        src.intersection_count loop, fragment.go:564-608). Returns
+        (row_ids, counts int64) or None."""
+        t0 = time.monotonic()
+        with self._mu:
+            sv = self.refresh(index, frame, view, num_slices)
+            if sv is None:
+                self.stats["fallback"] += 1
+                return None
+            sharded = sv.sharded
+            mask = self._mask_for(sv, slices)
+            if mask is None:
+                self.stats["fallback"] += 1
+                return None
+            if len(sv.row_ids) == 0:
+                return sv.row_ids, np.zeros(0, dtype=np.int64)
+
+            out = self._stage_leaves(index, src_leaves, num_slices)
+            if out is None:
+                return None
+            words_t, idx_t, hit_t, _first = out
+            dev_mask = self._device_mask(mask)
+            padded = 1 << (len(sv.row_ids) - 1).bit_length()
+            sig = json.dumps(_tree_signature(src_shape))
+            fkey = (sig, len(src_leaves), padded)
+            fn = self._rowcount_src_fns.get(fkey)
+            if fn is None:
+                fn = compile_serve_row_counts_src(
+                    self.mesh, json.loads(sig), len(src_leaves), padded)
+                self._rowcount_src_fns[fkey] = fn
+
+        key = (id(sharded.words), id(dev_mask), padded, sig,
+               tuple(id(a) for a in idx_t))
+        limbs = np.asarray(self._single_flight(
+            key, lambda: fn(sharded.keys, sharded.words, words_t,
+                            idx_t, hit_t, dev_mask)))
+        r = len(sv.row_ids)
+        counts = ((limbs[1, :r].astype(np.int64) << 16)
+                  + limbs[0, :r].astype(np.int64))
+        self.stats["topn"] += 1
+        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        return sv.row_ids, counts
+
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,
-              row_ids: Sequence[int], min_threshold: int
+              row_ids: Sequence[int], min_threshold: int,
+              src: Optional[tuple] = None
               ) -> Optional[List[Tuple[int, int]]]:
-        """Serve a plain TopN (no src / attr filters / tanimoto — the
-        executor keeps those on the host path): exact device counts,
-        host-side threshold/candidate/n semantics. With `row_ids` this
-        is also TopN's exact phase 2 (executor.go:273-310).
+        """Serve TopN (attr filters / tanimoto stay on the host path):
+        exact device counts, host-side threshold/candidate/n
+        semantics. With `row_ids` this is also TopN's exact phase 2
+        (executor.go:273-310). With `src` = (shape, leaves) — a
+        lowered bitmap-op tree — counts are |row ∩ src| (the
+        reference's src path, fragment.go:564-608), one fused device
+        pass instead of a per-row host intersection loop.
 
         Deliberate deviation from the reference: `threshold` filters
         the EXACT node-local totals, not each slice's partial count.
@@ -600,7 +666,11 @@ class MeshManager:
         writes pays no re-upload either — the two costs the rank cache
         amortizes on the host both vanish.
         """
-        out = self.row_counts(index, frame, view, slices, num_slices)
+        if src is not None:
+            out = self.row_counts_src(index, frame, view, src[0], src[1],
+                                      slices, num_slices)
+        else:
+            out = self.row_counts(index, frame, view, slices, num_slices)
         if out is None:
             return None
         all_rows, counts = out

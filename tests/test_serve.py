@@ -296,6 +296,37 @@ class TestServedTopN:
             assert dev == exact[:n]
         assert e.mesh_manager().stats["topn"] > 0
 
+    def test_topn_src_bitmap_on_device(self, holder, monkeypatch):
+        """TopN(Bitmap(src), ...) — the src tree evaluates on device
+        and intersects every row in one pass; results must match the
+        host path exactly (small data: host phase 1 is complete)."""
+        rng = np.random.default_rng(7)
+        f = seed(holder)
+        for r in range(12):
+            for c in rng.choice(SLICE_WIDTH * 2, size=5 * (r + 1),
+                                replace=False):
+                f.set_bit(r, int(c))
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        for pql in (
+            "TopN(Bitmap(rowID=11, frame=general), frame=general, n=6)",
+            "TopN(Bitmap(rowID=11, frame=general), frame=general)",
+            "TopN(Intersect(Bitmap(rowID=10, frame=general), "
+            "Bitmap(rowID=11, frame=general)), frame=general, n=4)",
+            "TopN(Bitmap(rowID=11, frame=general), frame=general, "
+            "ids=[2, 5, 9])",
+        ):
+            dev = q(e, "i", pql)[0]
+            want = q(host, "i", pql)[0]
+            assert dev == want, (pql, dev, want)
+        assert e.mesh_manager().stats["topn"] > 0
+
+    def test_topn_src_empty_row(self, holder):
+        f = seed(holder, bits=[(1, 0), (1, 5), (2, 5)])
+        e = Executor(holder, use_device=True)
+        pql = "TopN(Bitmap(rowID=99, frame=general), frame=general, n=5)"
+        assert q(e, "i", pql) == [[]]
+
     def test_topn_filters_stay_on_host(self, holder):
         f = self.seed_rows(holder, rows=6)
         f.row_attr_store.set_attrs(3, {"cat": "x"})
