@@ -507,16 +507,31 @@ class Executor:
 
     def _mesh_top_n_batch(self, index: str, c: Call):
         """A batch_fn serving TopN (and its exact ids phase 2) as one
-        masked row-count collective — including a src bitmap child,
-        which evaluates on device (serve.row_counts_src); None when the
-        call needs host state (attr filters, tanimoto, a non-lowerable
-        src tree)."""
+        masked row-count collective — including a src bitmap child
+        (evaluated on device, serve.row_counts_src) and attr filters
+        (exact device counts + a bounded host attr walk); None when
+        the call needs tanimoto or a non-lowerable src tree."""
         mgr = self.mesh_manager()
-        if mgr is None or c.args.get("filters"):
+        if mgr is None:
             return None
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
         if tanimoto:
             return None
+        attr_predicate = None
+        filters = c.args.get("filters")
+        field = c.args.get("field") or ""
+        if filters and field:
+            f_obj = self.holder.frame(index,
+                                      c.args.get("frame") or DEFAULT_FRAME)
+            if f_obj is None or f_obj.row_attr_store is None:
+                return None
+            store, allowed = f_obj.row_attr_store, set(filters)
+
+            def attr_predicate(row_id):
+                attr = store.attrs(row_id)
+                return bool(attr) and attr.get(field) in allowed
+        elif filters:
+            return None  # filters without a field: host path owns errors
         src = None
         if c.children:
             if len(c.children) > 1:
@@ -540,7 +555,8 @@ class Executor:
                     index, frame, VIEW_STANDARD, batch_slices,
                     self._batch_num_slices(index, batch_slices),
                     0 if row_ids else n, row_ids,
-                    min_threshold or MIN_THRESHOLD, src=src)
+                    min_threshold or MIN_THRESHOLD, src=src,
+                    attr_predicate=attr_predicate)
             except Exception:  # noqa: BLE001 — any device failure → host path
                 return None
 

@@ -638,15 +638,18 @@ class MeshManager:
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,
               row_ids: Sequence[int], min_threshold: int,
-              src: Optional[tuple] = None
+              src: Optional[tuple] = None,
+              attr_predicate=None
               ) -> Optional[List[Tuple[int, int]]]:
-        """Serve TopN (attr filters / tanimoto stay on the host path):
-        exact device counts, host-side threshold/candidate/n
-        semantics. With `row_ids` this is also TopN's exact phase 2
+        """Serve TopN (only tanimoto stays on the host path): exact
+        device counts, host-side threshold/candidate/n semantics. With
+        `row_ids` this is also TopN's exact phase 2
         (executor.go:273-310). With `src` = (shape, leaves) — a
         lowered bitmap-op tree — counts are |row ∩ src| (the
         reference's src path, fragment.go:564-608), one fused device
-        pass instead of a per-row host intersection loop.
+        pass instead of a per-row host intersection loop. With
+        `attr_predicate`, the exact-count walk applies the host-side
+        attribute filter until n rows match (bounded store lookups).
 
         Deliberate deviation from the reference: `threshold` filters
         the EXACT node-local totals, not each slice's partial count.
@@ -674,6 +677,8 @@ class MeshManager:
         if out is None:
             return None
         all_rows, counts = out
+        if len(all_rows) == 0:
+            return []
         if row_ids:
             want = np.asarray(sorted(row_ids), dtype=np.uint64)
             i = np.searchsorted(all_rows, want)
@@ -681,12 +686,25 @@ class MeshManager:
             ok &= all_rows[np.minimum(i, max(len(all_rows) - 1, 0))] == want
             pairs = [(int(r), int(counts[j]))
                      for r, j in zip(want[ok], i[ok])
-                     if counts[j] >= max(min_threshold, 1)]
+                     if counts[j] >= max(min_threshold, 1)
+                     and (attr_predicate is None or attr_predicate(int(r)))]
             pairs.sort(key=lambda p: (-p[1], p[0]))
             return pairs
         keep = np.nonzero(counts >= max(min_threshold, 1))[0]
         order = np.lexsort((all_rows[keep], -counts[keep]))
-        if n:
-            order = order[:n]
         keep = keep[order]
-        return [(int(all_rows[j]), int(counts[j])) for j in keep]
+        if attr_predicate is None:
+            if n:
+                keep = keep[:n]
+            return [(int(all_rows[j]), int(counts[j])) for j in keep]
+        # Attr filters (reference fragment.go:538-546): counts are
+        # already exact, so walk the sorted rows applying the host-side
+        # attribute predicate until n match — attr-store lookups stay
+        # bounded near n instead of scanning every row.
+        out: List[Tuple[int, int]] = []
+        for j in keep:
+            if attr_predicate(int(all_rows[j])):
+                out.append((int(all_rows[j]), int(counts[j])))
+                if n and len(out) == n:
+                    break
+        return out

@@ -296,7 +296,7 @@ class TestServedTopN:
             assert dev == exact[:n]
         assert e.mesh_manager().stats["topn"] > 0
 
-    def test_topn_src_bitmap_on_device(self, holder, monkeypatch):
+    def test_topn_src_bitmap_on_device(self, holder):
         """TopN(Bitmap(src), ...) — the src tree evaluates on device
         and intersects every row in one pass; results must match the
         host path exactly (small data: host phase 1 is complete)."""
@@ -327,14 +327,37 @@ class TestServedTopN:
         pql = "TopN(Bitmap(rowID=99, frame=general), frame=general, n=5)"
         assert q(e, "i", pql) == [[]]
 
-    def test_topn_filters_stay_on_host(self, holder):
-        f = self.seed_rows(holder, rows=6)
+    def test_topn_ids_on_empty_view(self, holder):
+        """ids recount against a frame with no rows: [] (a regression
+        here crashed on an empty staged row table)."""
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general")
+        f.set_bit(1, 0)
+        f.clear_bit(1, 0)  # view exists, zero containers
+        e = Executor(holder, use_device=True)
+        mgr = e.mesh_manager()
+        out = mgr.top_n("i", "general", "standard", [0], 1, 0, [1, 2], 1)
+        assert out == []
+
+    def test_topn_attr_filters_device_counts_host_walk(self, holder):
+        """Attr-filtered TopN: exact device counts + a bounded host
+        attr walk — matches the host path; tanimoto stays host-only."""
+        f = self.seed_rows(holder, rows=8)
         f.row_attr_store.set_attrs(3, {"cat": "x"})
+        f.row_attr_store.set_attrs(6, {"cat": "x"})
+        f.row_attr_store.set_attrs(7, {"cat": "y"})
         e = Executor(holder, use_device=True)
         host = Executor(holder, use_device=False)
-        pql = 'TopN(frame=general, n=5, field="cat", filters=["x"])'
+        for pql in ('TopN(frame=general, n=5, field="cat", filters=["x"])',
+                    'TopN(frame=general, field="cat", filters=["x", "y"])'):
+            assert q(e, "i", pql) == q(host, "i", pql)
+        assert e.mesh_manager().stats["topn"] > 0
+        # Tanimoto keeps the host path.
+        before = e.mesh_manager().stats["topn"]
+        pql = ("TopN(Bitmap(rowID=7, frame=general), frame=general, n=3, "
+               "tanimotoThreshold=50)")
         assert q(e, "i", pql) == q(host, "i", pql)
-        assert e.mesh_manager().stats["topn"] == 0
+        assert e.mesh_manager().stats["topn"] == before
 
 
 class TestFragmentPoolIncremental:
