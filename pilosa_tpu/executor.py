@@ -506,17 +506,19 @@ class Executor:
         return pairs
 
     def _mesh_top_n_batch(self, index: str, c: Call):
-        """A batch_fn serving TopN (and its exact ids phase 2) as one
-        masked row-count collective — including a src bitmap child
-        (evaluated on device, serve.row_counts_src) and attr filters
-        (exact device counts + a bounded host attr walk); None when
-        the call needs tanimoto or a non-lowerable src tree."""
+        """A batch_fn serving TopN (and its exact ids phase 2) as
+        masked row-count collectives — including a src bitmap child
+        (evaluated on device, serve.row_counts_src), attr filters
+        (exact device counts + a bounded host attr walk), and tanimoto
+        (band math over three exact device vectors); None only for a
+        non-lowerable src tree or malformed args (host path owns the
+        error reporting)."""
         mgr = self.mesh_manager()
         if mgr is None:
             return None
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
-        if tanimoto:
-            return None
+        if tanimoto > 100:
+            return None  # host path owns the error
         attr_predicate = None
         filters = c.args.get("filters")
         field = c.args.get("field") or ""
@@ -533,6 +535,8 @@ class Executor:
         elif filters:
             return None  # filters without a field: host path owns errors
         src = None
+        if tanimoto and not c.children:
+            return None  # tanimoto requires a src bitmap
         if c.children:
             if len(c.children) > 1:
                 return None
@@ -556,7 +560,8 @@ class Executor:
                     self._batch_num_slices(index, batch_slices),
                     0 if row_ids else n, row_ids,
                     min_threshold or MIN_THRESHOLD, src=src,
-                    attr_predicate=attr_predicate)
+                    attr_predicate=attr_predicate,
+                    tanimoto_threshold=tanimoto)
             except Exception:  # noqa: BLE001 — any device failure → host path
                 return None
 

@@ -587,6 +587,69 @@ class MeshManager:
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return row_ids, counts
 
+    def _top_n_tanimoto(self, index: str, frame: str, view: str, src,
+                        slices: Sequence[int], num_slices: int, n: int,
+                        tanimoto: int, row_ids: Sequence[int] = (),
+                        attr_predicate=None
+                        ) -> Optional[List[Tuple[int, int]]]:
+        """Tanimoto-banded TopN from three exact device vectors: full
+        per-row counts, per-row src-intersection counts, and |src| —
+        then the reference's band math on the host
+        (fragment.go:550-560,580-585: candidacy band on full counts,
+        ceil similarity check on the intersect counts).
+
+        The three vectors come from separate collectives; a write
+        landing between them would zip counts from different
+        generations, so the staged image is re-checked afterwards and a
+        changed view falls back (None → host path) rather than serving
+        a band no single snapshot would produce."""
+        key = (index, frame, view)
+        with self._mu:
+            sv0 = self.refresh(index, frame, view, num_slices)
+            if sv0 is None:
+                return None
+            words0, rows0 = sv0.sharded.words, sv0.row_ids
+        out = self.row_counts(index, frame, view, slices, num_slices)
+        if out is None:
+            return None
+        all_rows, full = out
+        out = self.row_counts_src(index, frame, view, src[0], src[1],
+                                  slices, num_slices)
+        if out is None:
+            return None
+        _, inter = out
+        src_count = self.count(index, src[0], src[1], slices, num_slices)
+        if src_count is None:
+            return None
+        with self._mu:
+            sv1 = self._views.get(key)
+            if (sv1 is None or sv1.sharded.words is not words0
+                    or sv1.row_ids is not rows0):
+                self.stats["fallback"] += 1
+                return None  # image changed mid-query: host path
+        if len(all_rows) == 0 or src_count == 0:
+            return []
+        min_tan = src_count * tanimoto / 100.0
+        max_tan = src_count * 100.0 / tanimoto
+        wanted = set(int(r) for r in row_ids) if row_ids else None
+        pairs: List[Tuple[int, int]] = []
+        for j in np.lexsort((all_rows, -inter)):
+            if wanted is not None and int(all_rows[j]) not in wanted:
+                continue  # exact ids recount phase (executor.go:273-310)
+            cnt, count = int(full[j]), int(inter[j])
+            if cnt <= min_tan or cnt >= max_tan or count == 0:
+                continue
+            t = -(-100 * count // (cnt + src_count - count))  # ceil
+            if t <= tanimoto:
+                continue
+            if attr_predicate is not None and not attr_predicate(
+                    int(all_rows[j])):
+                continue
+            pairs.append((int(all_rows[j]), count))
+            if n and len(pairs) == n:
+                break
+        return pairs
+
     def row_counts_src(self, index: str, frame: str, view: str,
                        src_shape, src_leaves, slices: Sequence[int],
                        num_slices: int):
@@ -639,10 +702,10 @@ class MeshManager:
               slices: Sequence[int], num_slices: int, n: int,
               row_ids: Sequence[int], min_threshold: int,
               src: Optional[tuple] = None,
-              attr_predicate=None
+              attr_predicate=None, tanimoto_threshold: int = 0
               ) -> Optional[List[Tuple[int, int]]]:
-        """Serve TopN (only tanimoto stays on the host path): exact
-        device counts, host-side threshold/candidate/n semantics. With
+        """Serve TopN — every argument form — from exact device
+        counts with host-side threshold/candidate/n semantics. With
         `row_ids` this is also TopN's exact phase 2
         (executor.go:273-310). With `src` = (shape, leaves) — a
         lowered bitmap-op tree — counts are |row ∩ src| (the
@@ -650,6 +713,8 @@ class MeshManager:
         pass instead of a per-row host intersection loop. With
         `attr_predicate`, the exact-count walk applies the host-side
         attribute filter until n rows match (bounded store lookups).
+        With `tanimoto_threshold`, the reference's similarity band
+        evaluates over three exact device vectors (_top_n_tanimoto).
 
         Deliberate deviation from the reference: `threshold` filters
         the EXACT node-local totals, not each slice's partial count.
@@ -669,6 +734,13 @@ class MeshManager:
         writes pays no re-upload either — the two costs the rank cache
         amortizes on the host both vanish.
         """
+        if tanimoto_threshold > 0:
+            if src is None:
+                return None
+            return self._top_n_tanimoto(index, frame, view, src, slices,
+                                        num_slices, 0 if row_ids else n,
+                                        tanimoto_threshold, row_ids,
+                                        attr_predicate)
         if src is not None:
             out = self.row_counts_src(index, frame, view, src[0], src[1],
                                       slices, num_slices)

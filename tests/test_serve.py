@@ -339,6 +339,22 @@ class TestServedTopN:
         out = mgr.top_n("i", "general", "standard", [0], 1, 0, [1, 2], 1)
         assert out == []
 
+    def test_topn_tanimoto_with_attr_filters(self, holder):
+        """filters + tanimoto combined: the attr predicate must apply
+        inside the tanimoto walk (regression: the device path once
+        dropped filters when tanimoto was set)."""
+        rng = np.random.default_rng(31)
+        f = seed(holder)
+        for r in range(6):
+            for c in rng.choice(4096, size=80 * (r + 1), replace=False):
+                f.set_bit(r, int(c))
+        f.row_attr_store.set_attrs(3, {"cat": "x"})
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = ('TopN(Bitmap(rowID=5, frame=general), frame=general, n=5, '
+               'field="cat", filters=["x"], tanimotoThreshold=10)')
+        assert q(e, "i", pql) == q(host, "i", pql)
+
     def test_topn_attr_filters_device_counts_host_walk(self, holder):
         """Attr-filtered TopN: exact device counts + a bounded host
         attr walk — matches the host path; tanimoto stays host-only."""
@@ -352,12 +368,26 @@ class TestServedTopN:
                     'TopN(frame=general, field="cat", filters=["x", "y"])'):
             assert q(e, "i", pql) == q(host, "i", pql)
         assert e.mesh_manager().stats["topn"] > 0
-        # Tanimoto keeps the host path.
-        before = e.mesh_manager().stats["topn"]
-        pql = ("TopN(Bitmap(rowID=7, frame=general), frame=general, n=3, "
-               "tanimotoThreshold=50)")
-        assert q(e, "i", pql) == q(host, "i", pql)
-        assert e.mesh_manager().stats["topn"] == before
+
+    def test_topn_tanimoto_on_device(self, holder):
+        """Tanimoto band from three exact device vectors. Single-slice
+        data: the host applies the candidacy band to per-slice counts,
+        the device to exact totals — they only provably coincide when
+        one slice holds everything."""
+        rng = np.random.default_rng(29)
+        f = seed(holder)
+        for r in range(10):
+            for c in rng.choice(4096, size=40 * (r + 1), replace=False):
+                f.set_bit(r, int(c))
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        for t in (30, 60, 90):
+            pql = ("TopN(Bitmap(rowID=9, frame=general), frame=general, "
+                   f"n=5, tanimotoThreshold={t})")
+            dev = q(e, "i", pql)[0]
+            want = q(host, "i", pql)[0]
+            assert dev == want, (t, dev, want)
+        assert e.mesh_manager().stats["topn"] > 0
 
 
 class TestFragmentPoolIncremental:
