@@ -104,13 +104,17 @@ class Executor:
     """
 
     def __init__(self, holder, host: str = "", cluster=None, client=None,
-                 use_device: Optional[bool] = None, max_workers: int = 8):
+                 use_device: Optional[bool] = None, max_workers: int = 8,
+                 device_min_work: Optional[int] = None):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.client = client
         # None = auto (device path when available); False = host roaring only.
         self.use_device = use_device
+        # Cost-routing threshold (see _route_to_host); None = resolve
+        # from PILOSA_TPU_DEVICE_MIN_WORK / the use_device mode.
+        self.device_min_work = device_min_work
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         # Separate pool for per-slice fan-out: _mapper submits node-level
         # tasks to _pool that block on slice-level results, so sharing
@@ -324,13 +328,16 @@ class Executor:
         # Lower the tree ONCE; both device paths share it. The
         # per-slice CountPlan is only built if the mesh batch declines
         # (it compiles per-slice jits the batch path never uses).
+        # Cost routing (_route_to_host) may decline the device entirely:
+        # lowered stays None and the map_fn serves host roaring.
         lowered = None
         if self._device_backend_on():
             from .parallel.plan import _lower_tree
 
             leaves: list = []
             shape = _lower_tree(self.holder, index, child, leaves)
-            if shape is not None and leaves:
+            if shape is not None and leaves \
+                    and not self._route_to_host(len(slices), len(leaves)):
                 lowered = (shape, leaves)
 
         plan_cell: list = []
@@ -419,6 +426,44 @@ class Executor:
                 return None
 
         return batch_fn
+
+    # Default cost-routing threshold, in work units (slices × tree
+    # leaves). Measured on the r2 rig: the device pays a ~2 ms dispatch
+    # floor per query while the host C++ kernels cost ~10 µs per
+    # slice-leaf unit (960 slices × 2 leaves ≈ 18 ms host, 2.8 ms
+    # device) — crossover ≈ 200 units. The reference has no such split:
+    # its per-query cost is flat regardless of size
+    # (executor.go:567-597); here small queries must not pay the floor
+    # (r2 measured nary_* at 26-270× SLOWER than host without routing).
+    _DEFAULT_MIN_WORK = 192
+
+    def _route_to_host(self, num_slices: int, num_leaves: int) -> bool:
+        """True when a lowerable Count tree should serve from the host
+        C++ kernels anyway: estimated device benefit below threshold.
+        Threshold resolution: explicit device_min_work arg >
+        PILOSA_TPU_DEVICE_MIN_WORK env > _DEFAULT_MIN_WORK. The cost
+        model applies in EVERY device mode — use_device picks which
+        backends are available, not which engine a given query should
+        pay for; 0 disables routing (every lowerable tree → mesh).
+        Routed queries count in /debug/vars mesh stats (routed_host)."""
+        thr = self.device_min_work
+        if thr is None:
+            import os
+
+            env = os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK", "")
+            if env:
+                try:
+                    thr = int(env)
+                except ValueError:
+                    thr = None
+            if thr is None:
+                thr = self._DEFAULT_MIN_WORK
+        if thr <= 0 or num_slices * max(1, num_leaves) >= thr:
+            return False
+        mgr = self.mesh_manager()
+        if mgr is not None:
+            mgr.stats["routed_host"] += 1
+        return True
 
     def _device_backend_on(self) -> bool:
         """use_device: True forces the device path, False forces host

@@ -444,6 +444,45 @@ def resolve_row_indices(keys_host: np.ndarray, dense_id: int):
     return within.astype(np.int32), hit.reshape(s, ROW_SPAN)
 
 
+def _gather_leaf_blocks(words_t, idx_t, hit_t, i):
+    """One leaf's (S_local*16, CONTAINER_WORDS) gathered blocks for the
+    serving kernels: a flat gather from the leaf's own pool using the
+    host-resolved within-slice indices, zeroed where the container is
+    absent (hit == 0). The ONE implementation every compile_serve_*
+    kernel folds its tree over — the gather indexing and absent-row
+    semantics cannot drift between the count, batch, src, and tanimoto
+    programs."""
+    w = words_t[i]
+    cap = w.shape[1]
+    wflat = w.reshape(w.shape[0] * cap, w.shape[2])
+    base = (jnp.arange(w.shape[0], dtype=jnp.int32) * cap)[:, None]
+    blk = wflat[(idx_t[i] + base).reshape(-1)]
+    return blk * hit_t[i].reshape(-1)[:, None]
+
+
+def _segment_rows(pc, dense, num_rows):
+    """vmap'd per-slice segment-sum of per-container counts into dense
+    rows: (S, cap) pc + (S, cap) dense ids -> (S, num_rows)."""
+
+    def one(pc_row, dense_row):
+        return jax.ops.segment_sum(pc_row, dense_row,
+                                   num_segments=num_rows + 1)[:num_rows]
+
+    return jax.vmap(one)(pc, dense)
+
+
+def _src_block_per_container(keys, src_blk, s_l):
+    """Align an evaluated src tree's (S*16, W) blocks with a pool's
+    containers: each container ANDs against the src block of its own
+    sub-key (key mod 16). Returns (src_per_container (S, cap, W),
+    valid (S, cap) presence mask). Shared by the src and tanimoto
+    row-count kernels so the sub-key gather can't diverge."""
+    src_blk3 = src_blk.reshape(s_l, ROW_SPAN, CONTAINER_WORDS)
+    valid = keys != INVALID_KEY
+    sub = jnp.where(valid, keys % ROW_SPAN, 0)
+    return jnp.take_along_axis(src_blk3, sub[:, :, None], axis=1), valid
+
+
 def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
     """Jit a masked Count over a bitmap-op tree with PER-LEAF pools and
     HOST-RESOLVED container indices.
@@ -472,12 +511,7 @@ def compile_serve_count(mesh: Mesh, tree_shape, num_leaves: int):
         s_l = words_t[0].shape[0]
 
         def leaf(i):
-            w = words_t[i]
-            cap_l = w.shape[1]
-            wflat = w.reshape(w.shape[0] * cap_l, w.shape[2])
-            base = (jnp.arange(w.shape[0], dtype=jnp.int32) * cap_l)[:, None]
-            blk = wflat[(idx_t[i] + base).reshape(-1)]
-            return blk * hit_t[i].reshape(-1)[:, None]
+            return _gather_leaf_blocks(words_t, idx_t, hit_t, i)
 
         pc = lax.population_count(fold_tree(tree, leaf))  # (S*16, 2048)
         per_slice = pc.sum(axis=1, dtype=jnp.uint32).reshape(
@@ -529,15 +563,9 @@ def compile_serve_count_batch(mesh: Mesh, tree_shape, num_leaves: int,
 
         def one(b):
             def leaf(i):
-                w = words_t[i]
-                cap_l = w.shape[1]
-                wflat = w.reshape(w.shape[0] * cap_l, w.shape[2])
-                base = (jnp.arange(w.shape[0], dtype=jnp.int32)
-                        * cap_l)[:, None]
-                idx = idx_flat[b * num_leaves + i]
-                hit = hit_flat[b * num_leaves + i]
-                blk = wflat[(idx + base).reshape(-1)]
-                return blk * hit.reshape(-1)[:, None]
+                return _gather_leaf_blocks(
+                    words_t, idx_flat[b * num_leaves:(b + 1) * num_leaves],
+                    hit_flat[b * num_leaves:(b + 1) * num_leaves], i)
 
             pc = lax.population_count(fold_tree(tree, leaf))
             return pc.sum(axis=1, dtype=jnp.uint32).reshape(
@@ -590,34 +618,98 @@ def compile_serve_row_counts_src(mesh: Mesh, tree_shape, num_leaves: int,
         s_l, cap_l = keys.shape
 
         def leaf(i):
-            w = src_words_t[i]
-            c = w.shape[1]
-            wflat = w.reshape(w.shape[0] * c, w.shape[2])
-            base = (jnp.arange(w.shape[0], dtype=jnp.int32) * c)[:, None]
-            blk = wflat[(src_idx_t[i] + base).reshape(-1)]
-            return blk * src_hit_t[i].reshape(-1)[:, None]
+            return _gather_leaf_blocks(src_words_t, src_idx_t, src_hit_t, i)
 
-        src_blk = fold_tree(tree, leaf).reshape(
-            s_l, ROW_SPAN, CONTAINER_WORDS)
-
-        valid = keys != INVALID_KEY
-        sub = jnp.where(valid, keys % ROW_SPAN, 0)          # (S, cap)
+        src_blk = fold_tree(tree, leaf)                      # (S*16, W)
         # Per-container src sub-block: gather (S, cap, W) from
         # (S, 16, W) — XLA fuses this into the AND+popcount consumer.
-        src_per_container = jnp.take_along_axis(
-            src_blk, sub[:, :, None], axis=1)
+        src_per_container, valid = _src_block_per_container(
+            keys, src_blk, s_l)
         pc = lax.population_count(words & src_per_container).sum(
             axis=2, dtype=jnp.int32)                         # (S, cap)
         dense = jnp.where(valid, keys // ROW_SPAN, num_rows)
         pc = jnp.where(valid & (mask[:, None] != 0), pc, 0)
 
-        def one_slice(pc_row, dense_row):
-            return jax.ops.segment_sum(pc_row, dense_row,
-                                       num_segments=num_rows + 1)[:num_rows]
-
-        local = jax.vmap(one_slice)(pc, dense)               # (S, R)
+        local = _segment_rows(pc, dense, num_rows)           # (S, R)
         lo = lax.psum((local & 0xFFFF).sum(axis=0), SLICE_AXIS)
         hi = lax.psum((local >> 16).sum(axis=0), SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SLICE_AXIS), P(SLICE_AXIS),
+                  (P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * num_leaves,
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def run(keys, words, src_words_t, src_idx_t, src_hit_t, mask):
+        return fn(keys, words, src_words_t, src_idx_t, src_hit_t, mask)
+
+    return run
+
+
+def compile_serve_row_counts_tanimoto(mesh: Mesh, tree_shape,
+                                      num_leaves: int, num_rows: int):
+    """Jit ALL THREE tanimoto vectors as ONE program: per-row full
+    counts, per-row src-intersection counts, and |src| — the fused form
+    of the reference's band evaluation inputs (fragment.go:550-608).
+
+    Round 2 ran these as 3-4 separate collectives with a staged-image
+    identity re-check between them (a write landing mid-query could zip
+    vectors from different generations). One program removes both the
+    extra dispatch floors and the consistency window: every vector
+    reads the SAME immutable device arrays.
+
+    Returns fn(keys, words — the TopN view's pool —
+    src_words_t/src_idx_t/src_hit_t (per src leaf), mask (S,))
+    -> (2, 2*num_rows + 1) limb array laid out
+       [:, :num_rows]          full per-row counts
+       [:, num_rows:2*num_rows] src-intersection per-row counts
+       [:, 2*num_rows]          |src|
+    — one array, one relay readback (see combine_count).
+    """
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    from ..ops.bitops import fold_tree
+
+    def per_shard(keys, words, src_words_t, src_idx_t, src_hit_t, mask):
+        s_l, cap_l = keys.shape
+
+        def leaf(i):
+            return _gather_leaf_blocks(src_words_t, src_idx_t, src_hit_t, i)
+
+        src_blk = fold_tree(tree, leaf)                 # (S*16, W)
+
+        # |src|: same limb scheme as compile_serve_count.
+        src_pc = lax.population_count(src_blk).sum(
+            axis=1, dtype=jnp.uint32).reshape(
+            s_l, ROW_SPAN).sum(axis=1, dtype=jnp.uint32)
+        src_pc = jnp.where(mask != 0, src_pc, jnp.uint32(0))
+        src_lo = (src_pc & jnp.uint32(0xFFFF)).astype(jnp.int32).sum()
+        src_hi = (src_pc >> 16).astype(jnp.int32).sum()
+
+        src_per_container, valid = _src_block_per_container(
+            keys, src_blk, s_l)
+        live = valid & (mask[:, None] != 0)
+        inter_pc = jnp.where(live, lax.population_count(
+            words & src_per_container).sum(axis=2, dtype=jnp.int32), 0)
+        full_pc = jnp.where(live, lax.population_count(words).sum(
+            axis=2, dtype=jnp.int32), 0)
+        dense = jnp.where(valid, keys // ROW_SPAN, num_rows)
+
+        # (S, 2R): full rows then intersection rows, one psum pair.
+        both = jnp.concatenate([_segment_rows(full_pc, dense, num_rows),
+                                _segment_rows(inter_pc, dense, num_rows)],
+                               axis=1)
+        lo = lax.psum((both & 0xFFFF).sum(axis=0), SLICE_AXIS)
+        hi = lax.psum((both >> 16).sum(axis=0), SLICE_AXIS)
+        lo = jnp.concatenate([lo, lax.psum(src_lo, SLICE_AXIS)[None]])
+        hi = jnp.concatenate([hi, lax.psum(src_hi, SLICE_AXIS)[None]])
         return jnp.stack([lo, hi])
 
     fn = jax.shard_map(

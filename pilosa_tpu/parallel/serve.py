@@ -32,6 +32,7 @@ import json
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,6 +47,7 @@ from .mesh import (
     compile_serve_count_batch,
     compile_serve_row_counts,
     compile_serve_row_counts_src,
+    compile_serve_row_counts_tanimoto,
     default_mesh,
     pack_mutation_batches,
     resolve_row_indices,
@@ -67,12 +69,14 @@ class StagedView:
         #                                   None = staged as absent
         self.num_slices = num_slices      # unpadded staged slice count
         # dense_id -> (flat_idx, hit) device arrays (resolve_row_indices
-        # output). Valid as long as the key layout is — incremental
-        # word scatters don't touch it; a restage builds a fresh
-        # StagedView, so the cache dies with the stale keys. Uploading
-        # these per query measured ~6 ms through the TPU relay; cached,
-        # a repeat-row query pays nothing.
-        self.idx_cache: Dict[int, tuple] = {}
+        # output), LRU-ordered (move-to-end on hit — a hot row staged
+        # early must not be the first evicted at the 1024 bound). Valid
+        # as long as the key layout is — incremental word scatters don't
+        # touch it; a restage builds a fresh StagedView, so the cache
+        # dies with the stale keys. Uploading these per query measured
+        # ~6 ms through the TPU relay; cached, a repeat-row query pays
+        # nothing.
+        self.idx_cache: "OrderedDict[int, tuple]" = OrderedDict()
 
     @property
     def padded_slices(self) -> int:
@@ -123,8 +127,9 @@ class MeshManager:
         self._batch_fns: Dict[tuple, object] = {}
         self._rowcount_fns: Dict[int, object] = {}
         self._rowcount_src_fns: Dict[tuple, object] = {}
+        self._tanimoto_fns: Dict[tuple, object] = {}
         self._apply_fn = None
-        self._mask_cache: Dict[bytes, object] = {}
+        self._mask_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
         self._batch_thread: Optional[threading.Thread] = None
         # In-flight row-count executions shared by identical concurrent
@@ -133,13 +138,41 @@ class MeshManager:
         # unrelated multi-second stage/refresh.
         self._inflight: Dict[tuple, list] = {}
         self._inflight_mu = threading.Lock()
+        # Guards get-or-compile on the _*_fns caches above: the dict ops
+        # are GIL-safe, but without the lock two concurrent FIRST
+        # queries of one shape each pay the multi-second compile
+        # (ADVICE r2). Call sites invoke _get_or_compile OUTSIDE _mu
+        # (a multi-second compile must not stall staging), and nothing
+        # under _compile_mu ever takes _mu — no ordering cycle.
+        self._compile_mu = threading.Lock()
+        # Completed-result memo for TopN-family limb vectors — the
+        # device analog of the reference's rank cache (cache.go:126-275,
+        # VERDICT r2 #4): a repeat TopN on an unchanged image re-enters
+        # no collective. Keyed on the staged arrays' identities, so an
+        # image swap (scatter or restage) naturally misses; entries hold
+        # strong refs to those arrays (id() of a dead object can be
+        # recycled — a ref-less key could false-hit a fresh array).
+        # _purge_memo drops entries when a view's words swap, so stale
+        # device images don't linger in HBM behind the memo. The epoch
+        # closes the put-after-purge race: a query snapshots the epoch
+        # under _mu alongside the arrays, and a store whose epoch is
+        # stale (any purge ran since) is dropped — otherwise a result
+        # landing after a concurrent refresh would insert an
+        # unreachable entry pinning the replaced device image.
+        self._topn_memo: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._memo_epoch = 0
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
-        # served device queries, plus cumulative timings.
+        # served device queries, plus cumulative timings and cache
+        # hit/miss/size gauges.
         self.stats = {
             "stage": 0, "incremental": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0,
             "fallback": 0, "stage_us": 0, "query_us": 0,
+            "memo_hit": 0, "memo_store": 0, "memo_size": 0,
+            "idx_cache_hit": 0, "idx_cache_miss": 0,
+            "mask_cache_hit": 0, "mask_cache_miss": 0,
+            "routed_host": 0,
         }
 
     @property
@@ -174,6 +207,9 @@ class MeshManager:
     def _stage(self, key, num_slices: int) -> StagedView:
         index, frame, view = key
         t0 = time.monotonic()
+        old = self._views.get(key)
+        if old is not None:
+            self._purge_memo(old.sharded.words)
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
         sharded, row_ids, keys_host = build_sharded_index(
@@ -242,6 +278,7 @@ class MeshManager:
                 per_slice, sv.padded_slices, sv.keys_host.shape[1])
             if self._apply_fn is None:
                 self._apply_fn = compile_serve_apply_writes(self.mesh)
+            self._purge_memo(sv.sharded.words)
             sv.sharded = self._apply_fn(sv.sharded, *batches)
             sv.slice_gens = new_gens
             self.stats["incremental"] += 1
@@ -252,9 +289,72 @@ class MeshManager:
         with self._mu:
             if index is None:
                 self._views.clear()
+                self._topn_memo.clear()
+                # The epoch must advance here too: an in-flight query's
+                # _memo_put would otherwise pass the staleness check and
+                # re-insert an entry pinning a just-dropped device image.
+                self._memo_epoch += 1
+                self.stats["memo_size"] = 0
             else:
                 for key in [k for k in self._views if k[0] == index]:
+                    self._purge_memo(self._views[key].sharded.words)
                     del self._views[key]
+
+    # -- completed-result memo (device rank-cache analog) ----------------------
+
+    # Bound on memoized TopN limb vectors: each is a (2, R_padded) int32
+    # device array (~32 KB at 4096 rows) plus refs to live staged
+    # arrays, so the memo itself is cheap; the bound exists so entries
+    # for masks/srcs that never repeat don't accumulate.
+    _TOPN_MEMO_MAX = 128
+
+    def _memo_get(self, key: tuple):
+        """Finished limb array for `key`, or None. Takes _mu (reentrant —
+        callers already under it just recurse)."""
+        with self._mu:
+            hit = self._topn_memo.get(key)
+            if hit is None:
+                return None
+            self._topn_memo.move_to_end(key)
+            self.stats["memo_hit"] += 1
+            return hit[0]
+
+    def _memo_put(self, key: tuple, limbs, refs: tuple, epoch: int):
+        """Memoize a finished limb array. `refs` must hold every staged
+        device array whose identity appears in `key` — they pin the ids
+        (no recycling) and let _purge_memo find entries by image.
+        `epoch` is the _memo_epoch snapshotted WITH the arrays: a store
+        from before any intervening purge is dropped rather than
+        inserted dead (see the __init__ comment).
+
+        A note on failed executions: `limbs` may be an async device
+        array whose execution later fails — the failure then surfaces
+        on every fetch, memo hits included, and callers fall back to
+        the host path per query. That's deliberate: the program runs
+        over immutable staged arrays, so re-running it deterministically
+        fails too; memoizing the failure loses nothing."""
+        with self._mu:
+            if epoch != self._memo_epoch:
+                return
+            if key in self._topn_memo:
+                self._topn_memo.move_to_end(key)
+                return
+            if len(self._topn_memo) >= self._TOPN_MEMO_MAX:
+                self._topn_memo.popitem(last=False)
+            self._topn_memo[key] = (limbs, refs)
+            self.stats["memo_store"] += 1
+            self.stats["memo_size"] = len(self._topn_memo)
+
+    def _purge_memo(self, words):
+        """Drop every memo entry that read `words` (a device image
+        about to be replaced). Call under _mu."""
+        self._memo_epoch += 1
+        dead = [k for k, (_, refs) in self._topn_memo.items()
+                if any(r is words for r in refs)]
+        for k in dead:
+            del self._topn_memo[k]
+        if dead:
+            self.stats["memo_size"] = len(self._topn_memo)
 
     # -- serving -------------------------------------------------------------
 
@@ -318,15 +418,29 @@ class MeshManager:
         first = next(iter(staged.values()))[0]
         return tuple(words_t), tuple(idx_t), tuple(hit_t), first
 
+    def _get_or_compile(self, cache: dict, key, build):
+        """Get-or-compile under _compile_mu so a given program compiles
+        ONCE even when two first queries of the same shape race
+        (ADVICE r2: the GIL kept the dicts safe but let both pay the
+        multi-second compile). The fast path stays lock-free; _mu is
+        never acquired here, so compiles don't block staging."""
+        fn = cache.get(key)
+        if fn is not None:
+            return fn
+        with self._compile_mu:
+            fn = cache.get(key)
+            if fn is None:
+                fn = build()
+                cache[key] = fn
+        return fn
+
     def _count_fn(self, sig: str, num_leaves: int):
         """Get-or-compile the unbatched serving-count program — the ONE
         place the (sig, num_leaves) cache key lives."""
-        fkey = (sig, num_leaves)
-        fn = self._count_fns.get(fkey)
-        if fn is None:
-            fn = compile_serve_count(self.mesh, json.loads(sig), num_leaves)
-            self._count_fns[fkey] = fn
-        return fn
+        return self._get_or_compile(
+            self._count_fns, (sig, num_leaves),
+            lambda: compile_serve_count(self.mesh, json.loads(sig),
+                                        num_leaves))
 
     def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
@@ -418,12 +532,10 @@ class MeshManager:
         from ..ops.pool import mutation_batch_width
 
         b_pad = min(mutation_batch_width(b, min_batch=2), self._MAX_BATCH)
-        fkey = (sig, num_leaves, b_pad)
-        fn = self._batch_fns.get(fkey)
-        if fn is None:
-            fn = compile_serve_count_batch(self.mesh, json.loads(sig),
-                                           num_leaves, b_pad)
-            self._batch_fns[fkey] = fn
+        fn = self._get_or_compile(
+            self._batch_fns, (sig, num_leaves, b_pad),
+            lambda: compile_serve_count_batch(self.mesh, json.loads(sig),
+                                              num_leaves, b_pad))
         padded = group + [group[-1]] * (b_pad - b)
         idx_flat = tuple(r.args[2][i] for r in padded
                          for i in range(num_leaves))
@@ -474,7 +586,10 @@ class MeshManager:
         Call under _mu — the eviction below is not otherwise safe."""
         cached = sv.idx_cache.get(dense_id)
         if cached is not None:
+            sv.idx_cache.move_to_end(dense_id)  # LRU, not FIFO
+            self.stats["idx_cache_hit"] += 1
             return cached
+        self.stats["idx_cache_miss"] += 1
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -483,7 +598,7 @@ class MeshManager:
         out = (jax.device_put(flat_idx, sharding),
                jax.device_put(hit, sharding))
         if len(sv.idx_cache) >= self._IDX_CACHE_MAX:
-            sv.idx_cache.pop(next(iter(sv.idx_cache)))
+            sv.idx_cache.popitem(last=False)
         sv.idx_cache[dense_id] = out
         return out
 
@@ -493,13 +608,16 @@ class MeshManager:
         key = mask.tobytes()
         cached = self._mask_cache.get(key)
         if cached is not None:
+            self._mask_cache.move_to_end(key)  # LRU, not FIFO
+            self.stats["mask_cache_hit"] += 1
             return cached
+        self.stats["mask_cache_miss"] += 1
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         dev = jax.device_put(mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
         if len(self._mask_cache) >= 64:
-            self._mask_cache.pop(next(iter(self._mask_cache)))
+            self._mask_cache.popitem(last=False)
         self._mask_cache[key] = dev
         return dev
 
@@ -524,14 +642,24 @@ class MeshManager:
             if len(sv.row_ids) == 0:
                 return sv.row_ids, None
             padded = 1 << (len(sv.row_ids) - 1).bit_length()
-            fn = self._rowcount_fns.get(padded)
-            if fn is None:
-                fn = compile_serve_row_counts(self.mesh, padded)
-                self._rowcount_fns[padded] = fn
             dev_mask = self._device_mask(mask)
-        key = (id(sharded.words), id(dev_mask), padded)
-        return sv.row_ids, (
-            lambda: self._single_flight(key, lambda: fn(sharded, dev_mask)))
+            epoch = self._memo_epoch
+        # Compile OUTSIDE _mu: a multi-second first-shape compile must
+        # not block staging/serving of every other query.
+        fn = self._get_or_compile(
+            self._rowcount_fns, padded,
+            lambda: compile_serve_row_counts(self.mesh, padded))
+        key = ("rc", id(sharded.words), id(dev_mask), padded)
+        memo = self._memo_get(key)
+        if memo is not None:
+            return sv.row_ids, (lambda: memo)
+
+        def call():
+            out = self._single_flight(key, lambda: fn(sharded, dev_mask))
+            self._memo_put(key, out, (sharded.words, dev_mask), epoch)
+            return out
+
+        return sv.row_ids, call
 
     def _single_flight(self, key: tuple, compute):
         """Share one in-flight device execution among identical
@@ -592,42 +720,38 @@ class MeshManager:
                         tanimoto: int, row_ids: Sequence[int] = (),
                         attr_predicate=None
                         ) -> Optional[List[Tuple[int, int]]]:
-        """Tanimoto-banded TopN from three exact device vectors: full
+        """Tanimoto-banded TopN from three exact device vectors — full
         per-row counts, per-row src-intersection counts, and |src| —
         then the reference's band math on the host
         (fragment.go:550-560,580-585: candidacy band on full counts,
         ceil similarity check on the intersect counts).
 
-        The three vectors come from separate collectives; a write
-        landing between them would zip counts from different
-        generations, so the staged image is re-checked afterwards and a
-        changed view falls back (None → host path) rather than serving
-        a band no single snapshot would produce."""
-        key = (index, frame, view)
-        with self._mu:
-            sv0 = self.refresh(index, frame, view, num_slices)
-            if sv0 is None:
-                return None
-            words0, rows0 = sv0.sharded.words, sv0.row_ids
-        out = self.row_counts(index, frame, view, slices, num_slices)
+        All three vectors come from ONE fused collective
+        (compile_serve_row_counts_tanimoto): round 2 ran 3-4 separate
+        dispatches with a staged-image identity re-check between them,
+        which both tripled the dispatch floor and left a window where a
+        src-side write could zip vectors from different generations
+        (ADVICE r2). A single program reads a single immutable snapshot
+        — there is no window to re-check."""
+        t0 = time.monotonic()
+        out = self._src_counts_limbs(
+            "tan", self._tanimoto_fns, compile_serve_row_counts_tanimoto,
+            index, frame, view, src, slices, num_slices)
         if out is None:
             return None
-        all_rows, full = out
-        out = self.row_counts_src(index, frame, view, src[0], src[1],
-                                  slices, num_slices)
-        if out is None:
-            return None
-        _, inter = out
-        src_count = self.count(index, src[0], src[1], slices, num_slices)
-        if src_count is None:
-            return None
-        with self._mu:
-            sv1 = self._views.get(key)
-            if (sv1 is None or sv1.sharded.words is not words0
-                    or sv1.row_ids is not rows0):
-                self.stats["fallback"] += 1
-                return None  # image changed mid-query: host path
-        if len(all_rows) == 0 or src_count == 0:
+        all_rows, padded, limbs = out
+        if limbs is None:
+            return []  # staged view has no rows
+        r = len(all_rows)
+        full = ((limbs[1, :r].astype(np.int64) << 16)
+                + limbs[0, :r].astype(np.int64))
+        inter = ((limbs[1, padded:padded + r].astype(np.int64) << 16)
+                 + limbs[0, padded:padded + r].astype(np.int64))
+        src_count = ((int(limbs[1, 2 * padded]) << 16)
+                     + int(limbs[0, 2 * padded]))
+        self.stats["topn"] += 1
+        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        if src_count == 0:
             return []
         min_tan = src_count * tanimoto / 100.0
         max_tan = src_count * 100.0 / tanimoto
@@ -650,15 +774,24 @@ class MeshManager:
                 break
         return pairs
 
-    def row_counts_src(self, index: str, frame: str, view: str,
-                       src_shape, src_leaves, slices: Sequence[int],
-                       num_slices: int):
-        """Exact per-row SRC-INTERSECTION counts: the src bitmap-op
-        tree evaluates per slice and ANDs against every row in one
-        fused pass (the device form of the reference's per-row
-        src.intersection_count loop, fragment.go:564-608). Returns
-        (row_ids, counts int64) or None."""
-        t0 = time.monotonic()
+    def _src_counts_limbs(self, kind: str, fn_cache: dict, compiler,
+                          index: str, frame: str, view: str, src,
+                          slices: Sequence[int], num_slices: int):
+        """Shared resolve+execute for the src-tree row-count programs
+        (row_counts_src and the fused tanimoto): snapshot under _mu,
+        compile outside it, memo/single-flight, one readback. Returns
+        (row_ids, padded, limbs np.ndarray), (row_ids, 0, None) for a
+        rowless view, or None on any fallback.
+
+        The consistency contract lives HERE, once: the memo/in-flight
+        key carries every src leaf's words identity (ADVICE r2 medium —
+        an incremental refresh can swap a src frame's words while this
+        view's staging stays put; without those ids a post-refresh
+        query would share a pre-refresh result that excludes its own
+        writes), the refs pin every id in the key, and the epoch is
+        snapshotted after _stage_leaves so src-side purges are
+        observed."""
+        src_shape, src_leaves = src
         with self._mu:
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
@@ -670,8 +803,7 @@ class MeshManager:
                 self.stats["fallback"] += 1
                 return None
             if len(sv.row_ids) == 0:
-                return sv.row_ids, np.zeros(0, dtype=np.int64)
-
+                return sv.row_ids, 0, None
             out = self._stage_leaves(index, src_leaves, num_slices)
             if out is None:
                 return None
@@ -679,24 +811,47 @@ class MeshManager:
             dev_mask = self._device_mask(mask)
             padded = 1 << (len(sv.row_ids) - 1).bit_length()
             sig = json.dumps(_tree_signature(src_shape))
-            fkey = (sig, len(src_leaves), padded)
-            fn = self._rowcount_src_fns.get(fkey)
-            if fn is None:
-                fn = compile_serve_row_counts_src(
-                    self.mesh, json.loads(sig), len(src_leaves), padded)
-                self._rowcount_src_fns[fkey] = fn
+            epoch = self._memo_epoch
+        # Compile OUTSIDE _mu (see _row_counts_call).
+        fn = self._get_or_compile(
+            fn_cache, (sig, len(src_leaves), padded),
+            lambda: compiler(self.mesh, json.loads(sig),
+                             len(src_leaves), padded))
+        key = (kind, id(sharded.words), id(dev_mask), padded, sig,
+               tuple(id(w) for w in words_t), tuple(id(a) for a in idx_t))
+        out = self._memo_get(key)
+        if out is None:
+            out = self._single_flight(
+                key, lambda: fn(sharded.keys, sharded.words, words_t,
+                                idx_t, hit_t, dev_mask))
+            self._memo_put(key, out,
+                           (sharded.words, dev_mask) + tuple(words_t)
+                           + tuple(idx_t), epoch)
+        return sv.row_ids, padded, np.asarray(out)
 
-        key = (id(sharded.words), id(dev_mask), padded, sig,
-               tuple(id(a) for a in idx_t))
-        limbs = np.asarray(self._single_flight(
-            key, lambda: fn(sharded.keys, sharded.words, words_t,
-                            idx_t, hit_t, dev_mask)))
-        r = len(sv.row_ids)
+    def row_counts_src(self, index: str, frame: str, view: str,
+                       src_shape, src_leaves, slices: Sequence[int],
+                       num_slices: int):
+        """Exact per-row SRC-INTERSECTION counts: the src bitmap-op
+        tree evaluates per slice and ANDs against every row in one
+        fused pass (the device form of the reference's per-row
+        src.intersection_count loop, fragment.go:564-608). Returns
+        (row_ids, counts int64) or None."""
+        t0 = time.monotonic()
+        out = self._src_counts_limbs(
+            "rcs", self._rowcount_src_fns, compile_serve_row_counts_src,
+            index, frame, view, (src_shape, src_leaves), slices, num_slices)
+        if out is None:
+            return None
+        row_ids, _padded, limbs = out
+        if limbs is None:
+            return row_ids, np.zeros(0, dtype=np.int64)
+        r = len(row_ids)
         counts = ((limbs[1, :r].astype(np.int64) << 16)
                   + limbs[0, :r].astype(np.int64))
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
-        return sv.row_ids, counts
+        return row_ids, counts
 
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,

@@ -21,6 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Cost routing would send every tiny-fixture Count to the host path —
+# the suite's device tests assert WHICH engine served, so routing is
+# off by default here; TestCostRouting opts back in with the explicit
+# device_min_work arg (which beats this env).
+os.environ.setdefault("PILOSA_TPU_DEVICE_MIN_WORK", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
