@@ -44,6 +44,8 @@ _MESH_NAMES = (
     "compile_serve_apply_writes",
     "compile_serve_count",
     "compile_serve_count_batch",
+    "compile_serve_count_coarse",
+    "coarse_row_starts",
     "compile_serve_row_counts",
     "compile_serve_row_counts_src",
     "connect_distributed",
@@ -76,6 +78,8 @@ __all__ = [
     "compile_serve_apply_writes",
     "compile_serve_count",
     "compile_serve_count_batch",
+    "compile_serve_count_coarse",
+    "coarse_row_starts",
     "compile_serve_row_counts",
     "compile_serve_row_counts_src",
     "pack_mutation_batches",

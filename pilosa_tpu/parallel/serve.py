@@ -41,10 +41,12 @@ from ..ops.pool import fold_log_entries, plan_slice_mutations
 from .mesh import (
     SLICE_AXIS,
     build_sharded_index,
+    coarse_row_starts,
     combine_count,
     compile_serve_apply_writes,
     compile_serve_count,
     compile_serve_count_batch,
+    compile_serve_count_coarse,
     compile_serve_row_counts,
     compile_serve_row_counts_src,
     compile_serve_row_counts_tanimoto,
@@ -91,12 +93,17 @@ def _reraise_shared(what: str, err: BaseException):
 
 
 class _CountRequest:
-    """One pending count in the dynamic batch queue."""
+    """One pending count in the dynamic batch queue. coarse_t holds a
+    per-leaf (starts, valid) device pair when the leaf is
+    coarse-eligible (coarse_row_starts), else None for that leaf — the
+    batch runner picks the coarse whole-row-gather program only when
+    every leaf of every request in a group is eligible."""
 
-    __slots__ = ("args", "done", "result", "error")
+    __slots__ = ("args", "coarse_t", "done", "result", "error")
 
-    def __init__(self, sig, words_t, idx_t, hit_t, dev_mask):
+    def __init__(self, sig, words_t, idx_t, hit_t, coarse_t, dev_mask):
         self.args = (sig, words_t, idx_t, hit_t, dev_mask)
+        self.coarse_t = coarse_t
         self.done = threading.Event()
         self.result = None
         self.error = None
@@ -125,6 +132,7 @@ class MeshManager:
         self._views: Dict[Tuple[str, str, str], StagedView] = {}
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._batch_fns: Dict[tuple, object] = {}
+        self._coarse_fns: Dict[tuple, object] = {}
         self._rowcount_fns: Dict[int, object] = {}
         self._rowcount_src_fns: Dict[tuple, object] = {}
         self._tanimoto_fns: Dict[tuple, object] = {}
@@ -167,7 +175,7 @@ class MeshManager:
         # hit/miss/size gauges.
         self.stats = {
             "stage": 0, "incremental": 0, "count": 0, "topn": 0,
-            "batched": 0, "deduped": 0, "inflight_shared": 0,
+            "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
             "fallback": 0, "stage_us": 0, "query_us": 0,
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
             "idx_cache_hit": 0, "idx_cache_miss": 0,
@@ -379,7 +387,7 @@ class MeshManager:
             out = self._stage_leaves(index, leaves, num_slices)
             if out is None:
                 return None
-            words_t, idx_t, hit_t, first = out
+            words_t, idx_t, hit_t, coarse_t, first = out
             mask = self._mask_for(first, slices)
             if mask is None:
                 self.stats["fallback"] += 1
@@ -387,18 +395,20 @@ class MeshManager:
             dev_mask = self._device_mask(mask)
 
         sig = json.dumps(_tree_signature(shape))
-        return (sig, words_t, idx_t, hit_t, dev_mask)
+        return (sig, words_t, idx_t, hit_t, coarse_t, dev_mask)
 
     def _stage_leaves(self, index: str, leaves, num_slices: int):
         """Stage every leaf's (frame, view) and resolve its row into
         cached device gather arrays. Call under _mu (staging snapshot
         consistency — see _count_args). Returns
-        (words_t, idx_t, hit_t, first_staged_view) or None; an absent
-        row maps to the past-the-end dense sentinel, which the resolver
-        turns into hit=0 everywhere. Shared by the Count path and the
-        TopN src path so absent-row/staging semantics can't diverge."""
+        (words_t, idx_t, hit_t, coarse_t, first_staged_view) or None;
+        an absent row maps to the past-the-end dense sentinel, which
+        the resolver turns into hit=0 everywhere. coarse_t[i] is the
+        leaf's (starts, valid) device pair when coarse-eligible, else
+        None. Shared by the Count path and the TopN src path so
+        absent-row/staging semantics can't diverge."""
         staged: Dict[Tuple[str, str], tuple] = {}
-        words_t, idx_t, hit_t = [], [], []
+        words_t, idx_t, hit_t, coarse_t = [], [], [], []
         for frame, view, row_id, _req in leaves:
             vkey = (frame, view)
             if vkey not in staged:
@@ -411,12 +421,14 @@ class MeshManager:
             i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
             if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
                 i = len(sv.row_ids)  # absent row: resolver yields hit=0
-            flat_idx, hit = self._leaf_arrays(sv, i)
+            flat_idx, hit, coarse = self._leaf_arrays(sv, i)
             words_t.append(words)
             idx_t.append(flat_idx)
             hit_t.append(hit)
+            coarse_t.append(coarse)
         first = next(iter(staged.values()))[0]
-        return tuple(words_t), tuple(idx_t), tuple(hit_t), first
+        return (tuple(words_t), tuple(idx_t), tuple(hit_t),
+                tuple(coarse_t), first)
 
     def _get_or_compile(self, cache: dict, key, build):
         """Get-or-compile under _compile_mu so a given program compiles
@@ -442,15 +454,30 @@ class MeshManager:
             lambda: compile_serve_count(self.mesh, json.loads(sig),
                                         num_leaves))
 
+    def _coarse_fn(self, sig: str, num_leaves: int, batch: int):
+        """Get-or-compile the coarse whole-row-gather program."""
+        return self._get_or_compile(
+            self._coarse_fns, (sig, num_leaves, batch),
+            lambda: compile_serve_count_coarse(self.mesh, json.loads(sig),
+                                               num_leaves, batch))
+
     def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
         """A zero-arg callable running ONE compiled (unbatched) serving
         count, returning the (2,) [lo, hi] limbs — the benchmarking
-        entry for the engine rate without queueing/readback."""
+        entry for the engine rate without queueing/readback. Picks the
+        coarse program when every leaf is eligible, exactly as the
+        batch loop does."""
         prepared = self._count_args(index, shape, leaves, slices, num_slices)
         if prepared is None:
             return None
-        sig, words_t, idx_t, hit_t, dev_mask = prepared
+        sig, words_t, idx_t, hit_t, coarse_t, dev_mask = prepared
+        if all(c is not None for c in coarse_t):
+            fn = self._coarse_fn(sig, len(idx_t), 1)
+            start_flat = tuple(c[0] for c in coarse_t)
+            valid_flat = tuple(c[1] for c in coarse_t)
+            return lambda: fn(words_t, start_flat, valid_flat,
+                              dev_mask)[:, 0]
         fn = self._count_fn(sig, len(idx_t))
         return lambda: fn(words_t, idx_t, hit_t, dev_mask)
 
@@ -518,11 +545,24 @@ class MeshManager:
                 r.done.set()
 
         b = len(group)
+        # Whole-row coarse gather when EVERY leaf of EVERY request in
+        # the group is eligible (measured 125 -> 165 GB/s on the
+        # headline pool; see coarse_row_starts). Mixed groups take the
+        # general container-gather program — correctness first.
+        coarse_ok = all(all(c is not None for c in r.coarse_t)
+                        for r in group)
         if b == 1:
             sig, words_t, idx_t, hit_t, dev_mask = group[0].args
-            fn = self._count_fn(sig, len(idx_t))
-            group[0].result = combine_count(fn(words_t, idx_t, hit_t,
-                                               dev_mask))
+            if coarse_ok:
+                fn = self._coarse_fn(sig, len(idx_t), 1)
+                ct = group[0].coarse_t
+                limbs = fn(words_t, tuple(c[0] for c in ct),
+                           tuple(c[1] for c in ct), dev_mask)[:, 0]
+                self.stats["coarse"] += 1
+            else:
+                fn = self._count_fn(sig, len(idx_t))
+                limbs = fn(words_t, idx_t, hit_t, dev_mask)
+            group[0].result = combine_count(limbs)
             group[0].done.set()
             _propagate()
             return
@@ -532,16 +572,26 @@ class MeshManager:
         from ..ops.pool import mutation_batch_width
 
         b_pad = min(mutation_batch_width(b, min_batch=2), self._MAX_BATCH)
-        fn = self._get_or_compile(
-            self._batch_fns, (sig, num_leaves, b_pad),
-            lambda: compile_serve_count_batch(self.mesh, json.loads(sig),
-                                              num_leaves, b_pad))
         padded = group + [group[-1]] * (b_pad - b)
-        idx_flat = tuple(r.args[2][i] for r in padded
-                         for i in range(num_leaves))
-        hit_flat = tuple(r.args[3][i] for r in padded
-                         for i in range(num_leaves))
-        limbs = _np.asarray(fn(words_t, idx_flat, hit_flat, dev_mask))
+        if coarse_ok:
+            fn = self._coarse_fn(sig, num_leaves, b_pad)
+            start_flat = tuple(r.coarse_t[i][0] for r in padded
+                               for i in range(num_leaves))
+            valid_flat = tuple(r.coarse_t[i][1] for r in padded
+                               for i in range(num_leaves))
+            limbs = _np.asarray(fn(words_t, start_flat, valid_flat,
+                                   dev_mask))
+            self.stats["coarse"] += b
+        else:
+            fn = self._get_or_compile(
+                self._batch_fns, (sig, num_leaves, b_pad),
+                lambda: compile_serve_count_batch(
+                    self.mesh, json.loads(sig), num_leaves, b_pad))
+            idx_flat = tuple(r.args[2][i] for r in padded
+                             for i in range(num_leaves))
+            hit_flat = tuple(r.args[3][i] for r in padded
+                             for i in range(num_leaves))
+            limbs = _np.asarray(fn(words_t, idx_flat, hit_flat, dev_mask))
         self.stats["batched"] += b
         for j, r in enumerate(group):
             r.result = (int(limbs[1, j]) << 16) + int(limbs[0, j])
@@ -582,7 +632,10 @@ class MeshManager:
     _IDX_CACHE_MAX = 1024
 
     def _leaf_arrays(self, sv: StagedView, dense_id: int):
-        """Device (idx, hit) for one leaf row, cached per view.
+        """Device (idx, hit, coarse) for one leaf row, cached per view;
+        coarse is a (starts, valid) device pair when the row stages as
+        contiguous aligned whole-row runs (coarse_row_starts — the
+        165-vs-125 GB/s gather-granularity fast path), else None.
         Call under _mu — the eviction below is not otherwise safe."""
         cached = sv.idx_cache.get(dense_id)
         if cached is not None:
@@ -595,8 +648,13 @@ class MeshManager:
 
         flat_idx, hit = resolve_row_indices(sv.keys_host, dense_id)
         sharding = NamedSharding(self.mesh, P(SLICE_AXIS))
+        coarse = coarse_row_starts(sv.keys_host, dense_id)
+        if coarse is not None:
+            coarse = (jax.device_put(coarse[0], sharding),
+                      jax.device_put(coarse[1], sharding))
         out = (jax.device_put(flat_idx, sharding),
-               jax.device_put(hit, sharding))
+               jax.device_put(hit, sharding),
+               coarse)
         if len(sv.idx_cache) >= self._IDX_CACHE_MAX:
             sv.idx_cache.popitem(last=False)
         sv.idx_cache[dense_id] = out
@@ -807,7 +865,7 @@ class MeshManager:
             out = self._stage_leaves(index, src_leaves, num_slices)
             if out is None:
                 return None
-            words_t, idx_t, hit_t, _first = out
+            words_t, idx_t, hit_t, _coarse_t, _first = out
             dev_mask = self._device_mask(mask)
             padded = 1 << (len(sv.row_ids) - 1).bit_length()
             sig = json.dumps(_tree_signature(src_shape))

@@ -162,7 +162,11 @@ class SpmdServer:
                 # failure must read as not-ready so every rank skips —
                 # compiling after agreement would let warm-cached peers
                 # enter the psum while this rank bails.
-                sig, words_t, idx_t, hit_t, mask = prepared
+                # coarse_t (the single-host whole-row fast path) is
+                # deliberately unused here: SPMD ranks agree on the
+                # GENERAL program, whose eligibility can't diverge
+                # between momentarily out-of-sync replicas.
+                sig, words_t, idx_t, hit_t, _coarse_t, mask = prepared
                 shapes = tuple(
                     [tuple(w.shape) for w in words_t]
                     + [tuple(i.shape) for i in idx_t]

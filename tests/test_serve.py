@@ -923,3 +923,170 @@ class TestPlanSliceMutations:
             keys, row_ids, np.array([70000], dtype=np.uint64),
             np.array([False]))
         assert len(slot) == 0
+
+
+class TestCoarseGather:
+    """The whole-row coarse-gather fast path (mesh.coarse_row_starts +
+    compile_serve_count_coarse): eligibility detection, correctness vs
+    the host path, and fallback to the general container gather for
+    partial/unaligned rows. The gather-granularity analog of the
+    reference's container-TYPE kernel dispatch (roaring.go:1270-1351)."""
+
+    @staticmethod
+    def seed_full_rows(holder, rows, slices):
+        """Each (row, slice) gets all 16 containers (one bit per 2^16
+        block), so rows stage as contiguous aligned runs."""
+        f = seed(holder)
+        for r in rows:
+            for s in slices:
+                for blk in range(16):
+                    f.set_bit(r, s * SLICE_WIDTH + blk * 65536 + r + s)
+        return f
+
+    def test_coarse_starts_eligible_dense(self):
+        from pilosa_tpu.parallel.mesh import coarse_row_starts
+
+        # two slices, two full rows each: keys 0..31 sorted
+        keys = np.tile(np.arange(32, dtype=np.int32), (2, 1))
+        out = coarse_row_starts(keys, 1)
+        assert out is not None
+        starts, valid = out
+        assert starts.tolist() == [1, 1]
+        assert valid.tolist() == [1, 1]
+
+    def test_coarse_starts_absent_slice_valid_zero(self):
+        from pilosa_tpu.ops.pool import INVALID_KEY
+        from pilosa_tpu.parallel.mesh import coarse_row_starts
+
+        keys = np.full((2, 32), INVALID_KEY, dtype=np.int32)
+        keys[0, :32] = np.arange(32)     # slice 0: rows 0,1 full
+        keys[1, :16] = np.arange(16)     # slice 1: row 0 only
+        out = coarse_row_starts(keys, 1)
+        assert out is not None
+        starts, valid = out
+        assert valid.tolist() == [1, 0]
+        assert starts[0] == 1
+
+    def test_coarse_starts_partial_row_ineligible(self):
+        from pilosa_tpu.ops.pool import INVALID_KEY
+        from pilosa_tpu.parallel.mesh import coarse_row_starts
+
+        keys = np.full((1, 32), INVALID_KEY, dtype=np.int32)
+        keys[0, :15] = np.arange(15)     # row 0 missing sub-key 15
+        assert coarse_row_starts(keys, 0) is None
+
+    def test_coarse_starts_unaligned_ineligible(self):
+        from pilosa_tpu.ops.pool import INVALID_KEY
+        from pilosa_tpu.parallel.mesh import coarse_row_starts
+
+        keys = np.full((1, 32), INVALID_KEY, dtype=np.int32)
+        keys[0, 0] = 5                   # stray container below row 1
+        keys[0, 1:17] = np.arange(16, 32)
+        assert coarse_row_starts(keys, 1) is None
+
+    def test_coarse_starts_absent_everywhere(self):
+        from pilosa_tpu.parallel.mesh import coarse_row_starts
+
+        keys = np.tile(np.arange(16, dtype=np.int32), (2, 1))
+        assert coarse_row_starts(keys, 7) is None
+
+    def test_full_rows_serve_coarse_and_match_host(self, holder):
+        self.seed_full_rows(holder, rows=(0, 1, 2), slices=(0, 1, 2))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        got = q(e, "i", pql)[0]
+        assert got == q(host, "i", pql)[0]
+        assert mgr.stats["coarse"] >= 1
+
+    def test_absent_slice_row_serves_coarse(self, holder):
+        # row 0 full in slices 0-2; row 1 full only in slices 0-1:
+        # slice 2 has valid=0 for row 1 (still coarse-eligible).
+        self.seed_full_rows(holder, rows=(0,), slices=(0, 1, 2))
+        self.seed_full_rows(holder, rows=(1,), slices=(0, 1))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        for pql in ("Count(Union(Bitmap(rowID=0), Bitmap(rowID=1)))",
+                    "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
+                    "Count(Difference(Bitmap(rowID=0), Bitmap(rowID=1)))"):
+            assert q(e, "i", pql)[0] == q(host, "i", pql)[0]
+        assert mgr.stats["coarse"] >= 3
+
+    def test_partial_row_falls_back_to_general(self, holder):
+        self.seed_full_rows(holder, rows=(0,), slices=(0, 1))
+        f = holder.index("i").frame("general")
+        f.set_bit(1, 3)  # row 1: a single container — not coarse
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        pql = "Count(Union(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        before = mgr.stats["coarse"]
+        assert q(e, "i", pql)[0] == q(host, "i", pql)[0]
+        assert mgr.stats["coarse"] == before  # general path served it
+        assert mgr.stats["count"] >= 1
+
+    def test_coarse_batch_group_matches_individual(self, holder):
+        self.seed_full_rows(holder, rows=(0, 1, 2, 3), slices=(0, 1))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        mgr = e.mesh_manager()
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.parallel.serve import _CountRequest
+
+        host = Executor(holder, use_device=False)
+        group, want = [], []
+        for a, b in [(0, 1), (2, 3), (1, 2)]:
+            pql = f"Count(Intersect(Bitmap(rowID={a}), Bitmap(rowID={b})))"
+            tree = parse_string(pql).calls[0].children[0]
+            leaves = []
+            shape = _lower_tree(holder, "i", tree, leaves)
+            prepared = mgr._count_args("i", shape, leaves, [0, 1], 2)
+            assert prepared is not None
+            assert all(c is not None for c in prepared[4])
+            group.append(_CountRequest(*prepared))
+            want.append(host.execute("i", parse_string(pql))[0])
+        before = mgr.stats["coarse"]
+        mgr._run_count_group(group)
+        assert [r.result for r in group] == want
+        assert mgr.stats["coarse"] == before + 3
+
+    def test_mixed_group_uses_general_program(self, holder):
+        """One request's leaf is not coarse-eligible: the whole group
+        takes the general container-gather program and stays correct."""
+        self.seed_full_rows(holder, rows=(0, 1), slices=(0, 1))
+        f = holder.index("i").frame("general")
+        f.set_bit(9, 5)  # sparse row
+        e = Executor(holder, use_device=True, device_min_work=0)
+        mgr = e.mesh_manager()
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.parallel.serve import _CountRequest
+
+        host = Executor(holder, use_device=False)
+        group, want = [], []
+        for a, b in [(0, 1), (0, 9)]:
+            pql = f"Count(Union(Bitmap(rowID={a}), Bitmap(rowID={b})))"
+            tree = parse_string(pql).calls[0].children[0]
+            leaves = []
+            shape = _lower_tree(holder, "i", tree, leaves)
+            group.append(_CountRequest(
+                *mgr._count_args("i", shape, leaves, [0, 1], 2)))
+            want.append(host.execute("i", parse_string(pql))[0])
+        before = mgr.stats["coarse"]
+        mgr._run_count_group(group)
+        assert [r.result for r in group] == want
+        assert mgr.stats["coarse"] == before
+
+    def test_write_after_coarse_stays_correct(self, holder):
+        """An incremental scatter swaps words but keeps the key layout:
+        cached coarse starts stay valid and serve the NEW bits."""
+        self.seed_full_rows(holder, rows=(0, 1), slices=(0,))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        first = q(e, "i", pql)[0]
+        f = holder.index("i").frame("general")
+        f.set_bit(0, 1 + 65536)  # into an existing container of row 0
+        f.set_bit(1, 1 + 65536)
+        got = q(e, "i", pql)[0]
+        assert got == q(host, "i", pql)[0] == first + 1

@@ -1,0 +1,158 @@
+"""Compare batch-count program formulations on the headline pool shape.
+
+The serving batcher's program (compile_serve_count_batch) unrolls B
+independent gather+AND+popcount chains. Candidates that might stream
+better: one vmapped gather with a batch dim, one mega-gather, and a
+lax.scan pipeline. Winner (if any) replaces the unrolled form.
+
+python tools/profile_batch.py [--slices 960] [--rows 8] [--batch 16]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def sustained(fn, iters, reps=4):
+    best = 1e9
+    np.asarray(fn())
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(iters):
+            o = fn()
+            acc = o if acc is None else acc + o
+        np.asarray(acc)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", type=int, default=960)
+    ap.add_argument("--rows", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from pilosa_tpu.parallel.mesh import (
+        SLICE_AXIS, compile_serve_count_batch, resolve_row_indices)
+
+    S, R, B = args.slices, args.rows, args.batch
+    cap = R * 16
+    rng = np.random.default_rng(7)
+    words_host = rng.integers(0, 2**32, size=(S, cap, 2048), dtype=np.uint32)
+    keys_host = np.tile(np.arange(cap, dtype=np.int32), (S, 1))
+    mesh = Mesh(np.array(jax.devices()[:1]), (SLICE_AXIS,))
+    sh = NamedSharding(mesh, P(SLICE_AXIS))
+    words = jax.device_put(words_host, sh)
+    mask = jax.device_put(np.ones(S, dtype=np.int32), sh)
+    d = lambda a: jax.device_put(a, sh)
+
+    pairs = [(a, b) for a in range(R) for b in range(R) if a < b][:B]
+    assert len(pairs) == B
+    idx_by_row, hit_by_row = {}, {}
+    for r in set(x for p in pairs for x in p):
+        i, h = resolve_row_indices(keys_host, r)
+        idx_by_row[r], hit_by_row[r] = d(i), d(h)
+
+    tree = ["and", ["leaf", 0], ["leaf", 1]]
+    words_t = (words, words)
+    idx_flat = tuple(idx_by_row[x] for p in pairs for x in p)
+    hit_flat = tuple(hit_by_row[x] for p in pairs for x in p)
+    gbq = S * 32 * 2048 * 4 / 1e9  # bytes one query reads
+
+    results = {}
+
+    def run(name, fn):
+        dt = sustained(fn, args.iters) / B
+        results[name] = {"per_query_ms": dt * 1e3, "gbps": gbq / dt,
+                         "batch_qps": 1.0 / dt}
+        print(f"{name:18s} {dt*1e3:7.3f} ms/query {gbq/dt:6.0f} GB/s "
+              f"{1.0/dt:7.0f} QPS", flush=True)
+
+    # A. current unrolled serving program
+    fn_cur = compile_serve_count_batch(mesh, tree, 2, B)
+    run("unrolled", lambda: fn_cur(words_t, idx_flat, hit_flat, mask))
+
+    # B. vmapped: idx/hit stacked (B, 2, S, 16); ONE batched gather
+    idx_st = d(np.stack([[np.asarray(idx_by_row[a]), np.asarray(idx_by_row[b])]
+                         for a, b in pairs]).transpose(2, 0, 1, 3))
+    hit_st = d(np.stack([[np.asarray(hit_by_row[a]), np.asarray(hit_by_row[b])]
+                         for a, b in pairs]).transpose(2, 0, 1, 3))
+    # shapes: (S, B, 2, 16)
+
+    @jax.jit
+    def vmapped(w, idx, hit, m):
+        # per-slice: gather (B, 2, 16) containers from (cap, 2048)
+        def one(wrow, irow, hrow):
+            g = wrow[irow.reshape(-1)] * hrow.reshape(-1).astype(
+                jnp.uint32)[:, None]
+            g = g.reshape(B, 2, 16 * wrow.shape[1])
+            pc = lax.population_count(g[:, 0] & g[:, 1])
+            return pc.sum(axis=1, dtype=jnp.uint32)  # (B,)
+
+        per = jax.vmap(one)(w, idx, hit)             # (S, B)
+        per = jnp.where(m[:, None] != 0, per, jnp.uint32(0))
+        lo = (per & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=0)
+        hi = (per >> 16).astype(jnp.int32).sum(axis=0)
+        return jnp.stack([lo, hi])
+
+    run("vmapped", lambda: vmapped(words, idx_st, hit_st, mask))
+
+    # C. scan over queries (sequential, pipelined by XLA)
+    idx_sc = d(np.stack([np.concatenate(
+        [np.asarray(idx_by_row[a]), np.asarray(idx_by_row[b])], axis=1)
+        for a, b in pairs]).transpose(1, 0, 2))   # (S, B, 32)
+    hit_sc = d(np.stack([np.concatenate(
+        [np.asarray(hit_by_row[a]), np.asarray(hit_by_row[b])], axis=1)
+        for a, b in pairs]).transpose(1, 0, 2))
+
+    @jax.jit
+    def scanned(w, idx, hit, m):
+        cap_ = w.shape[1]
+        wflat = w.reshape(S * cap_, 2048)
+        base = (jnp.arange(S, dtype=jnp.int32) * cap_)[:, None]
+
+        def step(carry, xs):
+            i, h = xs                                 # (S, 32) each
+            a = wflat[(i[:, :16] + base).reshape(-1)] \
+                * h[:, :16].reshape(-1).astype(jnp.uint32)[:, None]
+            b = wflat[(i[:, 16:] + base).reshape(-1)] \
+                * h[:, 16:].reshape(-1).astype(jnp.uint32)[:, None]
+            pc = lax.population_count(a & b).sum(
+                axis=1, dtype=jnp.uint32).reshape(S, 16).sum(
+                axis=1, dtype=jnp.uint32)
+            pc = jnp.where(m != 0, pc, jnp.uint32(0))
+            lo = (pc & jnp.uint32(0xFFFF)).astype(jnp.int32).sum()
+            hi = (pc >> 16).astype(jnp.int32).sum()
+            return carry, jnp.stack([lo, hi])
+
+        _, out = lax.scan(step, 0,
+                          (idx.transpose(1, 0, 2), hit.transpose(1, 0, 2)))
+        return out.T                                  # (2, B)
+
+    run("scanned", lambda: scanned(words, idx_sc, hit_sc, mask))
+
+    # sanity: all three agree
+    a0 = np.asarray(fn_cur(words_t, idx_flat, hit_flat, mask))
+    b0 = np.asarray(vmapped(words, idx_st, hit_st, mask))
+    c0 = np.asarray(scanned(words, idx_sc, hit_sc, mask))
+    assert np.array_equal(a0, b0), (a0, b0)
+    assert np.array_equal(a0, c0), (a0, c0)
+
+    with open("PROFILE_BATCH.json", "w") as f:
+        json.dump({k: {kk: round(vv, 3) for kk, vv in v.items()}
+                   for k, v in results.items()}, f, indent=2)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

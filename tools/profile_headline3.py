@@ -1,0 +1,149 @@
+"""Round-3 profiling: why does a streaming popcount+reduce run at
+~85 GB/s on a chip with ~819 GB/s HBM? Test reduction structures.
+
+All variants K-unrolled in one program (dispatch amortized), distinct
+multipliers defeat CSE. python tools/profile_headline3.py
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def sustained(fn, iters, reps=3):
+    best = 1e9
+    np.asarray(fn())
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(iters):
+            o = fn()
+            acc = o if acc is None else acc + o
+        np.asarray(acc)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", type=int, default=960)
+    ap.add_argument("--k", type=int, default=6)
+    ap.add_argument("--iters", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(7)
+    S = args.slices
+    w_host = rng.integers(0, 2**32, size=(S * 32, 2048), dtype=np.uint32)
+    w = jax.device_put(w_host)
+    f_host = w_host.view(np.float32)
+    f = jax.device_put(f_host)
+    K = args.k
+    mul = jax.device_put(np.arange(1, K + 1, dtype=np.uint32))
+    gb = w_host.nbytes / 1e9
+
+    results = {}
+
+    def run(name, fn):
+        dt = sustained(fn, args.iters) / K
+        results[name] = {"per_pass_ms": dt * 1e3, "gbps": gb / dt}
+        print(f"{name:26s} {dt*1e3:8.3f} ms/pass  {gb/dt:7.0f} GB/s",
+              flush=True)
+
+    @jax.jit
+    def pc_full(w, mul):
+        return jnp.stack([
+            (lax.population_count(w) * mul[k]).astype(jnp.uint32).sum()
+            for k in range(K)])
+
+    run("popcount_full_reduce", lambda: pc_full(w, mul))
+
+    @jax.jit
+    def pc_axis(w, mul):
+        return jnp.stack([
+            (lax.population_count(w) * mul[k]).sum(
+                axis=1, dtype=jnp.uint32).sum()
+            for k in range(K)])
+
+    run("popcount_axis_then_sum", lambda: pc_axis(w, mul))
+
+    @jax.jit
+    def f32_sum(f, mul):
+        return jnp.stack([(f * mul[k].astype(jnp.float32)).sum()
+                          for k in range(K)])
+
+    run("f32_full_reduce", lambda: f32_sum(f, mul))
+
+    ones = jax.device_put(np.ones((2048,), dtype=np.float32))
+
+    @jax.jit
+    def pc_matmul(w, ones, mul):
+        outs = []
+        for k in range(K):
+            pc = lax.population_count(w * mul[k]).astype(jnp.bfloat16)
+            outs.append(jnp.dot(pc, ones.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32).sum())
+        return jnp.stack(outs)
+
+    run("popcount_matmul_reduce", lambda: pc_matmul(w, ones, mul))
+
+    @jax.jit
+    def pc_matmul2(w, ones, mul):
+        # matmul on both stages: (N, 2048) @ (2048,) -> (N,) then
+        # ones @ (N,) via second dot
+        outs = []
+        o2 = jnp.ones((w.shape[0],), dtype=jnp.float32)
+        for k in range(K):
+            pc = lax.population_count(w * mul[k]).astype(jnp.bfloat16)
+            v = jnp.dot(pc, ones.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+            outs.append(jnp.dot(o2, v))
+        return jnp.stack(outs)
+
+    run("popcount_matmul_both", lambda: pc_matmul2(w, ones, mul))
+
+    # AND + popcount + matmul reduce (the real query shape, slab form)
+    a = jax.device_put(w_host[: S * 16])
+    b = jax.device_put(w_host[S * 16:])
+
+    @jax.jit
+    def and_pc_matmul(a, b, ones, mul):
+        outs = []
+        for k in range(K):
+            pc = lax.population_count((a * mul[k]) & b).astype(jnp.bfloat16)
+            outs.append(jnp.dot(pc, ones.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32).sum())
+        return jnp.stack(outs)
+
+    run("and_pc_matmul_reduce", lambda: and_pc_matmul(a, b, ones, mul))
+
+    # 8-bit view: popcount u8 then matmul reduce — same bytes, narrower
+    # lanes (4x element count; tests lane-width sensitivity)
+    w8 = jax.device_put(w_host.view(np.uint8))
+
+    @jax.jit
+    def pc8_matmul(w8, mul):
+        ones8 = jnp.ones((w8.shape[1],), dtype=jnp.bfloat16)
+        outs = []
+        for k in range(K):
+            pc = lax.population_count(w8 * mul[k].astype(jnp.uint8)
+                                      ).astype(jnp.bfloat16)
+            outs.append(jnp.dot(pc, ones8,
+                                preferred_element_type=jnp.float32).sum())
+        return jnp.stack(outs)
+
+    run("popcount_u8_matmul", lambda: pc8_matmul(w8, mul))
+
+    with open("PROFILE_HEADLINE3.json", "w") as fjs:
+        json.dump({k: {kk: round(vv, 3) for kk, vv in v.items()}
+                   for k, v in results.items()}, fjs, indent=2)
+        fjs.write("\n")
+
+
+if __name__ == "__main__":
+    main()
