@@ -89,6 +89,14 @@ class Config:
         self.cluster_type: str = "http"
         self.gossip_port: int = DEFAULT_GOSSIP_PORT
         self.gossip_seed: str = ""
+        # SPMD multi-host data plane ([cluster] type = "spmd"): the
+        # jax.distributed coordinator + this process's rank. Empty/-1
+        # defer to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+        # JAX_PROCESS_ID env vars, then JAX's own cluster autodetection
+        # (mesh.connect_distributed).
+        self.spmd_coordinator: str = ""
+        self.spmd_num_processes: int = -1
+        self.spmd_process_id: int = -1
         self.replica_n: int = DEFAULT_REPLICA_N
         self.partition_n: int = DEFAULT_PARTITION_N
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
@@ -117,6 +125,12 @@ class Config:
         c.gossip_seed = str(cl.get("gossip-seed", c.gossip_seed))
         c.replica_n = int(cl.get("replicas", c.replica_n))
         c.partition_n = int(cl.get("partitions", c.partition_n))
+        c.spmd_coordinator = str(cl.get("spmd-coordinator",
+                                        c.spmd_coordinator))
+        c.spmd_num_processes = int(cl.get("spmd-processes",
+                                          c.spmd_num_processes))
+        c.spmd_process_id = int(cl.get("spmd-process-id",
+                                       c.spmd_process_id))
         if "polling-interval" in cl:
             c.polling_interval = parse_duration(cl["polling-interval"])
         ae = data.get("anti-entropy", {})
@@ -149,6 +163,9 @@ class Config:
             f"hosts = [{hosts}]\n"
             f"gossip-port = {self.gossip_port}\n"
             f'gossip-seed = "{self.gossip_seed}"\n'
+            f'spmd-coordinator = "{self.spmd_coordinator}"\n'
+            f"spmd-processes = {self.spmd_num_processes}\n"
+            f"spmd-process-id = {self.spmd_process_id}\n"
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'

@@ -126,11 +126,36 @@ class Executor:
         # below remain the fallback.
         self._mesh_mgr = None
         self._mesh_mgr_failed = False
+        # SPMD descriptor plane (parallel/spmd.py), set by server wiring
+        # when [cluster] type = "spmd": device collectives must be
+        # driven through the multi-host descriptor stream, never by
+        # this process alone (a unilateral psum over a global mesh
+        # hangs every rank).
+        self._spmd = None
         # Guards lazy construction: two concurrent first queries must
         # not each build a manager and stage duplicate device images.
         import threading
 
         self._mesh_mgr_lock = threading.Lock()
+
+    def set_spmd(self, spmd):
+        """Wire the SPMD descriptor plane (rank 0 of a multi-host
+        deployment): Count/TopN collectives and bit writes route
+        through `spmd`, and the executor shares its MeshManager so
+        staging/stats have one home."""
+        self._spmd = spmd
+        self._mesh_mgr = spmd.manager
+
+    # Set True on SPMD worker ranks (server wiring): a mutation applied
+    # here alone would silently diverge this rank's replica from the
+    # descriptor-ordered stream — reject so the client retargets rank 0.
+    spmd_reject_writes = False
+
+    def _check_writable(self, what: str):
+        if self.spmd_reject_writes:
+            raise QueryError(
+                f"{what} must be sent to SPMD rank 0 (this is a worker "
+                "rank; writes ride the descriptor stream)")
 
     # -- top level -----------------------------------------------------------
 
@@ -418,6 +443,19 @@ class Executor:
             return None
         shape, leaves = lowered
 
+        if self._spmd is not None:
+            # Multi-host: the collective must be driven through the
+            # descriptor stream so every rank enters it together.
+            def batch_fn(batch_slices):
+                try:
+                    return self._spmd.count(
+                        index, shape, leaves, batch_slices,
+                        self._batch_num_slices(index, batch_slices))
+                except Exception:  # noqa: BLE001 — device failure → host
+                    return None
+
+            return batch_fn
+
         def batch_fn(batch_slices):
             try:
                 return mgr.count(index, shape, leaves, batch_slices,
@@ -558,6 +596,13 @@ class Executor:
         (band math over three exact device vectors); None only for a
         non-lowerable src tree or malformed args (host path owns the
         error reporting)."""
+        if not self._device_backend_on():
+            # Must be checked BEFORE consulting the manager: an SPMD
+            # worker rank has a manager injected for stats visibility
+            # but use_device=False — letting it drive mgr.top_n would
+            # enter a global-mesh psum unilaterally and hang every
+            # rank.
+            return None
         mgr = self.mesh_manager()
         if mgr is None:
             return None
@@ -597,6 +642,26 @@ class Executor:
         n, _ = c.uint_arg("n")
         row_ids, _ = c.uint_slice_arg("ids")
         min_threshold, _ = c.uint_arg("threshold")
+
+        if self._spmd is not None:
+            if src is not None or tanimoto:
+                # src-intersection and tanimoto forms are not
+                # descriptor-served yet; the host path answers them
+                # correctly from rank 0's full replica.
+                return None
+
+            def batch_fn(batch_slices):
+                try:
+                    return self._spmd.top_n(
+                        index, frame, VIEW_STANDARD, batch_slices,
+                        self._batch_num_slices(index, batch_slices),
+                        0 if row_ids else n, row_ids,
+                        min_threshold or MIN_THRESHOLD,
+                        attr_predicate=attr_predicate)
+                except Exception:  # noqa: BLE001 — device failure → host
+                    return None
+
+            return batch_fn
 
         def batch_fn(batch_slices):
             try:
@@ -680,6 +745,7 @@ class Executor:
         return f, row_id, col_id
 
     def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        self._check_writable("SetBit()")
         f, row_id, col_id = self._read_bit_args(index, c)
 
         timestamp = None
@@ -690,12 +756,25 @@ class Executor:
             except ValueError:
                 raise QueryError(f"invalid date: {ts}")
 
+        if self._spmd is not None and not opt.remote:
+            # Multi-host SPMD: the write broadcast on the descriptor
+            # stream IS the replication (every rank applies it to its
+            # holder, totally ordered with queries) — the per-replica
+            # HTTP fan-out below is the single-host-cluster path.
+            return self._spmd.write(index, f.name, row_id, col_id,
+                                    ts if isinstance(ts, str) else None,
+                                    clear=False)
+
         return self._execute_mutate_view(
             index, c, opt, col_id,
             lambda: f.set_bit(row_id, col_id, timestamp))
 
     def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        self._check_writable("ClearBit()")
         f, row_id, col_id = self._read_bit_args(index, c)
+        if self._spmd is not None and not opt.remote:
+            return self._spmd.write(index, f.name, row_id, col_id, None,
+                                    clear=True)
         return self._execute_mutate_view(
             index, c, opt, col_id,
             lambda: f.clear_bit(row_id, col_id))
@@ -729,6 +808,7 @@ class Executor:
         return [n for n in self.cluster.nodes if n.host != self.host]
 
     def _execute_set_row_attrs(self, index: str, c: Call, opt: ExecOptions):
+        self._check_writable("SetRowAttrs()")
         """SetRowAttrs (executor.go:799-855)."""
         frame_name = c.args.get("frame")
         if not isinstance(frame_name, str):
@@ -776,6 +856,7 @@ class Executor:
         return [None] * len(calls)
 
     def _execute_set_column_attrs(self, index: str, c: Call, opt: ExecOptions):
+        self._check_writable("SetColumnAttrs()")
         """SetColumnAttrs (executor.go:943-998)."""
         idx = self.holder.index(index)
         if idx is None:

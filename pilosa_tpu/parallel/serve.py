@@ -85,6 +85,58 @@ class StagedView:
         return self.sharded.num_slices
 
 
+def combine_limbs(limbs: np.ndarray, n: int, start: int = 0) -> np.ndarray:
+    """Combine a (2, R) [lo16, hi] int32 limb array's columns
+    [start, start+n) into int64 counts — the ONE host-side inverse of
+    the device kernels' 16-bit limb split (compile_serve_row_counts and
+    friends). Every consumer (single-host TopN paths, the SPMD
+    descriptor plane) must use this so a limb-width change lands
+    everywhere at once."""
+    lo = limbs[0, start:start + n].astype(np.int64)
+    hi = limbs[1, start:start + n].astype(np.int64)
+    return (hi << 16) + lo
+
+
+def rank_pairs(all_rows, counts, n: int, row_ids, min_threshold: int,
+               attr_predicate=None):
+    """Host-side TopN semantics over exact per-row totals: candidate
+    ids (phase 2), threshold, n, and the bounded attr-filter walk —
+    shared by the single-host serving path (MeshManager.top_n) and the
+    SPMD descriptor plane so the two cannot drift. See top_n's
+    docstring for the deliberate threshold deviation."""
+    if len(all_rows) == 0:
+        return []
+    if row_ids:
+        want = np.asarray(sorted(row_ids), dtype=np.uint64)
+        i = np.searchsorted(all_rows, want)
+        ok = (i < len(all_rows))
+        ok &= all_rows[np.minimum(i, max(len(all_rows) - 1, 0))] == want
+        pairs = [(int(r), int(counts[j]))
+                 for r, j in zip(want[ok], i[ok])
+                 if counts[j] >= max(min_threshold, 1)
+                 and (attr_predicate is None or attr_predicate(int(r)))]
+        pairs.sort(key=lambda p: (-p[1], p[0]))
+        return pairs
+    keep = np.nonzero(counts >= max(min_threshold, 1))[0]
+    order = np.lexsort((all_rows[keep], -counts[keep]))
+    keep = keep[order]
+    if attr_predicate is None:
+        if n:
+            keep = keep[:n]
+        return [(int(all_rows[j]), int(counts[j])) for j in keep]
+    # Attr filters (reference fragment.go:538-546): counts are already
+    # exact, so walk the sorted rows applying the host-side attribute
+    # predicate until n match — attr-store lookups stay bounded near n
+    # instead of scanning every row.
+    out = []
+    for j in keep:
+        if attr_predicate(int(all_rows[j])):
+            out.append((int(all_rows[j]), int(counts[j])))
+            if n and len(out) == n:
+                break
+    return out
+
+
 def _reraise_shared(what: str, err: BaseException):
     """Raise a FRESH exception wrapping a shared one: many threads can
     hold the same failed-group/in-flight error, and re-raising one
@@ -679,14 +731,14 @@ class MeshManager:
         self._mask_cache[key] = dev
         return dev
 
-    def _row_counts_call(self, index: str, frame: str, view: str,
+    def _row_counts_args(self, index: str, frame: str, view: str,
                          slices: Sequence[int], num_slices: int):
-        """(row_ids, zero-arg callable -> (2, padded) DEVICE limb
-        array — async; np.asarray it to materialize) or None; see
-        _count_call for the locking contract. Identical concurrent
-        calls (same staged image, mask, padding) SHARE one in-flight
-        device execution — the common shape of a TopN hotspot is many
-        clients asking the same frame."""
+        """Snapshot the staged arrays for a per-row-counts collective:
+        (row_ids, sharded, dev_mask, padded, epoch), ("empty", row_ids)
+        for a rowless view, or None on fallback. The resolution half of
+        _row_counts_call, shared with the SPMD descriptor plane
+        (spmd.SpmdServer) so staging/mask semantics cannot diverge.
+        Takes _mu."""
         with self._mu:
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
@@ -698,10 +750,26 @@ class MeshManager:
                 self.stats["fallback"] += 1
                 return None
             if len(sv.row_ids) == 0:
-                return sv.row_ids, None
+                return ("empty", sv.row_ids)
             padded = 1 << (len(sv.row_ids) - 1).bit_length()
             dev_mask = self._device_mask(mask)
             epoch = self._memo_epoch
+        return sv.row_ids, sharded, dev_mask, padded, epoch
+
+    def _row_counts_call(self, index: str, frame: str, view: str,
+                         slices: Sequence[int], num_slices: int):
+        """(row_ids, zero-arg callable -> (2, padded) DEVICE limb
+        array — async; np.asarray it to materialize) or None; see
+        _count_call for the locking contract. Identical concurrent
+        calls (same staged image, mask, padding) SHARE one in-flight
+        device execution — the common shape of a TopN hotspot is many
+        clients asking the same frame."""
+        out = self._row_counts_args(index, frame, view, slices, num_slices)
+        if out is None:
+            return None
+        if len(out) == 2:  # ("empty", row_ids): rowless view
+            return out[1], None
+        row_ids, sharded, dev_mask, padded, epoch = out
         # Compile OUTSIDE _mu: a multi-second first-shape compile must
         # not block staging/serving of every other query.
         fn = self._get_or_compile(
@@ -710,14 +778,14 @@ class MeshManager:
         key = ("rc", id(sharded.words), id(dev_mask), padded)
         memo = self._memo_get(key)
         if memo is not None:
-            return sv.row_ids, (lambda: memo)
+            return row_ids, (lambda: memo)
 
         def call():
             out = self._single_flight(key, lambda: fn(sharded, dev_mask))
             self._memo_put(key, out, (sharded.words, dev_mask), epoch)
             return out
 
-        return sv.row_ids, call
+        return row_ids, call
 
     def _single_flight(self, key: tuple, compute):
         """Share one in-flight device execution among identical
@@ -766,9 +834,7 @@ class MeshManager:
         if call is None:
             return row_ids, np.zeros(0, dtype=np.int64)
         limbs = np.asarray(call())
-        n = len(row_ids)
-        counts = ((limbs[1, :n].astype(np.int64) << 16)
-                  + limbs[0, :n].astype(np.int64))
+        counts = combine_limbs(limbs, len(row_ids))
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return row_ids, counts
@@ -801,12 +867,9 @@ class MeshManager:
         if limbs is None:
             return []  # staged view has no rows
         r = len(all_rows)
-        full = ((limbs[1, :r].astype(np.int64) << 16)
-                + limbs[0, :r].astype(np.int64))
-        inter = ((limbs[1, padded:padded + r].astype(np.int64) << 16)
-                 + limbs[0, padded:padded + r].astype(np.int64))
-        src_count = ((int(limbs[1, 2 * padded]) << 16)
-                     + int(limbs[0, 2 * padded]))
+        full = combine_limbs(limbs, r)
+        inter = combine_limbs(limbs, r, start=padded)
+        src_count = int(combine_limbs(limbs, 1, start=2 * padded)[0])
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         if src_count == 0:
@@ -904,9 +967,7 @@ class MeshManager:
         row_ids, _padded, limbs = out
         if limbs is None:
             return row_ids, np.zeros(0, dtype=np.int64)
-        r = len(row_ids)
-        counts = ((limbs[1, :r].astype(np.int64) << 16)
-                  + limbs[0, :r].astype(np.int64))
+        counts = combine_limbs(limbs, len(row_ids))
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
         return row_ids, counts
@@ -962,34 +1023,5 @@ class MeshManager:
         if out is None:
             return None
         all_rows, counts = out
-        if len(all_rows) == 0:
-            return []
-        if row_ids:
-            want = np.asarray(sorted(row_ids), dtype=np.uint64)
-            i = np.searchsorted(all_rows, want)
-            ok = (i < len(all_rows))
-            ok &= all_rows[np.minimum(i, max(len(all_rows) - 1, 0))] == want
-            pairs = [(int(r), int(counts[j]))
-                     for r, j in zip(want[ok], i[ok])
-                     if counts[j] >= max(min_threshold, 1)
-                     and (attr_predicate is None or attr_predicate(int(r)))]
-            pairs.sort(key=lambda p: (-p[1], p[0]))
-            return pairs
-        keep = np.nonzero(counts >= max(min_threshold, 1))[0]
-        order = np.lexsort((all_rows[keep], -counts[keep]))
-        keep = keep[order]
-        if attr_predicate is None:
-            if n:
-                keep = keep[:n]
-            return [(int(all_rows[j]), int(counts[j])) for j in keep]
-        # Attr filters (reference fragment.go:538-546): counts are
-        # already exact, so walk the sorted rows applying the host-side
-        # attribute predicate until n match — attr-store lookups stay
-        # bounded near n instead of scanning every row.
-        out: List[Tuple[int, int]] = []
-        for j in keep:
-            if attr_predicate(int(all_rows[j])):
-                out.append((int(all_rows[j]), int(counts[j])))
-                if n and len(out) == n:
-                    break
-        return out
+        return rank_pairs(all_rows, counts, n, row_ids, min_threshold,
+                          attr_predicate)

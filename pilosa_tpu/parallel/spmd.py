@@ -10,16 +10,34 @@ encodes each device request as a fixed-shape descriptor, broadcasts it
 over the device fabric (jax.experimental.multihost_utils), and ALL
 processes resolve it against their holder and execute the same
 collective. Replication model: the host-side data dir is replicated
-across hosts (each process opens the same fragments — the reference's
-ReplicaN=N analog); DEVICE memory is what shards, slices spreading
-over every host's chips via the global mesh.
+across hosts — kept in sync by routing every WRITE and SCHEMA change
+through the same descriptor stream (one total order for writes,
+schema, and queries; the reference's ReplicaN=N write fan-out,
+executor.go:767-797, becomes a broadcast on the device fabric); DEVICE
+memory is what shards, slices spreading over every host's chips via
+the global mesh.
+
+Descriptor ops:
+    COUNT      Count over a lowered bitmap-op tree (psum collective)
+    ROWCOUNTS  per-row totals for TopN (psum collective)
+    WRITE      SetBit/ClearBit — every rank applies to ITS holder; the
+               staged device image then folds the bits in as an
+               incremental scatter at the next query's refresh (a
+               per-shard device op, no cross-rank collective)
+    SCHEMA     a wire-framed broadcast message (CreateIndex/Frame/...)
+               applied through each rank's BroadcastHandler
+    STOP       release the worker loops
 
 Control flow per request:
-    rank 0: serve(index, shape, leaves, slices)  -> descriptor
-            broadcast_one_to_all(descriptor)     -> all ranks
-    all:    decode -> MeshManager._count_args -> compiled collective
-    all:    limbs replicated on every process; rank 0 returns the count
+    rank 0: serve(...) -> descriptor -> broadcast_one_to_all -> all
+    all:    decode -> resolve against local holder -> agreement gate ->
+            identical compiled collective (COUNT/ROWCOUNTS only)
+    all:    limbs replicated on every process; rank 0 returns the value
 Non-zero ranks sit in run_worker() until rank 0 broadcasts a stop.
+
+Bootable via `[cluster] type = "spmd"` in the server TOML (server.py
+wires connect_distributed + SpmdServer + the executor seams; the same
+wiring the reference does at startup in server/server.go:107-192).
 """
 
 from __future__ import annotations
@@ -29,12 +47,17 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .broadcast import Broadcaster
+
 # Fixed descriptor size: broadcast payloads must be identical shapes on
 # every rank. 64 KB bounds the slice list of a masked query.
 _DESC_BYTES = 65536
 
 _OP_COUNT = 1
 _OP_STOP = 2
+_OP_ROWCOUNTS = 3
+_OP_WRITE = 4
+_OP_SCHEMA = 5
 
 
 def _encode(obj: dict) -> np.ndarray:
@@ -51,13 +74,41 @@ def _decode(buf: np.ndarray) -> dict:
     return json.loads(raw[: raw.index(b"\x00")] if b"\x00" in raw else raw)
 
 
+class SpmdBroadcaster(Broadcaster):
+    """Broadcaster whose transport is the SPMD descriptor stream: a
+    schema message broadcast rides the same total order as writes and
+    queries, so a worker can never run a query descriptor against a
+    schema it hasn't applied yet. Rank 0 only — workers apply, they
+    never originate (their handler's mutating routes shouldn't be used;
+    originating from a worker would require a reverse channel)."""
+
+    def __init__(self, spmd: "SpmdServer"):
+        self._spmd = spmd
+
+    def send_sync(self, msg) -> None:
+        # A broadcast ORIGINATED by descriptor execution (e.g. a write
+        # growing a view's maxSlice fires CreateSliceMessage from
+        # inside _execute_write) must not re-enter the stream: every
+        # rank is executing the same descriptor and derives the same
+        # change locally — re-broadcasting would deadlock on _mu.
+        if getattr(self._spmd._local, "in_exec", False):
+            return
+        self._spmd.schema(msg)
+
+    def send_async(self, msg) -> None:
+        self.send_sync(msg)
+
+
 class SpmdServer:
     """One process's half of the SPMD serving pact.
 
     Every process constructs this over its own (replicated-data) holder;
-    rank 0 calls count(...) per client query, other ranks call
-    run_worker() once. All processes must create their MeshManager over
-    the same GLOBAL mesh (the default after connect_distributed)."""
+    rank 0 calls count/top_n/write/schema per client request, other
+    ranks call run_worker() once. All processes must create their
+    MeshManager over the same GLOBAL mesh (the default after
+    connect_distributed). `apply_message` must be set (by server
+    wiring) to the node's BroadcastHandler receive_message before
+    SCHEMA descriptors flow."""
 
     def __init__(self, holder, mesh=None):
         import threading
@@ -68,15 +119,28 @@ class SpmdServer:
 
         self.rank = jax.process_index()
         self.manager = MeshManager(holder, mesh=mesh)
-        # AOT-compiled programs keyed by (sig, shapes): compilation must
-        # happen BEFORE the agreement gate (see _execute), and jit only
-        # compiles at first call — lower().compile() forces it eagerly.
+        self.holder = holder
+        self.apply_message = None  # set by server wiring (receive_message)
+        # AOT-compiled programs keyed by (kind, sig, shapes): compilation
+        # must happen BEFORE the agreement gate (see _execute_count), and
+        # jit only compiles at first call — lower().compile() forces it.
         self._compiled: dict = {}
         # Serializes descriptor broadcast + gate + execute: the HTTP
         # front-end is threaded, and two interleaved
         # broadcast_one_to_all collectives from rank 0 would pair
         # nondeterministically with the workers' sequential loop.
         self._mu = threading.Lock()
+        # Per-thread "inside descriptor execution" flag — read by
+        # SpmdBroadcaster to swallow re-entrant broadcasts.
+        self._local = threading.local()
+
+    def _run(self, desc: dict):
+        """Execute one descriptor with the re-entrancy flag set."""
+        self._local.in_exec = True
+        try:
+            return self._dispatch(desc)
+        finally:
+            self._local.in_exec = False
 
     # -- rank 0 --------------------------------------------------------------
 
@@ -94,7 +158,82 @@ class SpmdServer:
         }
         with self._mu:
             self._broadcast(desc)
-            return self._execute(desc)
+            return self._run(desc)
+
+    def row_counts(self, index: str, frame: str, view: str,
+                   slices: Sequence[int], num_slices: int):
+        """Broadcast + execute one per-row-counts collective (the TopN
+        device half). Returns (row_ids, counts int64) or None. Rank 0
+        only."""
+        assert self.rank == 0
+        desc = {
+            "op": _OP_ROWCOUNTS,
+            "index": index,
+            "frame": frame,
+            "view": view,
+            "slices": list(map(int, slices)),
+            "num_slices": int(num_slices),
+        }
+        with self._mu:
+            self._broadcast(desc)
+            return self._run(desc)
+
+    def top_n(self, index: str, frame: str, view: str,
+              slices: Sequence[int], num_slices: int, n: int,
+              row_ids: Sequence[int], min_threshold: int,
+              attr_predicate=None):
+        """TopN from one ROWCOUNTS collective + the shared host-side
+        ranking (serve.rank_pairs). The src/tanimoto argument forms are
+        NOT descriptor-served — the executor falls back to the host
+        path for those (correct: rank 0's holder is a full replica)."""
+        out = self.row_counts(index, frame, view, slices, num_slices)
+        if out is None:
+            return None
+        from .serve import rank_pairs
+
+        all_rows, counts = out
+        return rank_pairs(all_rows, counts, n, row_ids, min_threshold,
+                          attr_predicate)
+
+    def write(self, index: str, frame: str, row_id: int, col_id: int,
+              timestamp: Optional[str], clear: bool) -> bool:
+        """Broadcast one bit mutation; EVERY rank (this one included)
+        applies it to its own holder, keeping the replicated data dirs
+        convergent and totally ordered with queries. Returns the local
+        changed flag (identical on every rank given identical
+        replicas). Rank 0 only."""
+        assert self.rank == 0
+        desc = {
+            "op": _OP_WRITE,
+            "index": index,
+            "frame": frame,
+            "row": int(row_id),
+            "col": int(col_id),
+            "ts": timestamp,
+            "clear": bool(clear),
+        }
+        with self._mu:
+            self._broadcast(desc)
+            return self._run(desc)
+
+    def schema(self, msg) -> None:
+        """Broadcast one wire schema message (CreateIndex/CreateFrame/
+        Delete.../CreateSlice) through the descriptor stream. Rank 0
+        applies locally through the same path as workers (idempotent —
+        the handler already applied the originating change to rank 0's
+        holder before broadcasting, reference handler.go semantics)."""
+        assert self.rank == 0
+        from ..wire import marshal_message
+
+        import base64
+
+        desc = {
+            "op": _OP_SCHEMA,
+            "raw": base64.b64encode(marshal_message(msg)).decode(),
+        }
+        with self._mu:
+            self._broadcast(desc)
+            self._run(desc)
 
     def stop(self):
         """Release every worker loop. Rank 0 only."""
@@ -117,12 +256,24 @@ class SpmdServer:
             if desc["op"] == _OP_STOP:
                 return
             try:
-                self._execute(desc)
+                self._run(desc)
             except Exception as e:  # noqa: BLE001 — stay in the pact
                 import logging
 
                 logging.getLogger("pilosa_tpu.spmd").warning(
                     "spmd worker: descriptor failed: %s", e)
+
+    def _dispatch(self, desc: dict):
+        op = desc["op"]
+        if op == _OP_COUNT:
+            return self._execute_count(desc)
+        if op == _OP_ROWCOUNTS:
+            return self._execute_rowcounts(desc)
+        if op == _OP_WRITE:
+            return self._execute_write(desc)
+        if op == _OP_SCHEMA:
+            return self._execute_schema(desc)
+        raise ValueError(f"unknown descriptor op: {op}")
 
     def _broadcast(self, desc: Optional[dict]) -> dict:
         from jax.experimental import multihost_utils
@@ -132,26 +283,34 @@ class SpmdServer:
         out = multihost_utils.broadcast_one_to_all(payload)
         return _decode(out)
 
-    def _execute(self, desc: dict) -> Optional[int]:
-        """Resolve, AGREE on the program, then execute.
+    # -- descriptor execution (symmetric on every rank) ----------------------
 
-        Resolution can fail — or succeed with a DIFFERENT program — on
-        one rank alone (replicated data dirs momentarily out of sync: a
-        lagging replica stages a different pool capacity). A rank
-        skipping the psum, or entering it with mismatched shapes, hangs
-        the whole mesh. So every rank resolves locally, then an
-        allgather compares PROGRAM FINGERPRINTS (tree signature + every
-        staged array shape, deterministically hashed): the collective
-        runs only when every rank resolved the identical program;
-        otherwise all skip together."""
+    def _gate(self, fingerprint_blob: Optional[bytes]) -> bool:
+        """Program-agreement gate: allgather a deterministic hash of
+        the locally-resolved program; the collective runs only when
+        every rank resolved the IDENTICAL program, else all skip
+        together (a rank entering a psum alone — or with mismatched
+        shapes — hangs the whole mesh)."""
         import zlib
 
         from jax.experimental import multihost_utils
 
+        fp = (np.int64(0) if fingerprint_blob is None
+              else np.int64(zlib.crc32(fingerprint_blob) + 1))
+        fps = multihost_utils.process_allgather(fp)
+        return int(fp) != 0 and bool(np.all(fps == fps[0]))
+
+    def _execute_count(self, desc: dict) -> Optional[int]:
+        """Resolve, AGREE on the program, then execute.
+
+        Resolution can fail — or succeed with a DIFFERENT program — on
+        one rank alone (replicated data dirs momentarily out of sync: a
+        lagging replica stages a different pool capacity), hence the
+        fingerprint gate."""
         from .mesh import combine_count
 
         leaves = [tuple(leaf) for leaf in desc["leaves"]]
-        compiled = None
+        compiled = blob = None
         try:
             prepared = self.manager._count_args(
                 desc["index"], desc["shape"], leaves, desc["slices"],
@@ -171,24 +330,107 @@ class SpmdServer:
                     [tuple(w.shape) for w in words_t]
                     + [tuple(i.shape) for i in idx_t]
                     + [tuple(mask.shape)])
-                ckey = (sig, shapes)
+                ckey = ("count", sig, shapes)
                 compiled = self._compiled.get(ckey)
                 if compiled is None:
                     fn = self.manager._count_fn(sig, len(idx_t))
                     compiled = fn.lower(words_t, idx_t, hit_t,
                                         mask).compile()
                     self._compiled[ckey] = compiled
+                blob = json.dumps(["count", sig, list(shapes)]).encode()
         except Exception:  # noqa: BLE001 — counted as not-ready below
             compiled = None
-        if compiled is None:
-            fp = np.int64(0)
-        else:
-            blob = json.dumps([sig, list(shapes)]).encode()
-            # NOT hash(): Python string hashing is per-process salted.
-            fp = np.int64(zlib.crc32(blob) + 1)
-        fps = multihost_utils.process_allgather(fp)
-        if int(fp) == 0 or not bool(np.all(fps == fps[0])):
+        if not self._gate(blob if compiled is not None else None):
             return None  # every rank skips: no divergent collective
         # Past the gate, all ranks run the identical program; a runtime
         # failure here hits every rank symmetrically.
-        return combine_count(compiled(words_t, idx_t, hit_t, mask))
+        out = combine_count(compiled(words_t, idx_t, hit_t, mask))
+        self.manager.stats["count"] += 1
+        return out
+
+    def _execute_rowcounts(self, desc: dict):
+        """ROWCOUNTS: per-row totals over the global mesh. The
+        fingerprint covers the staged shapes AND the dense row table —
+        misaligned row_ids across ranks would psum different rows into
+        the same position."""
+        import zlib
+
+        from .mesh import compile_serve_row_counts
+
+        compiled = blob = None
+        try:
+            out = self.manager._row_counts_args(
+                desc["index"], desc["frame"], desc["view"], desc["slices"],
+                desc["num_slices"])
+            if out is not None and len(out) == 2:
+                # Rowless view everywhere: agree on "empty" (crc of the
+                # marker) so every rank returns without a collective.
+                blob = b"rowcounts-empty"
+                if not self._gate(blob):
+                    return None
+                return out[1], np.zeros(0, dtype=np.int64)
+            if out is not None:
+                row_ids, sharded, dev_mask, padded, _epoch = out
+                ckey = ("rc", padded, tuple(sharded.words.shape))
+                compiled = self._compiled.get(ckey)
+                if compiled is None:
+                    fn = self.manager._get_or_compile(
+                        self.manager._rowcount_fns, padded,
+                        lambda: compile_serve_row_counts(
+                            self.manager.mesh, padded))
+                    compiled = fn.lower(sharded, dev_mask).compile()
+                    self._compiled[ckey] = compiled
+                blob = json.dumps(
+                    ["rc", padded, list(sharded.words.shape),
+                     int(zlib.crc32(np.ascontiguousarray(row_ids)))]
+                ).encode()
+        except Exception:  # noqa: BLE001 — not-ready below
+            compiled = None
+        if not self._gate(blob if compiled is not None else None):
+            return None
+        from .serve import combine_limbs
+
+        limbs = np.asarray(compiled(sharded, dev_mask))
+        counts = combine_limbs(limbs, len(row_ids))
+        self.manager.stats["topn"] += 1
+        return row_ids, counts
+
+    def _execute_write(self, desc: dict) -> bool:
+        """WRITE: apply the bit to THIS rank's holder (host-side; the
+        staged device image folds it in as an incremental scatter at
+        the next query's refresh). No collective, no gate — each rank
+        applies independently and the descriptor order is the total
+        order."""
+        idx = self.holder.index(desc["index"])
+        if idx is None:
+            return False
+        f = idx.frame(desc["frame"])
+        if f is None:
+            return False
+        if desc["clear"]:
+            return bool(f.clear_bit(desc["row"], desc["col"]))
+        ts = None
+        if desc["ts"]:
+            from ..executor import parse_time
+
+            ts = parse_time(desc["ts"])
+        return bool(f.set_bit(desc["row"], desc["col"], ts))
+
+    def _execute_schema(self, desc: dict) -> None:
+        """SCHEMA: unmarshal the wire message and apply it through the
+        node's BroadcastHandler (server.receive_message)."""
+        import base64
+
+        from ..wire import unmarshal_message
+
+        if self.apply_message is None:
+            raise RuntimeError("SpmdServer.apply_message not wired")
+        msg = unmarshal_message(base64.b64decode(desc["raw"]))
+        try:
+            self.apply_message(msg)
+        except ValueError:
+            # e.g. CreateSlice for an index this rank hasn't created
+            # yet on a fresh boot — the schema descriptor that creates
+            # it is earlier in the stream, so this is only reachable
+            # when rank 0 itself re-applies its own originating change.
+            pass

@@ -84,9 +84,32 @@ class Server:
         self.client = ClusterClient()
 
         # Transport selection (reference server/server.go:150-187:
-        # static | http | gossip).
+        # static | http | gossip; plus the TPU-native "spmd" multi-host
+        # data plane).
+        self.spmd = None
+        self._spmd_rank = 0
         ctype = self.config.cluster_type
-        if ctype == "gossip":
+        if ctype == "spmd":
+            # Multi-host SPMD: join the jax.distributed runtime FIRST
+            # (before anything touches a jax backend), then build the
+            # descriptor plane over the GLOBAL mesh. The node set is
+            # this host alone — replication and fan-out ride the
+            # descriptor stream, not HTTP (parallel/spmd.py).
+            from .parallel.mesh import connect_distributed
+            from .parallel.spmd import SpmdBroadcaster, SpmdServer
+
+            self._spmd_rank = connect_distributed(
+                self.config.spmd_coordinator or None,
+                (self.config.spmd_num_processes
+                 if self.config.spmd_num_processes > 0 else None),
+                (self.config.spmd_process_id
+                 if self.config.spmd_process_id >= 0 else None))
+            self.spmd = SpmdServer(self.holder)
+            self.spmd.apply_message = self.receive_message
+            self.node_set = StaticNodeSet([self.host])
+            self.broadcaster = (SpmdBroadcaster(self.spmd)
+                                if self._spmd_rank == 0 else NopBroadcaster())
+        elif ctype == "gossip":
             from .parallel.gossip import GossipNodeSet
             bind_ip = self.host.partition(":")[0] or "127.0.0.1"
             seeds = []
@@ -110,12 +133,32 @@ class Server:
             self.broadcaster = NopBroadcaster()
         else:
             raise ValueError(f"unknown cluster type: {ctype!r} "
-                             "(want static, http, or gossip)")
+                             "(want static, http, gossip, or spmd)")
         self.holder.broadcaster = self.broadcaster
 
+        use_device = self.config.use_device_flag()
+        if self.spmd is not None and self._spmd_rank != 0:
+            # A worker's executor must NEVER drive mesh collectives by
+            # itself (a unilateral shard_map over the global mesh hangs
+            # every rank); HTTP queries landing here serve from the
+            # host roaring path over the replicated holder.
+            use_device = False
         self.executor = Executor(self.holder, host=self.host,
                                  cluster=self.cluster, client=self.client,
-                                 use_device=self.config.use_device_flag())
+                                 use_device=use_device)
+        if self.spmd is not None:
+            if self._spmd_rank == 0:
+                self.executor.set_spmd(self.spmd)
+            else:
+                # Share the manager for /debug/vars visibility of the
+                # descriptor-driven collectives this rank participates
+                # in (use_device=False + the _device_backend_on gates in
+                # the executor keep this rank from driving it alone),
+                # and reject mutations: a write applied to this rank's
+                # holder outside the descriptor stream would silently
+                # diverge the replicas.
+                self.executor._mesh_mgr = self.spmd.manager
+                self.executor.spmd_reject_writes = True
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -164,6 +207,15 @@ class Server:
             t.start()
             self._threads.append(t)
 
+        if self.spmd is not None and self._spmd_rank != 0:
+            # SPMD worker: follow rank 0's descriptor stream (queries,
+            # writes, schema) until it broadcasts stop. The HTTP API
+            # stays up for status/debug and host-path reads.
+            t = threading.Thread(target=self.spmd.run_worker,
+                                 name="spmd-worker", daemon=True)
+            t.start()
+            self._threads.append(t)
+
         # Background warm: Holder.open defers fragment parsing (O(schema)
         # cold start); this prefetches storage so early queries don't
         # each pay a first-touch parse (SURVEY.md §7 async prefetch).
@@ -174,6 +226,11 @@ class Server:
         self._threads.append(t)
 
     def close(self):
+        if self.spmd is not None and self._spmd_rank == 0:
+            try:
+                self.spmd.stop()  # release every worker loop
+            except Exception as e:  # noqa: BLE001 — workers may be gone
+                self.logger.warning(f"spmd stop: {e}")
         self.closing.close()
         # Join the warm thread BEFORE holder.close(): a warm mid-load
         # after close would reopen a WAL fd on a fragment whose flock

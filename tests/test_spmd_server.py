@@ -1,0 +1,152 @@
+"""Bootable SPMD multi-host serving (VERDICT r2 item 3): two REAL
+server processes started through the CLI with `[cluster] type =
+"spmd"`, a client POSTing PQL over HTTP to rank 0, and the collective
+provably running on the GLOBAL mesh — the device-serving counters rise
+on BOTH ranks' /debug/vars.
+
+Reference analog: server/server.go:107-192 wires the whole node's
+transport at startup; executor.go:1103-1163 fans queries across nodes.
+Here the fan-out is one broadcast descriptor + one psum over the
+4-device (2 per process) mesh, and writes/schema ride the same
+descriptor stream (parallel/spmd.py).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+SLICE_WIDTH = 1 << 20
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body.encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _wait_http(port, deadline):
+    while time.time() < deadline:
+        try:
+            _get(port, "/version")
+            return True
+        except Exception:  # noqa: BLE001 — still booting
+            time.sleep(0.5)
+    return False
+
+
+def test_spmd_server_two_process_boot(tmp_path):
+    coord = _free_port()
+    http = [_free_port(), _free_port()]
+    cfgs = []
+    for r in (0, 1):
+        cfg = tmp_path / f"r{r}.toml"
+        cfg.write_text(
+            f'data-dir = "{tmp_path}/data{r}"\n'
+            f'host = "127.0.0.1:{http[r]}"\n'
+            f'use-device = "on"\n'
+            f"[cluster]\n"
+            f'type = "spmd"\n'
+            f'spmd-coordinator = "127.0.0.1:{coord}"\n'
+            f"spmd-processes = 2\n"
+            f"spmd-process-id = {r}\n")
+        cfgs.append(cfg)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PILOSA_TPU_DEVICE_MIN_WORK"] = "0"  # tiny queries stay on mesh
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.ctl.main", "server",
+             "-c", str(cfgs[r])],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path))
+        for r in (0, 1)
+    ]
+    try:
+        deadline = time.time() + 120
+        if not (_wait_http(http[0], deadline)
+                and _wait_http(http[1], deadline)):
+            for p in procs:
+                p.kill()
+            outs = [p.communicate(timeout=10) for p in procs]
+            detail = "\n".join(e[-1500:] for _, e in outs)
+            if "distributed" in detail or "initialize" in detail \
+                    or "gloo" in detail.lower():
+                pytest.skip(f"multi-process runtime unavailable:\n{detail}")
+            raise AssertionError(f"servers never came up:\n{detail}")
+
+        # schema + writes + queries, all against rank 0
+        _post(http[0], "/index/si", "{}")
+        _post(http[0], "/index/si/frame/f1", "{}")
+        for col in (5, SLICE_WIDTH + 5, 2 * SLICE_WIDTH + 5):
+            for row in (0, 1):
+                out = _post(http[0], "/index/si/query",
+                            f"SetBit(frame=f1, rowID={row}, columnID={col})")
+                assert out["results"][0] is True, out
+        _post(http[0], "/index/si/query",
+              f"SetBit(frame=f1, rowID=1, columnID={SLICE_WIDTH + 9})")
+
+        out = _post(http[0], "/index/si/query",
+                    "Count(Intersect(Bitmap(frame=f1, rowID=0), "
+                    "Bitmap(frame=f1, rowID=1)))")
+        assert out["results"][0] == 3, out
+
+        out = _post(http[0], "/index/si/query", "TopN(frame=f1, n=2)")
+        pairs = [(p["id"], p["count"]) for p in out["results"][0]]
+        assert pairs == [(1, 4), (0, 3)], out
+
+        # the collective ran on BOTH ranks (the device-serving counters
+        # live in the shared MeshManager each rank's executor exposes)
+        for r in (0, 1):
+            vars_ = _get(http[r], "/debug/vars")
+            mesh = vars_.get("mesh") or {}
+            assert mesh.get("count", 0) >= 1, (r, mesh)
+            assert mesh.get("topn", 0) >= 1, (r, mesh)
+            assert mesh.get("stage", 0) >= 1, (r, mesh)
+
+        # write replication: rank 1's own holder answers from the HOST
+        # path (its executor has the device path disabled) with the
+        # bits that traveled the descriptor stream
+        out = _post(http[1], "/index/si/query",
+                    "Count(Bitmap(frame=f1, rowID=1))")
+        assert out["results"][0] == 4, out
+    finally:
+        # rank 0 first: its shutdown broadcasts the STOP descriptor
+        # while rank 1's worker is still alive to receive it.
+        procs[0].send_signal(signal.SIGTERM)
+        try:
+            procs[0].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+        procs[1].send_signal(signal.SIGTERM)
+        try:
+            procs[1].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            procs[1].kill()
