@@ -79,32 +79,52 @@ from ..wire import (
 VERSION = "0.1.0"
 
 _WEBUI_PAGE = """<!doctype html>
-<html><head><title>pilosa-tpu console</title><style>
-body{font-family:monospace;margin:1.5em;max-width:100em;background:#fff;color:#222}
+<html><head><title>pilosa-tpu</title><style>
+body{font-family:monospace;margin:0;background:#fff;color:#222}
 textarea,input,select{font-family:monospace;box-sizing:border-box}
-textarea,input{width:100%}
+textarea{width:100%}
 pre{background:#f4f4f4;padding:.8em;overflow:auto;margin:.4em 0}
-.cols{display:flex;gap:1.5em}.cols>div{flex:1;min-width:0}
-h1{font-size:1.3em}h2{font-size:1em;border-bottom:1px solid #ccc;margin:.8em 0 .4em}
+h1{font-size:1.2em;margin:0}
+h2{font-size:1em;border-bottom:1px solid #ccc;margin:.8em 0 .4em}
 button{font-family:monospace;margin-right:.4em;cursor:pointer}
 table{border-collapse:collapse;margin:.4em 0}
 td,th{border:1px solid #ccc;padding:.15em .6em;text-align:right}
 th{background:#eee}
+.hdr{display:flex;align-items:center;gap:1.5em;padding:.7em 1.2em;
+     background:#123;color:#fff}
+.hdr .dim{color:#9ab}
+.nav{display:flex;gap:0}
+.nav div{padding:.35em 1.1em;cursor:pointer;border-bottom:2px solid transparent;color:#cde}
+.nav div.on{border-color:#6cf;color:#fff;background:#1a3a55}
+.page{display:none;padding:1em 1.5em}
+.page.on{display:block}
+.cols{display:flex;gap:1.5em}.cols>div{flex:1;min-width:0}
 .tree span{cursor:pointer;color:#035;text-decoration:underline}
 .tree ul{margin:.1em 0 .1em 1.2em;padding:0;list-style:none}
 #hist div,#hist2 div{cursor:pointer;color:#035;white-space:nowrap;overflow:hidden;text-overflow:ellipsis}
 .err{color:#a00}.dim{color:#777}
+.up{color:#070;font-weight:bold}.down{color:#a00;font-weight:bold}
 </style></head><body>
-<h1>pilosa-tpu <span class="dim" id="ver"></span></h1>
+<div class="hdr">
+  <h1>pilosa-tpu</h1><span class="dim" id="ver"></span>
+  <div class="nav">
+    <div id="tab-console" class="on" onclick="nav('console')">Console</div>
+    <div id="tab-cluster" onclick="nav('cluster')">Cluster Admin</div>
+    <div id="tab-stats" onclick="nav('stats')">Stats</div>
+    <div id="tab-docs" onclick="nav('docs')">Documentation</div>
+  </div>
+  <label style="margin-left:auto"><input type="checkbox" id="auto"> auto-refresh</label>
+</div>
+
+<div id="page-console" class="page on">
 <div class="cols">
-<div style="flex:1.4">
+<div style="flex:1.5">
 <h2>query</h2>
-<p>index: <input id="idx" value="i" style="width:12em">
-<label><input type="checkbox" id="auto" style="width:auto"> auto-refresh</label></p>
-<p><textarea id="q" rows="4">Count(Bitmap(rowID=1, frame=general))</textarea></p>
-<p><button onclick="run()">run</button>
+<p>index: <select id="idx" style="min-width:12em"><option value="i">i</option></select>
+   <button onclick="run()">run</button>
    <button onclick="refresh()">refresh</button>
    <span class="dim" id="took"></span></p>
+<p><textarea id="q" rows="4">Count(Bitmap(rowID=1, frame=general))</textarea></p>
 <div id="result"></div>
 <h2>history</h2><div id="hist"></div>
 <h2>examples</h2><div id="hist2">
@@ -116,14 +136,53 @@ th{background:#eee}
 </div>
 <div>
 <h2>schema</h2><div id="schema" class="tree"></div>
-<h2>cluster</h2><pre id="status"></pre>
 </div>
-<div>
+</div>
+</div>
+
+<div id="page-cluster" class="page">
+<h2>nodes</h2><div id="nodes"></div>
+<h2>indexes on this cluster</h2><div id="clusteridx"></div>
+<h2>raw /status</h2><pre id="status"></pre>
+</div>
+
+<div id="page-stats" class="page">
 <h2>stats (/debug/vars)</h2><div id="vars"></div>
 </div>
+
+<div id="page-docs" class="page">
+<h2>PQL quick reference</h2>
+<pre>
+SetBit(frame=f, rowID=R, columnID=C [, timestamp="2017-04-02T09:00"])
+ClearBit(frame=f, rowID=R, columnID=C)
+Bitmap(frame=f, rowID=R)            one row (columnID=C reads the inverse view)
+Union(a, b, ...)  Intersect(a, b, ...)  Difference(a, b, ...)
+Count(&lt;bitmap expr&gt;)                fused on-device popcount
+TopN(frame=f, n=N [, threshold=T] [, ids=[..]] [, field=.., filters=[..]]
+     [, tanimotoThreshold=P]) [&lt;src bitmap&gt;]
+Range(frame=f, rowID=R, start="...", end="...")   time-quantum views
+SetRowAttrs(frame=f, rowID=R, k=v, ...)   SetColumnAttrs(columnID=C, k=v, ...)
+</pre>
+<h2>HTTP API</h2>
+<pre>
+POST /index/{i}                    create index      POST /index/{i}/query   PQL
+POST /index/{i}/frame/{f}          create frame      GET  /schema
+GET  /status    GET /hosts         cluster state     GET  /slices/max
+POST /import                       protobuf bulk     GET  /export            CSV
+GET  /fragment/data                fragment snapshot GET  /debug/vars        stats
+GET  /debug/pprof/profile          sampling profiler GET  /version
+</pre>
+<p class="dim">Full upstream documentation: <a href="https://www.pilosa.com/docs/">pilosa.com/docs</a></p>
 </div>
+
 <script>
 const $ = id => document.getElementById(id);
+function nav(name){
+  for (const t of ['console','cluster','stats','docs']) {
+    $('tab-'+t).classList.toggle('on', t === name);
+    $('page-'+t).classList.toggle('on', t === name);
+  }
+}
 function setQ(el){ $('q').value = el.textContent; }
 function esc(s){ const d=document.createElement('div'); d.textContent=s; return d.innerHTML; }
 
@@ -168,8 +227,12 @@ function schemaTree(indexes){
     h += `<li><span onclick="$('idx').value='${ix.name}'">${esc(ix.name)}</span><ul>`;
     for (const f of ix.frames || []) {
       const views = (f.views || []).join(', ');
+      const m = f.meta || {};
+      const extra = [m.timeQuantum ? 'tq='+m.timeQuantum : '',
+                     m.inverseEnabled ? 'inverse' : '',
+                     m.cacheType || ''].filter(Boolean).join(' ');
       h += `<li><span onclick="pick('${ix.name}','${f.name}')">${esc(f.name)}</span>` +
-           ` <span class="dim" style="text-decoration:none;cursor:default">[${esc(views)}]</span></li>`;
+           ` <span class="dim" style="text-decoration:none;cursor:default">[${esc(views)}] ${esc(extra)}</span></li>`;
     }
     h += '</ul></li>';
   }
@@ -178,6 +241,52 @@ function schemaTree(indexes){
 function pick(ix, frame){
   $('idx').value = ix;
   $('q').value = `TopN(frame=${frame}, n=10)`;
+}
+
+function fillIndexDropdown(indexes){
+  const sel = $('idx'), cur = sel.value;
+  sel.innerHTML = '';
+  for (const ix of indexes || []) {
+    const o = document.createElement('option');
+    o.value = o.textContent = ix.name;
+    sel.appendChild(o);
+  }
+  if (!sel.options.length) {
+    const o = document.createElement('option');
+    o.value = o.textContent = 'i';
+    sel.appendChild(o);
+  }
+  if (cur) sel.value = cur;
+  if (!sel.value) sel.selectedIndex = 0;
+}
+
+function nodesTable(st){
+  let h = '<table><tr><th>host</th><th>state</th><th>indexes</th></tr>';
+  for (const n of st.nodes || []) {
+    const cls = (n.state || 'UP') === 'UP' ? 'up' : 'down';
+    const idxs = (n.indexes || []).map(i =>
+      `${esc(i.name)} (maxSlice ${i.maxSlice ?? 0})`).join(', ');
+    h += `<tr><td style="text-align:left">${esc(n.host||'')}</td>` +
+         `<td class="${cls}">${esc(n.state||'')}</td>` +
+         `<td style="text-align:left">${idxs}</td></tr>`;
+  }
+  return h + '</table>';
+}
+
+function clusterIndexTable(st){
+  const rows = {};
+  for (const n of st.nodes || [])
+    for (const i of n.indexes || []) {
+      rows[i.name] = rows[i.name] || {max: 0, frames: new Set(), nodes: 0};
+      rows[i.name].max = Math.max(rows[i.name].max, i.maxSlice ?? 0);
+      for (const f of i.frames || []) rows[i.name].frames.add(f);
+      rows[i.name].nodes++;
+    }
+  let h = '<table><tr><th>index</th><th>maxSlice</th><th>frames</th><th>nodes</th></tr>';
+  for (const [name, r] of Object.entries(rows))
+    h += `<tr><td style="text-align:left">${esc(name)}</td><td>${r.max}</td>` +
+         `<td style="text-align:left">${esc([...r.frames].join(', '))}</td><td>${r.nodes}</td></tr>`;
+  return h + '</table>';
 }
 
 function varsTables(v){
@@ -201,11 +310,17 @@ function varsTables(v){
 
 async function refresh(){
   try { $('ver').textContent = 'v' + (await (await fetch('/version')).json()).version; } catch(e){}
-  try { $('schema').innerHTML = schemaTree((await (await fetch('/schema')).json()).indexes); }
-  catch (e) { $('schema').textContent = String(e); }
-  try { $('status').textContent =
-        JSON.stringify(await (await fetch('/status')).json(), null, 2); }
-  catch (e) { $('status').textContent = String(e); }
+  try {
+    const sch = await (await fetch('/schema')).json();
+    $('schema').innerHTML = schemaTree(sch.indexes);
+    fillIndexDropdown(sch.indexes);
+  } catch (e) { $('schema').textContent = String(e); }
+  try {
+    const st = await (await fetch('/status')).json();
+    $('status').textContent = JSON.stringify(st, null, 2);
+    $('nodes').innerHTML = nodesTable(st);
+    $('clusteridx').innerHTML = clusterIndexTable(st);
+  } catch (e) { $('status').textContent = String(e); }
   try { $('vars').innerHTML = varsTables(await (await fetch('/debug/vars')).json()); }
   catch (e) { $('vars').textContent = String(e); }
 }

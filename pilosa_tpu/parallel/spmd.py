@@ -26,6 +26,10 @@ Descriptor ops:
                per-shard device op, no cross-rank collective)
     SCHEMA     a wire-framed broadcast message (CreateIndex/Frame/...)
                applied through each rank's BroadcastHandler
+    PQL        a re-serialized PQL write (SetRowAttrs/SetColumnAttrs —
+               the reference's own remote-exec encoding, pql/ast.go
+               String()) executed by every rank's executor with
+               remote=True, replicating the host-side attr stores
     STOP       release the worker loops
 
 Control flow per request:
@@ -58,6 +62,7 @@ _OP_STOP = 2
 _OP_ROWCOUNTS = 3
 _OP_WRITE = 4
 _OP_SCHEMA = 5
+_OP_PQL = 6
 
 
 def _encode(obj: dict) -> np.ndarray:
@@ -121,6 +126,8 @@ class SpmdServer:
         self.manager = MeshManager(holder, mesh=mesh)
         self.holder = holder
         self.apply_message = None  # set by server wiring (receive_message)
+        self.apply_query = None    # set by server wiring: (index, pql) ->
+        #                            executor.execute with remote=True
         # AOT-compiled programs keyed by (kind, sig, shapes): compilation
         # must happen BEFORE the agreement gate (see _execute_count), and
         # jit only compiles at first call — lower().compile() forces it.
@@ -216,6 +223,17 @@ class SpmdServer:
             self._broadcast(desc)
             return self._run(desc)
 
+    def execute_pql(self, index: str, pql: str):
+        """Broadcast a re-serialized PQL write; every rank (this one
+        included) executes it against its own holder with remote=True.
+        Used for attr mutations, whose state lives in host-side stores
+        the WRITE bit descriptors don't cover. Rank 0 only."""
+        assert self.rank == 0
+        desc = {"op": _OP_PQL, "index": index, "pql": pql}
+        with self._mu:
+            self._broadcast(desc)
+            return self._run(desc)
+
     def schema(self, msg) -> None:
         """Broadcast one wire schema message (CreateIndex/CreateFrame/
         Delete.../CreateSlice) through the descriptor stream. Rank 0
@@ -273,6 +291,8 @@ class SpmdServer:
             return self._execute_write(desc)
         if op == _OP_SCHEMA:
             return self._execute_schema(desc)
+        if op == _OP_PQL:
+            return self._execute_pql(desc)
         raise ValueError(f"unknown descriptor op: {op}")
 
     def _broadcast(self, desc: Optional[dict]) -> dict:
@@ -415,6 +435,16 @@ class SpmdServer:
 
             ts = parse_time(desc["ts"])
         return bool(f.set_bit(desc["row"], desc["col"], ts))
+
+    def _execute_pql(self, desc: dict):
+        """PQL: run the re-serialized write through this rank's
+        executor (remote=True: apply locally, never re-forward or
+        re-broadcast — and worker ranks' write-rejection guard admits
+        descriptor-applied writes)."""
+        if self.apply_query is None:
+            raise RuntimeError("SpmdServer.apply_query not wired")
+        out = self.apply_query(desc["index"], desc["pql"])
+        return out[0] if out else None
 
     def _execute_schema(self, desc: dict) -> None:
         """SCHEMA: unmarshal the wire message and apply it through the

@@ -106,6 +106,9 @@ class Server:
                  if self.config.spmd_process_id >= 0 else None))
             self.spmd = SpmdServer(self.holder)
             self.spmd.apply_message = self.receive_message
+            # Attr-write replication: descriptor PQL executes through
+            # this rank's executor with remote=True (wired below, after
+            # the executor exists).
             self.node_set = StaticNodeSet([self.host])
             self.broadcaster = (SpmdBroadcaster(self.spmd)
                                 if self._spmd_rank == 0 else NopBroadcaster())
@@ -147,6 +150,15 @@ class Server:
                                  cluster=self.cluster, client=self.client,
                                  use_device=use_device)
         if self.spmd is not None:
+            from .pql import parse_string as _parse
+
+            def _apply_query(index, pql):
+                from .executor import ExecOptions
+
+                return self.executor.execute(index, _parse(pql),
+                                             opt=ExecOptions(remote=True))
+
+            self.spmd.apply_query = _apply_query
             if self._spmd_rank == 0:
                 self.executor.set_spmd(self.spmd)
             else:

@@ -36,8 +36,11 @@ def _post(port, path, body):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}", data=body.encode(),
         method="POST")
-    with urllib.request.urlopen(req, timeout=30) as r:
-        return json.loads(r.read() or b"{}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:  # error bodies are JSON too
+        return json.loads(e.read() or b"{}")
 
 
 def _get(port, path):
@@ -137,6 +140,19 @@ def test_spmd_server_two_process_boot(tmp_path):
         out = _post(http[1], "/index/si/query",
                     "Count(Bitmap(frame=f1, rowID=1))")
         assert out["results"][0] == 4, out
+
+        # attr replication: SetRowAttrs rides the PQL descriptor, so a
+        # Bitmap read on rank 1 attaches the attrs
+        _post(http[0], "/index/si/query",
+              'SetRowAttrs(frame=f1, rowID=1, color="red")')
+        out = _post(http[1], "/index/si/query", "Bitmap(frame=f1, rowID=1)")
+        assert out["results"][0]["attrs"] == {"color": "red"}, out
+
+        # a mutation sent to a worker rank is rejected, not silently
+        # applied to one replica
+        out = _post(http[1], "/index/si/query",
+                    "SetBit(frame=f1, rowID=5, columnID=1)")
+        assert "SPMD rank 0" in out.get("error", ""), out
     finally:
         # rank 0 first: its shutdown broadcasts the STOP descriptor
         # while rank 1's worker is still alive to receive it.
