@@ -98,13 +98,12 @@ def build_dense_holder(tmp, num_slices, num_rows=2, seed=7):
     idx = h.create_index_if_not_exists("i")
     f = idx.create_frame_if_not_exists("general")
     view = f.create_view_if_not_exists("standard")
+    keys = [r * 16 + b for r in range(num_rows) for b in range(16)]
     for s in range(num_slices):
         frag = view.create_fragment_if_not_exists(s)
-        keys = [r * 16 + b for r in range(num_rows) for b in range(16)]
-        containers = [
-            Container(bitmap=rng.integers(0, 2**64, size=1024, dtype=np.uint64))
-            for _ in keys
-        ]
+        words = rng.integers(0, 2**64, size=(len(keys), 1024),
+                             dtype=np.uint64)  # one draw per slice
+        containers = [Container(bitmap=words[i]) for i in range(len(keys))]
         _inject(frag, keys, containers)
     return h
 
@@ -124,6 +123,11 @@ def build_mixed_holder(tmp, num_slices, num_rows, seed=13):
     view = f.create_view_if_not_exists("standard")
     for s in range(num_slices):
         keys, containers = [], []
+        # ONE permutation per slice; each sparse row takes a random
+        # window of it (a uniform n-subset; windows overlapping between
+        # rows is fine for count statistics and ~50x cheaper than a
+        # fresh rng.choice(65536, n, replace=False) per row).
+        perm = rng.permutation(65536).astype(np.uint32)
         for r in range(num_rows):
             if rng.random() < 0.1:
                 continue  # absent fragment row
@@ -133,8 +137,8 @@ def build_mixed_holder(tmp, num_slices, num_rows, seed=13):
                 c = Container(bitmap=words)
             else:
                 n = int(rng.integers(1, 4097))
-                vals = np.sort(rng.choice(65536, size=n, replace=False)
-                               ).astype(np.uint32)
+                start = int(rng.integers(0, 65536 - n))
+                vals = np.sort(perm[start:start + n])
                 c = Container(array=vals)
             keys.append(r * 16)  # block 0 of each row
             containers.append(c)
@@ -516,6 +520,28 @@ def main():
         "deduped_total": mgr.stats["deduped"]}
     assert batched_during > 0, "distinct queries never hit the batch path"
 
+    # open-loop: every query issued up-front from a thread pool — the
+    # batcher drains full groups while the fetch pipeline overlaps the
+    # per-batch readback with the next batch's device execution (the
+    # closed-loop pool above can't show this: its clients block on
+    # their own results, so the queue is empty during every fetch).
+    _progress("headline: open-loop burst (64 in-flight)")
+    from concurrent.futures import ThreadPoolExecutor as _TPE
+
+    n_open = 64 if on_tpu else 8
+
+    def one_open(i):
+        j = i % len(cli_qs)
+        assert e.execute("i", cli_qs[j])[0] == want_counts[j]
+
+    with _TPE(max_workers=n_open) as pool:
+        list(pool.map(one_open, range(n_open)))  # warm any new widths
+        t0 = time.perf_counter()
+        list(pool.map(one_open, range(n_open)))
+        open_dt = time.perf_counter() - t0
+    details["serving_openloop64_qps"] = {
+        "qps": n_open / open_dt, "in_flight": n_open}
+
     # -- config 1: Count(Bitmap(row)) ----------------------------------------
     _progress("count_bitmap")
     first, call1 = serve_count_call(e, "i", "Count(Bitmap(rowID=0))",
@@ -603,10 +629,14 @@ def main():
     # repeat-TopN memo (the rank-cache analog): a second identical TopN
     # on an unchanged image serves from the completed-result memo
     memo_before = mgrm.stats["memo_hit"]
+    em.execute("i", topn_q)  # first repeat: memo hit, but the hit pays
+    #                          the array's FIRST host fetch (a ~70 ms
+    #                          relay poll on this rig; us on attached
+    #                          chips) — time the steady state instead
     t0 = time.perf_counter()
     em.execute("i", topn_q)
     memo_dt = time.perf_counter() - t0
-    assert mgrm.stats["memo_hit"] > memo_before, "repeat TopN missed memo"
+    assert mgrm.stats["memo_hit"] >= memo_before + 2, "repeat TopN missed memo"
     details["topn_n100"] = {
         "mean_ms": dt * 1e3, "rows": topn_rows, "slices": topn_slices,
         "host_cpu_ms": host_dt * 1e3, "vs_host": host_dt / dt,

@@ -391,6 +391,12 @@ class Handler:
         self.stats = stats if stats is not None else ExpvarStats()
         self.logger = logger
         self.version = VERSION
+        # SPMD descriptor plane (server wiring): bulk imports must ride
+        # the descriptor stream so every rank's replica gets the bits;
+        # None outside spmd mode. spmd_worker marks non-zero ranks,
+        # whose mutating bulk routes are rejected.
+        self.spmd = None
+        self.spmd_worker = False
         self._routes: List[Route] = []
         r = self._add_route
         r("GET", r"/", self._get_webui)
@@ -763,7 +769,18 @@ class Handler:
                 datetime.fromtimestamp(t, timezone.utc).replace(tzinfo=None)
                 if t else None
                 for t in req.timestamps]
-        f.import_bits(list(req.row_ids), list(req.column_ids), timestamps)
+        if self.spmd_worker:
+            return _json_resp(
+                {"error": "imports must be sent to SPMD rank 0"}, 400)
+        if self.spmd is not None:
+            # Replicate through the descriptor stream (chunked) so every
+            # rank's holder receives the bits in query order.
+            self.spmd.import_bits(req.index, req.frame,
+                                  list(req.row_ids), list(req.column_ids),
+                                  timestamps)
+        else:
+            f.import_bits(list(req.row_ids), list(req.column_ids),
+                          timestamps)
         if self._accepts_proto(headers):
             return _proto_resp(pb.ImportResponse())
         return _json_resp({})
@@ -798,7 +815,25 @@ class Handler:
         return Response(200, {"Content-Type": "application/octet-stream"},
                         buf.getvalue())
 
+    def _spmd_guard_bulk(self, what: str):
+        """Raw-storage mutations (fragment tar restore, frame restore)
+        are not descriptor-replicated: applying one to a single rank
+        would silently diverge the SPMD replicas, so spmd mode rejects
+        them on every rank. Restore into an spmd cluster by restoring
+        the data dir on EVERY host before boot, or re-import through
+        /import (which replicates)."""
+        if self.spmd is not None or self.spmd_worker:
+            return _json_resp(
+                {"error": f"{what} is not supported under [cluster] "
+                          "type=\"spmd\": it would mutate one replica "
+                          "only; restore every rank's data dir offline "
+                          "or use /import"}, 400)
+        return None
+
     def _post_fragment_data(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_bulk("fragment restore")
+        if guard is not None:
+            return guard
         index, frame, view, slice_ = self._fragment_args(params)
         f = self.holder.frame(index, frame)
         if f is None:
@@ -873,6 +908,9 @@ class Handler:
     def _post_frame_restore(self, pv, params, headers, body) -> Response:
         """Pull every fragment of a frame from a remote host
         (handler.go:1180 handlePostFrameRestore)."""
+        guard = self._spmd_guard_bulk("frame restore")
+        if guard is not None:
+            return guard
         host = params.get("host")
         if not host:
             return _json_resp({"error": "host required"}, 400)

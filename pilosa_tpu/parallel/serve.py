@@ -191,6 +191,9 @@ class MeshManager:
         self._apply_fn = None
         self._mask_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
+        # Dispatched-but-unfetched batches (see _fetch_loop); maxsize is
+        # the readback pipeline depth.
+        self._fetch_q: "queue.Queue" = queue.Queue(maxsize=2)
         self._batch_thread: Optional[threading.Thread] = None
         # In-flight row-count executions shared by identical concurrent
         # callers: key -> [done_event, result, error]. Own tiny lock —
@@ -547,6 +550,26 @@ class MeshManager:
                                          name="mesh-count-batch", daemon=True)
                     t.start()
                     self._batch_thread = t
+                    f = threading.Thread(target=self._fetch_loop,
+                                         name="mesh-count-fetch", daemon=True)
+                    f.start()
+
+    def _fetch_loop(self):
+        """Materialize dispatched batches' results and wake waiters.
+        Decoupled from the batch loop so the per-batch host readback
+        (a ~67 ms completion-poll cadence through this rig's TPU relay)
+        overlaps the NEXT batch's dispatch and device execution —
+        without it the device idles for a full readback between
+        batches. The fetch queue's bound (maxsize) is the pipeline
+        depth: the batch loop blocks once that many batches await
+        readback, so a flood of clients can't queue unbounded device
+        work."""
+        while True:
+            finish = self._fetch_q.get()
+            try:
+                finish()
+            except Exception:  # noqa: BLE001 — finisher handles errors
+                pass
 
     def _batch_loop(self):
         """Drain-and-group: take everything queued while the device was
@@ -614,41 +637,61 @@ class MeshManager:
             else:
                 fn = self._count_fn(sig, len(idx_t))
                 limbs = fn(words_t, idx_t, hit_t, dev_mask)
-            group[0].result = combine_count(limbs)
-            group[0].done.set()
-            _propagate()
-            return
-
-        sig, words_t, _, _, dev_mask = group[0].args
-        num_leaves = len(group[0].args[2])
-        from ..ops.pool import mutation_batch_width
-
-        b_pad = min(mutation_batch_width(b, min_batch=2), self._MAX_BATCH)
-        padded = group + [group[-1]] * (b_pad - b)
-        if coarse_ok:
-            fn = self._coarse_fn(sig, num_leaves, b_pad)
-            start_flat = tuple(r.coarse_t[i][0] for r in padded
-                               for i in range(num_leaves))
-            valid_flat = tuple(r.coarse_t[i][1] for r in padded
-                               for i in range(num_leaves))
-            limbs = _np.asarray(fn(words_t, start_flat, valid_flat,
-                                   dev_mask))
-            self.stats["coarse"] += b
         else:
-            fn = self._get_or_compile(
-                self._batch_fns, (sig, num_leaves, b_pad),
-                lambda: compile_serve_count_batch(
-                    self.mesh, json.loads(sig), num_leaves, b_pad))
-            idx_flat = tuple(r.args[2][i] for r in padded
-                             for i in range(num_leaves))
-            hit_flat = tuple(r.args[3][i] for r in padded
-                             for i in range(num_leaves))
-            limbs = _np.asarray(fn(words_t, idx_flat, hit_flat, dev_mask))
-        self.stats["batched"] += b
-        for j, r in enumerate(group):
-            r.result = (int(limbs[1, j]) << 16) + int(limbs[0, j])
-            r.done.set()
-        _propagate()
+            sig, words_t, _, _, dev_mask = group[0].args
+            num_leaves = len(group[0].args[2])
+            from ..ops.pool import mutation_batch_width
+
+            b_pad = min(mutation_batch_width(b, min_batch=2),
+                        self._MAX_BATCH)
+            padded = group + [group[-1]] * (b_pad - b)
+            if coarse_ok:
+                fn = self._coarse_fn(sig, num_leaves, b_pad)
+                start_flat = tuple(r.coarse_t[i][0] for r in padded
+                                   for i in range(num_leaves))
+                valid_flat = tuple(r.coarse_t[i][1] for r in padded
+                                   for i in range(num_leaves))
+                limbs = fn(words_t, start_flat, valid_flat, dev_mask)
+                self.stats["coarse"] += b
+            else:
+                fn = self._get_or_compile(
+                    self._batch_fns, (sig, num_leaves, b_pad),
+                    lambda: compile_serve_count_batch(
+                        self.mesh, json.loads(sig), num_leaves, b_pad))
+                idx_flat = tuple(r.args[2][i] for r in padded
+                                 for i in range(num_leaves))
+                hit_flat = tuple(r.args[3][i] for r in padded
+                                 for i in range(num_leaves))
+                limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
+            self.stats["batched"] += b
+
+        # Dispatch done (async device handle in `limbs`); the FETCH —
+        # a full readback-poll through the relay — happens on the
+        # fetcher thread so the next batch's dispatch overlaps it.
+        # (Direct callers — tests, no batch thread running — finish
+        # synchronously below.)
+        def finish():
+            try:
+                arr = _np.asarray(limbs)
+                if arr.ndim == 1:  # single request: (2,) [lo, hi]
+                    group[0].result = (int(arr[1]) << 16) + int(arr[0])
+                else:
+                    for j, r in enumerate(group):
+                        r.result = (int(arr[1, j]) << 16) + int(arr[0, j])
+            except Exception as e:  # noqa: BLE001 — fail the group
+                for r in group:
+                    r.error = e
+            for r in group:
+                r.done.set()
+            _propagate()
+
+        if threading.current_thread() is self._batch_thread:
+            self._fetch_q.put(finish)
+        else:
+            # Direct callers (tests, bench helpers) must see results
+            # set when this returns — and must not depend on a fetch
+            # thread that may not exist.
+            finish()
 
     def count(self, index: str, shape, leaves, slices: Sequence[int],
               num_slices: int) -> Optional[int]:

@@ -30,6 +30,10 @@ Descriptor ops:
                the reference's own remote-exec encoding, pql/ast.go
                String()) executed by every rank's executor with
                remote=True, replicating the host-side attr stores
+    IMPORT     a chunk of bulk-import bits (base64-packed u64 arrays,
+               chunked under the fixed descriptor size); every rank
+               runs Frame.import_bits, so bulk loads cannot diverge
+               the replicas the way a rank-0-only import would
     STOP       release the worker loops
 
 Control flow per request:
@@ -63,6 +67,7 @@ _OP_ROWCOUNTS = 3
 _OP_WRITE = 4
 _OP_SCHEMA = 5
 _OP_PQL = 6
+_OP_IMPORT = 7
 
 
 def _encode(obj: dict) -> np.ndarray:
@@ -234,6 +239,50 @@ class SpmdServer:
             self._broadcast(desc)
             return self._run(desc)
 
+    # Bits per IMPORT chunk: 3 u64 arrays (row, col, ts) base64-encoded
+    # must fit _DESC_BYTES with JSON overhead. 24 B/bit raw -> 32 B/bit
+    # in base64; 1500 bits ~= 48 KB encoded.
+    _IMPORT_CHUNK = 1500
+
+    def import_bits(self, index: str, frame: str, rows, cols,
+                    timestamps=None) -> None:
+        """Broadcast a bulk import in chunks; every rank applies each
+        chunk to its own holder (Frame.import_bits — container
+        creation, time-view fan-out, and forced snapshot semantics all
+        evaluate identically per rank). Rank 0 only."""
+        assert self.rank == 0
+        import base64
+
+        rows = np.asarray(rows, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64)
+        from datetime import timezone as _tz
+
+        # Naive datetimes here are UTC by convention (the handler
+        # decodes wire timestamps as naive-UTC); t.timestamp() would
+        # read them in the HOST timezone and shift every bit's
+        # time-quantum view on non-UTC machines.
+        ts = (np.zeros(0, dtype=np.int64) if timestamps is None
+              else np.asarray(
+                  [0 if t is None
+                   else int(t.replace(tzinfo=_tz.utc).timestamp())
+                   for t in timestamps],
+                  dtype=np.int64))
+        for i in range(0, max(len(rows), 1), self._IMPORT_CHUNK):
+            desc = {
+                "op": _OP_IMPORT,
+                "index": index,
+                "frame": frame,
+                "rows": base64.b64encode(
+                    rows[i:i + self._IMPORT_CHUNK].tobytes()).decode(),
+                "cols": base64.b64encode(
+                    cols[i:i + self._IMPORT_CHUNK].tobytes()).decode(),
+                "ts": base64.b64encode(
+                    ts[i:i + self._IMPORT_CHUNK].tobytes()).decode(),
+            }
+            with self._mu:
+                self._broadcast(desc)
+                self._run(desc)
+
     def schema(self, msg) -> None:
         """Broadcast one wire schema message (CreateIndex/CreateFrame/
         Delete.../CreateSlice) through the descriptor stream. Rank 0
@@ -293,6 +342,8 @@ class SpmdServer:
             return self._execute_schema(desc)
         if op == _OP_PQL:
             return self._execute_pql(desc)
+        if op == _OP_IMPORT:
+            return self._execute_import(desc)
         raise ValueError(f"unknown descriptor op: {op}")
 
     def _broadcast(self, desc: Optional[dict]) -> dict:
@@ -445,6 +496,28 @@ class SpmdServer:
             raise RuntimeError("SpmdServer.apply_query not wired")
         out = self.apply_query(desc["index"], desc["pql"])
         return out[0] if out else None
+
+    def _execute_import(self, desc: dict) -> None:
+        """IMPORT: apply one chunk of bulk bits to THIS rank's holder."""
+        import base64
+        from datetime import datetime, timezone
+
+        idx = self.holder.index(desc["index"])
+        if idx is None:
+            return
+        f = idx.frame(desc["frame"])
+        if f is None:
+            return
+        rows = np.frombuffer(base64.b64decode(desc["rows"]), dtype=np.uint64)
+        cols = np.frombuffer(base64.b64decode(desc["cols"]), dtype=np.uint64)
+        ts_raw = np.frombuffer(base64.b64decode(desc["ts"]), dtype=np.int64)
+        timestamps = None
+        if len(ts_raw):
+            timestamps = [
+                datetime.fromtimestamp(t, timezone.utc).replace(tzinfo=None)
+                if t else None for t in ts_raw]
+        f.import_bits([int(r) for r in rows], [int(c) for c in cols],
+                      timestamps)
 
     def _execute_schema(self, desc: dict) -> None:
         """SCHEMA: unmarshal the wire message and apply it through the

@@ -177,6 +177,11 @@ class Server:
             broadcast_handler=self, status_handler=self,
             client_factory=self.client.for_host, stats=self.stats,
             logger=self.logger)
+        if self.spmd is not None:
+            if self._spmd_rank == 0:
+                self.handler.spmd = self.spmd
+            else:
+                self.handler.spmd_worker = True
 
         self._api: Optional[APIServer] = None
         self._threads: list = []

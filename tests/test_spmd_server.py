@@ -153,6 +153,27 @@ def test_spmd_server_two_process_boot(tmp_path):
         out = _post(http[1], "/index/si/query",
                     "SetBit(frame=f1, rowID=5, columnID=1)")
         assert "SPMD rank 0" in out.get("error", ""), out
+
+        # bulk import rides the descriptor stream too: POST protobuf
+        # /import to rank 0, then read the bits back from rank 1's
+        # host path
+        import sys as _sys
+        _sys.path.insert(0, repo)
+        from pilosa_tpu.wire import pb
+
+        ireq = pb.ImportRequest()
+        ireq.index, ireq.frame, ireq.slice = "si", "f1", 0
+        ireq.row_ids.extend([30, 30, 30])
+        ireq.column_ids.extend([100, 200, 300])
+        breq = urllib.request.Request(
+            f"http://127.0.0.1:{http[0]}/import",
+            data=ireq.SerializeToString(), method="POST",
+            headers={"Content-Type": "application/x-protobuf"})
+        with urllib.request.urlopen(breq, timeout=30) as r:
+            r.read()
+        out = _post(http[1], "/index/si/query",
+                    "Count(Bitmap(frame=f1, rowID=30))")
+        assert out["results"][0] == 3, out
     finally:
         # rank 0 first: its shutdown broadcasts the STOP descriptor
         # while rank 1's worker is still alive to receive it.
