@@ -79,13 +79,19 @@ def main():
          "-c", f"{base}/r{r}.toml"], env=env)
         for r in (0, 1)]
     try:
-        for port in (http0, http1):
+        for proc, port in zip(procs, (http0, http1)):
             for _ in range(120):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"server on port {port} exited rc={proc.returncode}"
+                        " during boot — check its stderr above")
                 try:
                     get(port, "/version")
                     break
                 except Exception:  # noqa: BLE001 — booting
                     time.sleep(0.5)
+            else:
+                raise RuntimeError(f"server on port {port} never came up")
 
         print("-> schema + writes against rank 0")
         post(http0, "/index/demo", "{}")

@@ -61,7 +61,7 @@ class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
-                 "num_slices", "idx_cache")
+                 "num_slices", "idx_cache", "last_used")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -79,6 +79,11 @@ class StagedView:
         # ~6 ms through the TPU relay; cached, a repeat-row query pays
         # nothing.
         self.idx_cache: "OrderedDict[int, tuple]" = OrderedDict()
+        # Use-epoch stamp (MeshManager._use_epoch at last access): the
+        # evictor never evicts a view used by the RESOLUTION in
+        # progress, so one query touching more frames than the budget
+        # fits degrades to over-budget rather than restage-thrashing.
+        self.last_used = 0
 
     @property
     def padded_slices(self) -> int:
@@ -181,7 +186,13 @@ class MeshManager:
         self.holder = holder
         self._mesh = mesh
         self._mu = threading.RLock()
-        self._views: Dict[Tuple[str, str, str], StagedView] = {}
+        # Staged device images, LRU-ordered (move-to-end on access):
+        # total HBM held by staged pools is bounded by _hbm_budget_bytes
+        # and the least-recently-USED view is evicted to make room — the
+        # device analog of the holder's periodic cache flush
+        # (holder.go:326-358). An evicted view restages on next use.
+        self._views: "OrderedDict[Tuple[str, str, str], StagedView]" = \
+            OrderedDict()
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._batch_fns: Dict[tuple, object] = {}
         self._coarse_fns: Dict[tuple, object] = {}
@@ -224,12 +235,17 @@ class MeshManager:
         # unreachable entry pinning the replaced device image.
         self._topn_memo: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._memo_epoch = 0
+        # Bumped at the start of each query resolution (under _mu);
+        # views touched since then carry the stamp and are
+        # eviction-exempt (see _evict_over_budget).
+        self._use_epoch = 0
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
         # served device queries, plus cumulative timings and cache
         # hit/miss/size gauges.
         self.stats = {
-            "stage": 0, "incremental": 0, "count": 0, "topn": 0,
+            "stage": 0, "incremental": 0, "evicted": 0,
+            "staged_bytes": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
             "fallback": 0, "stage_us": 0, "query_us": 0,
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
@@ -243,6 +259,47 @@ class MeshManager:
         if self._mesh is None:
             self._mesh = default_mesh()
         return self._mesh
+
+    @staticmethod
+    def _hbm_budget_bytes() -> int:
+        """Staged-pool HBM budget (PILOSA_TPU_HBM_BUDGET_MB env,
+        default 8192 MB — half a v5e chip's 16 GB, leaving room for
+        query intermediates). 0 disables eviction."""
+        import os
+
+        try:
+            mb = int(os.environ.get("PILOSA_TPU_HBM_BUDGET_MB", "8192"))
+        except ValueError:
+            mb = 8192
+        return mb << 20
+
+    @staticmethod
+    def _view_bytes(sv: StagedView) -> int:
+        return (int(np.prod(sv.sharded.words.shape)) * 4
+                + int(np.prod(sv.sharded.keys.shape)) * 4)
+
+    def _evict_over_budget(self):
+        """Evict least-recently-used staged views until under the HBM
+        budget. Views stamped with the CURRENT use-epoch (touched by
+        the resolution in progress — possibly several frames of one
+        query tree) are never evicted: a query spanning more frames
+        than the budget fits runs over budget once rather than
+        restage-thrashing forever. Call under _mu. Safe against
+        in-flight queries: they hold their own references to the
+        immutable arrays; eviction only drops the manager's, and the
+        memo entries reading those arrays are purged with them."""
+        total = sum(self._view_bytes(v) for v in self._views.values())
+        budget = self._hbm_budget_bytes()
+        if budget > 0:
+            for key in [k for k, v in self._views.items()
+                        if v.last_used != self._use_epoch]:
+                if total <= budget:
+                    break
+                sv = self._views.pop(key)
+                self._purge_memo(sv.sharded.words)
+                total -= self._view_bytes(sv)
+                self.stats["evicted"] += 1
+        self.stats["staged_bytes"] = total
 
     # -- staging -------------------------------------------------------------
 
@@ -284,7 +341,9 @@ class MeshManager:
             slice_gens=gens,
             num_slices=num_slices,
         )
+        sv.last_used = self._use_epoch
         self._views[key] = sv
+        self._evict_over_budget()
         self.stats["stage"] += 1
         self.stats["stage_us"] += int((time.monotonic() - t0) * 1e6)
         return sv
@@ -300,6 +359,9 @@ class MeshManager:
         key = (index, frame, view)
         with self._mu:
             sv = self._views.get(key)
+            if sv is not None:
+                self._views.move_to_end(key)  # LRU: most recently used
+                sv.last_used = self._use_epoch
             if sv is None or sv.num_slices != num_slices:
                 return self._stage(key, num_slices)
 
@@ -352,6 +414,7 @@ class MeshManager:
         with self._mu:
             if index is None:
                 self._views.clear()
+                self.stats["staged_bytes"] = 0
                 self._topn_memo.clear()
                 # The epoch must advance here too: an in-flight query's
                 # _memo_put would otherwise pass the staleness check and
@@ -362,6 +425,8 @@ class MeshManager:
                 for key in [k for k in self._views if k[0] == index]:
                     self._purge_memo(self._views[key].sharded.words)
                     del self._views[key]
+                self.stats["staged_bytes"] = sum(
+                    self._view_bytes(v) for v in self._views.values())
 
     # -- completed-result memo (device rank-cache analog) ----------------------
 
@@ -439,6 +504,7 @@ class MeshManager:
         and another after would mix two generations of the same view.
         Only compiled calls run unlocked."""
         with self._mu:
+            self._use_epoch += 1
             out = self._stage_leaves(index, leaves, num_slices)
             if out is None:
                 return None
@@ -783,6 +849,7 @@ class MeshManager:
         (spmd.SpmdServer) so staging/mask semantics cannot diverge.
         Takes _mu."""
         with self._mu:
+            self._use_epoch += 1
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
                 self.stats["fallback"] += 1
@@ -957,6 +1024,7 @@ class MeshManager:
         observed."""
         src_shape, src_leaves = src
         with self._mu:
+            self._use_epoch += 1
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
                 self.stats["fallback"] += 1
