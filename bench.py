@@ -785,7 +785,11 @@ def main():
         details["mapreduce_count"]["throughput_vs_host"] = \
             (bsz / bdt2) * head_host_dt
 
-    with open("BENCH_DETAILS.json", "w") as f:
+    # A CPU-fallback run (watchdog re-exec when the TPU tunnel is sick)
+    # must not clobber a real TPU artifact.
+    details_path = ("BENCH_DETAILS.json" if on_tpu
+                    else "BENCH_DETAILS_CPU.json")
+    with open(details_path, "w") as f:
         json.dump({k: {kk: round(vv, 4) for kk, vv in v.items()}
                    for k, v in details.items()}, f, indent=2)
         f.write("\n")
