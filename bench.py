@@ -301,7 +301,15 @@ def main():
         return jnp.stack([m.sum(), m.sum()])
 
     floor_dt = best_of(lambda: _noop(probe), 3, 30 if on_tpu else 3)
-    details["diagnostics"] = {"dispatch_floor_ms": floor_dt * 1e3}
+    details["diagnostics"] = {
+        "dispatch_floor_ms": floor_dt * 1e3,
+        # Every host_cpu_* column in this file is the repo's own C++
+        # kernel path (ops/native.py) standing in for the reference's
+        # amd64 POPCNT assembly — no Go toolchain exists in this
+        # environment to measure the reference itself (BASELINE.md;
+        # VERDICT r2 missing-item 3).
+        "host_baseline": "ops/native.py C++ kernels "
+                         "(assembly stand-in; no Go toolchain)"}
 
     # -- headline (config 5): 1B-column Intersect+Count through serving ------
     _progress(f"headline: building {num_slices}-slice {head_rows}-row "
@@ -790,7 +798,9 @@ def main():
     details_path = ("BENCH_DETAILS.json" if on_tpu
                     else "BENCH_DETAILS_CPU.json")
     with open(details_path, "w") as f:
-        json.dump({k: {kk: round(vv, 4) for kk, vv in v.items()}
+        json.dump({k: {kk: (round(vv, 4) if isinstance(vv, (int, float))
+                            else vv)
+                       for kk, vv in v.items()}
                    for k, v in details.items()}, f, indent=2)
         f.write("\n")
 
