@@ -483,10 +483,12 @@ def main():
     # Precompile the width-16 coarse batch program (the width the
     # 16-client drain most often lands on) so the warm pool run pays
     # fewer first-shape compiles. jit compiles at first CALL, so run it
-    # once on the first 16 pairs' args.
-    fn16 = mgr._coarse_fn(sig, num_leaves, 16)
-    np.asarray(fn16(words_t, start_flat[:16 * num_leaves],
-                    valid_flat[:16 * num_leaves], dmask))
+    # once on the first 16 pairs' args (needs >= 16 pairs: the CPU
+    # smoke config has only C(4,2) = 6).
+    if bsz >= 16:
+        fn16 = mgr._coarse_fn(sig, num_leaves, 16)
+        np.asarray(fn16(words_t, start_flat[:16 * num_leaves],
+                        valid_flat[:16 * num_leaves], dmask))
 
     def run_pool():
         barrier = _th.Barrier(n_cli + 1)

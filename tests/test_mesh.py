@@ -406,3 +406,26 @@ def test_single_device_mesh():
     fn = compile_mesh_count(mesh1, ["leaf"], 1)
     dense = int(np.searchsorted(row_ids, np.uint64(1)))
     assert int(fn(idx, np.int32([dense]))) == 2
+
+
+def test_spmd_import_chunking_single_process(tmp_path):
+    """SpmdServer.import_bits splits large imports into descriptor-size
+    chunks; on a single-process runtime the broadcast degenerates to a
+    local echo, so the chunk split + per-rank apply path runs without a
+    cluster (the 2-process integration test covers the multi-rank
+    path with a small import)."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.parallel.spmd import SpmdServer
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index_if_not_exists("i")
+    idx.create_frame_if_not_exists("f")
+    srv = SpmdServer(h)
+    n = 4000  # > 2 chunks at _IMPORT_CHUNK=1500
+    rows = [7] * n
+    cols = list(range(n))
+    srv.import_bits("i", "f", rows, cols)
+    frag = h.fragment("i", "f", "standard", 0)
+    assert frag is not None and frag.storage.count() == n
+    h.close()
