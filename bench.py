@@ -405,9 +405,47 @@ def main():
         assert got == want, (a, b, got, want)
     bdt = best_of(lambda: fnb(words_t, start_flat, valid_flat, dmask)[0],
                   reps, max(2, iters // 8))
-    details["mapreduce_count"]["throughput_batch_qps"] = bsz / bdt
+
+    # shared-read batch program: each of the 8 unique rows is read ONCE
+    # per slice and all 28 pair folds evaluate from the VMEM-resident
+    # block (serve.MeshManager upgrades repeated coarse compositions to
+    # this program adaptively — PILOSA_TPU_BATCH_SHARED). Bytes scale
+    # with unique leaves: ~1 GB/batch instead of ~7 GB.
+    _progress("headline: shared-read batch (28 pairs, 8 unique rows)")
+    from pilosa_tpu.parallel.mesh import compile_serve_count_batch_shared
+
+    uniq_rows = sorted(set(x for p in pairs for x in p))
+    coarse_by_row = {}
+    with mgr._mu:
+        sv_h = mgr._views[("i", "general", "standard")]
+        for r_ in uniq_rows:
+            coarse_by_row[r_] = mgr._leaf_arrays(sv_h, r_)[2]
+    assert all(c is not None for c in coarse_by_row.values())
+    leaf_map = tuple((uniq_rows.index(a), uniq_rows.index(b))
+                     for a, b in pairs)
+    fns = compile_serve_count_batch_shared(mgr.mesh, json.loads(sig),
+                                           leaf_map, len(uniq_rows))
+    sh_args = (tuple(words_t[0] for _ in uniq_rows),
+               tuple(coarse_by_row[r_][0] for r_ in uniq_rows),
+               tuple(coarse_by_row[r_][1] for r_ in uniq_rows), dmask)
+    limbs_sh = np.asarray(fns(*sh_args))
+    for j in range(bsz):
+        assert (int(limbs_sh[1, j]) << 16) + int(limbs_sh[0, j]) == \
+            (int(limbs[1, j]) << 16) + int(limbs[0, j]), j
+    sdt_sh = best_of(lambda: fns(*sh_args)[0], reps, max(2, iters // 8))
+    details["mapreduce_count"]["throughput_shared_qps"] = bsz / sdt_sh
+
+    # the serving layer uses the shared program for warmed repeated
+    # compositions, so the headline is the better of the two
+    best_dt = min(bdt, sdt_sh)
+    if sdt_sh <= bdt:
+        headline_call = lambda: fns(*sh_args)[0]  # noqa: E731
+    else:
+        headline_call = lambda: fnb(words_t, start_flat, valid_flat,  # noqa: E731
+                                    dmask)[0]
+    details["mapreduce_count"]["throughput_batch_qps"] = bsz / best_dt
     details["mapreduce_count"]["throughput_vs_host"] = \
-        (bsz / bdt) * host_dt
+        (bsz / best_dt) * host_dt
     details["mapreduce_count"]["throughput_distinct_pairs"] = bsz
 
     # write-then-Count: a bit into an existing container folds into the
@@ -787,10 +825,9 @@ def main():
     # relay's effective bandwidth drifts in multi-minute phases
     # (PROFILE_HEADLINE.md), so two samples ~5 minutes apart beat one.
     _progress("headline: second throughput sample")
-    bdt2 = best_of(lambda: fnb(words_t, start_flat, valid_flat, dmask)[0],
-                   reps, max(2, iters // 8))
+    bdt2 = best_of(headline_call, reps, max(2, iters // 8))
     details["mapreduce_count"]["throughput_batch_qps_run2"] = bsz / bdt2
-    if bdt2 < bdt:
+    if bdt2 < best_dt:
         details["mapreduce_count"]["throughput_batch_qps"] = bsz / bdt2
         details["mapreduce_count"]["throughput_vs_host"] = \
             (bsz / bdt2) * head_host_dt

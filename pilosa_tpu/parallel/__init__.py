@@ -45,6 +45,7 @@ _MESH_NAMES = (
     "compile_serve_count",
     "compile_serve_count_batch",
     "compile_serve_count_coarse",
+    "compile_serve_count_batch_shared",
     "coarse_row_starts",
     "compile_serve_row_counts",
     "compile_serve_row_counts_src",
@@ -79,6 +80,7 @@ __all__ = [
     "compile_serve_count",
     "compile_serve_count_batch",
     "compile_serve_count_coarse",
+    "compile_serve_count_batch_shared",
     "coarse_row_starts",
     "compile_serve_row_counts",
     "compile_serve_row_counts_src",

@@ -46,6 +46,7 @@ from .mesh import (
     compile_serve_apply_writes,
     compile_serve_count,
     compile_serve_count_batch,
+    compile_serve_count_batch_shared,
     compile_serve_count_coarse,
     compile_serve_row_counts,
     compile_serve_row_counts_src,
@@ -156,11 +157,16 @@ class _CountRequest:
     batch runner picks the coarse whole-row-gather program only when
     every leaf of every request in a group is eligible."""
 
-    __slots__ = ("args", "coarse_t", "done", "result", "error")
+    __slots__ = ("args", "coarse_t", "leaf_keys", "done", "result",
+                 "error")
 
     def __init__(self, sig, words_t, idx_t, hit_t, coarse_t, dev_mask):
         self.args = (sig, words_t, idx_t, hit_t, dev_mask)
         self.coarse_t = coarse_t
+        # Logical (frame, view, row_id) per leaf, set by count() — the
+        # shared-batch planner canonicalizes on THIS (stable across
+        # restages/evictions, unlike array ids).
+        self.leaf_keys = None
         self.done = threading.Event()
         self.result = None
         self.error = None
@@ -196,6 +202,17 @@ class MeshManager:
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._batch_fns: Dict[tuple, object] = {}
         self._coarse_fns: Dict[tuple, object] = {}
+        # Shared-read batch programs keyed on (sig, leaf_map, U): used
+        # when ALREADY compiled; compiled in the background the first
+        # time a composition is seen (policy below) so hot repeated
+        # workloads upgrade to unique-leaf traffic without a compile
+        # stall on the serving path.
+        self._shared_fns: "OrderedDict[tuple, object]" = OrderedDict()
+        self._shared_pending: set = set()
+        # Composition sightings: a shared program only compiles once a
+        # composition REPEATS (timing-dependent batch groupings must
+        # not each mint a multi-second background compile).
+        self._shared_seen: "OrderedDict[tuple, int]" = OrderedDict()
         self._rowcount_fns: Dict[int, object] = {}
         self._rowcount_src_fns: Dict[tuple, object] = {}
         self._tanimoto_fns: Dict[tuple, object] = {}
@@ -251,7 +268,7 @@ class MeshManager:
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
             "idx_cache_hit": 0, "idx_cache_miss": 0,
             "mask_cache_hit": 0, "mask_cache_miss": 0,
-            "routed_host": 0,
+            "routed_host": 0, "shared_batch": 0,
         }
 
     @property
@@ -582,6 +599,86 @@ class MeshManager:
             lambda: compile_serve_count_coarse(self.mesh, json.loads(sig),
                                                num_leaves, batch))
 
+    @staticmethod
+    def _shared_policy() -> str:
+        """PILOSA_TPU_BATCH_SHARED: "auto" (default — use a cached
+        shared-read program, compile new compositions in the
+        background), "sync" (compile inline; tests/bench), "off"."""
+        import os
+
+        v = os.environ.get("PILOSA_TPU_BATCH_SHARED", "auto").lower()
+        return v if v in ("auto", "sync", "off") else "auto"
+
+    def _shared_plan(self, group):
+        """(key, leaf_map, uniques, ordered_group) for a
+        coarse-eligible group, or None when sharing saves no reads
+        (every leaf distinct). The leaf map indexes each request's
+        leaves into the group's unique-(words, start, valid) table.
+        The group is CANONICALLY ordered by LOGICAL leaf identity
+        ((frame, view, row_id) — stable across restages and HBM
+        evictions, unlike array ids) so a repeated workload
+        composition maps to ONE compile key regardless of queue
+        arrival order or staging generation."""
+        if any(r.leaf_keys is None for r in group):
+            return None  # direct callers without logical keys
+        ordered = sorted(group, key=lambda r: r.leaf_keys)
+        uniq: Dict[tuple, int] = {}
+        uniques = []
+        leaf_map = []
+        for r in ordered:
+            row = []
+            # Logical keys are 1:1 with arrays WITHIN a group (same
+            # staged generation, enforced by group_key), so the unique
+            # table can key on them while carrying the arrays.
+            for k, (wt, ct) in zip(r.leaf_keys,
+                                   zip(r.args[1], r.coarse_t)):
+                u = uniq.get(k)
+                if u is None:
+                    u = uniq[k] = len(uniques)
+                    uniques.append((wt, ct[0], ct[1]))
+                row.append(u)
+            leaf_map.append(tuple(row))
+        total_slots = sum(len(m) for m in leaf_map)
+        if len(uniques) >= total_slots:
+            return None  # nothing shared: plain batch reads the same
+        sig = group[0].args[0]
+        return ((sig, tuple(leaf_map), len(uniques)),
+                tuple(leaf_map), uniques, ordered)
+
+    _SHARED_FNS_MAX = 32
+    _SHARED_SEEN_MAX = 256
+
+    def _shared_compile_async(self, key, tree_sig, leaf_map, num_unique):
+        """Kick a background compile of the shared program — only
+        once a composition has been seen TWICE (one-off groupings must
+        not churn compile threads), and bounded caches throughout."""
+        with self._compile_mu:
+            if key in self._shared_fns or key in self._shared_pending:
+                return
+            n = self._shared_seen.get(key, 0) + 1
+            self._shared_seen[key] = n
+            self._shared_seen.move_to_end(key)
+            while len(self._shared_seen) > self._SHARED_SEEN_MAX:
+                self._shared_seen.popitem(last=False)
+            if n < 2:
+                return
+            self._shared_pending.add(key)
+
+        def build():
+            try:
+                fn = compile_serve_count_batch_shared(
+                    self.mesh, json.loads(tree_sig), leaf_map, num_unique)
+                with self._compile_mu:
+                    self._shared_fns[key] = fn
+                    while len(self._shared_fns) > self._SHARED_FNS_MAX:
+                        self._shared_fns.popitem(last=False)
+            finally:
+                with self._compile_mu:
+                    self._shared_pending.discard(key)
+
+        threading.Thread(target=build, name="shared-batch-compile",
+                         daemon=True).start()
+
     def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
         """A zero-arg callable running ONE compiled (unbatched) serving
@@ -712,12 +809,44 @@ class MeshManager:
                         self._MAX_BATCH)
             padded = group + [group[-1]] * (b_pad - b)
             if coarse_ok:
-                fn = self._coarse_fn(sig, num_leaves, b_pad)
-                start_flat = tuple(r.coarse_t[i][0] for r in padded
-                                   for i in range(num_leaves))
-                valid_flat = tuple(r.coarse_t[i][1] for r in padded
-                                   for i in range(num_leaves))
-                limbs = fn(words_t, start_flat, valid_flat, dev_mask)
+                shared = None
+                policy = self._shared_policy()
+                plan = (self._shared_plan(group)
+                        if policy != "off" else None)
+                if plan is not None:
+                    key, leaf_map, uniques, ordered_group = plan
+                    shared = self._shared_fns.get(key)
+                    if shared is not None:
+                        with self._compile_mu:
+                            if key in self._shared_fns:
+                                self._shared_fns.move_to_end(key)
+                    if shared is None:
+                        if policy == "sync":
+                            shared = self._get_or_compile(
+                                self._shared_fns, key,
+                                lambda: compile_serve_count_batch_shared(
+                                    self.mesh, json.loads(sig), leaf_map,
+                                    len(uniques)))
+                        else:
+                            self._shared_compile_async(
+                                key, sig, leaf_map, len(uniques))
+                if shared is not None:
+                    limbs = shared(
+                        tuple(u[0] for u in uniques),
+                        tuple(u[1] for u in uniques),
+                        tuple(u[2] for u in uniques), dev_mask)
+                    # shared output columns follow the CANONICAL group
+                    # order; distribute results in that order (exact
+                    # width, no padding)
+                    group = ordered_group
+                    self.stats["shared_batch"] += b
+                else:
+                    fn = self._coarse_fn(sig, num_leaves, b_pad)
+                    start_flat = tuple(r.coarse_t[i][0] for r in padded
+                                       for i in range(num_leaves))
+                    valid_flat = tuple(r.coarse_t[i][1] for r in padded
+                                       for i in range(num_leaves))
+                    limbs = fn(words_t, start_flat, valid_flat, dev_mask)
                 self.stats["coarse"] += b
             else:
                 fn = self._get_or_compile(
@@ -779,6 +908,7 @@ class MeshManager:
         if prepared is None:
             return None
         req = _CountRequest(*prepared)
+        req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
         self._ensure_batch_thread()
         self._batch_q.put(req)
         req.done.wait()
