@@ -591,7 +591,22 @@ class Handler:
             raise IndexNotFoundError()
         return _json_resp({"index": idx.to_dict()})
 
+    def _spmd_guard_schema(self, what: str):
+        """Schema mutations on a non-zero SPMD rank would apply to the
+        local holder only (workers carry a NopBroadcaster), silently
+        diverging the replicated data dirs from the descriptor-ordered
+        stream — the same hazard the import/write guards close. Rank 0
+        is fine: its SpmdBroadcaster rides the change down the
+        descriptor stream to every rank."""
+        if self.spmd_worker:
+            return _json_resp(
+                {"error": f"{what} must be sent to SPMD rank 0"}, 400)
+        return None
+
     def _post_index(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("index create")
+        if guard is not None:
+            return guard
         opts = _decode_options(body, {"columnLabel": "column_label",
                                       "timeQuantum": "time_quantum"})
         idx = self.holder.create_index(pv["index"], **opts)
@@ -603,6 +618,9 @@ class Handler:
         return _json_resp({})
 
     def _delete_index(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("index delete")
+        if guard is not None:
+            return guard
         self.holder.delete_index(pv["index"])
         if hasattr(self.executor, "invalidate_device_index"):
             self.executor.invalidate_device_index(pv["index"])
@@ -612,6 +630,9 @@ class Handler:
         return _json_resp({})
 
     def _patch_index_time_quantum(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("index time-quantum patch")
+        if guard is not None:
+            return guard
         q = json.loads(body.decode() or "{}").get("timeQuantum", "")
         idx = self.holder.index(pv["index"])
         if idx is None:
@@ -620,6 +641,9 @@ class Handler:
         return _json_resp({})
 
     def _post_frame(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("frame create")
+        if guard is not None:
+            return guard
         opts = _decode_options(body, {
             "rowLabel": "row_label", "inverseEnabled": "inverse_enabled",
             "cacheType": "cache_type", "cacheSize": "cache_size",
@@ -638,6 +662,9 @@ class Handler:
         return _json_resp({})
 
     def _delete_frame(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("frame delete")
+        if guard is not None:
+            return guard
         idx = self.holder.index(pv["index"])
         if idx is None:
             raise IndexNotFoundError()
@@ -650,6 +677,9 @@ class Handler:
         return _json_resp({})
 
     def _patch_frame_time_quantum(self, pv, params, headers, body) -> Response:
+        guard = self._spmd_guard_schema("frame time-quantum patch")
+        if guard is not None:
+            return guard
         q = json.loads(body.decode() or "{}").get("timeQuantum", "")
         f = self.holder.frame(pv["index"], pv["frame"])
         if f is None:
@@ -941,6 +971,15 @@ class Handler:
     # -- internal control plane ---------------------------------------------
 
     def _post_internal_message(self, pv, params, headers, body) -> Response:
+        if self.spmd is not None or self.spmd_worker:
+            # In spmd mode the descriptor stream is the ONLY schema
+            # transport: an HTTP-delivered broadcast would apply to
+            # this rank's holder alone (rank 0 included — its
+            # receive_message never re-enters the stream), diverging
+            # the replicas the fingerprint gate then rejects forever.
+            return _json_resp(
+                {"error": "internal broadcasts are descriptor-stream "
+                          "only under [cluster] type=\"spmd\""}, 400)
         if self.broadcast_handler is None:
             return _json_resp({"error": "broadcast not supported"}, 501)
         msg = unmarshal_message(body)

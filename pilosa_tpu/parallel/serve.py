@@ -209,6 +209,13 @@ class MeshManager:
         # stall on the serving path.
         self._shared_fns: "OrderedDict[tuple, object]" = OrderedDict()
         self._shared_pending: set = set()
+        # Guards ONLY the _shared_fns/_shared_seen/_shared_pending
+        # structural ops (get+move_to_end, insert+trim) — held for dict
+        # ops alone, never across a compile, so the dispatch fast path
+        # can't stall behind an unrelated multi-second _compile_mu
+        # build. Ordering: _compile_mu -> _shared_mu where both are
+        # held; never the reverse.
+        self._shared_mu = threading.Lock()
         # Composition sightings: a shared program only compiles once a
         # composition REPEATS (timing-dependent batch groupings must
         # not each mint a multi-second background compile).
@@ -648,11 +655,40 @@ class MeshManager:
     _SHARED_FNS_MAX = 32
     _SHARED_SEEN_MAX = 256
 
+    def _shared_get(self, key):
+        """LRU lookup in the shared-program cache under its own
+        short-hold lock (the background builder inserts/popitems the
+        same OrderedDict; a bare .get() during structural mutation is
+        not a guaranteed-safe pattern — ADVICE r3)."""
+        with self._shared_mu:
+            fn = self._shared_fns.get(key)
+            if fn is not None:
+                self._shared_fns.move_to_end(key)
+            return fn
+
+    def _shared_put(self, key, fn):
+        with self._shared_mu:
+            self._shared_fns[key] = fn
+            while len(self._shared_fns) > self._SHARED_FNS_MAX:
+                self._shared_fns.popitem(last=False)
+
+    def _shared_compile_sync(self, key, tree_sig, leaf_map, num_unique):
+        """Inline compile for policy="sync" (tests/bench). _compile_mu
+        dedupes racing first compiles; _shared_mu alone covers the dict
+        ops, so warm lookups elsewhere never wait on the build."""
+        with self._compile_mu:
+            fn = self._shared_get(key)
+            if fn is None:
+                fn = compile_serve_count_batch_shared(
+                    self.mesh, json.loads(tree_sig), leaf_map, num_unique)
+                self._shared_put(key, fn)
+        return fn
+
     def _shared_compile_async(self, key, tree_sig, leaf_map, num_unique):
         """Kick a background compile of the shared program — only
         once a composition has been seen TWICE (one-off groupings must
         not churn compile threads), and bounded caches throughout."""
-        with self._compile_mu:
+        with self._shared_mu:
             if key in self._shared_fns or key in self._shared_pending:
                 return
             n = self._shared_seen.get(key, 0) + 1
@@ -668,12 +704,9 @@ class MeshManager:
             try:
                 fn = compile_serve_count_batch_shared(
                     self.mesh, json.loads(tree_sig), leaf_map, num_unique)
-                with self._compile_mu:
-                    self._shared_fns[key] = fn
-                    while len(self._shared_fns) > self._SHARED_FNS_MAX:
-                        self._shared_fns.popitem(last=False)
+                self._shared_put(key, fn)
             finally:
-                with self._compile_mu:
+                with self._shared_mu:
                     self._shared_pending.discard(key)
 
         threading.Thread(target=build, name="shared-batch-compile",
@@ -815,18 +848,11 @@ class MeshManager:
                         if policy != "off" else None)
                 if plan is not None:
                     key, leaf_map, uniques, ordered_group = plan
-                    shared = self._shared_fns.get(key)
-                    if shared is not None:
-                        with self._compile_mu:
-                            if key in self._shared_fns:
-                                self._shared_fns.move_to_end(key)
+                    shared = self._shared_get(key)
                     if shared is None:
                         if policy == "sync":
-                            shared = self._get_or_compile(
-                                self._shared_fns, key,
-                                lambda: compile_serve_count_batch_shared(
-                                    self.mesh, json.loads(sig), leaf_map,
-                                    len(uniques)))
+                            shared = self._shared_compile_sync(
+                                key, sig, leaf_map, len(uniques))
                         else:
                             self._shared_compile_async(
                                 key, sig, leaf_map, len(uniques))

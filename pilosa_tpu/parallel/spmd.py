@@ -61,6 +61,10 @@ from .broadcast import Broadcaster
 # every rank. 64 KB bounds the slice list of a masked query.
 _DESC_BYTES = 65536
 
+# IMPORT timestamp "absent" sentinel: outside the valid epoch range so
+# a real 1970-01-01T00:00:00 (epoch 0) survives the round-trip.
+_TS_NONE = np.iinfo(np.int64).min
+
 _OP_COUNT = 1
 _OP_STOP = 2
 _OP_ROWCOUNTS = 3
@@ -131,8 +135,9 @@ class SpmdServer:
         self.manager = MeshManager(holder, mesh=mesh)
         self.holder = holder
         self.apply_message = None  # set by server wiring (receive_message)
-        self.apply_query = None    # set by server wiring: (index, pql) ->
-        #                            executor.execute with remote=True
+        self.apply_query = None    # set by server wiring: (index, parsed
+        #                            pql.Query) -> executor.execute with
+        #                            remote=True
         # AOT-compiled programs keyed by (kind, sig, shapes): compilation
         # must happen BEFORE the agreement gate (see _execute_count), and
         # jit only compiles at first call — lower().compile() forces it.
@@ -260,10 +265,12 @@ class SpmdServer:
         # Naive datetimes here are UTC by convention (the handler
         # decodes wire timestamps as naive-UTC); t.timestamp() would
         # read them in the HOST timezone and shift every bit's
-        # time-quantum view on non-UTC machines.
+        # time-quantum view on non-UTC machines. None is encoded as
+        # int64 min — 0 is a legitimate epoch timestamp (1970-01-01)
+        # and must keep its time-quantum view fan-out.
         ts = (np.zeros(0, dtype=np.int64) if timestamps is None
               else np.asarray(
-                  [0 if t is None
+                  [_TS_NONE if t is None
                    else int(t.replace(tzinfo=_tz.utc).timestamp())
                    for t in timestamps],
                   dtype=np.int64))
@@ -487,6 +494,12 @@ class SpmdServer:
             ts = parse_time(desc["ts"])
         return bool(f.set_bit(desc["row"], desc["col"], ts))
 
+    # Calls a PQL descriptor may carry: host-side attr writes only. A
+    # read (e.g. Count) riding this op would re-enter SpmdServer._mu
+    # via executor -> _spmd.count on rank 0 (non-reentrant lock) and
+    # deadlock the whole cluster — enforce, don't assume.
+    _PQL_ALLOWED = frozenset({"SetRowAttrs", "SetColumnAttrs"})
+
     def _execute_pql(self, desc: dict):
         """PQL: run the re-serialized write through this rank's
         executor (remote=True: apply locally, never re-forward or
@@ -494,7 +507,16 @@ class SpmdServer:
         descriptor-applied writes)."""
         if self.apply_query is None:
             raise RuntimeError("SpmdServer.apply_query not wired")
-        out = self.apply_query(desc["index"], desc["pql"])
+        from ..pql import parse_string
+
+        query = parse_string(desc["pql"])
+        bad = [c.name for c in query.calls
+               if c.name not in self._PQL_ALLOWED]
+        if bad:
+            raise ValueError(
+                f"PQL descriptor carries non-attr-write calls {bad}; "
+                f"only {sorted(self._PQL_ALLOWED)} may ride this op")
+        out = self.apply_query(desc["index"], query)
         return out[0] if out else None
 
     def _execute_import(self, desc: dict) -> None:
@@ -515,7 +537,7 @@ class SpmdServer:
         if len(ts_raw):
             timestamps = [
                 datetime.fromtimestamp(t, timezone.utc).replace(tzinfo=None)
-                if t else None for t in ts_raw]
+                if t != _TS_NONE else None for t in ts_raw]
         f.import_bits([int(r) for r in rows], [int(c) for c in cols],
                       timestamps)
 

@@ -150,12 +150,12 @@ class Server:
                                  cluster=self.cluster, client=self.client,
                                  use_device=use_device)
         if self.spmd is not None:
-            from .pql import parse_string as _parse
-
-            def _apply_query(index, pql):
+            def _apply_query(index, query):
+                # query arrives pre-parsed: _execute_pql already parsed
+                # it for the allowlist check.
                 from .executor import ExecOptions
 
-                return self.executor.execute(index, _parse(pql),
+                return self.executor.execute(index, query,
                                              opt=ExecOptions(remote=True))
 
             self.spmd.apply_query = _apply_query

@@ -358,3 +358,48 @@ class TestQueryStats:
         assert snap.get("index:i,query.Count") == 1
         assert snap.get("index:i,query.SetBit") == 1
         assert "index:i,query.us.sum" in snap
+
+
+class TestSpmdWorkerGuards:
+    """Schema mutations on a non-zero SPMD rank must be rejected, not
+    applied to the local (Nop-broadcast) holder only — the same guard
+    imports and bit writes already have (ADVICE r3 medium)."""
+
+    def test_schema_routes_rejected_on_worker(self, env):
+        _, h = env
+        seed(h)  # pre-existing schema, created while still rank-0-like
+        h.spmd_worker = True
+        rejected = [
+            ("POST", "/index/i2", b""),
+            ("DELETE", "/index/i", b""),
+            ("POST", "/index/i/frame/f2", b""),
+            ("DELETE", "/index/i/frame/f", b""),
+            ("PATCH", "/index/i/time-quantum", b'{"timeQuantum":"YMD"}'),
+            ("PATCH", "/index/i/frame/f/time-quantum",
+             b'{"timeQuantum":"YMD"}'),
+        ]
+        for method, path, body in rejected:
+            r = h.handle(method, path, body=body)
+            assert r.status == 400, (method, path, r.status, r.body)
+            assert "SPMD rank 0" in r.json()["error"], (method, path)
+        # nothing was applied locally
+        holder, _ = env
+        assert holder.index("i2") is None
+        assert holder.index("i") is not None
+        assert holder.frame("i", "f") is not None
+        assert holder.frame("i", "f2") is None
+        # reads still work on a worker
+        assert h.handle("GET", "/schema").status == 200
+
+    def test_internal_message_rejected_in_spmd_mode(self, env):
+        # /internal/message applies a broadcast to ONE rank's holder —
+        # in spmd mode (rank 0 or worker) that bypasses the descriptor
+        # stream and diverges replicas, so both reject it.
+        _, h = env
+        for flag in ("spmd_worker", "spmd"):
+            setattr(h, flag, True if flag == "spmd_worker" else object())
+            body = marshal_message(pb.DeleteIndexMessage(index="i"))
+            r = post(h, "/internal/message", body=body)
+            assert r.status == 400, (flag, r.status, r.body)
+            assert "descriptor" in r.json()["error"], flag
+            setattr(h, flag, False if flag == "spmd_worker" else None)

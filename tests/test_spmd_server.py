@@ -154,6 +154,15 @@ def test_spmd_server_two_process_boot(tmp_path):
                     "SetBit(frame=f1, rowID=5, columnID=1)")
         assert "SPMD rank 0" in out.get("error", ""), out
 
+        # schema mutations on a worker rank are rejected the same way
+        # (a worker-local create would diverge the replicas: its
+        # broadcaster is a Nop, so the change never reaches the
+        # descriptor stream)
+        out = _post(http[1], "/index/rogue", "{}")
+        assert "SPMD rank 0" in out.get("error", ""), out
+        out = _post(http[1], "/index/si/frame/rogue", "{}")
+        assert "SPMD rank 0" in out.get("error", ""), out
+
         # bulk import rides the descriptor stream too: POST protobuf
         # /import to rank 0, then read the bits back from rank 1's
         # host path
@@ -187,3 +196,74 @@ def test_spmd_server_two_process_boot(tmp_path):
             procs[1].wait(timeout=30)
         except subprocess.TimeoutExpired:
             procs[1].kill()
+
+
+class TestDescriptorUnits:
+    """Descriptor-execution units, no multi-process runtime needed
+    (SpmdServer built without __init__ — these methods touch only the
+    holder / apply_query seams)."""
+
+    def _bare(self, holder=None):
+        from pilosa_tpu.parallel.spmd import SpmdServer
+
+        s = object.__new__(SpmdServer)
+        s.holder = holder
+        s.apply_message = None
+        s.apply_query = None
+        return s
+
+    def test_import_timestamp_epoch_zero_survives(self, tmp_path):
+        # 1970-01-01T00:00:00 is a legitimate timestamp and must keep
+        # its time-quantum view fan-out (ADVICE r3: 0-as-None dropped it)
+        import base64
+        from datetime import datetime
+
+        import numpy as np
+
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.parallel.spmd import _OP_IMPORT, _TS_NONE
+
+        holder = Holder(str(tmp_path / "d"))
+        holder.open()
+        idx = holder.create_index("i")
+        idx.create_frame("f", time_quantum="YMD")
+        s = self._bare(holder)
+
+        epoch = int(datetime(1970, 1, 1).timestamp() -
+                    datetime(1970, 1, 1).timestamp())  # 0 by construction
+        desc = {
+            "op": _OP_IMPORT, "index": "i", "frame": "f",
+            "rows": base64.b64encode(
+                np.array([1, 2], dtype=np.uint64).tobytes()).decode(),
+            "cols": base64.b64encode(
+                np.array([10, 20], dtype=np.uint64).tobytes()).decode(),
+            "ts": base64.b64encode(
+                np.array([epoch, _TS_NONE], dtype=np.int64).tobytes()
+            ).decode(),
+        }
+        s._execute_import(desc)
+        f = holder.frame("i", "f")
+        # epoch-0 bit landed in the 1970 time views
+        time_views = [v for v in f.views if "1970" in v]
+        assert time_views, sorted(f.views)
+        # the None-timestamp bit produced no time views of its own —
+        # every time view present is a 1970 one from the epoch-0 bit
+        assert all("1970" in v for v in f.views
+                   if v != "standard"), sorted(f.views)
+        holder.close()
+
+    def test_pql_descriptor_allowlist(self):
+        from pilosa_tpu.parallel.spmd import _OP_PQL
+
+        s = self._bare()
+        calls = []
+        s.apply_query = lambda index, q: calls.append((index, q)) or [True]
+        # allowed: attr writes
+        s._execute_pql({"op": _OP_PQL, "index": "i",
+                        "pql": 'SetRowAttrs(frame=f, rowID=1, color="red")'})
+        assert calls
+        # a read riding the PQL op would deadlock rank 0 (re-enters
+        # SpmdServer._mu via executor -> _spmd.count) — must raise
+        with pytest.raises(ValueError, match="non-attr-write"):
+            s._execute_pql({"op": _OP_PQL, "index": "i",
+                            "pql": "Count(Bitmap(frame=f, rowID=1))"})
