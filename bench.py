@@ -245,15 +245,37 @@ def main():
     import sys
     import threading
 
-    # TPU backend init through a sick relay can HANG rather than raise —
-    # watchdog-exec to CPU instead of waiting forever.
+    # -- TPU acquisition: retried attempts, then CPU (VERDICT r3 #1) ---------
+    # One 600 s watchdog proved fragile: a sick relay often RECOVERS
+    # within the 10-25 min single-lease window, so r3's one-shot
+    # CPU fallback recorded a loss the chip didn't earn. Now each
+    # attempt gets PILOSA_TPU_INIT_TIMEOUT seconds (default 240) and a
+    # hang re-execs into the next attempt (fresh process — the hung
+    # backend init dies with the image) up to PILOSA_TPU_INIT_ATTEMPTS
+    # (default 4, ~16 min of retrying) before falling back to CPU.
+    # PILOSA_TPU_BENCH_T0 carries the original start across re-execs so
+    # the run budget below is TOTAL, not per-attempt.
+    t0_wall = float(os.environ.setdefault(
+        "PILOSA_TPU_BENCH_T0", repr(time.time())))
+    reexec_cpu = bool(os.environ.get("PILOSA_TPU_BENCH_REEXEC"))
     init_done = threading.Event()
-    if not os.environ.get("PILOSA_TPU_BENCH_REEXEC"):
-        timeout_s = float(os.environ.get("PILOSA_TPU_INIT_TIMEOUT", "600"))
+    if not reexec_cpu:
+        attempt = int(os.environ.get("PILOSA_TPU_BENCH_ATTEMPT", "0"))
+        per_attempt = float(os.environ.get("PILOSA_TPU_INIT_TIMEOUT", "240"))
+        attempts = int(os.environ.get("PILOSA_TPU_INIT_ATTEMPTS", "4"))
 
         def watchdog():
-            if not init_done.wait(timeout_s):
-                _progress(f"TPU init exceeded {timeout_s:.0f}s; "
+            if not init_done.wait(per_attempt):
+                nxt = attempt + 1
+                if nxt < attempts:
+                    _progress(f"TPU init attempt {attempt + 1}/{attempts} "
+                              f"exceeded {per_attempt:.0f}s; retrying")
+                    env = dict(os.environ,
+                               PILOSA_TPU_BENCH_ATTEMPT=str(nxt))
+                    os.execve(sys.executable,
+                              [sys.executable, os.path.abspath(__file__)],
+                              env)
+                _progress(f"all {attempts} TPU init attempts exhausted; "
                           "re-running on CPU")
                 os.execve(sys.executable,
                           [sys.executable, os.path.abspath(__file__)],
@@ -265,16 +287,58 @@ def main():
 
     try:
         on_tpu = jax.default_backend() == "tpu"
+        if on_tpu:
+            # Backend CONFIRMATION, not just init: a tiny program must
+            # round-trip through the relay under the attempt watchdog
+            # before we invest in building + staging the 1 GB holder
+            # (a relay that inits but can't execute would otherwise
+            # strand the run mid-staging with nothing recorded).
+            import jax.numpy as _jnp
+
+            np.asarray(jax.jit(lambda x: x + 1)(
+                _jnp.ones(8, dtype=_jnp.int32)))
         init_done.set()
     except RuntimeError as e:
-        if os.environ.get("PILOSA_TPU_BENCH_REEXEC"):
+        if reexec_cpu:
             raise
         _progress(f"TPU backend unavailable ({e}); re-running on CPU")
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)],
                   _cpu_reexec_env())
 
+    # -- run budget + headline checkpoint (VERDICT r3 #1) --------------------
+    # The headline config runs FIRST and its result is checkpointed the
+    # moment it exists; if the relay stalls later in the run, the
+    # budget watchdog emits the checkpointed TPU headline instead of
+    # losing the run. Partial per-config results flush to the details
+    # file as each section completes.
+    checkpoint: dict = {"result": None}
+    budget = float(os.environ.get("PILOSA_TPU_RUN_BUDGET", "2400"))
+
+    def budget_watchdog():
+        while True:
+            left = budget - (time.time() - t0_wall)
+            if left <= 0:
+                break
+            time.sleep(min(left, 30))
+        if checkpoint["result"] is not None:
+            _progress(f"run budget {budget:.0f}s exhausted; emitting the "
+                      "checkpointed headline")
+            print(json.dumps(checkpoint["result"]), flush=True)
+            os._exit(0)
+        if not reexec_cpu:
+            _progress("run budget exhausted before the headline; "
+                      "re-running on CPU")
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)],
+                      _cpu_reexec_env())
+        _progress("run budget exhausted before the headline (CPU run); "
+                  "continuing — the driver's own timeout is the backstop")
+
+    threading.Thread(target=budget_watchdog, daemon=True).start()
+
     import tempfile
+    from contextlib import contextmanager
 
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.ops import native
@@ -288,6 +352,40 @@ def main():
     topn_slices = 8
     details = {}
     tmp = tempfile.mkdtemp(prefix="pilosa_bench_")
+    ncores = os.cpu_count() or 1
+
+    # A CPU-fallback run (watchdog re-exec when the TPU tunnel is sick)
+    # must not clobber a real TPU artifact.
+    details_path = ("BENCH_DETAILS.json" if on_tpu
+                    else "BENCH_DETAILS_CPU.json")
+
+    def flush_details():
+        """Checkpoint per-config results after every section: a late
+        relay stall must not lose the rows already measured."""
+        with open(details_path, "w") as f:
+            json.dump({k: {kk: (round(vv, 4)
+                                if isinstance(vv, (int, float)) else vv)
+                           for kk, vv in v.items()}
+                       for k, v in details.items()}, f, indent=2)
+            f.write("\n")
+
+    @contextmanager
+    def section(name):
+        """Contain one post-headline config: a failure records an error
+        row and the run continues (the headline checkpoint and the
+        other configs still land in the artifact)."""
+        _progress(name)
+        try:
+            yield
+        except Exception as err:  # noqa: BLE001 — recorded, not fatal
+            import traceback
+
+            details.setdefault(name, {})["error"] = \
+                f"{type(err).__name__}: {err}"
+            _progress(f"section {name} FAILED: {err}")
+            traceback.print_exc(file=sys.stderr)
+        finally:
+            flush_details()
 
     # -- run diagnostics: the relay's mood for THIS run ----------------------
     _progress("diagnostics: dispatch floor + stream bandwidth")
@@ -307,9 +405,14 @@ def main():
         # kernel path (ops/native.py) standing in for the reference's
         # amd64 POPCNT assembly — no Go toolchain exists in this
         # environment to measure the reference itself (BASELINE.md;
-        # VERDICT r2 missing-item 3).
+        # VERDICT r2 missing-item 3). Throughput rows additionally
+        # carry a host column measured over a thread pool saturating
+        # every host core (the reference's goroutine-per-slice
+        # parallelism, executor.go:1200-1236; the C++ kernels release
+        # the GIL, so threads scale across cores).
         "host_baseline": "ops/native.py C++ kernels "
-                         "(assembly stand-in; no Go toolchain)"}
+                         "(assembly stand-in; no Go toolchain)",
+        "host_cores": ncores}
 
     # -- headline (config 5): 1B-column Intersect+Count through serving ------
     _progress(f"headline: building {num_slices}-slice {head_rows}-row "
@@ -318,15 +421,30 @@ def main():
     e = Executor(h, use_device=True)
     pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
 
-    _progress("headline: staging + first serving query")
-    t_stage0 = time.perf_counter()
-    dev_count, call = serve_count_call(e, "i", pql, list(range(num_slices)))
-    stage_s = time.perf_counter() - t_stage0
+    # Staging (snapshot + pack + H2D) timed SEPARATELY from the first
+    # query's compile (VERDICT r3 #5: r3's stage_s conflated the two —
+    # a first XLA compile through the relay is tens of seconds on its
+    # own). block_until_ready pins the data-readiness point; the
+    # serving path itself never blocks (transfers stream while the
+    # first compile traces).
+    _progress("headline: staging (pack + chunked H2D)")
     mgr = e.mesh_manager()
-    sv = mgr._views[("i", "general", "standard")]
+    t_stage0 = time.perf_counter()
+    sv = mgr.refresh("i", "general", "standard", num_slices)
+    sv.sharded.words.block_until_ready()
+    stage_s = time.perf_counter() - t_stage0
     pool_bytes = int(np.prod(sv.sharded.words.shape)) * 4
     details["diagnostics"]["stage_s"] = stage_s
     details["diagnostics"]["staged_bytes"] = pool_bytes
+    details["diagnostics"]["stage_gbps"] = pool_bytes / 1e9 / stage_s
+    details["diagnostics"]["h2d_dispatch_s"] = \
+        mgr.stats["h2d_dispatch_us"] / 1e6
+
+    _progress("headline: first serving query (compile)")
+    t_c0 = time.perf_counter()
+    dev_count, call = serve_count_call(e, "i", pql, list(range(num_slices)))
+    details["diagnostics"]["first_query_compile_s"] = \
+        time.perf_counter() - t_c0
 
     # stream-read ceiling on the staged pool (whole-pool popcount)
     @jax.jit
@@ -353,19 +471,19 @@ def main():
                              fr.storage.containers[r * 16:(r + 1) * 16]])
              for fr in frags])
 
-    wa, wb = row_words(0), row_words(1)
+    rw = [row_words(r) for r in range(head_rows)]  # all rows: MT baseline
+    wa, wb = rw[0], rw[1]
     host_count = native.popcnt_and_slice(wa, wb)
     t0 = time.perf_counter()
     for _ in range(3):
         native.popcnt_and_slice(wa, wb)
     host_dt = (time.perf_counter() - t0) / 3
-    head_host_dt = host_dt  # later sections rebind host_dt; the run2
-    #                         re-sample must use the HEADLINE baseline
     assert dev_count == host_count, (dev_count, host_count)
     details["mapreduce_count"] = {
         "cols": num_slices << 20,
         "single_stream_qps": 1.0 / dt, "single_stream_mean_ms": dt * 1e3,
         "host_cpu_qps": 1.0 / host_dt,
+        "host_baseline": "cxx-popcnt, 1 thread (single-query latency)",
         "single_stream_vs_host": host_dt / dt}
 
     # throughput: 28 DISTINCT pairs (all C(8,2)) coalesced into one
@@ -380,6 +498,32 @@ def main():
     pairs = [(a, b) for a in range(head_rows) for b in range(head_rows)
              if a < b]
     bsz = len(pairs)
+
+    # Fair host THROUGHPUT baseline (VERDICT r3 #2 / ADVICE r3): the
+    # same distinct pairs through a thread pool saturating every host
+    # core — the reference's real host parallelism is goroutine-per-
+    # slice across all cores (executor.go:1200-1236), so batched device
+    # throughput must not be priced against a one-core sequential loop.
+    # The ctypes kernels release the GIL; on this rig host_cores is
+    # recorded alongside so the number can't be read without its
+    # methodology.
+    from concurrent.futures import ThreadPoolExecutor as _HostPool
+
+    mt_threads = max(1, min(ncores, bsz))
+
+    def _host_pair(j):
+        a_, b_ = pairs[j]
+        return native.popcnt_and_slice(rw[a_], rw[b_])
+
+    with _HostPool(mt_threads) as hpool:
+        list(hpool.map(_host_pair, range(bsz)))  # warm/page-in
+        t0 = time.perf_counter()
+        for _ in range(2):
+            list(hpool.map(_host_pair, range(bsz)))
+        host_mt_dt = (time.perf_counter() - t0) / 2
+    host_mt_qps = bsz / host_mt_dt
+    details["mapreduce_count"]["host_mt_qps"] = host_mt_qps
+    details["mapreduce_count"]["host_mt_threads"] = mt_threads
 
     def pair_args(a, b):
         t = parse_string(
@@ -401,457 +545,491 @@ def main():
     limbs = np.asarray(fnb(words_t, start_flat, valid_flat, dmask))
     for j, (a, b) in enumerate(pairs[:3]):  # host-kernel spot-check
         got = (int(limbs[1, j]) << 16) + int(limbs[0, j])
-        want = native.popcnt_and_slice(row_words(a), row_words(b))
+        want = native.popcnt_and_slice(rw[a], rw[b])
         assert got == want, (a, b, got, want)
+    rw = None  # ~1 GB of host row images; only wa/wb are needed below
     bdt = best_of(lambda: fnb(words_t, start_flat, valid_flat, dmask)[0],
                   reps, max(2, iters // 8))
 
-    # shared-read batch program: each of the 8 unique rows is read ONCE
-    # per slice and all 28 pair folds evaluate from the VMEM-resident
-    # block (serve.MeshManager upgrades repeated coarse compositions to
-    # this program adaptively — PILOSA_TPU_BATCH_SHARED). Bytes scale
-    # with unique leaves: ~1 GB/batch instead of ~7 GB.
-    _progress("headline: shared-read batch (28 pairs, 8 unique rows)")
-    from pilosa_tpu.parallel.mesh import compile_serve_count_batch_shared
+    def set_headline():
+        """(Re)build the checkpointed headline from the best throughput
+        so far — provenance inline (VERDICT r3 #9): the number cannot
+        be read without its baseline methodology."""
+        mc = details["mapreduce_count"]
+        checkpoint["result"] = {
+            "metric":
+                f"intersect_count_{num_slices << 20}cols_throughput_qps",
+            "value": round(mc["throughput_batch_qps"], 2),
+            "unit": "queries/sec",
+            "vs_baseline": round(mc["throughput_vs_host"], 2),
+            "baseline": {
+                "host": "self-measured C++ popcnt kernels "
+                        "(no Go toolchain; see BASELINE.md)",
+                "host_cores": ncores,
+                "host_threads": mc["host_mt_threads"],
+                "host_qps": round(mc["host_mt_qps"], 2),
+                "method": f"{mc['throughput_distinct_pairs']} distinct "
+                          "1B-col Intersect+Count queries: batched device "
+                          "program vs host thread pool over all cores",
+            },
+        }
+        flush_details()
 
-    uniq_rows = sorted(set(x for p in pairs for x in p))
-    coarse_by_row = {}
-    with mgr._mu:
-        sv_h = mgr._views[("i", "general", "standard")]
-        for r_ in uniq_rows:
-            coarse_by_row[r_] = mgr._leaf_arrays(sv_h, r_)[2]
-    assert all(c is not None for c in coarse_by_row.values())
-    leaf_map = tuple((uniq_rows.index(a), uniq_rows.index(b))
-                     for a, b in pairs)
-    fns = compile_serve_count_batch_shared(mgr.mesh, json.loads(sig),
-                                           leaf_map, len(uniq_rows))
-    sh_args = (tuple(words_t[0] for _ in uniq_rows),
-               tuple(coarse_by_row[r_][0] for r_ in uniq_rows),
-               tuple(coarse_by_row[r_][1] for r_ in uniq_rows), dmask)
-    limbs_sh = np.asarray(fns(*sh_args))
-    for j in range(bsz):
-        assert (int(limbs_sh[1, j]) << 16) + int(limbs_sh[0, j]) == \
-            (int(limbs[1, j]) << 16) + int(limbs[0, j]), j
-    sdt_sh = best_of(lambda: fns(*sh_args)[0], reps, max(2, iters // 8))
-    details["mapreduce_count"]["throughput_shared_qps"] = bsz / sdt_sh
-
-    # the serving layer uses the shared program for warmed repeated
-    # compositions, so the headline is the better of the two
-    best_dt = min(bdt, sdt_sh)
-    if sdt_sh <= bdt:
-        headline_call = lambda: fns(*sh_args)[0]  # noqa: E731
-    else:
-        headline_call = lambda: fnb(words_t, start_flat, valid_flat,  # noqa: E731
-                                    dmask)[0]
-    details["mapreduce_count"]["throughput_batch_qps"] = bsz / best_dt
+    details["mapreduce_count"]["throughput_batch_qps"] = bsz / bdt
     details["mapreduce_count"]["throughput_vs_host"] = \
-        (bsz / best_dt) * host_dt
+        (bsz / bdt) / host_mt_qps
     details["mapreduce_count"]["throughput_distinct_pairs"] = bsz
+    set_headline()  # TPU rows survive any later stall from here on
 
-    # write-then-Count: a bit into an existing container folds into the
-    # staged image as one scatter; compare against a forced full
-    # restage (what every write cost before incremental maintenance —
-    # VERDICT r1 item 4: write latency must not scale with pool size).
-    # Own (smaller) holder: the incremental-vs-restage comparison does
-    # not need the 1 GB pool, and a forced restage of that pool costs
-    # ~50 s of bench wall (measured) for no extra information.
-    _progress("write-then-count")
-    wt_slices = 240 if on_tpu else 24
-    hw = build_dense_holder(tmp, wt_slices, num_rows=2, seed=17)
-    ew = Executor(hw, use_device=True)
-    mgrw = ew.mesh_manager()
-    tree01 = parse_string(pql).calls[0].children[0]
-    leaves01 = []
-    shape01 = _lower_tree(hw, "i", tree01, leaves01)
-    frag0 = hw.fragment("i", "general", "standard", 0)
+    # The checkpoint exists; from here EVERYTHING runs inside section()
+    # so no later failure can lose the headline. best_dt/headline_call
+    # default to the plain batch program and are upgraded by the shared
+    # section when it wins.
+    best_dt = bdt
+    headline_call = lambda: fnb(words_t, start_flat, valid_flat,  # noqa: E731
+                                dmask)[0]
 
-    def timed_write_count(invalidate: bool, n: int):
-        total = 0.0
-        for k in range(n):
-            # State-neutral write pair into existing container 0 (the
-            # dense words hold random bits — end where we started).
-            col = 1 + k
-            if frag0.storage.contains(frag0._pos(0, col)):
-                frag0.clear_bit(0, col)
-                frag0.set_bit(0, col)
-            else:
-                frag0.set_bit(0, col)
-                frag0.clear_bit(0, col)
-            if invalidate:
-                mgrw.invalidate("i")
-            t0 = time.perf_counter()
-            mgrw.count("i", shape01, leaves01, list(range(wt_slices)),
-                       wt_slices)
-            total += time.perf_counter() - t0
-        return total / n
+    with section("throughput_shared"):
+        # shared-read batch program: each of the 8 unique rows is read
+        # ONCE per slice and all 28 pair folds evaluate from the
+        # VMEM-resident block (serve.MeshManager upgrades repeated
+        # coarse compositions to this program adaptively —
+        # PILOSA_TPU_BATCH_SHARED). Bytes scale with unique leaves:
+        # ~1 GB/batch instead of ~7 GB.
+        _progress("headline: shared-read batch (28 pairs, 8 unique rows)")
+        from pilosa_tpu.parallel.mesh import compile_serve_count_batch_shared
 
-    timed_write_count(False, 1)  # warm the scatter-apply compile
-    inc_dt = timed_write_count(False, 5 if on_tpu else 2)
-    restage_dt = timed_write_count(True, 2 if on_tpu else 1)
-    details["write_then_count"] = {
-        "slices": wt_slices,
-        "incremental_ms": inc_dt * 1e3, "restage_ms": restage_dt * 1e3,
-        "restage_over_incremental": restage_dt / inc_dt}
+        uniq_rows = sorted(set(x for p in pairs for x in p))
+        coarse_by_row = {}
+        with mgr._mu:
+            sv_h = mgr._views[("i", "general", "standard")]
+            for r_ in uniq_rows:
+                coarse_by_row[r_] = mgr._leaf_arrays(sv_h, r_)[2]
+        assert all(c is not None for c in coarse_by_row.values())
+        leaf_map = tuple((uniq_rows.index(a), uniq_rows.index(b))
+                         for a, b in pairs)
+        fns = compile_serve_count_batch_shared(mgr.mesh, json.loads(sig),
+                                               leaf_map, len(uniq_rows))
+        sh_args = (tuple(words_t[0] for _ in uniq_rows),
+                   tuple(coarse_by_row[r_][0] for r_ in uniq_rows),
+                   tuple(coarse_by_row[r_][1] for r_ in uniq_rows), dmask)
+        limbs_sh = np.asarray(fns(*sh_args))
+        for j in range(bsz):
+            assert (int(limbs_sh[1, j]) << 16) + int(limbs_sh[0, j]) == \
+                (int(limbs[1, j]) << 16) + int(limbs[0, j]), j
+        sdt_sh = best_of(lambda: fns(*sh_args)[0], reps, max(2, iters // 8))
+        details["mapreduce_count"]["throughput_shared_qps"] = bsz / sdt_sh
 
-    # executor-level per-call rate (includes per-query relay readback)
-    n_exec = 10 if on_tpu else 3
-    q = parse_string(pql)
-    t0 = time.perf_counter()
-    for _ in range(n_exec):
-        e.execute("i", q)
-    exec_dt = (time.perf_counter() - t0) / n_exec
-    details["serving_executor_qps"] = {
-        "qps": 1.0 / exec_dt, "mean_ms": exec_dt * 1e3}
+        # the serving layer uses the shared program for warmed repeated
+        # compositions, so the headline is the better of the two
+        if sdt_sh <= bdt:
+            best_dt = sdt_sh
+            headline_call = lambda: fns(*sh_args)[0]  # noqa: E731
+            details["mapreduce_count"]["throughput_batch_qps"] = \
+                bsz / best_dt
+            details["mapreduce_count"]["throughput_vs_host"] = \
+                (bsz / best_dt) / host_mt_qps
+            set_headline()
 
-    # concurrent clients: 16 threads, 16 DISTINCT queries, through
-    # executor.execute() — the dynamic batcher must coalesce them into
-    # batch programs (batched_total > 0), not just dedup identical ones
-    # (VERDICT r2 item 5: r2's run used one identical query, so dedup
-    # absorbed everything and the batch path went unexercised).
-    _progress("headline: 16 concurrent clients, distinct queries")
-    import threading as _th
+    with section("write_then_count"):
+        # write-then-Count: a bit into an existing container folds into the
+        # staged image as one scatter; compare against a forced full
+        # restage (what every write cost before incremental maintenance —
+        # VERDICT r1 item 4: write latency must not scale with pool size).
+        # Own (smaller) holder: the incremental-vs-restage comparison does
+        # not need the 1 GB pool, and a forced restage of that pool costs
+        # ~50 s of bench wall (measured) for no extra information.
+        _progress("write-then-count")
+        wt_slices = 240 if on_tpu else 24
+        hw = build_dense_holder(tmp, wt_slices, num_rows=2, seed=17)
+        ew = Executor(hw, use_device=True)
+        mgrw = ew.mesh_manager()
+        tree01 = parse_string(pql).calls[0].children[0]
+        leaves01 = []
+        shape01 = _lower_tree(hw, "i", tree01, leaves01)
+        frag0 = hw.fragment("i", "general", "standard", 0)
 
-    n_cli, per_cli = 16, (6 if on_tpu else 2)
-    cli_idx = [i % len(pairs) for i in range(n_cli)]
-    cli_qs = [parse_string(
-        "Count(Intersect(Bitmap(rowID={}), Bitmap(rowID={})))".format(
-            *pairs[j])) for j in cli_idx]
-    want_counts = [(int(limbs[1, j]) << 16) + int(limbs[0, j])
-                   for j in cli_idx]
-    # Precompile the width-16 coarse batch program (the width the
-    # 16-client drain most often lands on) so the warm pool run pays
-    # fewer first-shape compiles. jit compiles at first CALL, so run it
-    # once on the first 16 pairs' args (needs >= 16 pairs: the CPU
-    # smoke config has only C(4,2) = 6).
-    if bsz >= 16:
-        fn16 = mgr._coarse_fn(sig, num_leaves, 16)
-        np.asarray(fn16(words_t, start_flat[:16 * num_leaves],
-                        valid_flat[:16 * num_leaves], dmask))
+        def timed_write_count(invalidate: bool, n: int):
+            total = 0.0
+            for k in range(n):
+                # State-neutral write pair into existing container 0 (the
+                # dense words hold random bits — end where we started).
+                col = 1 + k
+                if frag0.storage.contains(frag0._pos(0, col)):
+                    frag0.clear_bit(0, col)
+                    frag0.set_bit(0, col)
+                else:
+                    frag0.set_bit(0, col)
+                    frag0.clear_bit(0, col)
+                if invalidate:
+                    mgrw.invalidate("i")
+                t0 = time.perf_counter()
+                mgrw.count("i", shape01, leaves01, list(range(wt_slices)),
+                           wt_slices)
+                total += time.perf_counter() - t0
+            return total / n
 
-    def run_pool():
-        barrier = _th.Barrier(n_cli + 1)
-        errors = []
+        timed_write_count(False, 1)  # warm the scatter-apply compile
+        inc_dt = timed_write_count(False, 5 if on_tpu else 2)
+        restage_dt = timed_write_count(True, 2 if on_tpu else 1)
+        details["write_then_count"] = {
+            "slices": wt_slices,
+            "incremental_ms": inc_dt * 1e3, "restage_ms": restage_dt * 1e3,
+            "restage_over_incremental": restage_dt / inc_dt}
 
-        def client(i):
+    with section("serving_executor_qps"):
+        # executor-level per-call rate (includes per-query relay readback)
+        n_exec = 10 if on_tpu else 3
+        q = parse_string(pql)
+        t0 = time.perf_counter()
+        for _ in range(n_exec):
+            e.execute("i", q)
+        exec_dt = (time.perf_counter() - t0) / n_exec
+        details["serving_executor_qps"] = {
+            "qps": 1.0 / exec_dt, "mean_ms": exec_dt * 1e3}
+
+    with section("serving_concurrent16_qps"):
+        # concurrent clients: 16 threads, 16 DISTINCT queries, through
+        # executor.execute() — the dynamic batcher must coalesce them into
+        # batch programs (batched_total > 0), not just dedup identical ones
+        # (VERDICT r2 item 5: r2's run used one identical query, so dedup
+        # absorbed everything and the batch path went unexercised).
+        _progress("headline: 16 concurrent clients, distinct queries")
+        import threading as _th
+
+        n_cli, per_cli = 16, (6 if on_tpu else 2)
+        cli_idx = [i % len(pairs) for i in range(n_cli)]
+        cli_qs = [parse_string(
+            "Count(Intersect(Bitmap(rowID={}), Bitmap(rowID={})))".format(
+                *pairs[j])) for j in cli_idx]
+        want_counts = [(int(limbs[1, j]) << 16) + int(limbs[0, j])
+                       for j in cli_idx]
+        # Precompile the width-16 coarse batch program (the width the
+        # 16-client drain most often lands on) so the warm pool run pays
+        # fewer first-shape compiles. jit compiles at first CALL, so run it
+        # once on the first 16 pairs' args (needs >= 16 pairs: the CPU
+        # smoke config has only C(4,2) = 6).
+        if bsz >= 16:
+            fn16 = mgr._coarse_fn(sig, num_leaves, 16)
+            np.asarray(fn16(words_t, start_flat[:16 * num_leaves],
+                            valid_flat[:16 * num_leaves], dmask))
+
+        def run_pool():
+            barrier = _th.Barrier(n_cli + 1)
+            errors = []
+
+            def client(i):
+                barrier.wait()
+                try:
+                    for _ in range(per_cli):
+                        got = e.execute("i", cli_qs[i])[0]
+                        assert got == want_counts[i], (i, got)
+                except Exception as err:  # noqa: BLE001 — fail the bench
+                    errors.append(err)
+
+            threads = [_th.Thread(target=client, args=(i,))
+                       for i in range(n_cli)]
+            for t in threads:
+                t.start()
             barrier.wait()
-            try:
-                for _ in range(per_cli):
-                    got = e.execute("i", cli_qs[i])[0]
-                    assert got == want_counts[i], (i, got)
-            except Exception as err:  # noqa: BLE001 — fail the bench
-                errors.append(err)
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            # A dead client finishing early would overstate QPS silently.
+            assert not errors, errors
+            return dt
 
-        threads = [_th.Thread(target=client, args=(i,))
-                   for i in range(n_cli)]
-        for t in threads:
-            t.start()
-        barrier.wait()
-        t0 = time.perf_counter()
-        for t in threads:
-            t.join()
-        dt = time.perf_counter() - t0
-        # A dead client finishing early would overstate QPS silently.
-        assert not errors, errors
-        return dt
+        run_pool()  # warm: compiles the batch-width programs
+        b_before = mgr.stats["batched"]
+        conc_dt = run_pool()
+        batched_during = mgr.stats["batched"] - b_before
+        details["serving_concurrent16_qps"] = {
+            "qps": n_cli * per_cli / conc_dt,
+            "clients": n_cli,
+            "distinct_queries": n_cli,
+            # distinct queries MUST coalesce into batch programs
+            "batched_during_run": batched_during,
+            "batched_total": mgr.stats["batched"],
+            "deduped_total": mgr.stats["deduped"]}
+        assert batched_during > 0, "distinct queries never hit the batch path"
 
-    run_pool()  # warm: compiles the batch-width programs
-    b_before = mgr.stats["batched"]
-    conc_dt = run_pool()
-    batched_during = mgr.stats["batched"] - b_before
-    details["serving_concurrent16_qps"] = {
-        "qps": n_cli * per_cli / conc_dt,
-        "clients": n_cli,
-        "distinct_queries": n_cli,
-        # distinct queries MUST coalesce into batch programs
-        "batched_during_run": batched_during,
-        "batched_total": mgr.stats["batched"],
-        "deduped_total": mgr.stats["deduped"]}
-    assert batched_during > 0, "distinct queries never hit the batch path"
+    with section("serving_openloop64_qps"):
+        # open-loop: every query issued up-front from a thread pool — the
+        # batcher drains full groups while the fetch pipeline overlaps the
+        # per-batch readback with the next batch's device execution (the
+        # closed-loop pool above can't show this: its clients block on
+        # their own results, so the queue is empty during every fetch).
+        _progress("headline: open-loop burst (64 in-flight)")
+        from concurrent.futures import ThreadPoolExecutor as _TPE
 
-    # open-loop: every query issued up-front from a thread pool — the
-    # batcher drains full groups while the fetch pipeline overlaps the
-    # per-batch readback with the next batch's device execution (the
-    # closed-loop pool above can't show this: its clients block on
-    # their own results, so the queue is empty during every fetch).
-    _progress("headline: open-loop burst (64 in-flight)")
-    from concurrent.futures import ThreadPoolExecutor as _TPE
+        n_open = 64 if on_tpu else 8
 
-    n_open = 64 if on_tpu else 8
+        def one_open(i):
+            j = i % len(cli_qs)
+            assert e.execute("i", cli_qs[j])[0] == want_counts[j]
 
-    def one_open(i):
-        j = i % len(cli_qs)
-        assert e.execute("i", cli_qs[j])[0] == want_counts[j]
+        with _TPE(max_workers=n_open) as pool:
+            list(pool.map(one_open, range(n_open)))  # warm any new widths
+            t0 = time.perf_counter()
+            list(pool.map(one_open, range(n_open)))
+            open_dt = time.perf_counter() - t0
+        details["serving_openloop64_qps"] = {
+            "qps": n_open / open_dt, "in_flight": n_open}
 
-    with _TPE(max_workers=n_open) as pool:
-        list(pool.map(one_open, range(n_open)))  # warm any new widths
-        t0 = time.perf_counter()
-        list(pool.map(one_open, range(n_open)))
-        open_dt = time.perf_counter() - t0
-    details["serving_openloop64_qps"] = {
-        "qps": n_open / open_dt, "in_flight": n_open}
-
-    # -- config 1: Count(Bitmap(row)) ----------------------------------------
-    _progress("count_bitmap")
-    first, call1 = serve_count_call(e, "i", "Count(Bitmap(rowID=0))",
-                                    list(range(num_slices)))
-    dt = best_of(lambda: call1()[0], reps, iters)
-    host_c = native.popcnt_slice(wa)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        native.popcnt_slice(wa)
-    host_dt = (time.perf_counter() - t0) / 3
-    assert first == host_c
-    details["count_bitmap"] = {
-        "qps": 1.0 / dt, "mean_ms": dt * 1e3,
-        "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
-
-    # -- config 2: Union / Intersect / Difference over 8 rows, 1 slice -------
-    # Two numbers per op: the raw device collective (routing bypassed —
-    # prices the dispatch floor honestly) and the ROUTED executor path
-    # (the cost model serves these from host kernels; VERDICT r2 item 2).
-    _progress("nary single slice")
-    h8 = build_dense_holder(tmp, 1, num_rows=8, seed=11)
-    e8 = Executor(h8, use_device=True)
-    fr8 = h8.fragment("i", "general", "standard", 0)
-    rows8 = [np.concatenate([c.words() for c in
-                             fr8.storage.containers[r * 16:(r + 1) * 16]])
-             for r in range(8)]
-    calls8 = {"union": "Union", "intersect": "Intersect",
-              "difference": "Difference"}
-    for name, op in [("union", "or"), ("intersect", "and"),
-                     ("difference", "andnot")]:
-        pql8 = (f"Count({calls8[name]}("
-                + ", ".join(f"Bitmap(rowID={r})" for r in range(8)) + "))")
-        first, call = serve_count_call(e8, "i", pql8, [0])
-        dt = best_of(lambda: call()[0], reps, iters)
-        want = host_nary(rows8, op)
+    with section("count_bitmap"):
+        # -- config 1: Count(Bitmap(row)) ----------------------------------------
+        _progress("count_bitmap")
+        first, call1 = serve_count_call(e, "i", "Count(Bitmap(rowID=0))",
+                                        list(range(num_slices)))
+        dt = best_of(lambda: call1()[0], reps, iters)
+        host_c = native.popcnt_slice(wa)
         t0 = time.perf_counter()
         for _ in range(3):
-            host_nary(rows8, op)
+            native.popcnt_slice(wa)
         host_dt = (time.perf_counter() - t0) / 3
-        assert first == want, (name, first, want)
-        # routed path: executor.execute applies the cost model
-        # (1 slice x 8 leaves = 8 < 192 -> host kernels)
-        q8 = parse_string(pql8)
-        routed_before = e8.mesh_manager().stats["routed_host"]
-        assert e8.execute("i", q8)[0] == want
-        assert e8.mesh_manager().stats["routed_host"] > routed_before, \
-            "small query was not routed to host"
+        assert first == host_c
+        details["count_bitmap"] = {
+            "qps": 1.0 / dt, "mean_ms": dt * 1e3,
+            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
+
+    with section("nary_8rows"):
+        # -- config 2: Union / Intersect / Difference over 8 rows, 1 slice -------
+        # Two numbers per op: the raw device collective (routing bypassed —
+        # prices the dispatch floor honestly) and the ROUTED executor path
+        # (the cost model serves these from host kernels; VERDICT r2 item 2).
+        _progress("nary single slice")
+        h8 = build_dense_holder(tmp, 1, num_rows=8, seed=11)
+        e8 = Executor(h8, use_device=True)
+        fr8 = h8.fragment("i", "general", "standard", 0)
+        rows8 = [np.concatenate([c.words() for c in
+                                 fr8.storage.containers[r * 16:(r + 1) * 16]])
+                 for r in range(8)]
+        calls8 = {"union": "Union", "intersect": "Intersect",
+                  "difference": "Difference"}
+        for name, op in [("union", "or"), ("intersect", "and"),
+                         ("difference", "andnot")]:
+            pql8 = (f"Count({calls8[name]}("
+                    + ", ".join(f"Bitmap(rowID={r})" for r in range(8)) + "))")
+            first, call = serve_count_call(e8, "i", pql8, [0])
+            dt = best_of(lambda: call()[0], reps, iters)
+            want = host_nary(rows8, op)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                host_nary(rows8, op)
+            host_dt = (time.perf_counter() - t0) / 3
+            assert first == want, (name, first, want)
+            # routed path: executor.execute applies the cost model
+            # (1 slice x 8 leaves = 8 < 192 -> host kernels)
+            q8 = parse_string(pql8)
+            routed_before = e8.mesh_manager().stats["routed_host"]
+            assert e8.execute("i", q8)[0] == want
+            assert e8.mesh_manager().stats["routed_host"] > routed_before, \
+                "small query was not routed to host"
+            n_r = 20 if on_tpu else 3
+            t0 = time.perf_counter()
+            for _ in range(n_r):
+                e8.execute("i", q8)
+            routed_dt = (time.perf_counter() - t0) / n_r
+            details[f"nary_{name}_8rows"] = {
+                "device_qps": 1.0 / dt, "device_mean_ms": dt * 1e3,
+                "host_cpu_qps": 1.0 / host_dt, "device_vs_host": host_dt / dt,
+                "routed_mean_ms": routed_dt * 1e3,
+                "routed_vs_host": host_dt / routed_dt,
+                "routed_vs_device": dt / routed_dt}
+
+    with section("topn_n100"):
+        # -- config 3: TopN(n=100), realistic mixed containers -------------------
+        _progress(f"topn: building mixed holder ({topn_rows} rows)")
+        hm = build_mixed_holder(tmp, topn_slices, topn_rows)
+        em = Executor(hm, use_device=True)
+        hostm = Executor(hm, use_device=False)
+        topn_q = parse_string("TopN(frame=general, n=100)")
+        dev_pairs = em.execute("i", topn_q)[0]
+        mgrm = em.mesh_manager()
+        # The execute above memoized its row-counts limbs (the rank-cache
+        # analog); drop the memo so rc_call times the live collective, not
+        # a finished-array fetch.
+        with mgrm._mu:
+            mgrm._topn_memo.clear()
+            mgrm._memo_epoch += 1
+        _, rc_call = mgrm._row_counts_call(
+            "i", "general", "standard", list(range(topn_slices)), topn_slices)
+        dt = best_of(lambda: rc_call()[0].sum(), reps, iters)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            hostm.execute("i", topn_q)
+        host_dt = (time.perf_counter() - t0) / 3
+        # Host phase-1 is rank-cache approximate; device is exact. Compare
+        # the top pair to the host's exact ids recount for sanity.
+        host_pairs = hostm.execute("i", topn_q)[0]
+        assert dev_pairs[0] == host_pairs[0], (dev_pairs[0], host_pairs[0])
+        # repeat-TopN memo (the rank-cache analog): a second identical TopN
+        # on an unchanged image serves from the completed-result memo
+        memo_before = mgrm.stats["memo_hit"]
+        em.execute("i", topn_q)  # first repeat: memo hit, but the hit pays
+        #                          the array's FIRST host fetch (a ~70 ms
+        #                          relay poll on this rig; us on attached
+        #                          chips) — time the steady state instead
+        t0 = time.perf_counter()
+        em.execute("i", topn_q)
+        memo_dt = time.perf_counter() - t0
+        assert mgrm.stats["memo_hit"] >= memo_before + 2, "repeat TopN missed memo"
+        details["topn_n100"] = {
+            "mean_ms": dt * 1e3, "rows": topn_rows, "slices": topn_slices,
+            "host_cpu_ms": host_dt * 1e3, "vs_host": host_dt / dt,
+            "repeat_memo_ms": memo_dt * 1e3}
+
+    with section("range_4views"):
+        # -- config 4: Range() time-quantum views (OR over 4 view rows) ----------
+        _progress("range views")
+        pql4 = ("Count(Union(" + ", ".join(
+            f"Bitmap(rowID={r})" for r in range(4)) + "))")
+        first, call4 = serve_count_call(em, "i", pql4, list(range(topn_slices)))
+        dt = best_of(lambda: call4()[0], reps, iters)
+        rows4 = []
+        for r in range(4):
+            acc = np.zeros(topn_slices * 1024, dtype=np.uint64)
+            for s in range(topn_slices):
+                fr = hm.fragment("i", "general", "standard", s)
+                i = fr.storage._find_key(r * 16)
+                if i >= 0:
+                    acc[s * 1024:(s + 1) * 1024] = fr.storage.containers[i].words()
+            rows4.append(acc)
+        want = host_nary(rows4, "or")
+        t0 = time.perf_counter()
+        for _ in range(3):
+            host_nary(rows4, "or")
+        host_dt = (time.perf_counter() - t0) / 3
+        assert first == want, (first, want)
+        q4 = parse_string(pql4)
+        assert em.execute("i", q4)[0] == want
         n_r = 20 if on_tpu else 3
         t0 = time.perf_counter()
         for _ in range(n_r):
-            e8.execute("i", q8)
+            em.execute("i", q4)
         routed_dt = (time.perf_counter() - t0) / n_r
-        details[f"nary_{name}_8rows"] = {
+        details["range_4views"] = {
             "device_qps": 1.0 / dt, "device_mean_ms": dt * 1e3,
             "host_cpu_qps": 1.0 / host_dt, "device_vs_host": host_dt / dt,
             "routed_mean_ms": routed_dt * 1e3,
-            "routed_vs_host": host_dt / routed_dt,
-            "routed_vs_device": dt / routed_dt}
+            "routed_vs_host": host_dt / routed_dt}
 
-    # -- config 3: TopN(n=100), realistic mixed containers -------------------
-    _progress(f"topn: building mixed holder ({topn_rows} rows)")
-    hm = build_mixed_holder(tmp, topn_slices, topn_rows)
-    em = Executor(hm, use_device=True)
-    hostm = Executor(hm, use_device=False)
-    topn_q = parse_string("TopN(frame=general, n=100)")
-    dev_pairs = em.execute("i", topn_q)[0]
-    mgrm = em.mesh_manager()
-    # The execute above memoized its row-counts limbs (the rank-cache
-    # analog); drop the memo so rc_call times the live collective, not
-    # a finished-array fetch.
-    with mgrm._mu:
-        mgrm._topn_memo.clear()
-        mgrm._memo_epoch += 1
-    _, rc_call = mgrm._row_counts_call(
-        "i", "general", "standard", list(range(topn_slices)), topn_slices)
-    dt = best_of(lambda: rc_call()[0].sum(), reps, iters)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        hostm.execute("i", topn_q)
-    host_dt = (time.perf_counter() - t0) / 3
-    # Host phase-1 is rank-cache approximate; device is exact. Compare
-    # the top pair to the host's exact ids recount for sanity.
-    host_pairs = hostm.execute("i", topn_q)[0]
-    assert dev_pairs[0] == host_pairs[0], (dev_pairs[0], host_pairs[0])
-    # repeat-TopN memo (the rank-cache analog): a second identical TopN
-    # on an unchanged image serves from the completed-result memo
-    memo_before = mgrm.stats["memo_hit"]
-    em.execute("i", topn_q)  # first repeat: memo hit, but the hit pays
-    #                          the array's FIRST host fetch (a ~70 ms
-    #                          relay poll on this rig; us on attached
-    #                          chips) — time the steady state instead
-    t0 = time.perf_counter()
-    em.execute("i", topn_q)
-    memo_dt = time.perf_counter() - t0
-    assert mgrm.stats["memo_hit"] >= memo_before + 2, "repeat TopN missed memo"
-    details["topn_n100"] = {
-        "mean_ms": dt * 1e3, "rows": topn_rows, "slices": topn_slices,
-        "host_cpu_ms": host_dt * 1e3, "vs_host": host_dt / dt,
-        "repeat_memo_ms": memo_dt * 1e3}
-
-    # -- config 4: Range() time-quantum views (OR over 4 view rows) ----------
-    _progress("range views")
-    pql4 = ("Count(Union(" + ", ".join(
-        f"Bitmap(rowID={r})" for r in range(4)) + "))")
-    first, call4 = serve_count_call(em, "i", pql4, list(range(topn_slices)))
-    dt = best_of(lambda: call4()[0], reps, iters)
-    rows4 = []
-    for r in range(4):
-        acc = np.zeros(topn_slices * 1024, dtype=np.uint64)
-        for s in range(topn_slices):
-            fr = hm.fragment("i", "general", "standard", s)
-            i = fr.storage._find_key(r * 16)
-            if i >= 0:
-                acc[s * 1024:(s + 1) * 1024] = fr.storage.containers[i].words()
-        rows4.append(acc)
-    want = host_nary(rows4, "or")
-    t0 = time.perf_counter()
-    for _ in range(3):
-        host_nary(rows4, "or")
-    host_dt = (time.perf_counter() - t0) / 3
-    assert first == want, (first, want)
-    q4 = parse_string(pql4)
-    assert em.execute("i", q4)[0] == want
-    n_r = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_r):
-        em.execute("i", q4)
-    routed_dt = (time.perf_counter() - t0) / n_r
-    details["range_4views"] = {
-        "device_qps": 1.0 / dt, "device_mean_ms": dt * 1e3,
-        "host_cpu_qps": 1.0 / host_dt, "device_vs_host": host_dt / dt,
-        "routed_mean_ms": routed_dt * 1e3,
-        "routed_vs_host": host_dt / routed_dt}
-
-    # -- extra: sparse array-container intersect (padded-pool worst case) ----
-    _progress("sparse intersect")
-    sparse_slices = min(num_slices, 240)
-    hs = build_sparse_holder(tmp, sparse_slices)
-    es = Executor(hs, use_device=True)
-    first, calls_ = serve_count_call(
-        es, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
-        list(range(sparse_slices)))
-    dt = best_of(lambda: calls_()[0], reps, iters)
-    # honest host baseline: sorted-array intersection counts (the
-    # reference's array-array kernel class), not dense popcount
-    want = 0
-    arrays = []
-    for s in range(sparse_slices):
-        fr = hs.fragment("i", "general", "standard", s)
-        for b in range(16):
-            ia = fr.storage._find_key(b)
-            ib = fr.storage._find_key(16 + b)
-            arrays.append((fr.storage.containers[ia].array,
-                           fr.storage.containers[ib].array))
-    for a, b in arrays:
-        want += native.intersection_count_sorted(a, b)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        n = 0
+    with section("sparse_intersect"):
+        # -- extra: sparse array-container intersect (padded-pool worst case) ----
+        _progress("sparse intersect")
+        sparse_slices = min(num_slices, 240)
+        hs = build_sparse_holder(tmp, sparse_slices)
+        es = Executor(hs, use_device=True)
+        first, calls_ = serve_count_call(
+            es, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
+            list(range(sparse_slices)))
+        dt = best_of(lambda: calls_()[0], reps, iters)
+        # honest host baseline: sorted-array intersection counts (the
+        # reference's array-array kernel class), not dense popcount
+        want = 0
+        arrays = []
+        for s in range(sparse_slices):
+            fr = hs.fragment("i", "general", "standard", s)
+            for b in range(16):
+                ia = fr.storage._find_key(b)
+                ib = fr.storage._find_key(16 + b)
+                arrays.append((fr.storage.containers[ia].array,
+                               fr.storage.containers[ib].array))
         for a, b in arrays:
-            n += native.intersection_count_sorted(a, b)
-    host_dt = (time.perf_counter() - t0) / 3
-    assert first == want, (first, want)
-    details["sparse_intersect"] = {
-        "qps": 1.0 / dt, "mean_ms": dt * 1e3, "density": 0.03,
-        "slices": sparse_slices,
-        "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
-
-    # -- extra: the bitmap-MATERIALIZING path (VERDICT r2 item 7) ------------
-    # Intersect() that RETURNS a bitmap runs the host roaring path (the
-    # device serves counts; materialization is host work by design).
-    # Host-kernel column: one vectorized AND over the same words — the
-    # raw-kernel floor under the roaring bookkeeping.
-    _progress("materializing intersect")
-    mat_q = parse_string("Intersect(Bitmap(rowID=0), Bitmap(rowID=1))")
-    host_e = Executor(h, use_device=False)
-    row_mat = host_e.execute("i", mat_q)[0]
-    assert row_mat.count() == host_count
-    n_m = 3
-    t0 = time.perf_counter()
-    for _ in range(n_m):
-        host_e.execute("i", mat_q)
-    mat_dt = (time.perf_counter() - t0) / n_m
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _ = wa & wb
-    kern_dt = (time.perf_counter() - t0) / 3
-    details["materialize_intersect"] = {
-        "executor_mean_ms": mat_dt * 1e3,
-        "kernel_and_ms": kern_dt * 1e3,
-        "overhead_x": mat_dt / kern_dt,
-        "cols": num_slices << 20}
-
-    # -- extra: >2^31-bit scale (VERDICT r2 item 8) --------------------------
-    # 3072 slices x 2 dense rows = ~3.22B columns: exercises capacity
-    # padding, (lo,hi) limb accumulation beyond int32, staging time and
-    # HBM footprint at scale.
-    if on_tpu:
-        _progress("scale: building 3072-slice holder (~3.2B cols)")
-        big_slices = 3072
-        hb = build_dense_holder(tmp, big_slices, num_rows=2, seed=31)
-        eb = Executor(hb, use_device=True)
+            want += native.intersection_count_sorted(a, b)
         t0 = time.perf_counter()
-        first, callb = serve_count_call(
-            eb, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
-            list(range(big_slices)))
-        stage_b = time.perf_counter() - t0
-        svb = eb.mesh_manager()._views[("i", "general", "standard")]
-        bytes_b = int(np.prod(svb.sharded.words.shape)) * 4
-        dt = best_of(lambda: callb()[0], 2, 10)
-        fragsb = [hb.fragment("i", "general", "standard", s)
-                  for s in range(big_slices)]
-        wab = np.concatenate(
-            [np.concatenate([c.words() for c in fr.storage.containers[:16]])
-             for fr in fragsb])
-        wbb = np.concatenate(
-            [np.concatenate([c.words() for c in fr.storage.containers[16:]])
-             for fr in fragsb])
-        wantb = native.popcnt_and_slice(wab, wbb)
+        for _ in range(3):
+            n = 0
+            for a, b in arrays:
+                n += native.intersection_count_sorted(a, b)
+        host_dt = (time.perf_counter() - t0) / 3
+        assert first == want, (first, want)
+        details["sparse_intersect"] = {
+            "qps": 1.0 / dt, "mean_ms": dt * 1e3, "density": 0.03,
+            "slices": sparse_slices,
+            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
+
+    with section("materialize_intersect"):
+        # -- extra: the bitmap-MATERIALIZING path (VERDICT r2 item 7) ------------
+        # Intersect() that RETURNS a bitmap runs the host roaring path (the
+        # device serves counts; materialization is host work by design).
+        # Host-kernel column: one vectorized AND over the same words — the
+        # raw-kernel floor under the roaring bookkeeping.
+        _progress("materializing intersect")
+        mat_q = parse_string("Intersect(Bitmap(rowID=0), Bitmap(rowID=1))")
+        host_e = Executor(h, use_device=False)
+        row_mat = host_e.execute("i", mat_q)[0]
+        assert row_mat.count() == host_count
+        n_m = 3
         t0 = time.perf_counter()
-        for _ in range(2):
-            native.popcnt_and_slice(wab, wbb)
-        host_dtb = (time.perf_counter() - t0) / 2
-        assert first == wantb, (first, wantb)
-        del wab, wbb, fragsb
-        details["scale_3221225472cols"] = {
-            "cols": big_slices << 20, "slices": big_slices,
-            "stage_s": stage_b, "staged_bytes": bytes_b,
-            "qps": 1.0 / dt, "mean_ms": dt * 1e3,
-            "host_cpu_qps": 1.0 / host_dtb, "vs_host": host_dtb / dt}
+        for _ in range(n_m):
+            host_e.execute("i", mat_q)
+        mat_dt = (time.perf_counter() - t0) / n_m
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _ = wa & wb
+        kern_dt = (time.perf_counter() - t0) / 3
+        details["materialize_intersect"] = {
+            "executor_mean_ms": mat_dt * 1e3,
+            "kernel_and_ms": kern_dt * 1e3,
+            "overhead_x": mat_dt / kern_dt,
+            "cols": num_slices << 20}
 
-    # Re-measure the headline throughput at the END of the run: the
-    # relay's effective bandwidth drifts in multi-minute phases
-    # (PROFILE_HEADLINE.md), so two samples ~5 minutes apart beat one.
-    _progress("headline: second throughput sample")
-    bdt2 = best_of(headline_call, reps, max(2, iters // 8))
-    details["mapreduce_count"]["throughput_batch_qps_run2"] = bsz / bdt2
-    if bdt2 < best_dt:
-        details["mapreduce_count"]["throughput_batch_qps"] = bsz / bdt2
-        details["mapreduce_count"]["throughput_vs_host"] = \
-            (bsz / bdt2) * head_host_dt
+    with section("scale"):
+        # -- extra: >2^31-bit scale (VERDICT r2 item 8) --------------------------
+        # 3072 slices x 2 dense rows = ~3.22B columns: exercises capacity
+        # padding, (lo,hi) limb accumulation beyond int32, staging time and
+        # HBM footprint at scale.
+        if on_tpu:
+            _progress("scale: building 3072-slice holder (~3.2B cols)")
+            big_slices = 3072
+            hb = build_dense_holder(tmp, big_slices, num_rows=2, seed=31)
+            eb = Executor(hb, use_device=True)
+            t0 = time.perf_counter()
+            first, callb = serve_count_call(
+                eb, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
+                list(range(big_slices)))
+            stage_b = time.perf_counter() - t0
+            svb = eb.mesh_manager()._views[("i", "general", "standard")]
+            bytes_b = int(np.prod(svb.sharded.words.shape)) * 4
+            dt = best_of(lambda: callb()[0], 2, 10)
+            fragsb = [hb.fragment("i", "general", "standard", s)
+                      for s in range(big_slices)]
+            wab = np.concatenate(
+                [np.concatenate([c.words() for c in fr.storage.containers[:16]])
+                 for fr in fragsb])
+            wbb = np.concatenate(
+                [np.concatenate([c.words() for c in fr.storage.containers[16:]])
+                 for fr in fragsb])
+            wantb = native.popcnt_and_slice(wab, wbb)
+            t0 = time.perf_counter()
+            for _ in range(2):
+                native.popcnt_and_slice(wab, wbb)
+            host_dtb = (time.perf_counter() - t0) / 2
+            assert first == wantb, (first, wantb)
+            del wab, wbb, fragsb
+            details["scale_3221225472cols"] = {
+                "cols": big_slices << 20, "slices": big_slices,
+                "stage_s": stage_b, "staged_bytes": bytes_b,
+                "qps": 1.0 / dt, "mean_ms": dt * 1e3,
+                "host_cpu_qps": 1.0 / host_dtb, "vs_host": host_dtb / dt}
 
-    # A CPU-fallback run (watchdog re-exec when the TPU tunnel is sick)
-    # must not clobber a real TPU artifact.
-    details_path = ("BENCH_DETAILS.json" if on_tpu
-                    else "BENCH_DETAILS_CPU.json")
-    with open(details_path, "w") as f:
-        json.dump({k: {kk: (round(vv, 4) if isinstance(vv, (int, float))
-                            else vv)
-                       for kk, vv in v.items()}
-                   for k, v in details.items()}, f, indent=2)
-        f.write("\n")
+    with section("throughput_run2"):
+        # Re-measure the headline throughput at the END of the run: the
+        # relay's effective bandwidth drifts in multi-minute phases
+        # (PROFILE_HEADLINE.md), so two samples ~5 minutes apart beat one.
+        _progress("headline: second throughput sample")
+        bdt2 = best_of(headline_call, reps, max(2, iters // 8))
+        details["mapreduce_count"]["throughput_batch_qps_run2"] = bsz / bdt2
+        if bdt2 < best_dt:
+            details["mapreduce_count"]["throughput_batch_qps"] = bsz / bdt2
+            details["mapreduce_count"]["throughput_vs_host"] = \
+                (bsz / bdt2) / host_mt_qps
+            set_headline()
 
-    tp = details["mapreduce_count"]["throughput_batch_qps"]
-    result = {
-        "metric": f"intersect_count_{num_slices << 20}cols_throughput_qps",
-        "value": round(tp, 2),
-        "unit": "queries/sec",
-        "vs_baseline": round(
-            details["mapreduce_count"]["throughput_vs_host"], 2),
-    }
-    print(json.dumps(result))
+    flush_details()
+    print(json.dumps(checkpoint["result"]))
 
 
 def _cpu_reexec_env():

@@ -55,9 +55,61 @@ class ShardedIndex(NamedTuple):
         return self.keys.shape[1]
 
 
+def _stage_chunk_bytes() -> int:
+    """H2D staging chunk size (PILOSA_TPU_STAGE_CHUNK_MB env, default
+    1024 MB): below the chunk size a shard moves as ONE device_put;
+    above it, as a pipeline of chunk-sized device_puts so host packing
+    of chunk i+1 overlaps the in-flight transfer of chunk i. The
+    default keeps sub-GB shards on the single-put path (no assembly
+    cost) until profiling on the target rig shows the pipeline wins."""
+    import os
+
+    try:
+        mb = int(os.environ.get("PILOSA_TPU_STAGE_CHUNK_MB", "1024"))
+    except ValueError:
+        mb = 1024
+    return max(1, mb) << 20
+
+
+_FOLD_CHUNK = None
+
+
+def _fold_chunk_fn():
+    """Jitted donated dynamic_update_slice: folds one transferred chunk
+    into the shard buffer IN PLACE (donation), so chunked assembly
+    peaks at shard + one chunk of HBM — a jnp.concatenate would
+    transiently hold shard + all chunks (2x the pool). CPU backends
+    don't implement donation; the fallback copy is fine at test scale."""
+    global _FOLD_CHUNK
+    if _FOLD_CHUNK is None:
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        _FOLD_CHUNK = jax.jit(
+            lambda buf, piece, off: lax.dynamic_update_slice(
+                buf, piece, (off, 0, 0)),
+            donate_argnums=donate)
+    return _FOLD_CHUNK
+
+
+def _assemble_shard(pieces: List, offs: List[int], shard_shape, dev):
+    """One device shard from its transferred chunk pieces."""
+    if len(pieces) == 1:
+        return pieces[0]
+    import contextlib
+
+    ctx = jax.default_device(dev) if dev is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        buf = jnp.zeros(shard_shape, dtype=jnp.uint32)
+    fold = _fold_chunk_fn()
+    for p, off in zip(pieces, offs):
+        buf = fold(buf, p, np.int32(off))
+    return buf
+
+
 def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
                         capacity: Optional[int] = None,
-                        with_host_keys: bool = False):
+                        with_host_keys: bool = False,
+                        stats_out: Optional[dict] = None):
     """Stack per-slice host bitmaps into a ShardedIndex.
 
     bitmaps[s] is the slice-s roaring Bitmap (or None for an absent
@@ -68,7 +120,23 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     consumers needing them must take this copy, NOT np.asarray the
     device keys, which fails on a multi-process mesh (non-addressable
     shards).
+
+    Staging is the cold-start hard part (SURVEY §7: the reference gets
+    O(1) open via mmap, fragment.go:211-229; a device needs explicit
+    H2D). Three levers here:
+      - words are packed PER ADDRESSABLE SHARD and device_put straight
+        to the owning device (no whole-pool transfer to device 0 and
+        re-distribution — on a multi-host mesh each process packs and
+        ships only its own slices);
+      - each shard moves as a pipeline of chunk-sized device_puts
+        (_stage_chunk_bytes), so packing overlaps the async transfer;
+      - nothing blocks on completion: the returned arrays are async
+        futures and the first query's compile proceeds while the
+        transfer streams. stats_out (if given) gets the host-side
+        dispatch seconds and byte counts for /debug/vars.
     """
+    import time as _time
+
     n_dev = mesh.shape[SLICE_AXIS] if mesh is not None else 1
     s = max(1, len(bitmaps))
     s_pad = -(-s // n_dev) * n_dev
@@ -86,26 +154,70 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     # runs, which needs 16 | cap. Cost: < 16 padded containers/slice.
     cap = -(-cap // ROW_SPAN) * ROW_SPAN
 
+    t0 = _time.monotonic()
+    # Keys (small, s_pad*cap*4 B) pack fully on every host; the sorted
+    # container order is kept for the words pack below.
     keys = np.full((s_pad, cap), INVALID_KEY, dtype=np.int32)
-    words = np.zeros((s_pad, cap, CONTAINER_WORDS), dtype=np.uint32)
+    orders: List[Optional[np.ndarray]] = [None] * s_pad
     for si, b in enumerate(bitmaps):
         if b is None or not len(b.keys):
             continue
         real = np.asarray(b.keys, dtype=np.uint64)
         dense = np.searchsorted(row_ids, real >> np.uint64(4))
-        k = (dense * ROW_SPAN + (real & np.uint64(15)).astype(np.int64)).astype(np.int32)
+        k = (dense * ROW_SPAN
+             + (real & np.uint64(15)).astype(np.int64)).astype(np.int32)
         order = np.argsort(k)
         keys[si, : len(k)] = k[order]
-        for j, ci in enumerate(order):
-            words[si, j] = b.containers[ci].words().view(np.uint32)
+        orders[si] = order
 
-    idx = ShardedIndex(keys=jnp.asarray(keys), words=jnp.asarray(words))
-    if mesh is not None:
+    def pack_range(lo: int, hi: int) -> np.ndarray:
+        buf = np.zeros((hi - lo, cap, CONTAINER_WORDS), dtype=np.uint32)
+        for si in range(lo, min(hi, len(bitmaps))):
+            order = orders[si]
+            if order is None:
+                continue
+            b = bitmaps[si]
+            row = buf[si - lo]
+            for j, ci in enumerate(order):
+                row[j] = b.containers[ci].words().view(np.uint32)
+        return buf
+
+    slice_bytes = cap * CONTAINER_WORDS * 4
+    chunk_slices = max(1, _stage_chunk_bytes() // max(1, slice_bytes))
+    h2d_bytes = 0
+
+    if mesh is None:
+        pieces = [jax.device_put(pack_range(lo, min(lo + chunk_slices,
+                                                    s_pad)))
+                  for lo in range(0, s_pad, chunk_slices)]
+        h2d_bytes = s_pad * slice_bytes
+        words_arr = _assemble_shard(
+            pieces, list(range(0, s_pad, chunk_slices)),
+            (s_pad, cap, CONTAINER_WORDS), None)
+        keys_arr = jnp.asarray(keys)
+    else:
         sharding = NamedSharding(mesh, P(SLICE_AXIS))
-        idx = ShardedIndex(
-            keys=jax.device_put(idx.keys, sharding),
-            words=jax.device_put(idx.words, sharding),
-        )
+        shape = (s_pad, cap, CONTAINER_WORDS)
+        imap = sharding.addressable_devices_indices_map(shape)
+        shards = []
+        for dev, idxs in imap.items():
+            lo = idxs[0].start or 0
+            hi = idxs[0].stop if idxs[0].stop is not None else s_pad
+            pieces = [jax.device_put(pack_range(c, min(c + chunk_slices,
+                                                       hi)), dev)
+                      for c in range(lo, hi, chunk_slices)]
+            h2d_bytes += (hi - lo) * slice_bytes
+            shards.append(_assemble_shard(
+                pieces, [c - lo for c in range(lo, hi, chunk_slices)],
+                (hi - lo, cap, CONTAINER_WORDS), dev))
+        words_arr = jax.make_array_from_single_device_arrays(
+            shape, sharding, shards)
+        keys_arr = jax.device_put(keys, sharding)
+    if stats_out is not None:
+        stats_out["h2d_dispatch_s"] = _time.monotonic() - t0
+        stats_out["h2d_bytes"] = h2d_bytes + keys.nbytes
+        stats_out["h2d_chunk_slices"] = chunk_slices
+    idx = ShardedIndex(keys=keys_arr, words=words_arr)
     if with_host_keys:
         return idx, row_ids, keys
     return idx, row_ids

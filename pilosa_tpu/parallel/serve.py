@@ -272,6 +272,7 @@ class MeshManager:
             "staged_bytes": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
             "fallback": 0, "stage_us": 0, "query_us": 0,
+            "h2d_bytes": 0, "h2d_dispatch_us": 0,
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
             "idx_cache_hit": 0, "idx_cache_miss": 0,
             "mask_cache_hit": 0, "mask_cache_miss": 0,
@@ -356,8 +357,12 @@ class MeshManager:
             self._purge_memo(old.sharded.words)
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
+        stage_io: dict = {}
         sharded, row_ids, keys_host = build_sharded_index(
-            bitmaps, self.mesh, with_host_keys=True)
+            bitmaps, self.mesh, with_host_keys=True, stats_out=stage_io)
+        self.stats["h2d_bytes"] += stage_io.get("h2d_bytes", 0)
+        self.stats["h2d_dispatch_us"] += int(
+            stage_io.get("h2d_dispatch_s", 0.0) * 1e6)
         sv = StagedView(
             sharded=sharded,
             row_ids=row_ids,
