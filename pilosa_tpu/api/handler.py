@@ -501,6 +501,9 @@ class Handler:
         mesh = getattr(self.executor, "device_stats", None)
         if mesh:
             snap = dict(snap, mesh=dict(mesh))
+        hc = getattr(self.executor, "host_cache_stats", None)
+        if hc:
+            snap = dict(snap, host_cache=dict(hc))
         return _json_resp(snap)
 
     def _get_cpu_profile(self, pv, params, headers, body) -> Response:

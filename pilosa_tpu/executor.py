@@ -137,6 +137,15 @@ class Executor:
         import threading
 
         self._mesh_mgr_lock = threading.Lock()
+        # Generation-validated caches for the cost-routed host count
+        # path (plan.HostQueryCache): repeated small queries serve at
+        # memo speed instead of re-extracting + re-folding.
+        from .parallel.plan import HostQueryCache
+
+        self._host_cache = HostQueryCache()
+        # _route_to_host threshold, resolved once (the env lookup is
+        # per-query overhead on the small-query path otherwise).
+        self._min_work_resolved: Optional[int] = None
 
     def set_spmd(self, spmd):
         """Wire the SPMD descriptor plane (rank 0 of a multi-host
@@ -380,7 +389,8 @@ class Executor:
                     plan_cell.append(CountPlan(self.holder, index, *lowered))
                 elif host_lowered is not None:
                     plan_cell.append(
-                        HostCountPlan(self.holder, index, *host_lowered))
+                        HostCountPlan(self.holder, index, *host_lowered,
+                                      cache=self._host_cache))
                 else:
                     plan_cell.append(None)
             return plan_cell[0]
@@ -433,6 +443,11 @@ class Executor:
         """Mesh serving-layer counters for /debug/vars, or None when no
         manager has been built (never forces construction)."""
         return self._mesh_mgr.stats if self._mesh_mgr is not None else None
+
+    @property
+    def host_cache_stats(self):
+        """Routed-host-path cache counters for /debug/vars."""
+        return self._host_cache.stats
 
     def _batch_num_slices(self, index: str, batch_slices) -> int:
         idx = self.holder.index(index)
@@ -495,6 +510,8 @@ class Executor:
         Routed queries count in /debug/vars mesh stats (routed_host)."""
         thr = self.device_min_work
         if thr is None:
+            thr = self._min_work_resolved
+        if thr is None:
             import os
 
             env = os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK", "")
@@ -505,6 +522,7 @@ class Executor:
                     thr = None
             if thr is None:
                 thr = self._DEFAULT_MIN_WORK
+            self._min_work_resolved = thr
         if thr <= 0 or num_slices * max(1, num_leaves) >= thr:
             return False
         mgr = self.mesh_manager()

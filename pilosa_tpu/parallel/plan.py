@@ -121,6 +121,92 @@ class CountPlan:
         return int(self._fn(leaf_args))
 
 
+class HostQueryCache:
+    """Generation-validated caches for the cost-routed host path
+    (VERDICT r3 #4): small-query workloads repeat, and the reference's
+    own answer to repeated counts is a cache (rank/row caches,
+    cache.go:126-275, fragment.go:404-408). Two layers, both validated
+    against the owning fragments' mutation generations — any write
+    bumps the generation, so a hit can never serve stale data (and
+    generations are monotonic, so an entry stored against a snapshot
+    that a concurrent write raced past can never validate later):
+
+      - leaf blocks: (fragment, row) -> dense (16*1024,) uint64 words.
+        Extraction is ~70% of a routed count's cost (measured 0.15 ms
+        of 0.24 ms for an 8-leaf slice); blocks are immutable by
+        convention (fold_tree never mutates operands).
+      - per-slice counts: (index, sig, rows, slice) -> int. A repeat
+        query re-reads only generations (~µs) instead of re-folding.
+
+    Memory: blocks are 128 KB each, LRU-bounded (256 ≈ 32 MB); count
+    entries are tuples. Thread-safe: one small lock, dict-sized ops,
+    never held across extraction or folding. Lock order: a fragment's
+    _mu may be held while taking this lock, never the reverse."""
+
+    _BLOCKS_MAX = 256
+    _MEMO_MAX = 4096
+
+    def __init__(self):
+        import threading
+        from collections import OrderedDict as _OD
+
+        self._mu = threading.Lock()
+        self._blocks: "_OD[tuple, tuple]" = _OD()
+        self._memo: "_OD[tuple, tuple]" = _OD()
+        self.stats = {"block_hit": 0, "block_miss": 0,
+                      "memo_hit": 0, "memo_miss": 0}
+
+    def block_get(self, frag, row_id: int, gen: int):
+        key = (id(frag), int(row_id))
+        with self._mu:
+            e = self._blocks.get(key)
+            # Identity check pins against id() recycling: entries hold
+            # a WEAK fragment ref (a deleted index's fragments — and
+            # their multi-MB parsed storage — must stay collectable),
+            # and a live weakref keeps the target's id stable.
+            if e is not None and e[0]() is frag and e[1] == gen:
+                self._blocks.move_to_end(key)
+                self.stats["block_hit"] += 1
+                return e[2]
+            self.stats["block_miss"] += 1
+            return None
+
+    def block_put(self, frag, row_id: int, gen: int, words) -> None:
+        import weakref
+
+        key = (id(frag), int(row_id))
+        with self._mu:
+            self._blocks[key] = (weakref.ref(frag), gen, words)
+            self._blocks.move_to_end(key)
+            while len(self._blocks) > self._BLOCKS_MAX:
+                self._blocks.popitem(last=False)
+
+    def memo_get(self, key: tuple, snapshot: tuple):
+        """`snapshot` holds LIVE (fragment_or_None, gen) pairs; stored
+        entries hold weak refs — a dead ref never validates."""
+        with self._mu:
+            e = self._memo.get(key)
+            if e is not None and len(e[0]) == len(snapshot) and all(
+                    (f0() if f0 is not None else None) is f1 and g0 == g1
+                    for (f0, g0), (f1, g1) in zip(e[0], snapshot)):
+                self._memo.move_to_end(key)
+                self.stats["memo_hit"] += 1
+                return e[1]
+            self.stats["memo_miss"] += 1
+            return None
+
+    def memo_put(self, key: tuple, snapshot: tuple, count: int) -> None:
+        import weakref
+
+        with self._mu:
+            self._memo[key] = (tuple(
+                (weakref.ref(f) if f is not None else None, g)
+                for f, g in snapshot), count)
+            self._memo.move_to_end(key)
+            while len(self._memo) > self._MEMO_MAX:
+                self._memo.popitem(last=False)
+
+
 class HostCountPlan:
     """Fused HOST Count over a lowered tree — what cost-routed small
     queries run (executor._route_to_host).
@@ -142,13 +228,23 @@ class HostCountPlan:
 
     _ZEROS = None  # shared all-zero block (read-only by convention)
 
-    def __init__(self, holder, index: str, shape, leaves: List[tuple]):
+    def __init__(self, holder, index: str, shape, leaves: List[tuple],
+                 cache: Optional[HostQueryCache] = None):
         self.holder = holder
         self.index = index
         self.leaves = leaves
         # Numbered depth-first once (CountPlan does the same); leaves
         # were collected in the same depth-first order.
         self._sig = _tree_signature(shape)
+        self.cache = cache
+        if cache is not None:
+            self._sig_json = json.dumps(self._sig)
+            self._leaves_key = tuple(
+                (f, v, int(r), bool(q)) for f, v, r, q in leaves)
+            # Unique (frame, view) pairs, order-stable: the generation
+            # snapshot covers each underlying fragment once.
+            self._uniq_views = list(dict.fromkeys(
+                (f, v) for f, v, _r, _q in leaves))
 
     @classmethod
     def _zeros(cls):
@@ -156,12 +252,31 @@ class HostCountPlan:
             cls._ZEROS = np.zeros(16 * 1024, dtype=np.uint64)
         return cls._ZEROS
 
+    def _gen_snapshot(self, slice_: int) -> tuple:
+        """(fragment_or_None, generation) per unique leaf view of this
+        slice — the validation token for the count memo."""
+        snap = []
+        for frame, view in self._uniq_views:
+            frag = self.holder.fragment(self.index, frame, view, slice_)
+            if frag is None:
+                snap.append((None, -1))
+            else:
+                with frag._mu:
+                    snap.append((frag, frag.generation))
+        return tuple(snap)
+
     def _leaf_words(self, frame, view, row_id, slice_):
         frag = self.holder.fragment(self.index, frame, view, slice_)
         if frag is None:
             return self._zeros()
+        cache = self.cache
         with frag._mu:
             frag.ensure_loaded()
+            if cache is not None:
+                gen = frag.generation
+                w = cache.block_get(frag, row_id, gen)
+                if w is not None:
+                    return w
             storage = frag.storage
             base = row_id * 16
             keys = storage.keys
@@ -176,19 +291,38 @@ class HostCountPlan:
                 sub = keys[i] - base
                 out[sub * 1024:(sub + 1) * 1024] = storage.containers[i].words()
                 i += 1
-            return out
+        if cache is not None:
+            cache.block_put(frag, row_id, gen, out)
+        return out
 
     def count_slice(self, slice_: int) -> Optional[int]:
         from ..ops import native
         from ..ops.bitops import fold_tree
 
+        cache = self.cache
+        key = snap = None
+        if cache is not None:
+            snap = self._gen_snapshot(slice_)
+            key = (self.index, self._sig_json, self._leaves_key, slice_)
+            n = cache.memo_get(key, snap)
+            if n is not None:
+                return n
+
         # fold_tree combines with &, |, & ~ — numpy blocks support all
         # three, so the host fold reuses the ONE shared combiner the
-        # XLA and Pallas paths use.
+        # XLA and Pallas paths use. It never mutates operands, so
+        # cached blocks are safe to feed directly.
         blocks = [self._leaf_words(frame, view, row_id, slice_)
                   for frame, view, row_id, _req in self.leaves]
         acc = fold_tree(self._sig, lambda i: blocks[i])
-        return native.popcnt_slice(acc)
+        n = native.popcnt_slice(acc)
+        if cache is not None:
+            # Generations are monotonic: if a write raced between the
+            # snapshot and the block reads, this entry's snapshot is
+            # already stale and can never validate — stale data cannot
+            # be served, only recomputed.
+            cache.memo_put(key, snap, n)
+        return n
 
 
 def _lower_tree(holder, index: str, c, leaves: List[tuple]):

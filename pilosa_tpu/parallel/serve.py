@@ -772,18 +772,51 @@ class MeshManager:
             except Exception:  # noqa: BLE001 — finisher handles errors
                 pass
 
+    @staticmethod
+    def _drain_window_s() -> float:
+        """Herd drain window (PILOSA_TPU_BATCH_WINDOW_MS env, default
+        3 ms): how long the batch loop waits for stragglers when the
+        PREVIOUS group showed concurrency. Priced against the ~67 ms
+        per-batch readback poll through the TPU relay: a 3 ms wait that
+        merges two half batches saves a whole poll."""
+        import os
+
+        try:
+            ms = float(os.environ.get("PILOSA_TPU_BATCH_WINDOW_MS", "3"))
+        except ValueError:
+            ms = 3.0
+        return max(0.0, ms) / 1e3
+
     def _batch_loop(self):
         """Drain-and-group: take everything queued while the device was
-        busy (no timed window — a lone request runs immediately), group
-        by compatible shape, execute each group as one program."""
+        busy, group by compatible shape, execute each group as one
+        program. A LONE request runs immediately (no timed window), but
+        when the previous drain coalesced multiple requests — a
+        concurrent-client herd mid-wake, whose members arrive spread
+        over a few GIL-staggered milliseconds — the loop waits a short
+        drain window for stragglers: each extra batch costs a full
+        readback poll (~67 ms through the relay), so fragmenting a herd
+        of 16 into 4x4 quadruples the fetch bill (r3 measured 43.7 QPS
+        at 16 clients against a demonstrated 574 QPS device rate for
+        exactly this reason)."""
+        last_group = 1
         while True:
             first = self._batch_q.get()
             reqs = [first]
+            deadline = (time.monotonic() + self._drain_window_s()
+                        if last_group > 1 else 0.0)
             while len(reqs) < self._MAX_BATCH:
                 try:
                     reqs.append(self._batch_q.get_nowait())
                 except queue.Empty:
-                    break
+                    wait = deadline - time.monotonic()
+                    if wait <= 0:
+                        break
+                    try:
+                        reqs.append(self._batch_q.get(timeout=wait))
+                    except queue.Empty:
+                        break
+            last_group = len(reqs)
             groups: Dict[tuple, List[_CountRequest]] = {}
             for r in reqs:
                 groups.setdefault(r.group_key(), []).append(r)
