@@ -675,7 +675,13 @@ def main():
         details["write_then_count"] = {
             "slices": wt_slices,
             "incremental_ms": inc_dt * 1e3, "restage_ms": restage_dt * 1e3,
-            "restage_over_incremental": restage_dt / inc_dt}
+            "restage_over_incremental": restage_dt / inc_dt,
+            # refresh() cost gate decisions (VERDICT r3 #7): on a
+            # backend where restage beats the scatter, the gate picks
+            # restage and "incremental_ms" above is the GATED cost.
+            "picks_incremental": mgrw.stats["refresh_pick_incremental"],
+            "picks_restage": mgrw.stats["refresh_pick_restage"],
+            "inc_ewma_us": mgrw.stats["inc_ewma_us"]}
 
     with section("serving_executor_qps"):
         # executor-level per-call rate (includes per-query relay readback)

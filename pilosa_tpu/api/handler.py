@@ -434,7 +434,12 @@ class Handler:
         r("GET", r"/version", self._get_version)
         r("GET", r"/debug/vars", self._get_expvar)
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
-        r("GET", r"/debug/pprof", self._get_pprof)
+        r("GET", r"/debug/pprof/heap", self._get_heap_profile)
+        r("GET", r"/debug/pprof/allocs", self._get_heap_profile)
+        r("GET", r"/debug/pprof/goroutine", self._get_thread_dump)
+        r("GET", r"/debug/pprof/threadcreate", self._get_threadcreate)
+        r("GET", r"/debug/pprof/cmdline", self._get_cmdline)
+        r("GET", r"/debug/pprof/?", self._get_pprof)
         r("POST", r"/internal/message", self._post_internal_message)
         r("GET", r"/internal/status", self._get_internal_status)
 
@@ -541,8 +546,26 @@ class Handler:
                         out.encode())
 
     def _get_pprof(self, pv, params, headers, body) -> Response:
-        """Thread stack dump — the analog of the reference's
-        /debug/pprof goroutine profile (handler.go:30,99)."""
+        """Profile index — the full pprof surface the reference mounts
+        at /debug/pprof/ (handler.go:30,99), with Python-runtime
+        analogs per profile. The thread dump is appended so a bare
+        GET /debug/pprof still answers 'what is every thread doing'."""
+        index = (
+            "pilosa-tpu /debug/pprof profiles:\n"
+            "  profile       sampling CPU profile, all threads "
+            "(?seconds=N, collapsed stacks)\n"
+            "  heap          tracemalloc top allocation sites + RSS "
+            "(?gc=1 collects first)\n"
+            "  allocs        alias of heap\n"
+            "  goroutine     per-thread stack dump\n"
+            "  threadcreate  live thread table\n"
+            "  cmdline       process command line\n\n")
+        dump = self._thread_dump_text()
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        (index + dump).encode())
+
+    @staticmethod
+    def _thread_dump_text() -> str:
         import sys
         import traceback
 
@@ -552,8 +575,67 @@ class Handler:
             out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
             out.extend(ln.rstrip()
                        for ln in traceback.format_stack(frame))
+        return "\n".join(out) + "\n"
+
+    def _get_thread_dump(self, pv, params, headers, body) -> Response:
+        """Per-thread stack dump — the goroutine-profile analog."""
         return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
-                        ("\n".join(out) + "\n").encode())
+                        self._thread_dump_text().encode())
+
+    def _get_threadcreate(self, pv, params, headers, body) -> Response:
+        """Live thread table (name, ident, daemon, alive)."""
+        rows = [f"{t.ident}\t{t.name}\tdaemon={t.daemon}\talive={t.is_alive()}"
+                for t in threading.enumerate()]
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        ("\n".join(rows) + "\n").encode())
+
+    def _get_cmdline(self, pv, params, headers, body) -> Response:
+        import sys
+
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        "\x00".join(sys.argv).encode())
+
+    def _get_heap_profile(self, pv, params, headers, body) -> Response:
+        """Heap profile — tracemalloc top allocation sites plus process
+        RSS/VM from /proc (the reference serves Go's runtime heap
+        profile here; tracemalloc is the Python runtime's equivalent).
+        tracemalloc has real per-allocation overhead, so it is NEVER
+        enabled implicitly: a bare GET reports process memory and how
+        to opt in; ?start=1 begins tracing, ?stop=1 reports and then
+        stops (Go's sampling profiler is always-on and cheap — Python's
+        is not, hence the explicit switch). ?gc=1 collects first,
+        mirroring Go's ?gc=1."""
+        import gc
+        import tracemalloc
+
+        if params.get("start") and not tracemalloc.is_tracing():
+            tracemalloc.start()
+        if params.get("gc"):
+            gc.collect()
+        out = []
+        try:
+            with open("/proc/self/status") as f:
+                for ln in f:
+                    if ln.startswith(("VmRSS", "VmHWM", "VmSize")):
+                        out.append("# " + ln.strip() + "\n")
+        except OSError:
+            pass
+        if tracemalloc.is_tracing():
+            current, peak = tracemalloc.get_traced_memory()
+            out.append(f"# tracemalloc current={current} peak={peak}\n\n")
+            snap = tracemalloc.take_snapshot()
+            for stat in snap.statistics("lineno")[:64]:
+                out.append(f"{stat.size}\t{stat.count}\t"
+                           f"{stat.traceback}\n")
+            if params.get("stop"):
+                tracemalloc.stop()
+                out.append("# tracemalloc stopped\n")
+        else:
+            out.append("# tracemalloc off — ?start=1 to begin tracing "
+                       "allocation sites, then re-request (?stop=1 to "
+                       "report and stop)\n")
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        "".join(out).encode())
 
     def _get_hosts(self, pv, params, headers, body) -> Response:
         nodes = self.cluster.nodes if self.cluster else []

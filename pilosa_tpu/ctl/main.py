@@ -80,13 +80,20 @@ def build_config(args) -> Config:
 def cmd_server(args) -> int:
     import logging
 
+    cfg = build_config(args)
+    if getattr(args, "dry_run", False):
+        # Hidden config seam (reference cmd/root.go:59-71): print the
+        # RESOLVED config (flags > env > TOML > defaults) and exit
+        # without executing — before the Server import, so the seam
+        # never pays (or needs) the jax/device stack.
+        sys.stdout.write(cfg.to_toml())
+        return 0
     from ..server import Server
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(message)s",
         filename=args.log_path or None)
-    cfg = build_config(args)
     srv = Server(cfg)
     srv.open()
     print(f"pilosa-tpu listening on http://{srv.host} "
@@ -383,6 +390,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "TPU backend is live; PILOSA_TPU_USE_DEVICE also "
                         "overrides auto)")
     p.add_argument("--log-path", default="")
+    # Hidden (no help): print resolved config and exit without
+    # executing — the reference's cmd/root.go:59-71 test seam.
+    p.add_argument("--dry-run", action="store_true",
+                   help=argparse.SUPPRESS)
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV bits")

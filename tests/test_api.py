@@ -403,3 +403,52 @@ class TestSpmdWorkerGuards:
             assert r.status == 400, (flag, r.status, r.body)
             assert "descriptor" in r.json()["error"], flag
             setattr(h, flag, False if flag == "spmd_worker" else None)
+
+
+class TestPprofSuite:
+    """Full /debug/pprof surface (reference handler.go:30,99 mounts the
+    whole net/http/pprof suite; VERDICT r3 #8)."""
+
+    def test_index_lists_profiles_and_dumps_threads(self, env):
+        _, h = env
+        r = h.handle("GET", "/debug/pprof", {}, b"")
+        assert r.status == 200
+        for name in ("heap", "goroutine", "threadcreate", "cmdline"):
+            assert name in r.body.decode()
+        assert "--- thread MainThread" in r.body.decode()
+        # trailing slash works too (reference mounts /debug/pprof/)
+        assert h.handle("GET", "/debug/pprof/", {}, b"").status == 200
+
+    def test_goroutine_dump(self, env):
+        _, h = env
+        r = h.handle("GET", "/debug/pprof/goroutine", {}, b"")
+        assert r.status == 200
+        assert "--- thread MainThread" in r.body.decode()
+
+    def test_heap_explicit_start_stop(self, env):
+        import tracemalloc
+
+        _, h = env
+        # a bare GET never enables tracing (overhead ratchet)
+        r1 = h.handle("GET", "/debug/pprof/heap", {}, b"")
+        assert r1.status == 200
+        assert "?start=1" in r1.body.decode()
+        assert not tracemalloc.is_tracing()
+        # explicit opt-in traces; ?stop=1 reports then stops
+        assert h.handle("GET", "/debug/pprof/heap",
+                        {"start": "1"}, b"").status == 200
+        assert tracemalloc.is_tracing()
+        blob = [bytearray(10000) for _ in range(10)]  # noqa: F841
+        r2 = h.handle("GET", "/debug/pprof/heap",
+                      {"gc": "1", "stop": "1"}, b"")
+        assert "current=" in r2.body.decode()
+        assert not tracemalloc.is_tracing()
+        # allocs is an alias
+        assert h.handle("GET", "/debug/pprof/allocs", {}, b"").status == 200
+
+    def test_threadcreate_and_cmdline(self, env):
+        _, h = env
+        r = h.handle("GET", "/debug/pprof/threadcreate", {}, b"")
+        assert "MainThread" in r.body.decode()
+        r = h.handle("GET", "/debug/pprof/cmdline", {}, b"")
+        assert r.status == 200 and r.body

@@ -302,3 +302,29 @@ def test_server_kill9_durability(tmp_path):
         assert sorted(cols) == [3, 9, 1_048_580]
     finally:
         holder.close()
+
+
+class TestServerDryRun:
+    """Hidden --dry-run seam (reference cmd/root.go:59-71): resolved
+    config prints without executing."""
+
+    def test_dry_run_precedence(self, tmp_path, capsys, monkeypatch):
+        from pilosa_tpu.ctl.main import main
+
+        cfg = tmp_path / "c.toml"
+        cfg.write_text('data-dir = "/from/toml"\nhost = "toml:1"\n')
+        # env beats TOML; flag beats env
+        monkeypatch.setenv("PILOSA_TPU_HOST", "env:2")
+        rc = main(["server", "-c", str(cfg), "-b", "flag:3", "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert 'host = "flag:3"' in out
+        assert '/from/toml' in out
+
+    def test_dry_run_env_only(self, capsys, monkeypatch):
+        from pilosa_tpu.ctl.main import main
+
+        monkeypatch.setenv("PILOSA_TPU_DATA_DIR", "/env/dir")
+        rc = main(["server", "--dry-run"])
+        assert rc == 0
+        assert '/env/dir' in capsys.readouterr().out

@@ -1428,3 +1428,54 @@ class TestAdaptiveSharedBatching:
         mgr._run_count_group(group)
         assert mgr.stats["shared_batch"] == 0
         assert not mgr._shared_fns
+
+
+class TestRefreshCostGate:
+    """refresh() picks incremental-vs-restage from MEASURED costs
+    (VERDICT r3 #7), not a hard-wired policy."""
+
+    def _mgr(self, tmp_path, slices=2):
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.parallel.serve import MeshManager
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        f = h.create_index_if_not_exists("i").create_frame_if_not_exists("g")
+        for s in range(slices):
+            f.set_bit(1, s * (1 << 20) + 3)
+        return h, MeshManager(h)
+
+    def test_restage_picked_when_cheaper(self, tmp_path):
+        h, mgr = self._mgr(tmp_path)
+        f = h.frame("i", "g")
+        sv = mgr.refresh("i", "g", "standard", 2)
+        assert sv is not None
+        # force the gate: staging (just measured) is declared cheaper
+        # than the incremental EWMA
+        mgr._inc_ewma_s = (sv.last_stage_s or 0.0) + 10.0
+        f.set_bit(1, 7)
+        before = mgr.stats["stage"]
+        mgr.refresh("i", "g", "standard", 2)
+        assert mgr.stats["stage"] == before + 1
+        assert mgr.stats["refresh_pick_restage"] == 1
+
+    def test_incremental_picked_when_cheaper(self, tmp_path):
+        h, mgr = self._mgr(tmp_path)
+        f = h.frame("i", "g")
+        sv = mgr.refresh("i", "g", "standard", 2)
+        sv.last_stage_s = 10.0  # staging declared expensive
+        mgr._inc_ewma_s = 0.001
+        f.set_bit(1, 7)
+        before = mgr.stats["incremental"]
+        mgr.refresh("i", "g", "standard", 2)
+        assert mgr.stats["incremental"] == before + 1
+        assert mgr.stats["refresh_pick_incremental"] == 1
+        # the gated refresh still yields correct counts
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.pql import parse_string
+
+        tree = parse_string("Count(Bitmap(frame=g, rowID=1))").calls[0] \
+            .children[0]
+        leaves = []
+        shape = _lower_tree(h, "i", tree, leaves)
+        assert mgr.count("i", shape, leaves, [0, 1], 2) == 3
