@@ -608,9 +608,19 @@ class Handler:
         import gc
         import tracemalloc
 
-        if params.get("start") and not tracemalloc.is_tracing():
+        def flag(name: str) -> bool:
+            # "?start=0" must mean OFF: query params arrive as strings,
+            # and a bare truthiness test would read "0" as on.
+            return params.get(name, "").lower() not in ("", "0", "false",
+                                                        "no")
+
+        if flag("start") and not tracemalloc.is_tracing():
             tracemalloc.start()
-        if params.get("gc"):
+            # Only a trace WE started may be stopped by ?stop=1 — an
+            # interpreter-level PYTHONTRACEMALLOC trace belongs to the
+            # operator, not this endpoint.
+            self._tracemalloc_ours = True
+        if flag("gc"):
             gc.collect()
         out = []
         try:
@@ -627,8 +637,9 @@ class Handler:
             for stat in snap.statistics("lineno")[:64]:
                 out.append(f"{stat.size}\t{stat.count}\t"
                            f"{stat.traceback}\n")
-            if params.get("stop"):
+            if flag("stop") and getattr(self, "_tracemalloc_ours", False):
                 tracemalloc.stop()
+                self._tracemalloc_ours = False
                 out.append("# tracemalloc stopped\n")
         else:
             out.append("# tracemalloc off — ?start=1 to begin tracing "

@@ -385,8 +385,25 @@ class MeshManager:
         self._views[key] = sv
         self._evict_over_budget()
         self.stats["stage"] += 1
-        sv.last_stage_s = time.monotonic() - t0
-        self.stats["stage_us"] += int(sv.last_stage_s * 1e6)
+        dispatch_s = time.monotonic() - t0
+        self.stats["stage_us"] += int(dispatch_s * 1e6)
+        # Cost-gate measurement must include DEVICE completion (the
+        # async H2D), not just host dispatch — but blocking here would
+        # serialize the cold-start pipeline (transfer overlapping the
+        # first compile). Measure to completion on a side thread: the
+        # gate reads the true cost with a small lag.
+        sv.last_stage_s = None
+        words = sv.sharded.words
+
+        def _measure(sv=sv, words=words, t0=t0):
+            try:
+                words.block_until_ready()
+            except Exception:  # noqa: BLE001 — failure surfaces at query
+                return
+            sv.last_stage_s = time.monotonic() - t0
+
+        threading.Thread(target=_measure, name="stage-cost-measure",
+                         daemon=True).start()
         return sv
 
     def refresh(self, index: str, frame: str, view: str,
@@ -446,6 +463,12 @@ class MeshManager:
             if (inc_est is not None and sv.last_stage_s is not None
                     and sv.last_stage_s < inc_est):
                 self.stats["refresh_pick_restage"] += 1
+                # Decay the incremental estimate on every restage pick:
+                # one anomalous slow scatter sample must not freeze the
+                # gate on restage forever — the decayed EWMA eventually
+                # re-admits an incremental, which re-measures reality.
+                self._inc_ewma_s = inc_est * 0.9
+                self.stats["inc_ewma_us"] = int(self._inc_ewma_s * 1e6)
                 return self._stage(key, num_slices)
             t_inc = time.monotonic()
             per_slice = {}
@@ -475,10 +498,27 @@ class MeshManager:
             self.stats["incremental"] += 1
             self.stats["refresh_pick_incremental"] += 1
             if not fresh_compile:
-                dt = time.monotonic() - t_inc
-                self._inc_ewma_s = (dt if self._inc_ewma_s is None
-                                    else 0.5 * (dt + self._inc_ewma_s))
-                self.stats["inc_ewma_us"] = int(self._inc_ewma_s * 1e6)
+                # Like staging, measure to DEVICE completion on a side
+                # thread — host dispatch alone is a near-constant floor
+                # that says nothing about the scatter's real cost.
+                inc_words = sv.sharded.words
+
+                def _measure_inc(words=inc_words, t0=t_inc):
+                    try:
+                        words.block_until_ready()
+                    except Exception:  # noqa: BLE001
+                        return
+                    dt = time.monotonic() - t0
+                    with self._mu:
+                        self._inc_ewma_s = (
+                            dt if self._inc_ewma_s is None
+                            else 0.5 * (dt + self._inc_ewma_s))
+                        self.stats["inc_ewma_us"] = \
+                            int(self._inc_ewma_s * 1e6)
+
+                threading.Thread(target=_measure_inc,
+                                 name="inc-cost-measure",
+                                 daemon=True).start()
             return sv
 
     def invalidate(self, index: Optional[str] = None):

@@ -1450,19 +1450,38 @@ class TestRefreshCostGate:
         f = h.frame("i", "g")
         sv = mgr.refresh("i", "g", "standard", 2)
         assert sv is not None
-        # force the gate: staging (just measured) is declared cheaper
-        # than the incremental EWMA
-        mgr._inc_ewma_s = (sv.last_stage_s or 0.0) + 10.0
+        import time as _t
+
+        sv.sharded.words.block_until_ready()
+        for _ in range(100):
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
+        # force the gate deterministically (the real measurements land
+        # asynchronously): staging declared cheap, incremental dear
+        sv.last_stage_s = 1e-4
+        ewma0 = mgr._inc_ewma_s = 10.0
         f.set_bit(1, 7)
         before = mgr.stats["stage"]
         mgr.refresh("i", "g", "standard", 2)
         assert mgr.stats["stage"] == before + 1
         assert mgr.stats["refresh_pick_restage"] == 1
+        # the estimate decays on a restage pick, so the gate re-explores
+        assert mgr._inc_ewma_s < ewma0
 
     def test_incremental_picked_when_cheaper(self, tmp_path):
+        import time as _t
+
         h, mgr = self._mgr(tmp_path)
         f = h.frame("i", "g")
         sv = mgr.refresh("i", "g", "standard", 2)
+        # let the async stage-cost measurement land before overriding,
+        # so it cannot race our forced value
+        sv.sharded.words.block_until_ready()
+        for _ in range(100):
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
         sv.last_stage_s = 10.0  # staging declared expensive
         mgr._inc_ewma_s = 0.001
         f.set_bit(1, 7)
