@@ -285,6 +285,23 @@ def main():
 
     import jax
 
+    # Persistent XLA compilation cache — installed BEFORE the first
+    # compile (the backend confirmation below) so even that program is
+    # served from / written to the cache. A first compile through the
+    # relay costs 20-40 s per program shape; cached executables survive
+    # across bench runs and processes. PILOSA_TPU_COMPILE_CACHE=off
+    # disables; best-effort (some backends compile remotely).
+    if os.environ.get("PILOSA_TPU_COMPILE_CACHE", "on") != "off":
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:  # noqa: BLE001 — older jax: no such config
+            pass
+
     try:
         on_tpu = jax.default_backend() == "tpu"
         if on_tpu:
@@ -670,8 +687,34 @@ def main():
             return total / n
 
         timed_write_count(False, 1)  # warm the scatter-apply compile
-        inc_dt = timed_write_count(False, 5 if on_tpu else 2)
+        # Forced restages FIRST: they give the cost gate a WARM stage
+        # sample (the cold first stage includes fragment parsing and is
+        # not what a steady-state restage costs), so the gated loop
+        # below picks from realistic data on both backends.
         restage_dt = timed_write_count(True, 2 if on_tpu else 1)
+        # let the warm-stage cost measurement land before the gated loop
+        svw = mgrw._views.get(("i", "general", "standard"))
+        if svw is not None:
+            svw.sharded.words.block_until_ready()
+            for _ in range(100):
+                if svw.last_stage_s is not None:
+                    break
+                time.sleep(0.02)
+        # absorb the restage->incremental transition one-off (the first
+        # scatter on a freshly assembled pool re-specializes; measured
+        # ~160 ms once, ~7 ms steady on CPU — r3's "incremental 4x
+        # worse than restage" CPU anomaly was this one-off averaged
+        # over two samples)
+        timed_write_count(False, 1)
+        inc_dt = timed_write_count(False, 5 if on_tpu else 3)
+        # Cost measurements land asynchronously (the measurement worker
+        # blocks on device completion); settle before reading so the
+        # recorded gate state isn't one sample stale.
+        for _ in range(100):
+            if (mgrw.stats["inc_ewma_us"] > 0
+                    and mgrw._measure_q.unfinished_tasks == 0):
+                break
+            time.sleep(0.02)
         details["write_then_count"] = {
             "slices": wt_slices,
             "incremental_ms": inc_dt * 1e3, "restage_ms": restage_dt * 1e3,
@@ -681,6 +724,7 @@ def main():
             # restage and "incremental_ms" above is the GATED cost.
             "picks_incremental": mgrw.stats["refresh_pick_incremental"],
             "picks_restage": mgrw.stats["refresh_pick_restage"],
+            "probe_restage": mgrw.stats["refresh_probe_restage"],
             "inc_ewma_us": mgrw.stats["inc_ewma_us"]}
 
     with section("serving_executor_qps"):
@@ -797,7 +841,8 @@ def main():
         assert first == host_c
         details["count_bitmap"] = {
             "qps": 1.0 / dt, "mean_ms": dt * 1e3,
-            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
+            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt,
+            "host_baseline": "cxx-popcnt, 1 thread, 3 reps"}
 
     with section("nary_8rows"):
         # -- config 2: Union / Intersect / Difference over 8 rows, 1 slice -------
@@ -884,7 +929,8 @@ def main():
         details["topn_n100"] = {
             "mean_ms": dt * 1e3, "rows": topn_rows, "slices": topn_slices,
             "host_cpu_ms": host_dt * 1e3, "vs_host": host_dt / dt,
-            "repeat_memo_ms": memo_dt * 1e3}
+            "repeat_memo_ms": memo_dt * 1e3,
+            "host_baseline": "host executor TopN (rank cache), 3 reps"}
 
     with section("range_4views"):
         # -- config 4: Range() time-quantum views (OR over 4 view rows) ----------
@@ -919,7 +965,8 @@ def main():
             "device_qps": 1.0 / dt, "device_mean_ms": dt * 1e3,
             "host_cpu_qps": 1.0 / host_dt, "device_vs_host": host_dt / dt,
             "routed_mean_ms": routed_dt * 1e3,
-            "routed_vs_host": host_dt / routed_dt}
+            "routed_vs_host": host_dt / routed_dt,
+            "host_baseline": "cxx-nary-fold, 1 thread, 3 reps"}
 
     with section("sparse_intersect"):
         # -- extra: sparse array-container intersect (padded-pool worst case) ----
@@ -954,7 +1001,8 @@ def main():
         details["sparse_intersect"] = {
             "qps": 1.0 / dt, "mean_ms": dt * 1e3, "density": 0.03,
             "slices": sparse_slices,
-            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt}
+            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt,
+            "host_baseline": "cxx-sorted-array-intersect, 1 thread, 3 reps"}
 
     with section("materialize_intersect"):
         # -- extra: the bitmap-MATERIALIZING path (VERDICT r2 item 7) ------------
@@ -1019,7 +1067,8 @@ def main():
                 "cols": big_slices << 20, "slices": big_slices,
                 "stage_s": stage_b, "staged_bytes": bytes_b,
                 "qps": 1.0 / dt, "mean_ms": dt * 1e3,
-                "host_cpu_qps": 1.0 / host_dtb, "vs_host": host_dtb / dt}
+                "host_cpu_qps": 1.0 / host_dtb, "vs_host": host_dtb / dt,
+                "host_baseline": "cxx-popcnt, 1 thread, 2 reps"}
 
     with section("throughput_run2"):
         # Re-measure the headline throughput at the END of the run: the

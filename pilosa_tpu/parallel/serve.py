@@ -62,7 +62,8 @@ class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
-                 "num_slices", "idx_cache", "last_used", "last_stage_s")
+                 "num_slices", "idx_cache", "last_used", "last_stage_s",
+                 "inc_spend_s")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -86,8 +87,11 @@ class StagedView:
         # fits degrades to over-budget rather than restage-thrashing.
         self.last_used = 0
         # Wall seconds the last _stage of this view took — one side of
-        # refresh()'s measured incremental-vs-restage cost gate.
+        # refresh()'s measured incremental-vs-restage cost gate — and
+        # the incremental seconds spent on this view since that stage
+        # (drives the periodic restage probe).
         self.last_stage_s: Optional[float] = None
+        self.inc_spend_s = 0.0
 
     @property
     def padded_slices(self) -> int:
@@ -233,6 +237,13 @@ class MeshManager:
         # a jit compile and are excluded from the EWMA).
         self._inc_ewma_s: Optional[float] = None
         self._apply_shapes: set = set()
+        # One long-lived worker measures device-completion costs (a
+        # thread per refresh would churn on write-heavy paths, and
+        # blocked threads would each pin a device image during a relay
+        # stall). Bounded: a full queue drops the sample, never blocks
+        # the serving path.
+        self._measure_q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._measure_thread: Optional[threading.Thread] = None
         self._mask_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
         # Dispatched-but-unfetched batches (see _fetch_loop); maxsize is
@@ -283,7 +294,7 @@ class MeshManager:
             "fallback": 0, "stage_us": 0, "query_us": 0,
             "h2d_bytes": 0, "h2d_dispatch_us": 0,
             "refresh_pick_incremental": 0, "refresh_pick_restage": 0,
-            "inc_ewma_us": 0,
+            "refresh_probe_restage": 0, "inc_ewma_us": 0,
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
             "idx_cache_hit": 0, "idx_cache_miss": 0,
             "mask_cache_hit": 0, "mask_cache_miss": 0,
@@ -390,21 +401,61 @@ class MeshManager:
         # Cost-gate measurement must include DEVICE completion (the
         # async H2D), not just host dispatch — but blocking here would
         # serialize the cold-start pipeline (transfer overlapping the
-        # first compile). Measure to completion on a side thread: the
-        # gate reads the true cost with a small lag.
+        # first compile). The measurement worker records the true cost
+        # with a small lag.
         sv.last_stage_s = None
-        words = sv.sharded.words
 
-        def _measure(sv=sv, words=words, t0=t0):
-            try:
-                words.block_until_ready()
-            except Exception:  # noqa: BLE001 — failure surfaces at query
-                return
-            sv.last_stage_s = time.monotonic() - t0
+        def on_done(elapsed, sv=sv):
+            sv.last_stage_s = elapsed
 
-        threading.Thread(target=_measure, name="stage-cost-measure",
-                         daemon=True).start()
+        self._measure_async(sv.sharded.words, t0, on_done)
         return sv
+
+    def _measure_async(self, words, t0: float, on_done) -> None:
+        """Enqueue a device-completion cost measurement: the worker
+        blocks until `words` is ready and calls on_done(elapsed). A
+        full queue drops the sample (bounded lag under a relay stall;
+        at most maxsize device images are pinned by pending items)."""
+        if self._measure_thread is None:
+            with self._mu:
+                if self._measure_thread is None:
+                    t = threading.Thread(target=self._measure_loop,
+                                         name="mesh-cost-measure",
+                                         daemon=True)
+                    t.start()
+                    self._measure_thread = t
+        try:
+            self._measure_q.put_nowait((words, t0, on_done))
+        except queue.Full:
+            # Never leave the sample unrecorded — a view whose
+            # last_stage_s stays None would disable its cost gate AND
+            # the probe forever. Dispatch-so-far is a lower bound; the
+            # next measurement that fits the queue refines it.
+            try:
+                on_done(time.monotonic() - t0)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _measure_loop(self):
+        while True:
+            words, t0, on_done = self._measure_q.get()
+            try:
+                try:
+                    words.block_until_ready()
+                    elapsed = time.monotonic() - t0
+                except Exception:  # noqa: BLE001 — surfaces at query
+                    continue
+                finally:
+                    del words
+                try:
+                    on_done(elapsed)
+                except Exception:  # noqa: BLE001 — never kill the worker
+                    pass
+            finally:
+                # task_done bookkeeping lets callers wait for SETTLED
+                # measurements (unfinished_tasks == 0), not merely an
+                # empty queue with the worker still mid-item.
+                self._measure_q.task_done()
 
     def refresh(self, index: str, frame: str, view: str,
                 num_slices: int) -> Optional[StagedView]:
@@ -460,15 +511,30 @@ class MeshManager:
             # First incremental runs unmeasured (no EWMA yet) and seeds
             # the estimate; decisions surface in /debug/vars.
             inc_est = self._inc_ewma_s
-            if (inc_est is not None and sv.last_stage_s is not None
-                    and sv.last_stage_s < inc_est):
+            # Periodic restage PROBE — the symmetric re-exploration: a
+            # stale stage-cost sample (e.g. a slow COLD first stage)
+            # would otherwise freeze the gate on incremental forever,
+            # since restaging is the only event that re-measures stage
+            # cost. Probing when cumulative incremental spend reaches
+            # 20x the stage estimate bounds probe overhead at ~5% while
+            # re-calibrating quickly when restage is genuinely cheap.
+            probe = (sv.last_stage_s is not None
+                     and sv.inc_spend_s > 20.0 * sv.last_stage_s)
+            if probe or (inc_est is not None and sv.last_stage_s is not None
+                         and sv.last_stage_s < inc_est):
                 self.stats["refresh_pick_restage"] += 1
-                # Decay the incremental estimate on every restage pick:
-                # one anomalous slow scatter sample must not freeze the
-                # gate on restage forever — the decayed EWMA eventually
-                # re-admits an incremental, which re-measures reality.
-                self._inc_ewma_s = inc_est * 0.9
-                self.stats["inc_ewma_us"] = int(self._inc_ewma_s * 1e6)
+                if probe:
+                    self.stats["refresh_probe_restage"] += 1
+                elif inc_est is not None:
+                    # Decay the incremental estimate on a GATE-chosen
+                    # restage: one anomalous slow scatter sample must
+                    # not freeze the gate on restage forever — the
+                    # decayed EWMA eventually re-admits an incremental,
+                    # which re-measures reality. (A PROBE carries no
+                    # evidence against incremental, so it must not
+                    # bias the estimate.)
+                    self._inc_ewma_s = inc_est * 0.9
+                    self.stats["inc_ewma_us"] = int(self._inc_ewma_s * 1e6)
                 return self._stage(key, num_slices)
             t_inc = time.monotonic()
             per_slice = {}
@@ -498,27 +564,20 @@ class MeshManager:
             self.stats["incremental"] += 1
             self.stats["refresh_pick_incremental"] += 1
             if not fresh_compile:
-                # Like staging, measure to DEVICE completion on a side
-                # thread — host dispatch alone is a near-constant floor
-                # that says nothing about the scatter's real cost.
-                inc_words = sv.sharded.words
-
-                def _measure_inc(words=inc_words, t0=t_inc):
-                    try:
-                        words.block_until_ready()
-                    except Exception:  # noqa: BLE001
-                        return
-                    dt = time.monotonic() - t0
+                # Like staging, measure to DEVICE completion on the
+                # measurement worker — host dispatch alone is a
+                # near-constant floor that says nothing about the
+                # scatter's real cost.
+                def on_inc(dt, sv=sv):
                     with self._mu:
                         self._inc_ewma_s = (
                             dt if self._inc_ewma_s is None
                             else 0.5 * (dt + self._inc_ewma_s))
                         self.stats["inc_ewma_us"] = \
                             int(self._inc_ewma_s * 1e6)
+                        sv.inc_spend_s += dt
 
-                threading.Thread(target=_measure_inc,
-                                 name="inc-cost-measure",
-                                 daemon=True).start()
+                self._measure_async(sv.sharded.words, t_inc, on_inc)
             return sv
 
     def invalidate(self, index: Optional[str] = None):

@@ -1498,3 +1498,31 @@ class TestRefreshCostGate:
         leaves = []
         shape = _lower_tree(h, "i", tree, leaves)
         assert mgr.count("i", shape, leaves, [0, 1], 2) == 3
+
+    def test_probe_restage_reexplores_stale_stage_cost(self, tmp_path):
+        """A slow cold first stage must not freeze the gate on
+        incremental forever: once cumulative incremental spend passes
+        20x the stage estimate, the gate probes a restage, which
+        re-measures stage cost."""
+        import time as _t
+
+        h, mgr = self._mgr(tmp_path)
+        f = h.frame("i", "g")
+        sv = mgr.refresh("i", "g", "standard", 2)
+        sv.sharded.words.block_until_ready()
+        for _ in range(100):  # let the async measurement land first
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
+        # stale, expensive-looking stage sample + cheap incremental
+        sv.last_stage_s = 0.001
+        sv.inc_spend_s = 0.5  # > 20 * 0.001
+        mgr._inc_ewma_s = 1e-6  # plain gate would pick incremental
+        f.set_bit(1, 7)
+        stages0 = mgr.stats["stage"]
+        mgr.refresh("i", "g", "standard", 2)
+        assert mgr.stats["stage"] == stages0 + 1
+        assert mgr.stats["refresh_probe_restage"] == 1
+        # the probe re-measured: the NEW view starts with zero spend
+        sv2 = mgr._views[("i", "g", "standard")]
+        assert sv2.inc_spend_s == 0.0
