@@ -329,7 +329,17 @@ def main():
     # budget watchdog emits the checkpointed TPU headline instead of
     # losing the run. Partial per-config results flush to the details
     # file as each section completes.
-    checkpoint: dict = {"result": None}
+    checkpoint: dict = {"result": None, "emitted": False}
+    emit_mu = threading.Lock()
+
+    def emit_once() -> bool:
+        """True exactly once — whoever wins prints the ONE JSON line."""
+        with emit_mu:
+            if checkpoint["emitted"]:
+                return False
+            checkpoint["emitted"] = True
+            return True
+
     budget = float(os.environ.get("PILOSA_TPU_RUN_BUDGET", "2400"))
 
     def budget_watchdog():
@@ -339,6 +349,8 @@ def main():
                 break
             time.sleep(min(left, 30))
         if checkpoint["result"] is not None:
+            if not emit_once():
+                return  # normal completion already printed the line
             _progress(f"run budget {budget:.0f}s exhausted; emitting the "
                       "checkpointed headline")
             print(json.dumps(checkpoint["result"]), flush=True)
@@ -1084,7 +1096,10 @@ def main():
             set_headline()
 
     flush_details()
-    print(json.dumps(checkpoint["result"]))
+    # ONE JSON line on stdout: the emit gate makes normal completion
+    # and a budget watchdog firing at this boundary mutually exclusive.
+    if emit_once():
+        print(json.dumps(checkpoint["result"]))
 
 
 def _cpu_reexec_env():

@@ -406,9 +406,20 @@ class Executor:
         def reduce_fn(prev, v):
             return (prev or 0) + v
 
+        if host_lowered is not None:
+            # Cost-routed host queries serve whole slice batches inline
+            # (plan.count_slices): the per-slice thread fan-out costs
+            # more than the memo-backed folds it would parallelize.
+            def host_batch_fn(batch_slices):
+                plan = slice_plan()
+                return plan.count_slices(batch_slices) if plan else None
+
+            batch_fn = host_batch_fn
+        else:
+            batch_fn = self._mesh_count_batch(index, lowered)
+
         result = self._map_reduce(
-            index, slices, c, opt, map_fn, reduce_fn,
-            batch_fn=self._mesh_count_batch(index, lowered))
+            index, slices, c, opt, map_fn, reduce_fn, batch_fn=batch_fn)
         return int(result or 0)
 
     def mesh_manager(self):

@@ -324,6 +324,22 @@ class HostCountPlan:
             cache.memo_put(key, snap, n)
         return n
 
+    def count_slices(self, slices) -> Optional[int]:
+        """Whole-batch host count: per-slice counts summed INLINE.
+        Serves as the executor's batch_fn for cost-routed queries — a
+        thread-pool fan-out per slice costs more than the fold itself
+        once the memo layer answers most slices in microseconds. A
+        declining slice (count_slice -> None, per its contract) makes
+        the whole batch decline: the executor then falls back to the
+        per-slice map_fn, which handles None slice-by-slice."""
+        total = 0
+        for s in slices:
+            n = self.count_slice(s)
+            if n is None:
+                return None
+            total += n
+        return total
+
 
 def _lower_tree(holder, index: str, c, leaves: List[tuple]):
     """Call → nested shape list, collecting leaves; None if not lowerable."""
