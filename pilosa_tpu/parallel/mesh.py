@@ -198,20 +198,34 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     else:
         sharding = NamedSharding(mesh, P(SLICE_AXIS))
         shape = (s_pad, cap, CONTAINER_WORDS)
-        imap = sharding.addressable_devices_indices_map(shape)
-        shards = []
-        for dev, idxs in imap.items():
-            lo = idxs[0].start or 0
-            hi = idxs[0].stop if idxs[0].stop is not None else s_pad
-            pieces = [jax.device_put(pack_range(c, min(c + chunk_slices,
-                                                       hi)), dev)
-                      for c in range(lo, hi, chunk_slices)]
-            h2d_bytes += (hi - lo) * slice_bytes
-            shards.append(_assemble_shard(
-                pieces, [c - lo for c in range(lo, hi, chunk_slices)],
-                (hi - lo, cap, CONTAINER_WORDS), dev))
-        words_arr = jax.make_array_from_single_device_arrays(
-            shape, sharding, shards)
+        try:
+            imap = sharding.addressable_devices_indices_map(shape)
+            shards = []
+            for dev, idxs in imap.items():
+                lo = idxs[0].start or 0
+                hi = idxs[0].stop if idxs[0].stop is not None else s_pad
+                pieces = [jax.device_put(
+                    pack_range(c, min(c + chunk_slices, hi)), dev)
+                    for c in range(lo, hi, chunk_slices)]
+                h2d_bytes += (hi - lo) * slice_bytes
+                shards.append(_assemble_shard(
+                    pieces, [c - lo for c in range(lo, hi, chunk_slices)],
+                    (hi - lo, cap, CONTAINER_WORDS), dev))
+            words_arr = jax.make_array_from_single_device_arrays(
+                shape, sharding, shards)
+        except Exception:  # noqa: BLE001 — backend without per-device
+            # placement support (untested relay backends): fall back to
+            # the whole-pool transfer + redistribution path (one host
+            # pack of the full pool — device_put with a global sharding
+            # needs the whole array per process anyway). Slower, and
+            # host-RAM-bound at extreme pool sizes, but always works.
+            # Drop the partial attempt's device buffers FIRST: keeping
+            # them across the second full transfer would stack partial
+            # + whole pool in HBM.
+            shards = pieces = None  # noqa: F841 — release device refs
+            words_arr = jax.device_put(pack_range(0, s_pad), sharding)
+            # += : chunks shipped before the failure were real traffic.
+            h2d_bytes += s_pad * slice_bytes
         keys_arr = jax.device_put(keys, sharding)
     if stats_out is not None:
         stats_out["h2d_dispatch_s"] = _time.monotonic() - t0

@@ -429,3 +429,34 @@ def test_spmd_import_chunking_single_process(tmp_path):
     frag = h.fragment("i", "f", "standard", 0)
     assert frag is not None and frag.storage.count() == n
     h.close()
+
+
+def test_build_sharded_index_fallback_placement(monkeypatch):
+    """If per-device placement is unsupported (untested relay
+    backends), staging falls back to whole-pool device_put with the
+    same result."""
+    import jax
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.parallel import build_sharded_index, default_mesh
+    from pilosa_tpu.roaring import Bitmap
+
+    bitmaps = []
+    for s in range(8):
+        b = Bitmap()
+        b.add(0 * SLICE_WIDTH + s)
+        b.add(1 * SLICE_WIDTH + 2 * s)
+        bitmaps.append(b)
+    mesh = default_mesh(8)
+    want, want_rows = build_sharded_index(bitmaps, mesh)
+
+    def boom(*a, **k):
+        raise RuntimeError("no per-device placement on this backend")
+
+    monkeypatch.setattr(jax, "make_array_from_single_device_arrays", boom)
+    got, got_rows = build_sharded_index(bitmaps, mesh)
+    assert np.array_equal(np.asarray(want.keys), np.asarray(got.keys))
+    assert np.array_equal(np.asarray(want.words), np.asarray(got.words))
+    assert np.array_equal(want_rows, got_rows)
+    assert got.words.sharding == want.words.sharding
