@@ -213,15 +213,25 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
                     (hi - lo, cap, CONTAINER_WORDS), dev))
             words_arr = jax.make_array_from_single_device_arrays(
                 shape, sharding, shards)
-        except Exception:  # noqa: BLE001 — backend without per-device
-            # placement support (untested relay backends): fall back to
-            # the whole-pool transfer + redistribution path (one host
-            # pack of the full pool — device_put with a global sharding
-            # needs the whole array per process anyway). Slower, and
-            # host-RAM-bound at extreme pool sizes, but always works.
-            # Drop the partial attempt's device buffers FIRST: keeping
-            # them across the second full transfer would stack partial
-            # + whole pool in HBM.
+        except Exception as fb_err:  # noqa: BLE001 — backend without
+            # per-device placement support (untested relay backends):
+            # fall back to the whole-pool transfer + redistribution
+            # path (one host pack of the full pool — device_put with a
+            # global sharding needs the whole array per process
+            # anyway). Slower, and host-RAM-bound at extreme pool
+            # sizes, but always works. Drop the partial attempt's
+            # device buffers FIRST: keeping them across the second full
+            # transfer would stack partial + whole pool in HBM. Loudly
+            # recorded — a silent fallback would read as a mysterious
+            # staging regression.
+            import logging
+
+            logging.getLogger("pilosa_tpu.mesh").warning(
+                "per-device staging failed (%s: %s); falling back to "
+                "whole-pool placement", type(fb_err).__name__, fb_err)
+            if stats_out is not None:
+                stats_out["h2d_fallback"] = f"{type(fb_err).__name__}: " \
+                                            f"{fb_err}"
             shards = pieces = None  # noqa: F841 — release device refs
             words_arr = jax.device_put(pack_range(0, s_pad), sharding)
             # += : chunks shipped before the failure were real traffic.
@@ -752,10 +762,19 @@ def compile_serve_count_batch_shared(mesh: Mesh, tree_shape,
 
         def step(acc, s):
             # Gather each UNIQUE leaf's whole-row run for slice s —
-            # read once, used by every query below.
-            blocks = [wr_t[u][s, start_st[u, s]]
-                      * valid_st[u, s].astype(jnp.uint32)
-                      for u in range(num_unique)]
+            # read once, used by every query below. The barrier is the
+            # load-bearing part: without it XLA is free to fuse (i.e.
+            # DUPLICATE) each cheap dynamic-slice gather into every
+            # consuming fold, re-reading HBM per query and silently
+            # degenerating this program to the plain batch's traffic —
+            # r3 measured the two at identical wall time, which is
+            # exactly that failure. The barrier forces the U blocks to
+            # materialize once (U * 128 KB, VMEM-resident) before the
+            # B folds consume them.
+            blocks = list(lax.optimization_barrier(tuple(
+                wr_t[u][s, start_st[u, s]]
+                * valid_st[u, s].astype(jnp.uint32)
+                for u in range(num_unique))))
 
             live = (mask[s] != 0).astype(jnp.uint32)
             outs = []

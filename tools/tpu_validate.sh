@@ -1,0 +1,31 @@
+#!/bin/sh
+# One-shot TPU validation sequence for when the relay recovers.
+# Runs ONE jax process at a time (single-lease chip):
+#   1. staging profile (tools/profile_stage.py -> PROFILE_STAGE.json)
+#   2. full bench     (bench.py -> BENCH_DETAILS.json + headline line)
+#   3. snapshot the headline + details for the round record
+# Usage: sh tools/tpu_validate.sh  (from /root/repo)
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 120 python -c "import jax; print(jax.devices())" || {
+    echo "relay still down"; exit 1; }
+
+echo "== staging profile =="
+timeout 1500 python tools/profile_stage.py || echo "profile_stage failed"
+
+echo "== bench =="
+# No pipe: a pipeline would report tee's status and mask a bench
+# failure, snapshotting stale details as a "valid" round record.
+if PILOSA_TPU_RUN_BUDGET=2400 timeout 2600 python bench.py \
+        >BENCH_TPU_headline.json 2>bench_tpu.log; then
+    cat BENCH_TPU_headline.json
+    echo "== snapshot =="
+    cp BENCH_DETAILS.json BENCH_TPU_r4_snapshot.json
+else
+    echo "bench FAILED (rc=$?) — no snapshot taken"
+    tail -20 bench_tpu.log
+    exit 1
+fi
+tail -5 bench_tpu.log
