@@ -591,6 +591,10 @@ def main():
             "value": round(mc["throughput_batch_qps"], 2),
             "unit": "queries/sec",
             "vs_baseline": round(mc["throughput_vs_host"], 2),
+            # A fallback run must be readable as one: XLA-on-CPU vs
+            # native C++ is a smoke config, not the TPU engine losing.
+            "backend": ("tpu" if on_tpu
+                        else "cpu-fallback (TPU backend unavailable)"),
             "baseline": {
                 "host": "self-measured C++ popcnt kernels "
                         "(no Go toolchain; see BASELINE.md)",
