@@ -682,20 +682,15 @@ class Executor:
         min_threshold, _ = c.uint_arg("threshold")
 
         if self._spmd is not None:
-            if src is not None or tanimoto:
-                # src-intersection and tanimoto forms are not
-                # descriptor-served yet; the host path answers them
-                # correctly from rank 0's full replica.
-                return None
-
             def batch_fn(batch_slices):
                 try:
                     return self._spmd.top_n(
                         index, frame, VIEW_STANDARD, batch_slices,
                         self._batch_num_slices(index, batch_slices),
                         0 if row_ids else n, row_ids,
-                        min_threshold or MIN_THRESHOLD,
-                        attr_predicate=attr_predicate)
+                        min_threshold or MIN_THRESHOLD, src=src,
+                        attr_predicate=attr_predicate,
+                        tanimoto_threshold=tanimoto)
                 except Exception:  # noqa: BLE001 — device failure → host
                     return None
 

@@ -150,6 +150,38 @@ def rank_pairs(all_rows, counts, n: int, row_ids, min_threshold: int,
     return out
 
 
+def tanimoto_rank(all_rows, full, inter, src_count: int, n: int,
+                  tanimoto: int, row_ids, attr_predicate=None
+                  ) -> List[Tuple[int, int]]:
+    """Host-side tanimoto band math over three exact count vectors
+    (reference fragment.go:550-560,580-585: candidacy band on full
+    counts, ceil similarity check on intersect counts) — shared by the
+    single-host serving path and the SPMD descriptor plane so the two
+    cannot drift."""
+    if src_count == 0:
+        return []
+    min_tan = src_count * tanimoto / 100.0
+    max_tan = src_count * 100.0 / tanimoto
+    wanted = set(int(r) for r in row_ids) if row_ids else None
+    pairs: List[Tuple[int, int]] = []
+    for j in np.lexsort((all_rows, -inter)):
+        if wanted is not None and int(all_rows[j]) not in wanted:
+            continue  # exact ids recount phase (executor.go:273-310)
+        cnt, count = int(full[j]), int(inter[j])
+        if cnt <= min_tan or cnt >= max_tan or count == 0:
+            continue
+        t = -(-100 * count // (cnt + src_count - count))  # ceil
+        if t <= tanimoto:
+            continue
+        if attr_predicate is not None and not attr_predicate(
+                int(all_rows[j])):
+            continue
+        pairs.append((int(all_rows[j]), count))
+        if n and len(pairs) == n:
+            break
+    return pairs
+
+
 def _reraise_shared(what: str, err: BaseException):
     """Raise a FRESH exception wrapping a shared one: many threads can
     hold the same failed-group/in-flight error, and re-raising one
@@ -1318,28 +1350,42 @@ class MeshManager:
         src_count = int(combine_limbs(limbs, 1, start=2 * padded)[0])
         self.stats["topn"] += 1
         self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
-        if src_count == 0:
-            return []
-        min_tan = src_count * tanimoto / 100.0
-        max_tan = src_count * 100.0 / tanimoto
-        wanted = set(int(r) for r in row_ids) if row_ids else None
-        pairs: List[Tuple[int, int]] = []
-        for j in np.lexsort((all_rows, -inter)):
-            if wanted is not None and int(all_rows[j]) not in wanted:
-                continue  # exact ids recount phase (executor.go:273-310)
-            cnt, count = int(full[j]), int(inter[j])
-            if cnt <= min_tan or cnt >= max_tan or count == 0:
-                continue
-            t = -(-100 * count // (cnt + src_count - count))  # ceil
-            if t <= tanimoto:
-                continue
-            if attr_predicate is not None and not attr_predicate(
-                    int(all_rows[j])):
-                continue
-            pairs.append((int(all_rows[j]), count))
-            if n and len(pairs) == n:
-                break
-        return pairs
+        return tanimoto_rank(all_rows, full, inter, src_count, n,
+                             tanimoto, row_ids, attr_predicate)
+
+    def _src_counts_args(self, index: str, frame: str, view: str, src,
+                         slices: Sequence[int], num_slices: int):
+        """Resolve a src-tree row-count request to device arrays under
+        _mu: (sv, sharded, words_t, idx_t, hit_t, dev_mask, padded,
+        sig, epoch), or the explicit ("empty", row_ids) marker for a
+        rowless view, or None on any fallback. Shared by the
+        single-host execute path
+        (_src_counts_limbs) and the SPMD descriptor plane (which must
+        resolve-then-gate before entering the collective)."""
+        src_shape, src_leaves = src
+        with self._mu:
+            self._use_epoch += 1
+            sv = self.refresh(index, frame, view, num_slices)
+            if sv is None:
+                self.stats["fallback"] += 1
+                return None
+            sharded = sv.sharded
+            mask = self._mask_for(sv, slices)
+            if mask is None:
+                self.stats["fallback"] += 1
+                return None
+            if len(sv.row_ids) == 0:
+                return ("empty", sv.row_ids)
+            out = self._stage_leaves(index, src_leaves, num_slices)
+            if out is None:
+                return None
+            words_t, idx_t, hit_t, _coarse_t, _first = out
+            dev_mask = self._device_mask(mask)
+            padded = 1 << (len(sv.row_ids) - 1).bit_length()
+            sig = json.dumps(_tree_signature(src_shape))
+            epoch = self._memo_epoch
+        return (sv, sharded, words_t, idx_t, hit_t, dev_mask, padded,
+                sig, epoch)
 
     def _src_counts_limbs(self, kind: str, fn_cache: dict, compiler,
                           index: str, frame: str, view: str, src,
@@ -1358,33 +1404,19 @@ class MeshManager:
         writes), the refs pin every id in the key, and the epoch is
         snapshotted after _stage_leaves so src-side purges are
         observed."""
-        src_shape, src_leaves = src
-        with self._mu:
-            self._use_epoch += 1
-            sv = self.refresh(index, frame, view, num_slices)
-            if sv is None:
-                self.stats["fallback"] += 1
-                return None
-            sharded = sv.sharded
-            mask = self._mask_for(sv, slices)
-            if mask is None:
-                self.stats["fallback"] += 1
-                return None
-            if len(sv.row_ids) == 0:
-                return sv.row_ids, 0, None
-            out = self._stage_leaves(index, src_leaves, num_slices)
-            if out is None:
-                return None
-            words_t, idx_t, hit_t, _coarse_t, _first = out
-            dev_mask = self._device_mask(mask)
-            padded = 1 << (len(sv.row_ids) - 1).bit_length()
-            sig = json.dumps(_tree_signature(src_shape))
-            epoch = self._memo_epoch
+        prepared = self._src_counts_args(index, frame, view, src,
+                                         slices, num_slices)
+        if prepared is None:
+            return None
+        if prepared[0] == "empty":  # rowless view
+            return prepared[1], 0, None
+        (sv, sharded, words_t, idx_t, hit_t, dev_mask, padded, sig,
+         epoch) = prepared
         # Compile OUTSIDE _mu (see _row_counts_call).
         fn = self._get_or_compile(
-            fn_cache, (sig, len(src_leaves), padded),
+            fn_cache, (sig, len(idx_t), padded),
             lambda: compiler(self.mesh, json.loads(sig),
-                             len(src_leaves), padded))
+                             len(idx_t), padded))
         key = (kind, id(sharded.words), id(dev_mask), padded, sig,
                tuple(id(w) for w in words_t), tuple(id(a) for a in idx_t))
         out = self._memo_get(key)

@@ -72,6 +72,7 @@ _OP_WRITE = 4
 _OP_SCHEMA = 5
 _OP_PQL = 6
 _OP_IMPORT = 7
+_OP_RCSRC = 8  # src / tanimoto row-count collectives (kind field)
 
 
 def _encode(obj: dict) -> np.ndarray:
@@ -198,19 +199,70 @@ class SpmdServer:
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,
               row_ids: Sequence[int], min_threshold: int,
-              attr_predicate=None):
-        """TopN from one ROWCOUNTS collective + the shared host-side
-        ranking (serve.rank_pairs). The src/tanimoto argument forms are
-        NOT descriptor-served — the executor falls back to the host
-        path for those (correct: rank 0's holder is a full replica)."""
-        out = self.row_counts(index, frame, view, slices, num_slices)
-        if out is None:
-            return None
-        from .serve import rank_pairs
+              src=None, attr_predicate=None, tanimoto_threshold: int = 0):
+        """TopN — every argument form — from one descriptor-broadcast
+        collective + the SAME host-side ranking the single-host path
+        uses (serve.rank_pairs / serve.tanimoto_rank, so the two cannot
+        drift). `src` is a lowered (shape, leaves) bitmap-op tree; with
+        tanimoto_threshold the fused three-vector program serves the
+        band math. Rank 0 only."""
+        from .serve import combine_limbs, rank_pairs, tanimoto_rank
 
-        all_rows, counts = out
+        if tanimoto_threshold > 0:
+            if src is None:
+                return None
+            out = self._rcsrc("tan", index, frame, view, src, slices,
+                              num_slices)
+            if out is None:
+                return None
+            all_rows, padded, limbs = out
+            if limbs is None:
+                return []  # staged view has no rows
+            r = len(all_rows)
+            full = combine_limbs(limbs, r)
+            inter = combine_limbs(limbs, r, start=padded)
+            src_count = int(combine_limbs(limbs, 1, start=2 * padded)[0])
+            return tanimoto_rank(all_rows, full, inter, src_count,
+                                 0 if row_ids else n, tanimoto_threshold,
+                                 row_ids, attr_predicate)
+        if src is not None:
+            out = self._rcsrc("rcs", index, frame, view, src, slices,
+                              num_slices)
+            if out is None:
+                return None
+            all_rows, _padded, limbs = out
+            counts = (np.zeros(0, dtype=np.int64) if limbs is None
+                      else combine_limbs(limbs, len(all_rows)))
+        else:
+            out = self.row_counts(index, frame, view, slices, num_slices)
+            if out is None:
+                return None
+            all_rows, counts = out
         return rank_pairs(all_rows, counts, n, row_ids, min_threshold,
                           attr_predicate)
+
+    def _rcsrc(self, kind: str, index: str, frame: str, view: str,
+               src, slices: Sequence[int], num_slices: int):
+        """Broadcast + execute one src-tree row-count collective
+        (kind "rcs" = src intersection counts, "tan" = the fused
+        three-vector tanimoto program). Returns (row_ids, padded,
+        limbs np.ndarray | None) or None. Rank 0 only."""
+        assert self.rank == 0
+        src_shape, src_leaves = src
+        desc = {
+            "op": _OP_RCSRC,
+            "kind": kind,
+            "index": index,
+            "frame": frame,
+            "view": view,
+            "shape": src_shape,
+            "leaves": [list(leaf) for leaf in src_leaves],
+            "slices": list(map(int, slices)),
+            "num_slices": int(num_slices),
+        }
+        with self._mu:
+            self._broadcast(desc)
+            return self._run(desc)
 
     def write(self, index: str, frame: str, row_id: int, col_id: int,
               timestamp: Optional[str], clear: bool) -> bool:
@@ -343,6 +395,8 @@ class SpmdServer:
             return self._execute_count(desc)
         if op == _OP_ROWCOUNTS:
             return self._execute_rowcounts(desc)
+        if op == _OP_RCSRC:
+            return self._execute_rcsrc(desc)
         if op == _OP_WRITE:
             return self._execute_write(desc)
         if op == _OP_SCHEMA:
@@ -472,6 +526,77 @@ class SpmdServer:
         counts = combine_limbs(limbs, len(row_ids))
         self.manager.stats["topn"] += 1
         return row_ids, counts
+
+    def _execute_rcsrc(self, desc: dict):
+        """RCSRC: src-tree row counts ("rcs") or the fused tanimoto
+        three-vector program ("tan") over the global mesh. Resolution +
+        AOT compile BEFORE the agreement gate (the _execute_count
+        pattern); the fingerprint covers the program shape AND the
+        dense row table AND the src tree, so ranks with momentarily
+        divergent replicas skip together instead of entering a
+        mismatched collective."""
+        import zlib
+
+        from .mesh import (compile_serve_row_counts_src,
+                           compile_serve_row_counts_tanimoto)
+
+        kind = desc["kind"]
+        compiler = (compile_serve_row_counts_tanimoto if kind == "tan"
+                    else compile_serve_row_counts_src)
+        src = (desc["shape"], [tuple(leaf) for leaf in desc["leaves"]])
+        compiled = blob = None
+        try:
+            prepared = self.manager._src_counts_args(
+                desc["index"], desc["frame"], desc["view"], src,
+                desc["slices"], desc["num_slices"])
+            if prepared is not None and prepared[0] == "empty":
+                # Rowless view everywhere: agree on "empty", no
+                # collective (the _execute_rowcounts pattern).
+                blob = b"rcsrc-empty-" + kind.encode()
+                if not self._gate(blob):
+                    return None
+                return prepared[1], 0, None
+            if prepared is not None:
+                (sv, sharded, words_t, idx_t, hit_t, dev_mask, padded,
+                 sig, _epoch) = prepared
+                # EVERY argument shape the lowering specializes on must
+                # be in the cache key AND the fingerprint — a shape
+                # left out (e.g. the gather idx/hit arrays) would let
+                # mismatched ranks pass the gate and enter divergent
+                # collectives, or an intra-rank cache hit return an
+                # executable lowered for stale shapes.
+                shapes = (tuple(sharded.keys.shape),
+                          tuple(sharded.words.shape),
+                          tuple(tuple(w.shape) for w in words_t),
+                          tuple(tuple(i.shape) for i in idx_t),
+                          tuple(tuple(hh.shape) for hh in hit_t),
+                          tuple(dev_mask.shape))
+                ckey = (kind, sig, padded, shapes)
+                compiled = self._compiled.get(ckey)
+                if compiled is None:
+                    fn = self.manager._get_or_compile(
+                        self.manager._tanimoto_fns if kind == "tan"
+                        else self.manager._rowcount_src_fns,
+                        (sig, len(idx_t), padded),
+                        lambda: compiler(self.manager.mesh,
+                                         json.loads(sig),
+                                         len(idx_t), padded))
+                    compiled = fn.lower(sharded.keys, sharded.words,
+                                        words_t, idx_t, hit_t,
+                                        dev_mask).compile()
+                    self._compiled[ckey] = compiled
+                blob = json.dumps(
+                    [kind, sig, padded, repr(shapes),
+                     int(zlib.crc32(np.ascontiguousarray(sv.row_ids)))]
+                ).encode()
+        except Exception:  # noqa: BLE001 — counted as not-ready below
+            compiled = None
+        if not self._gate(blob if compiled is not None else None):
+            return None
+        limbs = np.asarray(compiled(sharded.keys, sharded.words, words_t,
+                                    idx_t, hit_t, dev_mask))
+        self.manager.stats["topn"] += 1
+        return sv.row_ids, padded, limbs
 
     def _execute_write(self, desc: dict) -> bool:
         """WRITE: apply the bit to THIS rank's holder (host-side; the

@@ -125,6 +125,22 @@ def test_spmd_server_two_process_boot(tmp_path):
         pairs = [(p["id"], p["count"]) for p in out["results"][0]]
         assert pairs == [(1, 4), (0, 3)], out
 
+        # src-intersection TopN rides the RCSRC descriptor: counts are
+        # |row ∩ src| over the global mesh (row0∩row0=3, row1∩row0=3)
+        out = _post(http[0], "/index/si/query",
+                    "TopN(Bitmap(frame=f1, rowID=0), frame=f1, n=2)")
+        pairs = [(p["id"], p["count"]) for p in out["results"][0]]
+        assert sorted(pairs) == [(0, 3), (1, 3)], out
+
+        # tanimoto form: fused three-vector program + host band math.
+        # src=row0 (|src|=3): row0 similarity 100 > 50 qualifies;
+        # row1: inter=3, union=4 -> ceil(75) > 50 qualifies too.
+        out = _post(http[0], "/index/si/query",
+                    "TopN(Bitmap(frame=f1, rowID=0), frame=f1, n=2, "
+                    "tanimotoThreshold=50)")
+        pairs = [(p["id"], p["count"]) for p in out["results"][0]]
+        assert sorted(pairs) == [(0, 3), (1, 3)], out
+
         # the collective ran on BOTH ranks (the device-serving counters
         # live in the shared MeshManager each rank's executor exposes)
         for r in (0, 1):
