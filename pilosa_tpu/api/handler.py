@@ -47,6 +47,7 @@ from __future__ import annotations
 import binascii
 import io
 import json
+import os
 import re
 import threading
 import time
@@ -397,6 +398,11 @@ class Handler:
         # whose mutating bulk routes are rejected.
         self.spmd = None
         self.spmd_worker = False
+        # Guards tracemalloc start/stop from /debug/pprof/heap: the
+        # handler is threaded, and crossed ?start/?stop pairs without
+        # the lock could stop a trace another request thinks it owns.
+        self._tracemalloc_mu = threading.Lock()
+        self._tracemalloc_ours = False
         self._routes: List[Route] = []
         r = self._add_route
         r("GET", r"/", self._get_webui)
@@ -604,47 +610,67 @@ class Handler:
         to opt in; ?start=1 begins tracing, ?stop=1 reports and then
         stops (Go's sampling profiler is always-on and cheap — Python's
         is not, hence the explicit switch). ?gc=1 collects first,
-        mirroring Go's ?gc=1."""
+        mirroring Go's ?gc=1.
+
+        ?start additionally requires PILOSA_TPU_HEAP_TRACE=1 in the
+        environment (ADVICE r4): the debug mux is unauthenticated, and
+        process-wide allocation tracing is an operator decision, not
+        something any client on the debug port may switch on. The
+        start/stop transitions run under a lock so two crossed
+        requests can't stop a trace the other thinks it owns."""
         import gc
         import tracemalloc
 
-        def flag(name: str) -> bool:
-            # "?start=0" must mean OFF: query params arrive as strings,
-            # and a bare truthiness test would read "0" as on.
-            return params.get(name, "").lower() not in ("", "0", "false",
-                                                        "no")
+        # "?start=0" (or =false/=no, any case) must mean OFF: query
+        # params and env values arrive as strings, and a bare
+        # truthiness test would read "0" as on. One spelling list for
+        # both the query flags and the env gate, so they can't drift.
+        falsy = ("", "0", "false", "no")
 
-        if flag("start") and not tracemalloc.is_tracing():
-            tracemalloc.start()
-            # Only a trace WE started may be stopped by ?stop=1 — an
-            # interpreter-level PYTHONTRACEMALLOC trace belongs to the
-            # operator, not this endpoint.
-            self._tracemalloc_ours = True
-        if flag("gc"):
-            gc.collect()
+        def flag(name: str) -> bool:
+            return params.get(name, "").lower() not in falsy
+
         out = []
-        try:
-            with open("/proc/self/status") as f:
-                for ln in f:
-                    if ln.startswith(("VmRSS", "VmHWM", "VmSize")):
-                        out.append("# " + ln.strip() + "\n")
-        except OSError:
-            pass
-        if tracemalloc.is_tracing():
-            current, peak = tracemalloc.get_traced_memory()
-            out.append(f"# tracemalloc current={current} peak={peak}\n\n")
-            snap = tracemalloc.take_snapshot()
-            for stat in snap.statistics("lineno")[:64]:
-                out.append(f"{stat.size}\t{stat.count}\t"
-                           f"{stat.traceback}\n")
-            if flag("stop") and getattr(self, "_tracemalloc_ours", False):
-                tracemalloc.stop()
-                self._tracemalloc_ours = False
-                out.append("# tracemalloc stopped\n")
-        else:
-            out.append("# tracemalloc off — ?start=1 to begin tracing "
-                       "allocation sites, then re-request (?stop=1 to "
-                       "report and stop)\n")
+        with self._tracemalloc_mu:
+            if flag("start") and not tracemalloc.is_tracing():
+                if os.environ.get("PILOSA_TPU_HEAP_TRACE",
+                                  "").lower() in falsy:
+                    out.append("# ?start=1 refused: set "
+                               "PILOSA_TPU_HEAP_TRACE=1 to allow this "
+                               "endpoint to enable tracemalloc\n")
+                else:
+                    tracemalloc.start()
+                    # Only a trace WE started may be stopped by
+                    # ?stop=1 — an interpreter-level PYTHONTRACEMALLOC
+                    # trace belongs to the operator, not this endpoint.
+                    self._tracemalloc_ours = True
+            if flag("gc"):
+                gc.collect()
+            try:
+                with open("/proc/self/status") as f:
+                    for ln in f:
+                        if ln.startswith(("VmRSS", "VmHWM", "VmSize")):
+                            out.append("# " + ln.strip() + "\n")
+            except OSError:
+                pass
+            if tracemalloc.is_tracing():
+                current, peak = tracemalloc.get_traced_memory()
+                out.append(f"# tracemalloc current={current} "
+                           f"peak={peak}\n\n")
+                snap = tracemalloc.take_snapshot()
+                for stat in snap.statistics("lineno")[:64]:
+                    out.append(f"{stat.size}\t{stat.count}\t"
+                               f"{stat.traceback}\n")
+                if flag("stop") and self._tracemalloc_ours:
+                    tracemalloc.stop()
+                    self._tracemalloc_ours = False
+                    out.append("# tracemalloc stopped\n")
+            else:
+                out.append("# tracemalloc off — ?start=1 to begin "
+                           "tracing allocation sites (requires "
+                           "PILOSA_TPU_HEAP_TRACE=1 in the server "
+                           "env), then re-request (?stop=1 to report "
+                           "and stop)\n")
         return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
                         "".join(out).encode())
 

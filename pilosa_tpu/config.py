@@ -101,6 +101,11 @@ class Config:
         self.partition_n: int = DEFAULT_PARTITION_N
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
         self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
+        # Parity-only (reference config.go:50, cmd/server.go:96): the
+        # reference declares [plugins] path but ships no plugin loader,
+        # so the field is vestigial there and deliberately inert here —
+        # accepted so reference TOML files load unchanged, never read.
+        self.plugins_path: str = ""
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -136,6 +141,8 @@ class Config:
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             c.anti_entropy_interval = parse_duration(ae["interval"])
+        c.plugins_path = str(data.get("plugins", {}).get("path",
+                                                         c.plugins_path))
         return c
 
     def expanded_data_dir(self) -> str:

@@ -63,7 +63,7 @@ class StagedView:
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
                  "num_slices", "idx_cache", "last_used", "last_stage_s",
-                 "inc_spend_s")
+                 "inc_spend_s", "inc_ewma_s", "inc_count")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -92,6 +92,16 @@ class StagedView:
         # (drives the periodic restage probe).
         self.last_stage_s: Optional[float] = None
         self.inc_spend_s = 0.0
+        # EWMA (seconds) of THIS view's measured incremental-apply cost
+        # — the other side of the gate. Per-view, not manager-global
+        # (ADVICE r4): with heterogeneous view sizes a cheap scatter
+        # measured on a small view must not drive repeated full
+        # restages of a large one. Seeded across a restage of the same
+        # key so a gate-chosen restage doesn't amnesia the estimate.
+        self.inc_ewma_s: Optional[float] = None
+        # Incremental applies since this view was staged — drives the
+        # deterministic (count-based) restage policy in SPMD mode.
+        self.inc_count = 0
 
     @property
     def padded_slices(self) -> int:
@@ -269,6 +279,12 @@ class MeshManager:
         # a jit compile and are excluded from the EWMA).
         self._inc_ewma_s: Optional[float] = None
         self._apply_shapes: set = set()
+        # SPMD descriptor-plane mode (set by SpmdServer): replace the
+        # measured incremental-vs-restage gate with a deterministic
+        # count-based policy so every rank picks the same path for the
+        # same descriptor — per-rank timings must never steer a
+        # decision that changes device-pool shapes (ADVICE r4).
+        self.deterministic_gate = False
         # One long-lived worker measures device-completion costs (a
         # thread per refresh would churn on write-heavy paths, and
         # blocked threads would each pin a device image during a relay
@@ -409,6 +425,7 @@ class MeshManager:
         old = self._views.get(key)
         if old is not None:
             self._purge_memo(old.sharded.words)
+        inherit_inc_ewma = old.inc_ewma_s if old is not None else None
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
         stage_io: dict = {}
@@ -425,6 +442,10 @@ class MeshManager:
             num_slices=num_slices,
         )
         sv.last_used = self._use_epoch
+        # Carry the same key's incremental estimate across the restage:
+        # a gate-chosen restage must not amnesia the cost evidence (the
+        # caller decays it first when the restage was gate-chosen).
+        sv.inc_ewma_s = inherit_inc_ewma
         self._views[key] = sv
         self._evict_over_budget()
         self.stats["stage"] += 1
@@ -437,7 +458,18 @@ class MeshManager:
         # with a small lag.
         sv.last_stage_s = None
 
-        def on_done(elapsed, sv=sv):
+        def on_done(elapsed, ok=True, sv=sv):
+            if not ok:
+                # The transfer FAILED: elapsed is time-to-exception,
+                # which for a fast abort is near zero — recording it
+                # raw would read as "staging is free" and steer the
+                # gate into a restage storm against an unhealthy
+                # device. Clamp to no less than the view's incremental
+                # estimate so the gate degrades to the cheap path
+                # (incremental) while the probe stays armed.
+                floor = sv.inc_ewma_s
+                if floor is not None:
+                    elapsed = max(elapsed, floor)
             sv.last_stage_s = elapsed
 
         self._measure_async(sv.sharded.words, t0, on_done)
@@ -472,15 +504,25 @@ class MeshManager:
         while True:
             words, t0, on_done = self._measure_q.get()
             try:
+                ok = True
                 try:
                     words.block_until_ready()
                     elapsed = time.monotonic() - t0
                 except Exception:  # noqa: BLE001 — surfaces at query
-                    continue
+                    # A failed fetch still records a sample (ADVICE
+                    # r4): dropping it would leave last_stage_s=None
+                    # forever, disabling the view's cost gate AND the
+                    # restage probe — exactly the failure mode the
+                    # queue-full fallback below documents as forbidden.
+                    # ok=False tells the callback the value is a
+                    # time-to-exception, not a cost — a fast abort
+                    # must not read as "this path is cheap".
+                    elapsed = time.monotonic() - t0
+                    ok = False
                 finally:
                     del words
                 try:
-                    on_done(elapsed)
+                    on_done(elapsed, ok)
                 except Exception:  # noqa: BLE001 — never kill the worker
                     pass
             finally:
@@ -542,32 +584,55 @@ class MeshManager:
             # a hard-wired incremental would be the wrong policy.
             # First incremental runs unmeasured (no EWMA yet) and seeds
             # the estimate; decisions surface in /debug/vars.
-            inc_est = self._inc_ewma_s
-            # Periodic restage PROBE — the symmetric re-exploration: a
-            # stale stage-cost sample (e.g. a slow COLD first stage)
-            # would otherwise freeze the gate on incremental forever,
-            # since restaging is the only event that re-measures stage
-            # cost. Probing when cumulative incremental spend reaches
-            # 20x the stage estimate bounds probe overhead at ~5% while
-            # re-calibrating quickly when restage is genuinely cheap.
-            probe = (sv.last_stage_s is not None
-                     and sv.inc_spend_s > 20.0 * sv.last_stage_s)
-            if probe or (inc_est is not None and sv.last_stage_s is not None
-                         and sv.last_stage_s < inc_est):
-                self.stats["refresh_pick_restage"] += 1
-                if probe:
-                    self.stats["refresh_probe_restage"] += 1
-                elif inc_est is not None:
-                    # Decay the incremental estimate on a GATE-chosen
-                    # restage: one anomalous slow scatter sample must
-                    # not freeze the gate on restage forever — the
-                    # decayed EWMA eventually re-admits an incremental,
-                    # which re-measures reality. (A PROBE carries no
-                    # evidence against incremental, so it must not
-                    # bias the estimate.)
-                    self._inc_ewma_s = inc_est * 0.9
-                    self.stats["inc_ewma_us"] = int(self._inc_ewma_s * 1e6)
-                return self._stage(key, num_slices)
+            if self.deterministic_gate:
+                # SPMD mode (ADVICE r4): every rank executes the same
+                # descriptor stream, but measured timings are per-rank —
+                # a measured gate could pick restage on one rank and
+                # incremental on another, and if a restage shrinks
+                # capacity the shapes diverge and the fingerprint gate
+                # host-falls-back every collective for this view
+                # forever. Decide from replicated state only: restage
+                # every fixed number of incremental applies (bounds
+                # capacity creep the scatters can't reclaim), otherwise
+                # incremental. Same stream -> same counter -> same pick
+                # on every rank.
+                if sv.inc_count >= self._DET_RESTAGE_EVERY:
+                    self.stats["refresh_pick_restage"] += 1
+                    return self._stage(key, num_slices)
+            else:
+                # Per-VIEW incremental estimate (ADVICE r4): comparing a
+                # per-view stage time against a manager-global EWMA let
+                # cheap scatters measured on a small view drive repeated
+                # full restages of a large one — both sides of the gate
+                # must cost the same pool.
+                inc_est = sv.inc_ewma_s
+                # Periodic restage PROBE — the symmetric re-exploration:
+                # a stale stage-cost sample (e.g. a slow COLD first
+                # stage) would otherwise freeze the gate on incremental
+                # forever, since restaging is the only event that
+                # re-measures stage cost. Probing when cumulative
+                # incremental spend reaches 20x the stage estimate
+                # bounds probe overhead at ~5% while re-calibrating
+                # quickly when restage is genuinely cheap.
+                probe = (sv.last_stage_s is not None
+                         and sv.inc_spend_s > 20.0 * sv.last_stage_s)
+                if probe or (inc_est is not None
+                             and sv.last_stage_s is not None
+                             and sv.last_stage_s < inc_est):
+                    self.stats["refresh_pick_restage"] += 1
+                    if probe:
+                        self.stats["refresh_probe_restage"] += 1
+                    elif inc_est is not None:
+                        # Decay the incremental estimate on a GATE-chosen
+                        # restage: one anomalous slow scatter sample must
+                        # not freeze the gate on restage forever — the
+                        # decayed EWMA (inherited by the fresh view in
+                        # _stage) eventually re-admits an incremental,
+                        # which re-measures reality. (A PROBE carries no
+                        # evidence against incremental, so it must not
+                        # bias the estimate.)
+                        sv.inc_ewma_s = inc_est * 0.9
+                    return self._stage(key, num_slices)
             t_inc = time.monotonic()
             per_slice = {}
             try:
@@ -593,6 +658,7 @@ class MeshManager:
             self._purge_memo(sv.sharded.words)
             sv.sharded = self._apply_fn(sv.sharded, *batches)
             sv.slice_gens = new_gens
+            sv.inc_count += 1
             self.stats["incremental"] += 1
             self.stats["refresh_pick_incremental"] += 1
             if not fresh_compile:
@@ -600,8 +666,21 @@ class MeshManager:
                 # measurement worker — host dispatch alone is a
                 # near-constant floor that says nothing about the
                 # scatter's real cost.
-                def on_inc(dt, sv=sv):
+                def on_inc(dt, ok=True, sv=sv):
+                    if not ok:
+                        # A failed scatter's time-to-exception says
+                        # nothing about incremental cost — feeding it
+                        # to the EWMA would make incrementals look
+                        # artificially cheap. Skip the sample; the
+                        # stage side keeps the gate decidable.
+                        return
                     with self._mu:
+                        sv.inc_ewma_s = (
+                            dt if sv.inc_ewma_s is None
+                            else 0.5 * (dt + sv.inc_ewma_s))
+                        # Manager-global EWMA survives only as an
+                        # observability gauge (/debug/vars) — the gate
+                        # reads the per-view estimate.
                         self._inc_ewma_s = (
                             dt if self._inc_ewma_s is None
                             else 0.5 * (dt + self._inc_ewma_s))
@@ -632,6 +711,13 @@ class MeshManager:
                     self._view_bytes(v) for v in self._views.values())
 
     # -- completed-result memo (device rank-cache analog) ----------------------
+
+    # Deterministic-gate restage period: in SPMD mode a view restages
+    # after this many incremental applies (bounds capacity creep from
+    # rows/containers the scatters can't add), otherwise scatters. The
+    # value only needs to be identical across ranks; 256 keeps restage
+    # amortized to well under 1% of refreshes on write-heavy streams.
+    _DET_RESTAGE_EVERY = 256
 
     # Bound on memoized TopN limb vectors: each is a (2, R_padded) int32
     # device array (~32 KB at 4096 rows) plus refs to live staged

@@ -134,6 +134,14 @@ class SpmdServer:
 
         self.rank = jax.process_index()
         self.manager = MeshManager(holder, mesh=mesh)
+        # Descriptor-plane invariant: every rank must make the SAME
+        # restage-vs-incremental pick for the same descriptor, or a
+        # capacity-shrinking restage on one rank diverges pool shapes
+        # and the fingerprint gate rejects this view's collectives
+        # forever (correct but a silent performance cliff — ADVICE r4).
+        # Per-rank measured timings can't satisfy that; switch the
+        # manager to the count-based deterministic policy.
+        self.manager.deterministic_gate = True
         self.holder = holder
         self.apply_message = None  # set by server wiring (receive_message)
         self.apply_query = None    # set by server wiring: (index, parsed

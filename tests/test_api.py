@@ -425,7 +425,7 @@ class TestPprofSuite:
         assert r.status == 200
         assert "--- thread MainThread" in r.body.decode()
 
-    def test_heap_explicit_start_stop(self, env):
+    def test_heap_explicit_start_stop(self, env, monkeypatch):
         import tracemalloc
 
         _, h = env
@@ -439,7 +439,23 @@ class TestPprofSuite:
             assert r1.status == 200
             assert "?start=1" in r1.body.decode()
             assert not tracemalloc.is_tracing()
-        # explicit opt-in traces; ?stop=1 reports then stops
+        # ?start=1 without the operator env flag is refused: the debug
+        # mux is unauthenticated, so process-wide tracing is gated on
+        # PILOSA_TPU_HEAP_TRACE (ADVICE r4) — and falsy spellings of
+        # the env value in any case count as off
+        for val in (None, "0", "False", "NO"):
+            if val is None:
+                monkeypatch.delenv("PILOSA_TPU_HEAP_TRACE",
+                                   raising=False)
+            else:
+                monkeypatch.setenv("PILOSA_TPU_HEAP_TRACE", val)
+            r = h.handle("GET", "/debug/pprof/heap", {"start": "1"}, b"")
+            assert r.status == 200
+            assert "refused" in r.body.decode()
+            assert not tracemalloc.is_tracing()
+        # explicit opt-in (env + query flag) traces; ?stop=1 reports
+        # then stops
+        monkeypatch.setenv("PILOSA_TPU_HEAP_TRACE", "1")
         assert h.handle("GET", "/debug/pprof/heap",
                         {"start": "1"}, b"").status == 200
         assert tracemalloc.is_tracing()

@@ -1458,16 +1458,21 @@ class TestRefreshCostGate:
                 break
             _t.sleep(0.01)
         # force the gate deterministically (the real measurements land
-        # asynchronously): staging declared cheap, incremental dear
+        # asynchronously): staging declared cheap, incremental dear.
+        # The gate reads the PER-VIEW estimate (ADVICE r4) — a global
+        # EWMA let small views drive restages of large ones.
         sv.last_stage_s = 1e-4
-        ewma0 = mgr._inc_ewma_s = 10.0
+        ewma0 = sv.inc_ewma_s = 10.0
         f.set_bit(1, 7)
         before = mgr.stats["stage"]
         mgr.refresh("i", "g", "standard", 2)
         assert mgr.stats["stage"] == before + 1
         assert mgr.stats["refresh_pick_restage"] == 1
-        # the estimate decays on a restage pick, so the gate re-explores
-        assert mgr._inc_ewma_s < ewma0
+        # the estimate decays on a restage pick (and is inherited by
+        # the fresh view), so the gate re-explores
+        sv2 = mgr._views[("i", "g", "standard")]
+        assert sv2 is not sv
+        assert sv2.inc_ewma_s is not None and sv2.inc_ewma_s < ewma0
 
     def test_incremental_picked_when_cheaper(self, tmp_path):
         import time as _t
@@ -1483,7 +1488,7 @@ class TestRefreshCostGate:
                 break
             _t.sleep(0.01)
         sv.last_stage_s = 10.0  # staging declared expensive
-        mgr._inc_ewma_s = 0.001
+        sv.inc_ewma_s = 0.001
         f.set_bit(1, 7)
         before = mgr.stats["incremental"]
         mgr.refresh("i", "g", "standard", 2)
@@ -1517,12 +1522,113 @@ class TestRefreshCostGate:
         # stale, expensive-looking stage sample + cheap incremental
         sv.last_stage_s = 0.001
         sv.inc_spend_s = 0.5  # > 20 * 0.001
-        mgr._inc_ewma_s = 1e-6  # plain gate would pick incremental
+        sv.inc_ewma_s = 1e-6  # plain gate would pick incremental
         f.set_bit(1, 7)
         stages0 = mgr.stats["stage"]
         mgr.refresh("i", "g", "standard", 2)
         assert mgr.stats["stage"] == stages0 + 1
         assert mgr.stats["refresh_probe_restage"] == 1
-        # the probe re-measured: the NEW view starts with zero spend
+        # the probe re-measured: the NEW view starts with zero spend,
+        # and the probe did NOT decay the incremental estimate (it
+        # carries no evidence against incremental)
         sv2 = mgr._views[("i", "g", "standard")]
         assert sv2.inc_spend_s == 0.0
+        assert sv2.inc_ewma_s == 1e-6
+
+    def test_gate_is_per_view(self, tmp_path):
+        """A cheap scatter measured on one view must not drive a
+        restage of ANOTHER view (ADVICE r4): each view's gate compares
+        its own stage cost against its own incremental estimate."""
+        import time as _t
+
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.parallel.serve import MeshManager
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        idx = h.create_index_if_not_exists("i")
+        fs = idx.create_frame_if_not_exists("small")
+        fl = idx.create_frame_if_not_exists("large")
+        for s in range(2):
+            fs.set_bit(1, s * (1 << 20) + 3)
+            fl.set_bit(1, s * (1 << 20) + 3)
+        mgr = MeshManager(h)
+        svs = mgr.refresh("i", "small", "standard", 2)
+        svl = mgr.refresh("i", "large", "standard", 2)
+        for sv in (svs, svl):
+            sv.sharded.words.block_until_ready()
+            for _ in range(100):
+                if sv.last_stage_s is not None:
+                    break
+                _t.sleep(0.01)
+        # ANOTHER view's big-pool scatters polluted the manager-global
+        # EWMA high (the ADVICE r4 scenario); this view's stage reads
+        # cheaper than that foreign estimate, but it has no incremental
+        # sample of its OWN yet
+        mgr._inc_ewma_s = 10.0
+        svs.inc_ewma_s = 10.0
+        svl.inc_ewma_s = None
+        svl.last_stage_s = 1.0
+        fl.set_bit(1, 7)
+        before = mgr.stats["stage"]
+        mgr.refresh("i", "large", "standard", 2)
+        # the old global gate would restage (last_stage_s 1.0 < global
+        # ewma 10.0); the per-view gate has no estimate for THIS view,
+        # so the first incremental runs and seeds it
+        assert mgr.stats["stage"] == before
+        assert mgr.stats["refresh_pick_incremental"] >= 1
+
+    def test_deterministic_gate_ignores_measured_costs(self, tmp_path):
+        """SPMD mode (ADVICE r4): with deterministic_gate set, measured
+        timings never steer the pick — only the replicated incremental
+        counter does, so every rank decides identically."""
+        h, mgr = self._mgr(tmp_path)
+        mgr.deterministic_gate = True
+        f = h.frame("i", "g")
+        sv = mgr.refresh("i", "g", "standard", 2)
+        # timings scream "restage is free" — a measured gate would
+        # restage; the deterministic gate must not listen
+        sv.last_stage_s = 1e-9
+        sv.inc_ewma_s = 100.0
+        sv.inc_spend_s = 100.0
+        before = mgr.stats["stage"]
+        f.set_bit(1, 7)
+        mgr.refresh("i", "g", "standard", 2)
+        assert mgr.stats["stage"] == before
+        assert mgr.stats["incremental"] == 1
+        # ...until the fixed count-based period elapses
+        sv.inc_count = mgr._DET_RESTAGE_EVERY
+        f.set_bit(1, 9)
+        mgr.refresh("i", "g", "standard", 2)
+        assert mgr.stats["stage"] == before + 1
+
+    def test_spmd_server_sets_deterministic_gate(self, tmp_path):
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.parallel.spmd import SpmdServer
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        assert SpmdServer(h).manager.deterministic_gate is True
+
+    def test_measure_loop_records_sample_on_device_error(self, tmp_path):
+        """A failed device fetch still records dispatch-so-far
+        (ADVICE r4): a view whose measurement errors must not lose its
+        cost gate and probe forever."""
+        h, mgr = self._mgr(tmp_path)
+
+        class Boom:
+            def block_until_ready(self):
+                raise RuntimeError("device lost")
+
+        got = []
+        import time as _t
+
+        mgr._measure_async(Boom(), _t.monotonic(),
+                           lambda e, ok=True: got.append((e, ok)))
+        for _ in range(200):
+            if got:
+                break
+            _t.sleep(0.01)
+        # sample recorded, flagged as a failure (ok=False) so callbacks
+        # treat it as time-to-exception, not a cost
+        assert got and got[0][0] >= 0.0 and got[0][1] is False

@@ -70,6 +70,17 @@ class TestConfig:
         c2 = Config.from_toml(Config().to_toml(), is_text=True)
         assert c2.host == Config().host
 
+    def test_reference_plugins_section_loads_unchanged(self):
+        """A reference TOML carrying the vestigial [plugins] path
+        (config.go:50 — no loader exists there either) parses without
+        error; the field is accepted and inert."""
+        c = Config.from_toml(
+            'data-dir = "/tmp/p"\n[plugins]\npath = "/opt/plugins"\n'
+            '[cluster]\nreplicas = 3\n', is_text=True)
+        assert c.plugins_path == "/opt/plugins"
+        assert c.replica_n == 3
+        assert Config().plugins_path == ""
+
 
 class TestMultiNode:
     def test_schema_broadcast(self, cluster2):
