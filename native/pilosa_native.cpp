@@ -66,6 +66,94 @@ uint64_t pilosa_popcnt_andnot_slice(const uint64_t* s, const uint64_t* m,
   return total;
 }
 
+// Per-BLOCK popcounts in one pass: out[b] = popcount(s[b*bwords ..
+// (b+1)*bwords)). The materializing query path needs one count per
+// roaring container (1024 words) to pick array-vs-bitmap form and to
+// pre-fill segment count caches; calling the scalar popcount per
+// container paid the ctypes/Python dispatch 16x per slice.
+void pilosa_popcnt_blocks(const uint64_t* s, size_t nblocks, size_t bwords,
+                          uint64_t* out) {
+  for (size_t b = 0; b < nblocks; ++b) {
+    uint64_t total = 0;
+    const uint64_t* p = s + b * bwords;
+    for (size_t i = 0; i < bwords; ++i) total += POPCNT64(p[i]);
+    out[b] = total;
+  }
+}
+
+// Fused FLAT left-fold + per-block popcount, one pass: out[i] =
+// leaves[0][i] op leaves[1][i] op ..., counts[b] = popcount of block b
+// of out. The materializing query path's hot loop — a separate numpy
+// fold plus a count pass re-reads the 100+ MB result once more; this
+// counts in-register while the words are live. op: 0=and, 1=or,
+// 2=andnot (matching ops/bitops.fold_tree's left-fold semantics).
+// Two loops per block, ON PURPOSE: the fold loop carries no popcount
+// so the compiler auto-vectorizes it; the count loop then re-reads the
+// 8 KB block while it is still in L1 (vs a separate whole-result count
+// pass that re-streams 100+ MB from memory).
+#define FOLD_LOOP(OPEXPR)                                              \
+  for (size_t b = 0; b < nblocks; ++b) {                               \
+    const size_t off = b * bwords;                                     \
+    uint64_t* ob = out + off;                                          \
+    for (size_t i = 0; i < bwords; ++i) {                              \
+      uint64_t acc = leaves[0][off + i];                               \
+      for (size_t l = 1; l < nleaves; ++l) {                           \
+        const uint64_t w = leaves[l][off + i];                         \
+        acc = (OPEXPR);                                                \
+      }                                                                \
+      ob[i] = acc;                                                     \
+    }                                                                  \
+    uint64_t cnt = 0;                                                  \
+    for (size_t i = 0; i < bwords; ++i) cnt += POPCNT64(ob[i]);        \
+    counts[b] = cnt;                                                   \
+  }
+
+// Two-leaf specialization: the runtime `nleaves` loop above defeats
+// auto-vectorization; with two fixed pointers the fold loop compiles
+// to plain SIMD and/or/andn. Two leaves is the dominant materializing
+// shape (Intersect/Difference are mostly binary in practice).
+#define FOLD2_LOOP(OPEXPR)                                             \
+  for (size_t b = 0; b < nblocks; ++b) {                               \
+    const size_t off = b * bwords;                                     \
+    const uint64_t* pa = a + off;                                      \
+    const uint64_t* pb = bb + off;                                     \
+    uint64_t* ob = out + off;                                          \
+    for (size_t i = 0; i < bwords; ++i) ob[i] = (OPEXPR);              \
+    uint64_t cnt = 0;                                                  \
+    for (size_t i = 0; i < bwords; ++i) cnt += POPCNT64(ob[i]);        \
+    counts[b] = cnt;                                                   \
+  }
+
+static void fold2_blocks(const uint64_t* a, const uint64_t* bb, int op,
+                         size_t nblocks, size_t bwords, uint64_t* out,
+                         uint64_t* counts) {
+  if (op == 0) {
+    FOLD2_LOOP(pa[i] & pb[i])
+  } else if (op == 1) {
+    FOLD2_LOOP(pa[i] | pb[i])
+  } else {
+    FOLD2_LOOP(pa[i] & ~pb[i])
+  }
+}
+#undef FOLD2_LOOP
+
+void pilosa_fold_blocks(const uint64_t** leaves, size_t nleaves, int op,
+                        size_t nblocks, size_t bwords, uint64_t* out,
+                        uint64_t* counts) {
+  if (nleaves == 2) {
+    fold2_blocks(leaves[0], leaves[1], op, nblocks, bwords, out, counts);
+    return;
+  }
+  if (op == 0) {
+    FOLD_LOOP(acc & w)
+  } else if (op == 1) {
+    FOLD_LOOP(acc | w)
+  } else {
+    FOLD_LOOP(acc & ~w)
+  }
+}
+#undef FOLD_LOOP
+
 // ---- sorted-array container kernels (roaring.go:1192-1558 analogs) --------
 // Inputs are sorted unique; outputs are sorted unique. `out` must have
 // room for the worst case (na, na+nb, na, na+nb respectively).

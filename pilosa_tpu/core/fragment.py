@@ -37,6 +37,38 @@ from .row import Row
 # Snapshot after this many WAL ops (reference fragment.go:62-65).
 MAX_OP_N = 2000
 
+
+class _MutationEpoch:
+    """Process-wide monotonic mutation counter.
+
+    Every completed data mutation that can change a query's answer —
+    bit writes, imports/restores (log reset), index/frame create or
+    delete, label or time-quantum changes — bumps it. A query-level
+    memo validated by `n` (HostQueryCache.query_get) turns a repeated
+    read-only Count into one dict probe + one int compare, the host
+    analog of the device-side TopN memo.
+
+    Process-wide rather than per-holder on purpose: threading a
+    counter through Holder→Index→Frame→View→Fragment buys nothing but
+    plumbing — multiple holders share one interpreter only in tests,
+    and cross-holder bumps merely over-invalidate (a performance
+    non-event), never under-invalidate. The bump is lock-guarded
+    because `n += 1` on two threads can lose an update, and a LOST
+    bump is the one thing that could validate a stale entry."""
+
+    __slots__ = ("n", "_mu")
+
+    def __init__(self):
+        self.n = 0
+        self._mu = threading.Lock()
+
+    def bump(self):
+        with self._mu:
+            self.n += 1
+
+
+MUTATION_EPOCH = _MutationEpoch()
+
 # Rows per checksummed block (reference fragment.go HashBlockSize).
 HASH_BLOCK_SIZE = 100
 
@@ -284,6 +316,7 @@ class Fragment:
 
     def _log_append(self, op: int, pos: int, churn: bool):
         self.generation += 1
+        MUTATION_EPOCH.bump()
         self._log.append((op, pos, churn))
         if len(self._log) > self._log_limit:
             drop = len(self._log) - self._log_limit
@@ -294,6 +327,7 @@ class Fragment:
         """Wholesale storage replacement (import, restore): consumers at
         any earlier generation must rebuild."""
         self.generation += 1
+        MUTATION_EPOCH.bump()
         self._log.clear()
         self._log_base = self.generation
 

@@ -20,6 +20,7 @@ import numpy as np
 from ..utils import validate_label, validate_name
 from .attr import AttrStore
 from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
+from .fragment import MUTATION_EPOCH
 from .timequantum import TimeQuantum, views_by_time
 from .view import VIEW_INVERSE, VIEW_STANDARD, View
 
@@ -99,10 +100,12 @@ class Frame:
 
     def set_time_quantum(self, q: TimeQuantum):
         self.time_quantum = q
+        MUTATION_EPOCH.bump()  # changes Range view covers
         self._save_meta()
 
     def set_row_label(self, label: str):
         self.row_label = validate_label(label)
+        MUTATION_EPOCH.bump()  # changes how Bitmap args lower
         self._save_meta()
 
     # -- views -------------------------------------------------------------

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 
 from ..errors import IndexExistsError
 from ..utils import NopStats
+from .fragment import MUTATION_EPOCH
 from .index import Index
 
 
@@ -74,6 +75,7 @@ class Holder:
         idx.open()
         # Copy-on-write: readers iterate self.indexes without the lock.
         self.indexes = {**self.indexes, name: idx}
+        MUTATION_EPOCH.bump()
         return idx
 
     def delete_index(self, name: str):
@@ -84,6 +86,7 @@ class Holder:
             rest = dict(self.indexes)
             idx = rest.pop(name, None)
             self.indexes = rest
+            MUTATION_EPOCH.bump()
             if idx is not None:
                 idx.close()
                 shutil.rmtree(idx.path, ignore_errors=True)

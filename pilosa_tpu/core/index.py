@@ -16,6 +16,7 @@ from typing import Dict, Optional
 from ..errors import FrameExistsError
 from ..utils import validate_label, validate_name
 from .attr import AttrStore
+from .fragment import MUTATION_EPOCH
 from .frame import Frame
 from .timequantum import TimeQuantum
 
@@ -81,10 +82,12 @@ class Index:
 
     def set_column_label(self, label: str):
         self.column_label = validate_label(label)
+        MUTATION_EPOCH.bump()  # changes how Bitmap args lower
         self._save_meta()
 
     def set_time_quantum(self, q: TimeQuantum):
         self.time_quantum = q
+        MUTATION_EPOCH.bump()  # changes Range view covers
         self._save_meta()
 
     # -- slices ------------------------------------------------------------
@@ -139,6 +142,7 @@ class Index:
         frame.open()
         # Copy-on-write: readers iterate self.frames without the lock.
         self.frames = {**self.frames, name: frame}
+        MUTATION_EPOCH.bump()
         return frame
 
     def delete_frame(self, name: str):
@@ -146,6 +150,7 @@ class Index:
             rest = dict(self.frames)
             f = rest.pop(name, None)
             self.frames = rest
+            MUTATION_EPOCH.bump()
             if f is not None:
                 f.close()
                 shutil.rmtree(f.path, ignore_errors=True)

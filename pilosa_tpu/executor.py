@@ -177,10 +177,14 @@ class Executor:
         opt = opt or ExecOptions()
 
         need = needs_slices(q.calls)
-        inverse_slices: List[int] = []
+        # Built lazily on the first inverse call: most queries touch no
+        # inverse view, and at headline slice counts (960) the eager
+        # list was a measurable per-query tax on the routed fast path.
+        inverse_slices: Optional[List[int]] = None
         column_label = DEFAULT_COLUMN_LABEL
 
         idx = self.holder.index(index)
+        defaulted = False
         if slices:
             slices = list(slices)
         else:
@@ -188,8 +192,8 @@ class Executor:
             if need:
                 if idx is None:
                     raise IndexNotFoundError()
+                defaulted = True
                 slices = list(range(idx.max_slice() + 1))
-                inverse_slices = list(range(idx.max_inverse_slice() + 1))
                 column_label = idx.column_label
 
         # Bulk attribute insertion fast path (executor.go:857-941).
@@ -205,6 +209,13 @@ class Executor:
                 if f is None:
                     raise FrameNotFoundError()
                 if call.is_inverse(f.row_label, column_label):
+                    if inverse_slices is None:
+                        # Explicit caller slices keep their original
+                        # behavior (inverse calls got the empty list);
+                        # only the defaulted path derives the cover.
+                        inverse_slices = list(
+                            range(idx.max_inverse_slice() + 1)) \
+                            if defaulted else []
                     call_slices = inverse_slices
             results.append(self._execute_call(index, call, call_slices, opt))
         return results
@@ -229,16 +240,56 @@ class Executor:
 
     def _execute_bitmap_call(self, index: str, c: Call, slices: Sequence[int],
                              opt: ExecOptions) -> Row:
-        def map_fn(slice_):
-            return self.execute_bitmap_call_slice(index, c, slice_)
+        # Fused materialization (VERDICT r4 #5): a lowerable multi-leaf
+        # tree folds dense word blocks once per slice and lifts the
+        # RESULT into roaring — no per-operand container
+        # materialization, no pairwise merges. Single-leaf Bitmap()
+        # stays on the fragment row cache (a plain cache hit beats any
+        # fold); non-lowerable trees keep the general roaring path.
+        mat_plan = None
+        if c.name in ("Intersect", "Union", "Difference", "Range"):
+            from .parallel.plan import HostMaterializePlan, _lower_tree
 
-        def reduce_fn(prev, v):
-            if prev is None:
-                prev = Row()
-            prev.merge(v)
-            return prev
+            leaves: list = []
+            shape = _lower_tree(self.holder, index, c, leaves)
+            if shape is not None and len(leaves) > 1:
+                mat_plan = HostMaterializePlan(
+                    self.holder, index, shape, leaves,
+                    cache=self._host_cache)
 
-        row = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        if mat_plan is not None:
+            def batch_fn(batch_slices):
+                return mat_plan.materialize_row(batch_slices)
+
+            def map_fn(slice_):
+                seg = mat_plan.materialize_slice(slice_)
+                r = Row()
+                if seg is not None:
+                    r.segments[slice_] = seg
+                return r
+
+            def reduce_fn(prev, v):
+                # batch_fn/map_fn results are freshly built (never the
+                # fragment row cache's shared Rows) — the first one can
+                # be adopted without a defensive merge-clone.
+                if prev is None:
+                    return v
+                prev.merge(v)
+                return prev
+
+            row = self._map_reduce(index, slices, c, opt, map_fn,
+                                   reduce_fn, batch_fn=batch_fn)
+        else:
+            def map_fn(slice_):
+                return self.execute_bitmap_call_slice(index, c, slice_)
+
+            def reduce_fn(prev, v):
+                if prev is None:
+                    prev = Row()
+                prev.merge(v)
+                return prev
+
+            row = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
         if row is None:
             row = Row()
 
@@ -359,6 +410,32 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
 
+        # Whole-query memo (the Range/nary routed-path answer to the
+        # reference's rank cache): a repeated read-only Count on an
+        # unmutated holder is one dict probe validated by the
+        # process-wide MUTATION_EPOCH — skipping re-lowering, plan
+        # construction, and the per-slice generation walk, which
+        # together dwarf the actual fold on small routed queries.
+        # Single-node only: with cluster fan-out, remote writes don't
+        # bump the LOCAL epoch, so a hit could serve another node's
+        # stale slices. (SPMD replicates writes to every rank's holder
+        # via the descriptor stream, so its rank-0 executor — which
+        # has no cluster nodes — still qualifies; so does the default
+        # server's one-node static cluster, where every write IS local.)
+        qkey = qepoch = None
+        nodes = self.cluster.nodes if self.cluster is not None else []
+        if (not nodes
+                or (len(nodes) == 1 and nodes[0].host == self.host)):
+            ck = c.cache_key()
+            if ck is not None:
+                from .core.fragment import MUTATION_EPOCH
+
+                qkey = (index, ck, tuple(slices))
+                qepoch = MUTATION_EPOCH.n
+                hit = self._host_cache.query_get(qkey, qepoch)
+                if hit is not None:
+                    return hit
+
         # Lower the tree ONCE; every count engine shares it. The
         # per-slice CountPlan is only built if the mesh batch declines
         # (it compiles per-slice jits the batch path never uses).
@@ -420,7 +497,13 @@ class Executor:
 
         result = self._map_reduce(
             index, slices, c, opt, map_fn, reduce_fn, batch_fn=batch_fn)
-        return int(result or 0)
+        n = int(result or 0)
+        if qkey is not None:
+            # Stored against the PRE-compute epoch: a write racing the
+            # fold bumped it, so the entry can never validate — stale
+            # results invalidate, they don't serve.
+            self._host_cache.query_put(qkey, qepoch, n)
+        return n
 
     def mesh_manager(self):
         """The mesh serving layer, or None when the device backend is

@@ -103,6 +103,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.pilosa_bitmap_contains_u32.restype = None
     lib.pilosa_bitmap_contains_u32.argtypes = [_U64P, _U32P,
                                                ctypes.c_size_t, _U8P]
+    lib.pilosa_popcnt_blocks.restype = None
+    lib.pilosa_popcnt_blocks.argtypes = [_U64P, ctypes.c_size_t,
+                                         ctypes.c_size_t, _U64P]
+    lib.pilosa_fold_blocks.restype = None
+    lib.pilosa_fold_blocks.argtypes = [ctypes.POINTER(_U64P),
+                                       ctypes.c_size_t, ctypes.c_int,
+                                       ctypes.c_size_t, ctypes.c_size_t,
+                                       _U64P, _U64P]
     return lib
 
 
@@ -143,6 +151,47 @@ def popcnt_slice(s: np.ndarray) -> int:
             and len(s) >= POPCNT_NATIVE_MIN):
         return int(lib.pilosa_popcnt_slice(_p64(s), len(s)))
     return int(np.bitwise_count(s).sum())
+
+
+def popcnt_blocks(s: np.ndarray, block_words: int = 1024) -> np.ndarray:
+    """Per-block popcounts: (len(s)/block_words,) uint64 — ONE pass,
+    one call, for per-container counts on the materializing path."""
+    nblocks = len(s) // block_words
+    lib = _get_lib()
+    if (lib is not None and s.dtype == np.uint64 and s.flags.c_contiguous
+            and len(s) >= POPCNT_NATIVE_MIN):
+        out = np.empty(nblocks, dtype=np.uint64)
+        lib.pilosa_popcnt_blocks(_p64(s), nblocks, block_words, _p64(out))
+        return out
+    return np.bitwise_count(s).reshape(nblocks, block_words) \
+        .sum(axis=1, dtype=np.uint64)
+
+
+_FOLD_OPS = {"and": 0, "or": 1, "andnot": 2}
+
+
+def fold_blocks(leaves, op: str, block_words: int = 1024):
+    """Fused flat fold + per-block popcount: (out, counts) for
+    out = leaves[0] op leaves[1] op ... (left fold), or None when the
+    native library is unavailable or inputs don't qualify — callers
+    fall back to a numpy fold + popcnt_blocks (one extra result pass)."""
+    lib = _get_lib()
+    code = _FOLD_OPS.get(op)
+    if (lib is None or code is None or len(leaves) < 2
+            or any(a.dtype != np.uint64 or not a.flags.c_contiguous
+                   or a.shape != leaves[0].shape for a in leaves)):
+        return None
+    n = leaves[0].size
+    if n % block_words or n < POPCNT_NATIVE_MIN:
+        return None
+    nblocks = n // block_words
+    out = np.empty(n, dtype=np.uint64)
+    counts = np.empty(nblocks, dtype=np.uint64)
+    ptrs = (_U64P * len(leaves))(*[
+        a.ctypes.data_as(_U64P) for a in leaves])
+    lib.pilosa_fold_blocks(ptrs, len(leaves), code, nblocks, block_words,
+                           _p64(out), _p64(counts))
+    return out, counts
 
 
 def _popcnt_pair(name: str, np_op, s: np.ndarray, m: np.ndarray) -> int:

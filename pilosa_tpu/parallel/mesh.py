@@ -1235,7 +1235,9 @@ def sharded_index_from_holder(holder, index: str, frame: str,
 
 def connect_distributed(coordinator_address: Optional[str] = None,
                         num_processes: Optional[int] = None,
-                        process_id: Optional[int] = None) -> int:
+                        process_id: Optional[int] = None,
+                        heartbeat_timeout_seconds: Optional[int] = None
+                        ) -> int:
     """Join this host to the multi-host JAX runtime (the data plane's
     answer to the reference's multi-node HTTP query fan-out).
 
@@ -1258,7 +1260,17 @@ def connect_distributed(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    kw = {}
+    if heartbeat_timeout_seconds is None and os.environ.get(
+            "PILOSA_TPU_HEARTBEAT_TIMEOUT_S"):
+        heartbeat_timeout_seconds = int(
+            os.environ["PILOSA_TPU_HEARTBEAT_TIMEOUT_S"])
+    if heartbeat_timeout_seconds is not None:
+        # Rank-death detection bound: a died peer surfaces as a
+        # coordination error on the survivors within this window
+        # instead of wedging the next collective indefinitely.
+        kw["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kw)
     return jax.process_index()

@@ -86,7 +86,14 @@ def _encode(obj: dict) -> np.ndarray:
 
 def _decode(buf: np.ndarray) -> dict:
     raw = bytes(np.asarray(buf, dtype=np.uint8))
-    return json.loads(raw[: raw.index(b"\x00")] if b"\x00" in raw else raw)
+    desc = json.loads(raw[: raw.index(b"\x00")] if b"\x00" in raw else raw)
+    # A corrupt payload that still parses as json must not dispatch as
+    # a half-valid descriptor: the op tag is the minimum contract
+    # (bool excluded — json true would otherwise dispatch as op 1).
+    op = desc.get("op") if isinstance(desc, dict) else None
+    if not isinstance(op, int) or isinstance(op, bool):
+        raise ValueError("descriptor missing integer op tag")
+    return desc
 
 
 class SpmdBroadcaster(Broadcaster):
@@ -385,17 +392,29 @@ class SpmdServer:
         (broadcast_one_to_all blocks until ALL processes enter), so a
         failed execute logs and keeps following."""
         assert self.rank != 0, "rank 0 drives; workers follow"
+        import logging
+
+        log = logging.getLogger("pilosa_tpu.spmd")
         while True:
-            desc = self._broadcast(None)
+            try:
+                desc = self._broadcast(None)
+            except (ValueError, KeyError) as e:  # corrupt descriptor
+                # broadcast_one_to_all hands EVERY rank the same bytes,
+                # so a payload that fails to DECODE fails identically
+                # everywhere — all ranks log and stay aligned for the
+                # next descriptor rather than one rank leaving the loop
+                # and wedging every later collective. Only the decode
+                # contract is caught: a distributed-runtime error (dead
+                # coordinator, heartbeat loss) must still propagate and
+                # end this worker loudly, not spin it hot forever.
+                log.warning("spmd worker: undecodable descriptor: %s", e)
+                continue
             if desc["op"] == _OP_STOP:
                 return
             try:
                 self._run(desc)
             except Exception as e:  # noqa: BLE001 — stay in the pact
-                import logging
-
-                logging.getLogger("pilosa_tpu.spmd").warning(
-                    "spmd worker: descriptor failed: %s", e)
+                log.warning("spmd worker: descriptor failed: %s", e)
 
     def _dispatch(self, desc: dict):
         op = desc["op"]

@@ -542,6 +542,48 @@ class Bitmap:
             for v in vals:
                 yield base | int(v)
 
+    # -- bulk construction --------------------------------------------------
+
+    @classmethod
+    def from_dense_words(cls, words: np.ndarray, counts=None,
+                         own: bool = False, key_base: int = 0) -> "Bitmap":
+        """Build a bitmap from dense 64-bit words covering keys
+        [key_base, key_base + len(words)/1024): one container per
+        nonzero 1024-word block, normalized at the 4096 threshold like
+        every set-op result. The inverse of laying containers out via
+        words() — what fused dense folds (plan.HostMaterializePlan)
+        produce.
+
+        `counts` (per-block popcounts, ops.native.popcnt_blocks) skips
+        the per-container count; `own=True` declares `words` freshly
+        allocated and exclusively this call's, letting containers be
+        VIEWS into it (blocks are disjoint 1024-word runs, so one
+        container's in-place mutation cannot touch a sibling's)."""
+        assert len(words) % 1024 == 0
+        blocks = words.reshape(-1, 1024)
+        if counts is None:
+            from ..ops import native
+
+            counts = native.popcnt_blocks(words)
+        b = cls.__new__(cls)
+        b.keys = []
+        b.containers = []
+        b.op_writer = None
+        b.op_n = 0
+        for key in np.flatnonzero(counts):
+            blk = blocks[key] if own else blocks[key].copy()
+            c = Container.__new__(Container)
+            c.shared = False
+            if counts[key] <= ARRAY_MAX_SIZE:
+                c.array = bitmap_to_values(blk)
+                c.bitmap = None
+            else:
+                c.array = None
+                c.bitmap = blk
+            b.keys.append(key_base + int(key))
+            b.containers.append(c)
+        return b
+
     # -- maintenance -------------------------------------------------------
 
     def clone(self) -> "Bitmap":

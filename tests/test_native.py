@@ -104,3 +104,41 @@ class TestFallback:
         words = rng.integers(0, 2**63, 1024, dtype=np.uint64)
         bits = np.unpackbits(words.view(np.uint8), bitorder="little")
         assert (nat.bitmap_to_values(words) == np.nonzero(bits)[0]).all()
+
+
+@requires_native
+class TestBlockKernels:
+    """Per-block popcount + fused flat fold (the materializing path's
+    hot kernels), differential against numpy."""
+
+    @pytest.mark.parametrize("nblocks", [8, 16, 96])
+    def test_popcnt_blocks(self, rng, nblocks):
+        s = rng.integers(0, 2**63, nblocks * 1024, dtype=np.uint64)
+        want = np.bitwise_count(s).reshape(nblocks, 1024).sum(axis=1)
+        assert np.array_equal(nat.popcnt_blocks(s), want)
+
+    @pytest.mark.parametrize("op,np_fn", [
+        ("and", lambda a, b: a & b),
+        ("or", lambda a, b: a | b),
+        ("andnot", lambda a, b: a & ~b),
+    ])
+    @pytest.mark.parametrize("nleaves", [2, 3, 5])
+    def test_fold_blocks(self, rng, op, np_fn, nleaves):
+        leaves = [rng.integers(0, 2**63, 16 * 1024, dtype=np.uint64)
+                  for _ in range(nleaves)]
+        got = nat.fold_blocks(leaves, op)
+        assert got is not None
+        out, counts = got
+        want = leaves[0]
+        for w in leaves[1:]:
+            want = np_fn(want, w)
+        assert np.array_equal(out, want)
+        assert np.array_equal(
+            counts, np.bitwise_count(want).reshape(-1, 1024).sum(axis=1))
+
+    def test_fold_blocks_declines(self, rng):
+        a = rng.integers(0, 2**63, 16 * 1024, dtype=np.uint64)
+        assert nat.fold_blocks([a], "and") is None          # < 2 leaves
+        assert nat.fold_blocks([a, a], "xor") is None       # unknown op
+        b32 = a.astype(np.uint32)
+        assert nat.fold_blocks([b32, b32], "and") is None   # wrong dtype

@@ -210,3 +210,51 @@ class TestSerializeFuzz:
         for cut in (0, 1, 3, 7, len(data) // 2, len(data) - 1):
             with pytest.raises((ValueError, EOFError)):
                 Bitmap.from_bytes(data[:cut])
+
+
+class TestFromDenseWords:
+    def test_forms_and_roundtrip(self):
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+
+        words = np.zeros(4 * 1024, dtype=np.uint64)
+        # block 0: sparse (3 bits) -> array container
+        words[0] = 0b1011
+        # block 2: dense (> 4096 bits) -> bitmap container
+        words[2 * 1024:3 * 1024] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        b = Bitmap.from_dense_words(words)
+        assert b.keys == [0, 2]
+        assert b.containers[0].is_array()
+        assert not b.containers[1].is_array()
+        assert b.count() == 3 + 1024 * 64
+        # the dense words round-trip exactly
+        assert np.array_equal(b.containers[1].words(),
+                              words[2 * 1024:3 * 1024])
+        assert sorted(b.containers[0].values().tolist()) == [0, 1, 3]
+
+    def test_key_base_and_counts(self):
+        import numpy as np
+
+        from pilosa_tpu.ops import native
+        from pilosa_tpu.roaring import Bitmap
+
+        words = np.zeros(2 * 1024, dtype=np.uint64)
+        words[1024] = 0xF0
+        counts = native.popcnt_blocks(words)
+        b = Bitmap.from_dense_words(words, counts=counts, key_base=16)
+        assert b.keys == [17]
+        assert b.count() == 4
+
+    def test_own_views_are_safe_to_mutate(self):
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+
+        words = np.ones(2 * 1024, dtype=np.uint64) * np.uint64(2**63)
+        b = Bitmap.from_dense_words(words, own=True)
+        # in-place container mutation must not leak across containers
+        c0 = b.containers[0]
+        if not c0.is_array():
+            c0.bitmap[0] = np.uint64(0)
+            assert b.containers[1].words()[0] == np.uint64(2**63)

@@ -1200,11 +1200,18 @@ class TestHbmBudgetEviction:
                 f.set_bit(2, blk * 65536 + 3)
 
     def test_lru_eviction_and_restage(self, holder, monkeypatch):
+        from pilosa_tpu.core.fragment import MUTATION_EPOCH
+
         self.seed_frames(holder, ["f1", "f2", "f3"])
         e = Executor(holder, use_device=True, device_min_work=0)
         mgr = e.mesh_manager()
 
         def pql(fr):
+            # The executor's query-level memo would answer repeats
+            # without ever touching the mesh layer (correct, but this
+            # test exists to drive staging/eviction): move the epoch so
+            # every execute reaches the device path.
+            MUTATION_EPOCH.bump()
             return (f"Count(Intersect(Bitmap(rowID=1, frame={fr}), "
                     f"Bitmap(rowID=2, frame={fr})))")
 
@@ -1234,6 +1241,8 @@ class TestHbmBudgetEviction:
         self.seed_frames(holder, ["f1", "f2", "f3"])
         e = Executor(holder, use_device=True, device_min_work=0)
         mgr = e.mesh_manager()
+        from pilosa_tpu.core.fragment import MUTATION_EPOCH
+
         q3 = ("Count(Union(Bitmap(rowID=1, frame=f1), "
               "Bitmap(rowID=1, frame=f2), Bitmap(rowID=1, frame=f3)))")
         assert q(e, "i", q3)[0] == 16
@@ -1242,9 +1251,11 @@ class TestHbmBudgetEviction:
                             staticmethod(lambda: 2 * one + one // 2))
         mgr.invalidate()
         before = mgr.stats["evicted"]
+        MUTATION_EPOCH.bump()  # past the query memo, to the device path
         assert q(e, "i", q3)[0] == 16
         assert len(mgr._views) == 3  # over budget, but no mid-query evict
         assert mgr.stats["evicted"] == before
+        MUTATION_EPOCH.bump()
         assert q(e, "i", q3)[0] == 16  # repeats stay staged: no thrash
         assert mgr.stats["evicted"] == before
         assert mgr.stats["stage"] == 6  # 3 initial + 3 after invalidate
