@@ -160,6 +160,78 @@ def _tree_count_call(words4, idx, hit, tree, num_leaves, interpret):
     return out[0, 0]
 
 
+def _tree_count_coarse_kernel(tree, num_leaves, starts_ref, *refs):
+    o_ref = refs[num_leaves]
+    s = pl.program_id(0)
+
+    @pl.when(s == 0)
+    def _init():
+        o_ref[0, 0] = jnp.int32(0)
+
+    def leaf(i):
+        blk = refs[i][0, 0, :, :]
+        keep = starts_ref[i, s] >= 0
+        return jnp.where(keep, blk, jnp.uint32(0))
+
+    o_ref[0, 0] += jnp.sum(
+        lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+
+
+def tree_count_pallas_coarse(words, starts, tree, *,
+                             interpret: bool = False):
+    """Fused popcount(eval_tree) over COARSE whole-row runs — ONE
+    pallas_call for ANY slice count (VERDICT r4 #2).
+
+    The general kernel above needs (L, S, 16) idx+hit prefetch tables;
+    at headline scale they overflow the 1 MB SMEM budget and force a
+    lax.scan of slab launches, each paying the dispatch floor — the
+    measured reason it lost to the XLA gather path (7.4 ms vs 5.1 ms on
+    the 960-slice Intersect+Count). When every leaf row is staged as
+    one contiguous 16-aligned container run (mesh.coarse_row_starts —
+    true for dense rows, which staging sorts and pads), the per-slice
+    address state collapses to ONE signed int per (leaf, slice): the
+    row-run index, negative where the slice holds no part of the row.
+    That is 1/48th the SMEM (4 bytes vs 2x16x4), so 3072 slices x 8
+    leaves still fits one launch with headroom, and each grid step
+    streams each leaf's whole 128 KB row run from HBM exactly once —
+    no gathered intermediate is ever written back (the XLA path's ~3x
+    traffic overhead, kernels.py header note).
+
+    words:  (S, cap, 2048) uint32 pool, cap % 16 == 0.
+    starts: (L, S) int32 signed row-run index (pos // 16, or any
+            negative where absent/masked out).
+    tree:   nested op list with numbered leaves (plan._tree_signature).
+
+    Returns the shard's total count as a scalar int32.
+    """
+    num_leaves, s_n = starts.shape
+    cap = words.shape[1]
+    assert cap % 16 == 0, cap
+    # One block = one whole row run: 16 containers x 2048 words viewed
+    # as a (256, 128) tile — minor dims satisfy the (8, 128) rule.
+    words5 = words.reshape(s_n, cap // 16, 16 * _SUBLANES, _LANES)
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (1, 1, 16 * _SUBLANES, _LANES),
+            lambda s, starts_ref, leaf=leaf: (
+                s, jnp.maximum(starts_ref[leaf, s], 0), 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n,),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    out = pl.pallas_call(
+        functools.partial(_tree_count_coarse_kernel, tree, num_leaves),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *([words5] * num_leaves))
+    return out[0, 0]
+
+
 def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):
     """Fused popcount(eval_tree) over one shard's container pool.
 

@@ -519,6 +519,14 @@ class HostMaterializePlan(HostCountPlan):
         # per container measured ~3x the whole fold at 96 slices.
         blocks = list(acc.reshape(-1, 1024))  # views minted at C speed
         counts_l = counts.tolist()
+        nz = np.flatnonzero(counts).tolist()
+        # Dense containers normally keep VIEWS into `acc` (zero-copy —
+        # the result Row collectively owns most of it anyway). But when
+        # only a sliver of the batch is nonzero, one retained container
+        # view would pin the WHOLE (S, 16384) allocation for the Row's
+        # lifetime; below a quarter occupancy, copy the referenced
+        # blocks and let the big buffer free.
+        copy_blocks = len(nz) * 4 < len(blocks)
         per_slice = counts.reshape(-1, 16).sum(axis=1).tolist()
         row = Row()
         segments = row.segments
@@ -526,7 +534,7 @@ class HostMaterializePlan(HostCountPlan):
         cnew, bnew = Container.__new__, RBitmap.__new__
         keys_append = containers_append = None
         cur_slice = -1
-        for idx in np.flatnonzero(counts).tolist():
+        for idx in nz:
             s_j = idx >> 4
             if s_j != cur_slice:
                 cur_slice = s_j
@@ -548,7 +556,8 @@ class HostMaterializePlan(HostCountPlan):
                 c.bitmap = None
             else:
                 c.array = None
-                c.bitmap = blocks[idx]
+                c.bitmap = blocks[idx].copy() if copy_blocks \
+                    else blocks[idx]
             keys_append(idx & 15)
             containers_append(c)
         return row

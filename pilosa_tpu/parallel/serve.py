@@ -865,7 +865,30 @@ class MeshManager:
                                         num_leaves))
 
     def _coarse_fn(self, sig: str, num_leaves: int, batch: int):
-        """Get-or-compile the coarse whole-row-gather program."""
+        """Get-or-compile the coarse whole-row-gather program.
+
+        Backend dispatch (the kernels.use_pallas analog at the serving
+        layer): PILOSA_TPU_COUNT_BACKEND=pallas routes SINGLE coarse
+        queries through the one-launch Pallas streaming kernel
+        (compile_serve_count_coarse_pallas — reads each leaf row once,
+        no gathered HBM intermediate); batches keep the XLA program
+        (the batched Pallas twin would take B*L block operands). Off
+        by default until hardware-validated: Pallas cannot compile
+        through the single-chip relay this rig benches on."""
+        import os
+
+        backend = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+        if batch == 1 and backend in ("pallas", "pallas_interpret"):
+            from .mesh import compile_serve_count_coarse_pallas
+
+            # The key carries the exact backend string: "pallas" and
+            # "pallas_interpret" compile different programs, and an
+            # env flip between them must not serve the other's.
+            return self._get_or_compile(
+                self._coarse_fns, (sig, num_leaves, batch, backend),
+                lambda: compile_serve_count_coarse_pallas(
+                    self.mesh, json.loads(sig), num_leaves,
+                    interpret=backend == "pallas_interpret"))
         return self._get_or_compile(
             self._coarse_fns, (sig, num_leaves, batch),
             lambda: compile_serve_count_coarse(self.mesh, json.loads(sig),

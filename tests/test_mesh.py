@@ -508,3 +508,70 @@ def test_spmd_rank_death_refuses_loudly():
     assert "first 4" in out0, outs
     assert ("refused" in out0) or outs[0][0] != 0, outs
     assert outs[1][0] == 17, outs  # the worker really died abruptly
+
+
+def test_serve_coarse_pallas_matches_xla(mesh, tmp_path, monkeypatch):
+    """One-launch coarse Pallas streaming count (VERDICT r4 #2) ==
+    XLA coarse gather program, end-to-end through the serving layer
+    (PILOSA_TPU_COUNT_BACKEND=pallas_interpret on the CPU mesh)."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pql import parse_string
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    f = h.create_index_if_not_exists("i").create_frame_if_not_exists("g")
+    # dense rows -> coarse-eligible staging (full 16-container runs)
+    for s in range(8):
+        for blk in range(16):
+            for b in (1, 5, 9):
+                f.set_bit(0, s * (1 << 20) + blk * 65536 + b)
+                f.set_bit(1, s * (1 << 20) + blk * 65536 + b + (s % 2))
+    host = Executor(h, use_device=False)
+    for pql in (
+        "Count(Intersect(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=1)))",
+        "Count(Union(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=1)))",
+        "Count(Difference(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=1)))",
+    ):
+        want = host.execute("i", parse_string(pql))[0]
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
+        ep = Executor(h, use_device=True, device_min_work=0)
+        got_p = ep.execute("i", parse_string(pql))[0]
+        assert ep.mesh_manager().stats["coarse"] >= 1, \
+            "query did not take the coarse path"
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "xla")
+        ex = Executor(h, use_device=True, device_min_work=0)
+        got_x = ex.execute("i", parse_string(pql))[0]
+        assert got_p == got_x == want, (pql, got_p, got_x, want)
+
+
+def test_tree_count_pallas_coarse_kernel_differential():
+    """Direct kernel differential: coarse one-launch Pallas vs numpy,
+    absent rows (negative starts) contributing zero."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pilosa_tpu.ops.kernels import tree_count_pallas_coarse
+
+    rng = np.random.default_rng(3)
+    S, R = 6, 4
+    words = rng.integers(0, 2**32, (S, R * 16, 2048), dtype=np.uint32)
+    starts = np.array([[0, 2, -1, 3, 1, -1],
+                       [1, -1, 0, 3, 2, 0],
+                       [2, 1, 1, -1, 0, 3]], dtype=np.int32)
+    for tree, f in (
+        (["and", ["leaf", 0], ["leaf", 1], ["leaf", 2]],
+         lambda a, b, c: a & b & c),
+        (["or", ["leaf", 0], ["andnot", ["leaf", 1], ["leaf", 2]]],
+         lambda a, b, c: a | (b & ~c)),
+    ):
+        got = int(tree_count_pallas_coarse(
+            jnp.asarray(words), jnp.asarray(starts), tree, interpret=True))
+        want = 0
+        for s in range(S):
+            blks = [np.zeros((16, 2048), np.uint32)
+                    if starts[l, s] < 0
+                    else words[s, starts[l, s] * 16:(starts[l, s] + 1) * 16]
+                    for l in range(3)]
+            want += int(np.bitwise_count(f(*blks)).sum())
+        assert got == want, tree
