@@ -1154,14 +1154,16 @@ def main():
     # traffic matters.
     try:
         agg: dict = {}
+        mesh_agg: dict = {}
         for ex_ in (v for n, v in list(locals().items())
                     if isinstance(v, Executor)):
             for k, val in ex_.host_cache_stats.items():
                 agg[k] = agg.get(k, 0) + int(val)
+            if ex_.device_stats is not None:
+                for k, val in ex_.device_stats.items():
+                    mesh_agg[k] = mesh_agg.get(k, 0) + int(val)
         details["diagnostics"]["host_cache"] = agg
-        if e.device_stats is not None:
-            details["diagnostics"]["mesh_stats"] = {
-                k: int(v) for k, v in e.device_stats.items()}
+        details["diagnostics"]["mesh_stats"] = mesh_agg
     except Exception:  # noqa: BLE001 — diagnostics must not kill the run
         pass
 

@@ -66,7 +66,7 @@ from ..errors import (
     PilosaError,
     QueryError,
 )
-from ..pql import Parser, ParseError
+from ..pql import Parser, ParseError, parse_string_cached
 from ..executor import ExecOptions
 from ..utils.stats import ExpvarStats
 from ..wire import (
@@ -442,6 +442,9 @@ class Handler:
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
         r("GET", r"/debug/pprof/heap", self._get_heap_profile)
         r("GET", r"/debug/pprof/allocs", self._get_heap_profile)
+        r("GET", r"/debug/pprof/(?P<kind>block|mutex)",
+          self._get_block_profile)
+        r("GET", r"/debug/pprof/trace", self._get_trace)
         r("GET", r"/debug/pprof/goroutine", self._get_thread_dump)
         r("GET", r"/debug/pprof/threadcreate", self._get_threadcreate)
         r("GET", r"/debug/pprof/cmdline", self._get_cmdline)
@@ -525,16 +528,52 @@ class Handler:
         ready for flamegraph.pl / speedscope. A sampler beats cProfile
         here: cProfile instruments only its own thread, while queries
         run on executor pool threads."""
-        import sys
-        import time as _time
         from collections import Counter
 
         seconds = min(float(params.get("seconds", "2") or 2), 30.0)
-        interval = 0.01
         stacks: Counter = Counter()
+        for _t, _name, parts in self._sample_stacks(seconds):
+            stacks[";".join(parts)] += 1
+        out = "".join(f"{stack} {n}\n" for stack, n in stacks.most_common())
+        return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
+                        out.encode())
+
+    # Frames that mean "this thread is waiting on synchronization, not
+    # running": the sampling block/mutex profiles classify a sample as
+    # waiting when any of its two innermost PYTHON frames matches (a
+    # raw C-level Lock.acquire leaves no Python frame of its own, but
+    # every composite wait — Condition.wait, Event.wait, queue.get,
+    # Thread.join, selectors — runs these stdlib frames).
+    _WAIT_FRAMES = frozenset((
+        "threading.py:wait", "threading.py:acquire", "threading.py:join",
+        "threading.py:_wait_for_tstate_lock", "queue.py:get",
+        "queue.py:put", "selectors.py:select", "socket.py:accept",
+        "socketserver.py:serve_forever"))
+    # The mutex restriction matches only DIRECT lock waits by their
+    # innermost Python frame (pure-Python RLock.acquire, Thread.join's
+    # tstate lock) — a Condition/Event/queue wait also passes through
+    # threading.py:wait, but classifying an idle queue consumer as
+    # lock contention would misdiagnose healthy blocking as a lock
+    # bottleneck, so composite waits belong to /block only. (A raw
+    # C-level Lock.acquire leaves no Python frame at all and is
+    # invisible to any Python sampler — documented limitation.)
+    _MUTEX_FRAMES = frozenset((
+        "threading.py:acquire", "threading.py:_wait_for_tstate_lock"))
+
+    def _sample_stacks(self, seconds: float, interval: float = 0.01):
+        """~1/interval Hz samples of every OTHER thread's stack:
+        (t_offset_s, thread_name, [frame, ...] outermost-first).
+        The shared engine under profile/block/mutex/trace."""
+        import sys
+        import time as _time
+
         me = threading.get_ident()
-        deadline = _time.monotonic() + seconds
+        samples = []
+        t0 = _time.monotonic()
+        deadline = t0 + seconds
         while _time.monotonic() < deadline:
+            names = {t.ident: t.name for t in threading.enumerate()}
+            now = _time.monotonic() - t0
             for tid, frame in list(sys._current_frames().items()):
                 if tid == me:
                     continue
@@ -545,11 +584,60 @@ class Handler:
                     parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
                                  f"{code.co_name}")
                     f = f.f_back
-                stacks[";".join(reversed(parts))] += 1
+                parts.reverse()
+                samples.append((now, names.get(tid, str(tid)), parts))
             _time.sleep(interval)
-        out = "".join(f"{stack} {n}\n" for stack, n in stacks.most_common())
+        return samples
+
+    def _get_block_profile(self, pv, params, headers, body) -> Response:
+        """Blocking profile — the reference serves Go's block/mutex
+        profiles here (net/http/pprof); the Python-runtime analog is a
+        sampling wait profile: stacks whose INNERMOST frame is a
+        synchronization wait (lock acquire, queue get, join, poll),
+        collapsed + counted over ?seconds=N. /debug/pprof/mutex serves
+        the same data restricted to lock acquires."""
+        seconds = min(float(params.get("seconds", "2") or 2), 30.0)
+        mutex_only = pv.get("kind") == "mutex"
+        from collections import Counter
+
+        waits: Counter = Counter()
+        total = 0
+        for _t, _name, parts in self._sample_stacks(seconds):
+            total += 1
+            if mutex_only:
+                # Direct lock waits only, by INNERMOST frame (see
+                # _MUTEX_FRAMES note): composite waits are /block's.
+                if parts[-1] not in self._MUTEX_FRAMES:
+                    continue
+            elif not any(p in self._WAIT_FRAMES for p in parts[-2:]):
+                continue
+            waits[";".join(parts)] += 1
+        out = [f"# sampling {'mutex' if mutex_only else 'block'} "
+               f"profile: {seconds}s, {total} thread-samples, "
+               f"{sum(waits.values())} in waits\n"]
+        out += [f"{stack} {n}\n" for stack, n in waits.most_common()]
         return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
-                        out.encode())
+                        "".join(out).encode())
+
+    def _get_trace(self, pv, params, headers, body) -> Response:
+        """Execution trace — the reference serves Go's runtime trace;
+        the analog here is a wall-clock timeline: per-thread stack
+        samples over ?seconds=N as chrome://tracing JSON
+        (trace_event format, load in Perfetto), one complete event per
+        sample with the innermost frame as the event name."""
+        import json as _json
+
+        seconds = min(float(params.get("seconds", "1") or 1), 30.0)
+        interval = 0.005
+        events = []
+        for t, name, parts in self._sample_stacks(seconds, interval):
+            events.append({
+                "name": parts[-1], "cat": "sample", "ph": "X",
+                "ts": int(t * 1e6), "dur": int(interval * 1e6),
+                "pid": 1, "tid": name,
+                "args": {"stack": ";".join(parts)}})
+        return Response(200, {"Content-Type": "application/json"},
+                        _json.dumps({"traceEvents": events}).encode())
 
     def _get_pprof(self, pv, params, headers, body) -> Response:
         """Profile index — the full pprof surface the reference mounts
@@ -563,6 +651,11 @@ class Handler:
             "  heap          tracemalloc top allocation sites + RSS "
             "(?gc=1 collects first)\n"
             "  allocs        alias of heap\n"
+            "  block         sampling wait profile (sync waits: locks, "
+            "queues, joins; ?seconds=N)\n"
+            "  mutex         block, restricted to lock acquires\n"
+            "  trace         wall-clock timeline as chrome trace JSON "
+            "(?seconds=N; open in Perfetto)\n"
             "  goroutine     per-thread stack dump\n"
             "  threadcreate  live thread table\n"
             "  cmdline       process command line\n\n")
@@ -834,7 +927,11 @@ class Handler:
             remote = False
 
         try:
-            q = Parser(query).parse()
+            # Parsed-query LRU (pql.parse_string_cached): repeat PQL
+            # texts skip the ~100 us parse, which dominates a
+            # memo-served Count. The shared Query is immutable by
+            # convention (see the cache's docstring).
+            q = parse_string_cached(query)
             t0 = time.monotonic()
             results = self.executor.execute(
                 index, q, slices or None, ExecOptions(remote=remote))

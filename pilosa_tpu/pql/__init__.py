@@ -7,7 +7,7 @@ what travels to remote nodes (reference executor.go:1000-1083).
 """
 
 from .ast import Call, Query
-from .parser import ParseError, Parser, parse_string
+from .parser import ParseError, Parser, parse_string, parse_string_cached
 from .scanner import Scanner, Token
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "ParseError",
     "Parser",
     "parse_string",
+    "parse_string_cached",
     "Scanner",
     "Token",
 ]

@@ -6,6 +6,8 @@ detected by IDENT+LPAREN lookahead; duplicate argument keys are errors.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Optional
 
 from .ast import Call, Query
@@ -162,3 +164,33 @@ class Parser:
 
 def parse_string(src: str) -> Query:
     return Parser(src).parse()
+
+
+# Parsed-query cache for the serving path: parsing costs ~100 µs of
+# Python while a memoized Count executes in ~10 µs, so re-parsing per
+# HTTP request dominates repeat-query latency. Safe to share because
+# parsed Calls are immutable after parse by convention (the one
+# arg-editing site, the executor's TopN phase 2, edits a fresh
+# clone() — the same convention Call.cache_key's memo relies on).
+# Bounded LRU; high-cardinality write streams (unique literals per
+# request) churn the tail without growing it.
+_PARSE_CACHE: "OrderedDict[str, Query]" = OrderedDict()
+_PARSE_MU = threading.Lock()
+_PARSE_MAX = 1024
+
+
+def parse_string_cached(src: str) -> Query:
+    """parse_string through a bounded LRU keyed on the exact source
+    text. Callers must treat the returned Query as immutable."""
+    with _PARSE_MU:
+        q = _PARSE_CACHE.get(src)
+        if q is not None:
+            _PARSE_CACHE.move_to_end(src)
+            return q
+    q = Parser(src).parse()
+    with _PARSE_MU:
+        _PARSE_CACHE[src] = q
+        _PARSE_CACHE.move_to_end(src)
+        while len(_PARSE_CACHE) > _PARSE_MAX:
+            _PARSE_CACHE.popitem(last=False)
+    return q

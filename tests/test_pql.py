@@ -179,3 +179,34 @@ class TestParserFuzz:
             once = str(Parser(s).parse())
             twice = str(Parser(once).parse())
             assert once == twice, s
+
+
+class TestParseCache:
+    def test_repeat_returns_shared_parse(self):
+        from pilosa_tpu.pql import parse_string, parse_string_cached
+        from pilosa_tpu.pql.parser import _PARSE_CACHE
+
+        src = "Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))"
+        a = parse_string_cached(src)
+        b = parse_string_cached(src)
+        assert a is b  # the whole point: no re-parse
+        # and it parses to the same thing a fresh parse does
+        assert str(a.calls[0]) == str(parse_string(src).calls[0])
+
+    def test_parse_errors_are_not_cached(self):
+        import pytest
+
+        from pilosa_tpu.pql import ParseError, parse_string_cached
+
+        with pytest.raises(ParseError):
+            parse_string_cached("Count(")
+        with pytest.raises(ParseError):
+            parse_string_cached("Count(")
+
+    def test_bound(self):
+        from pilosa_tpu.pql import parse_string_cached
+        from pilosa_tpu.pql import parser as P
+
+        for i in range(P._PARSE_MAX + 50):
+            parse_string_cached(f"Bitmap(rowID={i})")
+        assert len(P._PARSE_CACHE) <= P._PARSE_MAX
