@@ -22,7 +22,7 @@ if PILOSA_TPU_RUN_BUDGET=2400 timeout 2600 python bench.py \
         >BENCH_TPU_headline.json 2>bench_tpu.log; then
     cat BENCH_TPU_headline.json
     echo "== snapshot =="
-    cp BENCH_DETAILS.json BENCH_TPU_r4_snapshot.json
+    cp BENCH_DETAILS.json BENCH_TPU_r5_snapshot.json
 else
     echo "bench FAILED (rc=$?) — no snapshot taken"
     tail -20 bench_tpu.log
