@@ -690,8 +690,7 @@ class SpmdServer:
             timestamps = [
                 datetime.fromtimestamp(t, timezone.utc).replace(tzinfo=None)
                 if t != _TS_NONE else None for t in ts_raw]
-        f.import_bits([int(r) for r in rows], [int(c) for c in cols],
-                      timestamps)
+        f.import_bits(rows, cols, timestamps)
 
     def _execute_schema(self, desc: dict) -> None:
         """SCHEMA: unmarshal the wire message and apply it through the
