@@ -448,7 +448,16 @@ def main():
     _progress(f"headline: building {num_slices}-slice {head_rows}-row "
               "dense holder")
     h = build_dense_holder(tmp, num_slices, num_rows=head_rows)
-    e = Executor(h, use_device=True)
+    # Every executor the sections build, for the end-of-run cache
+    # diagnostics: an explicit registry (locals() introspection
+    # would double-count any aliased name and hide breakage).
+    all_executors = []
+
+    def _reg(ex_):
+        all_executors.append(ex_)
+        return ex_
+
+    e = _reg(Executor(h, use_device=True))
     pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
 
     # Staging (snapshot + pack + H2D) timed SEPARATELY from the first
@@ -676,7 +685,7 @@ def main():
         _progress("write-then-count")
         wt_slices = 240 if on_tpu else 24
         hw = build_dense_holder(tmp, wt_slices, num_rows=2, seed=17)
-        ew = Executor(hw, use_device=True)
+        ew = _reg(Executor(hw, use_device=True))
         mgrw = ew.mesh_manager()
         tree01 = parse_string(pql).calls[0].children[0]
         leaves01 = []
@@ -894,7 +903,7 @@ def main():
         # (the cost model serves these from host kernels; VERDICT r2 item 2).
         _progress("nary single slice")
         h8 = build_dense_holder(tmp, 1, num_rows=8, seed=11)
-        e8 = Executor(h8, use_device=True)
+        e8 = _reg(Executor(h8, use_device=True))
         fr8 = h8.fragment("i", "general", "standard", 0)
         rows8 = [np.concatenate([c.words() for c in
                                  fr8.storage.containers[r * 16:(r + 1) * 16]])
@@ -947,8 +956,8 @@ def main():
         # -- config 3: TopN(n=100), realistic mixed containers -------------------
         _progress(f"topn: building mixed holder ({topn_rows} rows)")
         hm = build_mixed_holder(tmp, topn_slices, topn_rows)
-        em = Executor(hm, use_device=True)
-        hostm = Executor(hm, use_device=False)
+        em = _reg(Executor(hm, use_device=True))
+        hostm = _reg(Executor(hm, use_device=False))
         topn_q = parse_string("TopN(frame=general, n=100)")
         dev_pairs = em.execute("i", topn_q)[0]
         mgrm = em.mesh_manager()
@@ -1035,7 +1044,7 @@ def main():
         _progress("sparse intersect")
         sparse_slices = min(num_slices, 240)
         hs = build_sparse_holder(tmp, sparse_slices)
-        es = Executor(hs, use_device=True)
+        es = _reg(Executor(hs, use_device=True))
         first, calls_ = serve_count_call(
             es, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
             list(range(sparse_slices)))
@@ -1074,7 +1083,7 @@ def main():
         # raw-kernel floor under the roaring bookkeeping.
         _progress("materializing intersect")
         mat_q = parse_string("Intersect(Bitmap(rowID=0), Bitmap(rowID=1))")
-        host_e = Executor(h, use_device=False)
+        host_e = _reg(Executor(h, use_device=False))
         row_mat = host_e.execute("i", mat_q)[0]
         assert row_mat.count() == host_count
         # best-of like every other section: each materialization
@@ -1101,7 +1110,7 @@ def main():
             _progress("scale: building 3072-slice holder (~3.2B cols)")
             big_slices = 3072
             hb = build_dense_holder(tmp, big_slices, num_rows=2, seed=31)
-            eb = Executor(hb, use_device=True)
+            eb = _reg(Executor(hb, use_device=True))
             t0 = time.perf_counter()
             first, callb = serve_count_call(
                 eb, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
@@ -1152,20 +1161,16 @@ def main():
     # Executor owns its own HostQueryCache, and the routed/materialize
     # sections (e8, em, host_e, ...) are exactly the ones whose memo
     # traffic matters.
-    try:
-        agg: dict = {}
-        mesh_agg: dict = {}
-        for ex_ in (v for n, v in list(locals().items())
-                    if isinstance(v, Executor)):
-            for k, val in ex_.host_cache_stats.items():
-                agg[k] = agg.get(k, 0) + int(val)
-            if ex_.device_stats is not None:
-                for k, val in ex_.device_stats.items():
-                    mesh_agg[k] = mesh_agg.get(k, 0) + int(val)
-        details["diagnostics"]["host_cache"] = agg
-        details["diagnostics"]["mesh_stats"] = mesh_agg
-    except Exception:  # noqa: BLE001 — diagnostics must not kill the run
-        pass
+    agg: dict = {}
+    mesh_agg: dict = {}
+    for ex_ in all_executors:
+        for k, val in ex_.host_cache_stats.items():
+            agg[k] = agg.get(k, 0) + int(val)
+        if ex_.device_stats is not None:
+            for k, val in ex_.device_stats.items():
+                mesh_agg[k] = mesh_agg.get(k, 0) + int(val)
+    details["diagnostics"]["host_cache"] = agg
+    details["diagnostics"]["mesh_stats"] = mesh_agg
 
     flush_details()
     # ONE JSON line on stdout: the emit gate makes normal completion

@@ -191,11 +191,18 @@ def tree_count_pallas_coarse(words, starts, tree, *,
     true for dense rows, which staging sorts and pads), the per-slice
     address state collapses to ONE signed int per (leaf, slice): the
     row-run index, negative where the slice holds no part of the row.
-    That is 1/48th the SMEM (4 bytes vs 2x16x4), so 3072 slices x 8
-    leaves still fits one launch with headroom, and each grid step
+    That is 1/48th the SMEM (4 bytes vs 2x16x4), so even a 3072-slice
+    x 8-leaf TABLE fits one launch with headroom, and each grid step
     streams each leaf's whole 128 KB row run from HBM exactly once —
     no gathered intermediate is ever written back (the XLA path's ~3x
     traffic overhead, kernels.py header note).
+
+    Count range: the scalar accumulator is int32, exact to 2^31-1 set
+    bits per SHARD (~2048 fully-dense slices) — the same bound as the
+    general kernel above and the XLA mesh path. >2^31-bit shards are
+    the SERVING layer's regime, whose programs split per-slice counts
+    into 16-bit limbs before the psum (compile_serve_count*,
+    combine_limbs) precisely for that.
 
     words:  (S, cap, 2048) uint32 pool, cap % 16 == 0.
     starts: (L, S) int32 signed row-run index (pos // 16, or any

@@ -458,22 +458,31 @@ class MeshManager:
         # with a small lag.
         sv.last_stage_s = None
 
-        def on_done(elapsed, ok=True, sv=sv):
-            if not ok:
-                # The transfer FAILED: elapsed is time-to-exception,
-                # which for a fast abort is near zero — recording it
-                # raw would read as "staging is free" and steer the
-                # gate into a restage storm against an unhealthy
-                # device. Clamp to no less than the view's incremental
-                # estimate so the gate degrades to the cheap path
-                # (incremental) while the probe stays armed.
-                floor = sv.inc_ewma_s
-                if floor is not None:
-                    elapsed = max(elapsed, floor)
-            sv.last_stage_s = elapsed
-
-        self._measure_async(sv.sharded.words, t0, on_done)
+        self._measure_async(
+            sv.sharded.words, t0,
+            lambda elapsed, ok=True, sv=sv:
+                self._record_stage_sample(sv, elapsed, ok))
         return sv
+
+    def _record_stage_sample(self, sv: StagedView, elapsed: float,
+                             ok: bool) -> None:
+        """Store a stage-cost measurement on the view. A FAILED fetch
+        (ok=False) reports time-to-exception, which for a fast abort is
+        near zero — recording it raw would read as "staging is free"
+        and steer the gate into a restage storm against an unhealthy
+        device. Clamp to no less than the view's incremental estimate
+        so the gate degrades to the cheap path (incremental) while the
+        probe stays armed; a COLD view (no incremental estimate yet)
+        clamps to the fixed pessimistic floor instead — without it the
+        raw near-zero sample would arm the probe after microseconds of
+        incremental spend and fire a restage at the device that just
+        failed."""
+        if not ok:
+            floor = sv.inc_ewma_s
+            elapsed = max(elapsed,
+                          floor if floor is not None
+                          else self._FAILED_STAGE_FLOOR_S)
+        sv.last_stage_s = elapsed
 
     def _measure_async(self, words, t0: float, on_done) -> None:
         """Enqueue a device-completion cost measurement: the worker
@@ -711,6 +720,13 @@ class MeshManager:
                     self._view_bytes(v) for v in self._views.values())
 
     # -- completed-result memo (device rank-cache analog) ----------------------
+
+    # Pessimistic stage-cost floor recorded when a COLD view's stage
+    # measurement fails (no incremental estimate to clamp to yet):
+    # "staging looks very expensive" is the safe lie — the gate stays
+    # on incremental and the probe can't fire until real spend
+    # justifies re-trying the device that just failed.
+    _FAILED_STAGE_FLOOR_S = 60.0
 
     # Deterministic-gate restage period: in SPMD mode a view restages
     # after this many incremental applies (bounds capacity creep from

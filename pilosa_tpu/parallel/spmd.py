@@ -396,17 +396,20 @@ class SpmdServer:
 
         log = logging.getLogger("pilosa_tpu.spmd")
         while True:
+            # The COLLECTIVE runs outside any catch: a distributed-
+            # runtime error (dead coordinator, heartbeat loss — even
+            # one raised as ValueError inside jax) must propagate and
+            # end this worker loudly, never hot-spin re-entering a
+            # failing collective.
+            raw = self._broadcast_raw(None)
             try:
-                desc = self._broadcast(None)
+                desc = _decode(raw)
             except (ValueError, KeyError) as e:  # corrupt descriptor
                 # broadcast_one_to_all hands EVERY rank the same bytes,
                 # so a payload that fails to DECODE fails identically
                 # everywhere — all ranks log and stay aligned for the
                 # next descriptor rather than one rank leaving the loop
-                # and wedging every later collective. Only the decode
-                # contract is caught: a distributed-runtime error (dead
-                # coordinator, heartbeat loss) must still propagate and
-                # end this worker loudly, not spin it hot forever.
+                # and wedging every later collective.
                 log.warning("spmd worker: undecodable descriptor: %s", e)
                 continue
             if desc["op"] == _OP_STOP:
@@ -434,13 +437,18 @@ class SpmdServer:
             return self._execute_import(desc)
         raise ValueError(f"unknown descriptor op: {op}")
 
-    def _broadcast(self, desc: Optional[dict]) -> dict:
+    def _broadcast_raw(self, desc: Optional[dict]) -> np.ndarray:
+        """The collective half alone — callers that must distinguish a
+        transport failure (propagate, die loudly) from a decode failure
+        (symmetric, survivable) run the two halves separately."""
         from jax.experimental import multihost_utils
 
         payload = _encode(desc) if desc is not None else np.zeros(
             _DESC_BYTES, dtype=np.uint8)
-        out = multihost_utils.broadcast_one_to_all(payload)
-        return _decode(out)
+        return multihost_utils.broadcast_one_to_all(payload)
+
+    def _broadcast(self, desc: Optional[dict]) -> dict:
+        return _decode(self._broadcast_raw(desc))
 
     # -- descriptor execution (symmetric on every rank) ----------------------
 

@@ -66,11 +66,20 @@ class Call:
         self.__dict__["_ckey"] = k
         return k
 
+    @staticmethod
+    def _typed(v):
+        """Value wrapped with its concrete type: Python equality makes
+        1 == 1.0 == True, but Count(rowID=1) and Count(rowID=1.0) are
+        DIFFERENT queries (the latter must raise in uint_arg) — a
+        type-blind key would let one serve the other from a cache."""
+        if isinstance(v, (list, tuple)):
+            return tuple(Call._typed(x) for x in v)
+        return (type(v).__name__, v)
+
     def _cache_key_uncached(self):
         try:
             args = tuple(sorted(
-                (k, tuple(v) if isinstance(v, list) else v)
-                for k, v in self.args.items()))
+                (k, self._typed(v)) for k, v in self.args.items()))
             hash(args)  # nested unhashables must decline HERE, not
             #             explode later inside a cache's dict probe
             kids = tuple(c.cache_key() for c in self.children)

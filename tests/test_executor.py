@@ -643,3 +643,34 @@ class TestFusedMaterialize:
         row = q(e, "i", "Range(rowID=1, frame=general, "
                 "start='2017-01-02T00:00', end='2017-01-05T00:00')")[0]
         assert sorted(row) == [3, 9, SLICE_WIDTH + 4]
+
+
+class TestCacheKeyTypeSafety:
+    def test_float_row_id_raises_even_after_int_memoized(self, holder):
+        """1 == 1.0 == True in Python, but Count(rowID=1.0) must raise
+        (uint_arg) even when Count(rowID=1) was just memoized — the
+        cache key carries value TYPES."""
+        seed(holder, bits=[(1, 5), (1, 9)])
+        e = Executor(holder, use_device=True, device_min_work=10**9)
+        assert q(e, "i", "Count(Bitmap(rowID=1))")[0] == 2
+        assert q(e, "i", "Count(Bitmap(rowID=1))")[0] == 2  # memoized
+        from pilosa_tpu.pql import Query
+        from pilosa_tpu.pql.ast import Call
+
+        float_q = Query(calls=[Call(name="Count", children=[
+            Call(name="Bitmap", args={"rowID": 1.0})])])
+        with pytest.raises(TypeError):
+            e.execute("i", float_q)
+        bool_q = Query(calls=[Call(name="Count", children=[
+            Call(name="Bitmap", args={"rowID": True})])])
+        with pytest.raises(TypeError):
+            e.execute("i", bool_q)
+
+    def test_typed_keys_distinguish(self):
+        from pilosa_tpu.pql.ast import Call
+
+        a = Call(name="Bitmap", args={"rowID": 1})
+        b = Call(name="Bitmap", args={"rowID": 1.0})
+        c = Call(name="Bitmap", args={"rowID": True})
+        keys = {a.cache_key(), b.cache_key(), c.cache_key()}
+        assert len(keys) == 3

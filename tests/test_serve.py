@@ -1643,3 +1643,59 @@ class TestRefreshCostGate:
         # sample recorded, flagged as a failure (ok=False) so callbacks
         # treat it as time-to-exception, not a cost
         assert got and got[0][0] >= 0.0 and got[0][1] is False
+
+
+class TestFailedStageClamp:
+    def test_cold_view_failed_stage_records_pessimistic_floor(self,
+                                                              tmp_path):
+        """A COLD view whose stage measurement fails must not record a
+        near-zero stage cost (that would arm the restage probe after
+        microseconds of incremental spend and hammer a failing
+        device): with no incremental estimate yet, the sample clamps
+        to the fixed pessimistic floor."""
+        import time as _t
+
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.parallel.serve import MeshManager
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        f = h.create_index_if_not_exists("i").create_frame_if_not_exists("g")
+        f.set_bit(1, 3)
+        mgr = MeshManager(h)
+        sv = mgr.refresh("i", "g", "standard", 1)
+        sv.sharded.words.block_until_ready()
+        for _ in range(100):
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
+        # simulate the measurement worker reporting a FAILED fetch on a
+        # cold view (no inc_ewma_s): re-stage bookkeeping
+        sv.last_stage_s = None
+        sv.inc_ewma_s = None
+
+        class Boom:
+            def block_until_ready(self):
+                raise RuntimeError("device lost")
+
+        # the REAL recording path, driven through the measure worker
+        def on_done(elapsed, ok=True):
+            mgr._record_stage_sample(sv, elapsed, ok)
+
+        mgr._measure_async(Boom(), _t.monotonic(), on_done)
+        for _ in range(200):
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
+        assert sv.last_stage_s is not None
+        assert sv.last_stage_s >= mgr._FAILED_STAGE_FLOOR_S
+        # with a warm incremental estimate, the clamp uses it instead
+        sv.last_stage_s = None
+        sv.inc_ewma_s = 0.25
+        mgr._measure_async(Boom(), _t.monotonic(), on_done)
+        for _ in range(200):
+            if sv.last_stage_s is not None:
+                break
+            _t.sleep(0.01)
+        assert sv.last_stage_s is not None
+        assert 0.25 <= sv.last_stage_s < mgr._FAILED_STAGE_FLOOR_S
