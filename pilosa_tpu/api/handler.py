@@ -66,7 +66,7 @@ from ..errors import (
     PilosaError,
     QueryError,
 )
-from ..pql import Parser, ParseError, parse_string_cached
+from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
 from ..utils.stats import ExpvarStats
 from ..wire import (

@@ -160,21 +160,54 @@ def _tree_count_call(words4, idx, hit, tree, num_leaves, interpret):
     return out[0, 0]
 
 
-def _tree_count_coarse_kernel(tree, num_leaves, starts_ref, *refs):
+def _coarse_count_kernel(tree, num_leaves, starts_ref, *refs):
     o_ref = refs[num_leaves]
     s = pl.program_id(0)
-
-    @pl.when(s == 0)
-    def _init():
-        o_ref[0, 0] = jnp.int32(0)
 
     def leaf(i):
         blk = refs[i][0, 0, :, :]
         keep = starts_ref[i, s] >= 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
-    o_ref[0, 0] += jnp.sum(
+    o_ref[0, s] = jnp.sum(
         lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+
+
+def coarse_count_per_slice(views, starts, tree, *,
+                           interpret: bool = False):
+    """ONE pallas_call producing per-slice coarse counts.
+
+    The shared engine under both coarse count surfaces — the
+    mesh-level scalar kernel below and the serving-layer program
+    (mesh.compile_serve_count_coarse_pallas), which differ only in
+    whether leaves share one pool and how the per-slice counts are
+    reduced (scalar sum vs 16-bit limb psum).
+
+    views:  tuple per leaf of (S, R_i, 16*16, 128) uint32 row-run
+            views (each leaf may have its own pool/capacity).
+    starts: (L, S) int32 signed row-run index; negative = absent or
+            masked out (the block is read clipped and zeroed).
+    Returns (1, S) int32 per-slice counts (each <= 2^20, exact)."""
+    num_leaves, s_n = starts.shape
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (1, 1, 16 * _SUBLANES, _LANES),
+            lambda s, starts_ref, leaf=leaf: (
+                s, jnp.maximum(starts_ref[leaf, s], 0), 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n,),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_coarse_count_kernel, tree, num_leaves),
+        out_shape=jax.ShapeDtypeStruct((1, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *views)
 
 
 def tree_count_pallas_coarse(words, starts, tree, *,
@@ -217,26 +250,9 @@ def tree_count_pallas_coarse(words, starts, tree, *,
     # One block = one whole row run: 16 containers x 2048 words viewed
     # as a (256, 128) tile — minor dims satisfy the (8, 128) rule.
     words5 = words.reshape(s_n, cap // 16, 16 * _SUBLANES, _LANES)
-
-    def leaf_spec(leaf):
-        return pl.BlockSpec(
-            (1, 1, 16 * _SUBLANES, _LANES),
-            lambda s, starts_ref, leaf=leaf: (
-                s, jnp.maximum(starts_ref[leaf, s], 0), 0, 0))
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(s_n,),
-        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-    )
-    out = pl.pallas_call(
-        functools.partial(_tree_count_coarse_kernel, tree, num_leaves),
-        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(starts, *([words5] * num_leaves))
-    return out[0, 0]
+    per_slice = coarse_count_per_slice(
+        (words5,) * num_leaves, starts, tree, interpret=interpret)
+    return per_slice.sum(dtype=jnp.int32)
 
 
 def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):

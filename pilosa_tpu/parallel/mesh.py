@@ -775,25 +775,10 @@ def compile_serve_count_coarse_pallas(mesh: Mesh, tree_shape,
     (PILOSA_TPU_COUNT_BACKEND=pallas opts in): Pallas cannot compile
     through the single-chip relay this rig benches on; differential
     coverage runs in interpret mode on the CPU mesh."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    from ..ops.bitops import fold_tree as _fold
+    from ..ops.kernels import coarse_count_per_slice
 
     sig = json.dumps(_tree_signature(tree_shape))
     tree = json.loads(sig)
-
-    def kernel(starts_ref, *refs):
-        o_ref = refs[num_leaves]
-        s = pl.program_id(0)
-
-        def leaf(i):
-            blk = refs[i][0, 0, :, :]
-            keep = starts_ref[i, s] >= 0
-            return jnp.where(keep, blk, jnp.uint32(0))
-
-        o_ref[0, s] = jnp.sum(
-            lax.population_count(_fold(tree, leaf)).astype(jnp.int32))
 
     def per_shard(words_t, start_flat, valid_flat, mask):
         s_l = words_t[0].shape[0]
@@ -803,31 +788,11 @@ def compile_serve_count_coarse_pallas(mesh: Mesh, tree_shape,
             jnp.where((valid_flat[i] != 0) & (mask != 0),
                       start_flat[i], jnp.int32(-1))
             for i in range(num_leaves)])
-        views = []
-        for i in range(num_leaves):
-            w = words_t[i]
-            cap = w.shape[1]
-            views.append(w.reshape(s_l, cap // ROW_SPAN,
-                                   ROW_SPAN * 16, 128))
-
-        def leaf_spec(leaf):
-            return pl.BlockSpec(
-                (1, 1, ROW_SPAN * 16, 128),
-                lambda s, starts_ref, leaf=leaf: (
-                    s, jnp.maximum(starts_ref[leaf, s], 0), 0, 0))
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(s_l,),
-            in_specs=[leaf_spec(i) for i in range(num_leaves)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-        )
-        per_slice = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((1, s_l), jnp.int32),
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(starts, *views)[0].astype(jnp.uint32)
+        views = tuple(
+            w.reshape(s_l, w.shape[1] // ROW_SPAN, ROW_SPAN * 16, 128)
+            for w in words_t)
+        per_slice = coarse_count_per_slice(
+            views, starts, tree, interpret=interpret)[0].astype(jnp.uint32)
         lo = lax.psum(
             (per_slice & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
             SLICE_AXIS)
