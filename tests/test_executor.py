@@ -674,3 +674,50 @@ class TestCacheKeyTypeSafety:
         c = Call(name="Bitmap", args={"rowID": True})
         keys = {a.cache_key(), b.cache_key(), c.cache_key()}
         assert len(keys) == 3
+
+
+class TestMemoConcurrency:
+    def test_concurrent_reads_writes_converge_exact(self, holder):
+        """Racing readers (query memo + parse cache hot) against a
+        writer: no exceptions, every observed count is sane (monotone
+        under a set-only writer), and the final quiesced count is
+        exact. The host-layer analog of the dryrun's fault-evict-race
+        surface."""
+        import threading
+
+        seed(holder, bits=[(1, c) for c in range(8)])
+        e = Executor(holder, use_device=False)
+        f = holder.frame("i", "general")
+        errors = []
+        stop = threading.Event()
+
+        def writer():
+            c = 100
+            while not stop.is_set():
+                f.set_bit(1, c)
+                c += 1
+
+        def reader():
+            from pilosa_tpu.pql import parse_string_cached
+
+            try:
+                last = 0
+                for _ in range(300):
+                    q_ = parse_string_cached("Count(Bitmap(rowID=1))")
+                    n = e.execute("i", q_)[0]
+                    assert n >= last >= 0, (n, last)
+                    last = n
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        wt = threading.Thread(target=writer)
+        rs = [threading.Thread(target=reader) for _ in range(3)]
+        wt.start()
+        [r.start() for r in rs]
+        [r.join() for r in rs]
+        stop.set()
+        wt.join()
+        assert not errors, errors
+        want = holder.fragment("i", "general", "standard", 0).row(1).count()
+        assert e.execute(
+            "i", parse_string("Count(Bitmap(rowID=1))"))[0] == want
