@@ -686,27 +686,34 @@ class TestMemoConcurrency:
         import threading
 
         seed(holder, bits=[(1, c) for c in range(8)])
-        e = Executor(holder, use_device=False)
+        e = make_executor(holder)
         f = holder.frame("i", "general")
         errors = []
         stop = threading.Event()
 
         def writer():
-            c = 100
-            while not stop.is_set():
-                f.set_bit(1, c)
-                c += 1
+            try:
+                c = 100
+                while not stop.is_set():
+                    f.set_bit(1, c)
+                    c += 1
+            except Exception as err:  # noqa: BLE001 — a dying writer
+                #                       must FAIL the test, not
+                #                       silently quiesce the race
+                errors.append(err)
 
         def reader():
             from pilosa_tpu.pql import parse_string_cached
 
             try:
-                last = 0
                 for _ in range(300):
                     q_ = parse_string_cached("Count(Bitmap(rowID=1))")
                     n = e.execute("i", q_)[0]
-                    assert n >= last >= 0, (n, last)
-                    last = n
+                    # The memo's contract is epoch-consistency, not
+                    # real-time monotonicity (a delayed query_put can
+                    # briefly re-serve an older epoch-valid count), so
+                    # assert only sanity bounds per observation.
+                    assert n >= 8, n
             except Exception as err:  # noqa: BLE001
                 errors.append(err)
 
@@ -719,5 +726,6 @@ class TestMemoConcurrency:
         wt.join()
         assert not errors, errors
         want = holder.fragment("i", "general", "standard", 0).row(1).count()
+        assert want > 8  # the writer really made progress
         assert e.execute(
             "i", parse_string("Count(Bitmap(rowID=1))"))[0] == want
