@@ -48,7 +48,16 @@ class APIServer:
 
             do_GET = do_POST = do_DELETE = do_PATCH = _dispatch
 
-        self._server = ThreadingHTTPServer((host, port), _Request)
+        # A herd of concurrent clients opening fresh connections (the
+        # reference serves via Go's net/http, whose listener rides the
+        # kernel SOMAXCONN backlog) overflows Python's default backlog
+        # of 5 and the kernel RSTs the overflow — observed as
+        # ConnectionResetError at 50+ simultaneous connects. Raise the
+        # accept backlog before bind (class attr: bind happens in
+        # __init__).
+        srv_cls = type("_PilosaHTTPServer", (ThreadingHTTPServer,),
+                       {"request_queue_size": 128})
+        self._server = srv_cls((host, port), _Request)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -295,8 +295,15 @@ class MeshManager:
         self._mask_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
         # Dispatched-but-unfetched batches (see _fetch_loop); maxsize is
-        # the readback pipeline depth.
-        self._fetch_q: "queue.Queue" = queue.Queue(maxsize=2)
+        # the readback pipeline depth — one slot per fetch worker plus
+        # a small buffer so the batch loop keeps dispatching while all
+        # workers sit inside a completion wait. The pool size is read
+        # ONCE here and reused by _ensure_batch_thread, so the queue
+        # bound and the worker count cannot disagree if the env changes
+        # between construction and first query.
+        self._fetch_pool_n = self._fetch_threads()
+        self._fetch_q: "queue.Queue" = queue.Queue(
+            maxsize=self._fetch_pool_n + 2)
         self._batch_thread: Optional[threading.Thread] = None
         # In-flight row-count executions shared by identical concurrent
         # callers: key -> [done_event, result, error]. Own tiny lock —
@@ -346,7 +353,7 @@ class MeshManager:
             "memo_hit": 0, "memo_store": 0, "memo_size": 0,
             "idx_cache_hit": 0, "idx_cache_miss": 0,
             "mask_cache_hit": 0, "mask_cache_miss": 0,
-            "routed_host": 0, "shared_batch": 0,
+            "routed_host": 0, "shared_batch": 0, "fetch_threads": 0,
         }
 
     @property
@@ -1042,6 +1049,28 @@ class MeshManager:
     # with the unroll, and 16 already amortizes the dispatch floor ~10x.
     _MAX_BATCH = 16
 
+    @staticmethod
+    def _fetch_threads() -> int:
+        """Readback worker count (PILOSA_TPU_FETCH_THREADS env, default
+        8). Measured on the r5 TPU relay (tools/probe_r5.py readback):
+        a result fetch costs one ~70 ms completion-notification period
+        REGARDLESS of which thread fetches or how long the program ran,
+        but N CONCURRENT fetches overlap almost perfectly (8 fetches
+        complete in ~64 ms total, not 8 x 70). One fetch worker
+        therefore serializes every batch behind a full period — the
+        r3/r5 concurrent-collapse (43.7 / 36.5 QPS against a 570+ QPS
+        device rate) was exactly this — while a small pool makes
+        fragmented herd groups nearly free. The workers only block in
+        the PJRT client (GIL released), so the pool costs nothing on a
+        1-core host."""
+        import os
+
+        try:
+            n = int(os.environ.get("PILOSA_TPU_FETCH_THREADS", "8"))
+        except ValueError:
+            n = 8
+        return max(1, n)
+
     def _ensure_batch_thread(self):
         if self._batch_thread is None:
             with self._mu:
@@ -1050,20 +1079,28 @@ class MeshManager:
                                          name="mesh-count-batch", daemon=True)
                     t.start()
                     self._batch_thread = t
-                    f = threading.Thread(target=self._fetch_loop,
-                                         name="mesh-count-fetch", daemon=True)
-                    f.start()
+                    for i in range(self._fetch_pool_n):
+                        f = threading.Thread(
+                            target=self._fetch_loop,
+                            name=f"mesh-count-fetch-{i}", daemon=True)
+                        f.start()
+                    self.stats["fetch_threads"] = self._fetch_pool_n
 
     def _fetch_loop(self):
         """Materialize dispatched batches' results and wake waiters.
         Decoupled from the batch loop so the per-batch host readback
-        (a ~67 ms completion-poll cadence through this rig's TPU relay)
-        overlaps the NEXT batch's dispatch and device execution —
-        without it the device idles for a full readback between
-        batches. The fetch queue's bound (maxsize) is the pipeline
-        depth: the batch loop blocks once that many batches await
-        readback, so a flood of clients can't queue unbounded device
-        work."""
+        (a ~70 ms completion-notification period through this rig's
+        TPU relay) overlaps the NEXT batch's dispatch and device
+        execution — without it the device idles for a full readback
+        between batches. SEVERAL workers run this loop: concurrent
+        fetches overlap on the relay (see _fetch_threads), so distinct
+        groups' readbacks ride the same notification period instead of
+        queueing behind one another. Each finish() is self-contained
+        (its own group's results + events), so completion order across
+        workers doesn't matter. The fetch queue's bound (maxsize) is
+        the pipeline depth: the batch loop blocks once that many
+        batches await readback, so a flood of clients can't queue
+        unbounded device work."""
         while True:
             finish = self._fetch_q.get()
             try:
@@ -1075,9 +1112,11 @@ class MeshManager:
     def _drain_window_s() -> float:
         """Herd drain window (PILOSA_TPU_BATCH_WINDOW_MS env, default
         3 ms): how long the batch loop waits for stragglers when the
-        PREVIOUS group showed concurrency. Priced against the ~67 ms
-        per-batch readback poll through the TPU relay: a 3 ms wait that
-        merges two half batches saves a whole poll."""
+        PREVIOUS group showed concurrency. With the fetch pool
+        overlapping readbacks, a merged group saves one program
+        dispatch (~2.5 ms relay floor) plus the extra group's padded
+        device time — the 3 ms wait is priced at about that dispatch
+        floor."""
         import os
 
         try:
@@ -1093,11 +1132,12 @@ class MeshManager:
         when the previous drain coalesced multiple requests — a
         concurrent-client herd mid-wake, whose members arrive spread
         over a few GIL-staggered milliseconds — the loop waits a short
-        drain window for stragglers: each extra batch costs a full
-        readback poll (~67 ms through the relay), so fragmenting a herd
-        of 16 into 4x4 quadruples the fetch bill (r3 measured 43.7 QPS
-        at 16 clients against a demonstrated 574 QPS device rate for
-        exactly this reason)."""
+        drain window for stragglers. Since the fetch POOL overlaps
+        concurrent groups' readbacks (see _fetch_threads), a fragmented
+        herd no longer serializes whole ~70 ms notification periods;
+        what fragmentation still costs is one extra program dispatch
+        (~2.5 ms floor) plus padded-width device time per extra group,
+        which the 3 ms window remains correctly priced against."""
         last_group = 1
         while True:
             first = self._batch_q.get()
@@ -1173,10 +1213,19 @@ class MeshManager:
         else:
             sig, words_t, _, _, dev_mask = group[0].args
             num_leaves = len(group[0].args[2])
-            from ..ops.pool import mutation_batch_width
-
-            b_pad = min(mutation_batch_width(b, min_batch=2),
-                        self._MAX_BATCH)
+            # ONE batch width per shape: every multi-request group runs
+            # the _MAX_BATCH-wide program, padded with repeats of the
+            # last request. Sizing the pad to the group (the old
+            # mutation_batch_width policy) meant a 16-client herd that
+            # fragmented into 13+3 compiled TWO programs — and each
+            # first-seen width paid a multi-second XLA compile ON THE
+            # BATCH THREAD, stalling the pipeline, fragmenting the next
+            # herd into yet more odd widths (measured: one width-8
+            # compile inside a closed-loop run blocked dispatch 1.2 s
+            # and halved the run's throughput). The padding's device
+            # cost is a few ms of extra gathers, hidden under the
+            # ~70 ms readback period the fetch pool is already paying.
+            b_pad = self._MAX_BATCH
             padded = group + [group[-1]] * (b_pad - b)
             if coarse_ok:
                 shared = None
@@ -1223,9 +1272,21 @@ class MeshManager:
                 limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
             self.stats["batched"] += b
 
+        # Start the D2H copy NOW: by the time the completion
+        # notification lands (~70 ms period on the relay; microseconds
+        # attached), the bytes are already host-side and the worker's
+        # np.asarray is a memcpy, not a second round-trip (measured:
+        # asarray after copy_to_host_async + settled notification is
+        # 0.15 ms vs 73 ms for a cold fetch — tools/probe_r5.py).
+        try:
+            limbs.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path only
+            pass
+
         # Dispatch done (async device handle in `limbs`); the FETCH —
-        # a full readback-poll through the relay — happens on the
-        # fetcher thread so the next batch's dispatch overlaps it.
+        # a full readback-poll through the relay — happens on a
+        # fetcher-pool worker so the next batch's dispatch overlaps it
+        # and concurrent groups' readbacks overlap each other.
         # (Direct callers — tests, no batch thread running — finish
         # synchronously below.)
         def finish():

@@ -1,0 +1,237 @@
+"""Round-5 on-chip probes (single-lease chip; run one subcommand at a
+time). Each answers one question the r5 TPU bench raised:
+
+  stage    — which device_put PLACEMENT path is slow? The bench's
+             refresh staged 1 GB in ~110 s while profile_stage's plain
+             jax.device_put of the same bytes took ~1 s. Suspects: the
+             explicit-device put + make_array_from_single_device_arrays
+             path build_sharded_index uses for meshes vs the default
+             put; warm-vs-cold; sharding-annotated put.
+  readback — why does the executor path cost ~99 ms/query when the
+             direct serving call costs ~8.9 ms? Both fetch; the
+             difference is WHICH THREAD fetches (batcher hands the
+             np.asarray to a fetch thread). Measures same-thread vs
+             cross-thread fetch and an is_ready()-poll-then-fetch
+             pattern against the relay's completion-poll cadence.
+  pallas   — does a trivial pallas_call compile through the relay at
+             all this round? (r3/r4: hung; run under timeout.)
+
+Writes PROBE_R5_<name>.json to the repo root.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLICES = int(os.environ.get("PROBE_SLICES", "240"))
+CAP = 128
+
+
+def _pool():
+    rng = np.random.default_rng(11)
+    # Same shape/dtype/layout as the bench's packed pool (C-contiguous).
+    return rng.integers(0, 2**32, size=(SLICES, CAP, 2048),
+                        dtype=np.uint64).astype(np.uint32)
+
+
+def _write(name, out):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), f"PROBE_R5_{name}.json")
+    with open(path, "w") as f:
+        json.dump({k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in out.items()}, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+
+
+def stage():
+    import jax
+
+    out = {"backend": jax.default_backend(), "slices": SLICES}
+    words = _pool()
+    gb = words.nbytes / 1e9
+    out["pool_gb"] = gb
+    dev0 = jax.devices()[0]
+
+    def timed(tag, fn):
+        t0 = time.perf_counter()
+        arr = fn()
+        arr.block_until_ready()
+        dt = time.perf_counter() - t0
+        out[f"{tag}_s"] = dt
+        out[f"{tag}_gbps"] = gb / dt
+        del arr
+
+    # A: default placement (what profile_stage measured at ~1 GB/s)
+    timed("put_default_cold", lambda: jax.device_put(words))
+    timed("put_default_warm", lambda: jax.device_put(words))
+    # B: explicit device (what build_sharded_index's per-device loop does)
+    timed("put_device", lambda: jax.device_put(words, dev0))
+    # C: explicit SingleDeviceSharding
+    from jax.sharding import SingleDeviceSharding
+    timed("put_sds", lambda: jax.device_put(words, SingleDeviceSharding(dev0)))
+    # D: the full mesh path: per-device put + assemble
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:1]), ("slice",))
+    sharding = NamedSharding(mesh, P("slice"))
+
+    def mesh_path():
+        shard = jax.device_put(words, dev0)
+        return jax.make_array_from_single_device_arrays(
+            words.shape, sharding, [shard])
+
+    timed("put_mesh_assemble", mesh_path)
+    # E: sharding-annotated put (single call, global)
+    timed("put_named_sharding", lambda: jax.device_put(words, sharding))
+    _write("stage", out)
+
+
+def readback():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    out = {"backend": jax.default_backend(), "slices": SLICES}
+    words = _pool()
+    x = jax.device_put(words)
+    x.block_until_ready()
+
+    @jax.jit
+    def f(w, salt):
+        pc = lax.population_count(w ^ salt).sum(axis=(1, 2),
+                                                dtype=jnp.uint32)
+        lo = (pc & jnp.uint32(0xFFFF)).astype(jnp.int32).sum()
+        hi = (pc >> 16).astype(jnp.int32).sum()
+        return jnp.stack([lo, hi])
+
+    np.asarray(f(x, jnp.uint32(0)))  # compile
+
+    def run(salt):
+        return f(x, jnp.uint32(salt))
+
+    n = 12
+
+    # 1: dispatch + same-thread asarray
+    t0 = time.perf_counter()
+    for i in range(n):
+        np.asarray(run(i + 1))
+    out["same_thread_ms"] = (time.perf_counter() - t0) / n * 1e3
+
+    # 2: dispatch + same-thread block_until_ready then asarray
+    t0 = time.perf_counter()
+    for i in range(n):
+        r = run(100 + i)
+        r.block_until_ready()
+        np.asarray(r)
+    out["block_then_fetch_ms"] = (time.perf_counter() - t0) / n * 1e3
+
+    # 3: dispatch on main, fetch on a worker thread (the batcher's
+    # fetch-loop shape)
+    def cross_once(salt):
+        r = run(salt)
+        box = {}
+
+        def fetch():
+            box["v"] = np.asarray(r)
+
+        th = threading.Thread(target=fetch)
+        t0 = time.perf_counter()
+        th.start()
+        th.join()
+        return time.perf_counter() - t0
+
+    cross_once(200)
+    dts = [cross_once(201 + i) for i in range(n)]
+    out["cross_thread_ms"] = sum(dts) / n * 1e3
+
+    # 4: persistent fetch thread via queue (exactly the serve.py shape)
+    import queue
+
+    q: "queue.Queue" = queue.Queue()
+    done: "queue.Queue" = queue.Queue()
+
+    def loop():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            done.put(np.asarray(item))
+
+    th = threading.Thread(target=loop, daemon=True)
+    th.start()
+    t0 = time.perf_counter()
+    for i in range(n):
+        q.put(run(300 + i))
+        done.get()
+    out["fetch_thread_ms"] = (time.perf_counter() - t0) / n * 1e3
+
+    # 5: pipelined: all dispatches up-front, fetch thread drains
+    t0 = time.perf_counter()
+    for i in range(n):
+        q.put(run(400 + i))
+    for _ in range(n):
+        done.get()
+    out["pipelined_fetch_ms"] = (time.perf_counter() - t0) / n * 1e3
+    q.put(None)
+
+    # 6: is_ready poll (0.2 ms sleep) then fetch, same thread
+    def poll_fetch(salt):
+        r = run(salt)
+        while not r.is_ready():
+            time.sleep(2e-4)
+        return np.asarray(r)
+
+    poll_fetch(500)
+    t0 = time.perf_counter()
+    for i in range(n):
+        poll_fetch(501 + i)
+    out["poll_then_fetch_ms"] = (time.perf_counter() - t0) / n * 1e3
+
+    _write("readback", out)
+
+
+def pallas():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out = {"backend": jax.default_backend()}
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] + 1
+
+    x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+    t0 = time.perf_counter()
+    y = pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(x)
+    got = np.asarray(y)
+    out["trivial_compile_s"] = time.perf_counter() - t0
+    out["correct"] = bool((got == np.arange(8 * 128).reshape(8, 128) + 1
+                           ).all())
+
+    # The real coarse kernel at a small shape.
+    from pilosa_tpu.ops.kernels import tree_count_pallas_coarse
+
+    rng = np.random.default_rng(3)
+    words = jnp.asarray(rng.integers(0, 2**32, size=(8, 32, 2048),
+                                     dtype=np.uint64).astype(np.uint32))
+    starts = jnp.asarray(np.array([[0] * 8, [1] * 8], dtype=np.int32))
+    t0 = time.perf_counter()
+    n = int(tree_count_pallas_coarse(
+        words, starts, ["and", ["leaf", 0], ["leaf", 1]]))
+    out["coarse_small_compile_s"] = time.perf_counter() - t0
+    w = np.asarray(words)
+    want = int(np.bitwise_count(
+        w[:, 0:16].astype(np.uint64) & w[:, 16:32].astype(np.uint64)).sum())
+    out["coarse_small_correct"] = bool(n == want)
+    _write("pallas", out)
+
+
+if __name__ == "__main__":
+    {"stage": stage, "readback": readback, "pallas": pallas}[sys.argv[1]]()
