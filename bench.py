@@ -323,6 +323,50 @@ def main():
                   [sys.executable, os.path.abspath(__file__)],
                   _cpu_reexec_env())
 
+    # -- Pallas availability probe (r5) --------------------------------------
+    # The coarse Pallas kernels beat the XLA gather programs on-chip
+    # (PROFILE_RELAY.md §4: 1.14-1.25x single query, and the shared
+    # batch kernel 857 vs 689 QPS over the plain batch at headline
+    # scale), but the r3/r4 relay HUNG any pallas compile — so the
+    # serving default stays XLA and the bench opts in only after
+    # proving a trivial kernel compiles, under its own watchdog: a
+    # hang re-execs this process with pallas pinned off.
+    if on_tpu and os.environ.get("PILOSA_TPU_COUNT_BACKEND") is None:
+        mode = os.environ.get("PILOSA_TPU_PALLAS", "probe")
+        if mode == "on":
+            os.environ["PILOSA_TPU_COUNT_BACKEND"] = "pallas"
+        elif mode == "probe":
+            pallas_done = threading.Event()
+
+            def pallas_watchdog():
+                if not pallas_done.wait(float(os.environ.get(
+                        "PILOSA_TPU_PALLAS_TIMEOUT", "120"))):
+                    _progress("pallas probe hung; re-running with "
+                              "pallas off")
+                    os.execve(sys.executable,
+                              [sys.executable, os.path.abspath(__file__)],
+                              dict(os.environ, PILOSA_TPU_PALLAS="off"))
+
+            threading.Thread(target=pallas_watchdog, daemon=True).start()
+            try:
+                from jax.experimental import pallas as pl
+
+                def _pk(x_ref, o_ref):
+                    o_ref[:] = x_ref[:] + 1
+
+                _pout = pl.pallas_call(
+                    _pk,
+                    out_shape=jax.ShapeDtypeStruct((8, 128), _jnp.int32))(
+                    _jnp.zeros((8, 128), _jnp.int32))
+                pallas_ok = bool((np.asarray(_pout) == 1).all())
+            except Exception as pe:  # noqa: BLE001 — any failure: xla
+                _progress(f"pallas probe failed ({pe}); staying on xla")
+                pallas_ok = False
+            pallas_done.set()
+            if pallas_ok:
+                os.environ["PILOSA_TPU_COUNT_BACKEND"] = "pallas"
+                _progress("pallas probe OK; count backend = pallas")
+
     # -- run budget + headline checkpoint (VERDICT r3 #1) --------------------
     # The headline config runs FIRST and its result is checkpointed the
     # moment it exists; if the relay stalls later in the run, the
@@ -442,7 +486,8 @@ def main():
         # the GIL, so threads scale across cores).
         "host_baseline": "ops/native.py C++ kernels "
                          "(assembly stand-in; no Go toolchain)",
-        "host_cores": ncores}
+        "host_cores": ncores,
+        "count_backend": os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")}
 
     # -- headline (config 5): 1B-column Intersect+Count through serving ------
     _progress(f"headline: building {num_slices}-slice {head_rows}-row "
@@ -640,8 +685,6 @@ def main():
         # PILOSA_TPU_BATCH_SHARED). Bytes scale with unique leaves:
         # ~1 GB/batch instead of ~7 GB.
         _progress("headline: shared-read batch (28 pairs, 8 unique rows)")
-        from pilosa_tpu.parallel.mesh import compile_serve_count_batch_shared
-
         uniq_rows = sorted(set(x for p in pairs for x in p))
         coarse_by_row = {}
         with mgr._mu:
@@ -651,8 +694,13 @@ def main():
         assert all(c is not None for c in coarse_by_row.values())
         leaf_map = tuple((uniq_rows.index(a), uniq_rows.index(b))
                          for a, b in pairs)
-        fns = compile_serve_count_batch_shared(mgr.mesh, json.loads(sig),
-                                               leaf_map, len(uniq_rows))
+        # Build on the backend the env selects (the pallas probe above
+        # flips it when the relay can compile pallas): the grid kernel
+        # measured 857 vs 689 (plain) vs 382 (XLA scan) QPS on-chip.
+        shared_backend = mgr._count_backend()
+        fns = mgr._build_shared(sig, leaf_map, len(uniq_rows),
+                                shared_backend)
+        details["mapreduce_count"]["shared_backend"] = shared_backend
         sh_args = (tuple(words_t[0] for _ in uniq_rows),
                    tuple(coarse_by_row[r_][0] for r_ in uniq_rows),
                    tuple(coarse_by_row[r_][1] for r_ in uniq_rows), dmask)

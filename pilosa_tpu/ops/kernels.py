@@ -20,7 +20,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .bitops import BINARY_OPS, count_pair, fold_tree
-from .pool import CONTAINER_WORDS
+from .pool import CONTAINER_WORDS, ROW_SPAN
 
 # Rows of 2048-word containers processed per grid step (512 KB/input block).
 _BLOCK_M = 64
@@ -165,7 +165,7 @@ def _coarse_count_kernel(tree, num_leaves, starts_ref, *refs):
     s = pl.program_id(0)
 
     def leaf(i):
-        blk = refs[i][0, 0, :, :]
+        blk = refs[i][0, :, :]
         keep = starts_ref[i, s] >= 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
@@ -183,8 +183,16 @@ def coarse_count_per_slice(views, starts, tree, *,
     whether leaves share one pool and how the per-slice counts are
     reduced (scalar sum vs 16-bit limb psum).
 
-    views:  tuple per leaf of (S, R_i, 16*16, 128) uint32 row-run
-            views (each leaf may have its own pool/capacity).
+    views:  tuple per leaf of the NATIVE (S, cap_i, 2048) uint32 pool
+            (cap_i % 16 == 0; leaves may share one pool object). A
+            whole-row run is the (1, 16, 2048) block at row-run index
+            starts[leaf, s] — 16 sublanes x 2048 lanes satisfies the
+            (8k, 128k) tiling rule DIRECTLY, so no reshape of the pool
+            is needed. (The previous (S, cap/16, 256, 128) view was
+            NOT a bitcast: splitting the 2048-lane rows retiles the
+            physical T(8,128) layout, and XLA materialized a whole
+            POOL-SIZED copy per kernel operand — 960 MB per leaf at
+            headline scale, OOM at batch width 16.)
     starts: (L, S) int32 signed row-run index; negative = absent or
             masked out (the block is read clipped and zeroed).
     Returns (1, S) int32 per-slice counts (each <= 2^20, exact)."""
@@ -192,9 +200,9 @@ def coarse_count_per_slice(views, starts, tree, *,
 
     def leaf_spec(leaf):
         return pl.BlockSpec(
-            (1, 1, 16 * _SUBLANES, _LANES),
+            (1, ROW_SPAN, 16 * _LANES),
             lambda s, starts_ref, leaf=leaf: (
-                s, jnp.maximum(starts_ref[leaf, s], 0), 0, 0))
+                s, jnp.maximum(starts_ref[leaf, s], 0), 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -205,6 +213,143 @@ def coarse_count_per_slice(views, starts, tree, *,
     return pl.pallas_call(
         functools.partial(_coarse_count_kernel, tree, num_leaves),
         out_shape=jax.ShapeDtypeStruct((1, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *views)
+
+
+def _identity_batch_kernel(tree, num_leaves, starts_ref, *refs):
+    o_ref = refs[num_leaves]
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+
+    def leaf(i):
+        blk = refs[i][0, :, :]
+        keep = starts_ref[b * num_leaves + i, s] >= 0
+        return jnp.where(keep, blk, jnp.uint32(0))
+
+    o_ref[b, s] = jnp.sum(
+        lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+
+
+def coarse_count_identity_batch(pools, starts, tree, *,
+                                interpret: bool = False):
+    """ONE pallas_call producing per-(query, slice) counts for a PLAIN
+    (no leaf sharing assumed) coarse batch — grid (B, S), each step
+    computing one query's fold for one slice from the L leaf-position
+    pools.
+
+    Why not the shared-read kernel with an identity leaf map: a B*L
+    operand list repeating one pool makes the AOT compiler budget HBM
+    for EVERY alias (arguments: 30 GB at batch 16 over the 1 GB
+    headline pool — a compile-time OOM even though the runtime buffers
+    alias). Here the operand list is the L DISTINCT leaf-position
+    pools — the same worst-case accounting the XLA batch programs
+    already pay — and the (b, s) grid picks each slot's row-run via
+    the scalar-prefetched starts table. Traffic matches the plain XLA
+    batch (each query reads its own rows) minus the gathered-copy
+    amplification, and ONE compile serves every width-B herd of this
+    tree shape regardless of which rows the queries name.
+
+    pools:  tuple per LEAF POSITION of the NATIVE (S, cap_l, 2048)
+            uint32 pool (cap_l % 16 == 0).
+    starts: (B*L, S) int32 signed row-run indices, slot-major
+            (slot = b*L + l); negative = absent or masked out.
+    tree:   nested op list with numbered leaf POSITIONS.
+
+    Returns (B, S) int32 per-(query, slice) counts."""
+    slots, s_n = starts.shape
+    num_leaves = len(pools)
+    batch = slots // num_leaves
+    assert batch * num_leaves == slots, (slots, num_leaves)
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (1, ROW_SPAN, 16 * _LANES),
+            lambda b, s, starts_ref, leaf=leaf: (
+                s, jnp.maximum(starts_ref[b * num_leaves + leaf, s], 0), 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, s_n),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_identity_batch_kernel, tree, num_leaves),
+        out_shape=jax.ShapeDtypeStruct((batch, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *pools)
+
+
+def _coarse_batch_kernel(tree, leaf_map, num_unique, starts_ref, *refs):
+    o_ref = refs[num_unique]
+    s = pl.program_id(0)
+    blocks = []
+    for u in range(num_unique):
+        blk = refs[u][0, :, :]
+        keep = starts_ref[u, s] >= 0
+        blocks.append(jnp.where(keep, blk, jnp.uint32(0)))
+    for b, lm in enumerate(leaf_map):
+        cnt = jnp.sum(lax.population_count(
+            fold_tree(tree, lambda i, lm=lm: blocks[lm[i]])
+        ).astype(jnp.int32))
+        o_ref[b, s] = cnt
+
+
+def coarse_count_batch_per_slice(views, starts, tree, leaf_map, *,
+                                 interpret: bool = False):
+    """ONE pallas_call producing per-(query, slice) counts for a
+    SHARED-READ coarse batch: B queries of one tree shape over U
+    unique whole-row leaves.
+
+    The device analog of the reference's per-fragment row cache
+    serving many queries from one materialized row (fragment.go:
+    332-367 + BitmapCache) — same sharing the XLA scan program
+    (mesh.compile_serve_count_batch_shared) expresses, but as a
+    PIPELINED GRID instead of a lax.scan: the scan's 960 sequential
+    steps of tiny compute are latency-bound on real hardware (r5 TPU:
+    the XLA shared program LOST to the plain batch, 353 vs 569 QPS),
+    while a grid step's DMA prefetch overlaps the previous step's
+    compute. Each step streams the U unique 128 KB row runs HBM->VMEM
+    exactly once (U * 128 KB resident, e.g. 1 MB for the headline's 8
+    rows) and computes all B folds from VMEM, so HBM traffic scales
+    with UNIQUE leaves — the 28-pair headline reads 8 rows/slice, not
+    56 — and no gathered intermediate is ever written back.
+
+    views:    tuple per UNIQUE leaf of the NATIVE (S, cap_u, 2048)
+              uint32 pool (cap_u % 16 == 0; leaves may share one pool
+              object — see coarse_count_per_slice on why the native
+              shape, not a (256, 128) view, is load-bearing).
+    starts:   (U, S) int32 signed row-run index; negative = absent or
+              masked out (block read clipped and zeroed).
+    tree:     nested op list with numbered leaf POSITIONS
+              (plan._tree_signature).
+    leaf_map: STATIC tuple per query: leaf position -> unique index.
+
+    Returns (B, S) int32 per-(query, slice) counts (each <= 2^20).
+    SMEM budget: the (B, S) output + (U, S) prefetch table — at the
+     28-query/960-slice headline that is ~215 KB, well inside the
+    1 MB/core the general kernel's tables overflowed."""
+    num_unique, s_n = starts.shape
+
+    def leaf_spec(u):
+        return pl.BlockSpec(
+            (1, ROW_SPAN, 16 * _LANES),
+            lambda s, starts_ref, u=u: (
+                s, jnp.maximum(starts_ref[u, s], 0), 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n,),
+        in_specs=[leaf_spec(u) for u in range(num_unique)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_coarse_batch_kernel, tree, tuple(leaf_map),
+                          num_unique),
+        out_shape=jax.ShapeDtypeStruct((len(leaf_map), s_n), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(starts, *views)
@@ -247,11 +392,10 @@ def tree_count_pallas_coarse(words, starts, tree, *,
     num_leaves, s_n = starts.shape
     cap = words.shape[1]
     assert cap % 16 == 0, cap
-    # One block = one whole row run: 16 containers x 2048 words viewed
-    # as a (256, 128) tile — minor dims satisfy the (8, 128) rule.
-    words5 = words.reshape(s_n, cap // 16, 16 * _SUBLANES, _LANES)
+    # The pool streams in its NATIVE shape — one block = one whole row
+    # run, the (1, 16, 2048) tile at row-run index starts[l, s].
     per_slice = coarse_count_per_slice(
-        (words5,) * num_leaves, starts, tree, interpret=interpret)
+        (words,) * num_leaves, starts, tree, interpret=interpret)
     return per_slice.sum(dtype=jnp.int32)
 
 

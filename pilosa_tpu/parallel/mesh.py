@@ -781,18 +781,15 @@ def compile_serve_count_coarse_pallas(mesh: Mesh, tree_shape,
     tree = json.loads(sig)
 
     def per_shard(words_t, start_flat, valid_flat, mask):
-        s_l = words_t[0].shape[0]
         # Fold validity AND slice ownership into the sign: the kernel
         # masks blocks by `start >= 0` alone.
         starts = jnp.stack([
             jnp.where((valid_flat[i] != 0) & (mask != 0),
                       start_flat[i], jnp.int32(-1))
             for i in range(num_leaves)])
-        views = tuple(
-            w.reshape(s_l, w.shape[1] // ROW_SPAN, ROW_SPAN * 16, 128)
-            for w in words_t)
         per_slice = coarse_count_per_slice(
-            views, starts, tree, interpret=interpret)[0].astype(jnp.uint32)
+            tuple(words_t), starts, tree,
+            interpret=interpret)[0].astype(jnp.uint32)
         lo = lax.psum(
             (per_slice & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
             SLICE_AXIS)
@@ -909,6 +906,120 @@ def compile_serve_count_batch_shared(mesh: Mesh, tree_shape,
                   (P(SLICE_AXIS),) * num_unique,
                   P(SLICE_AXIS)),
         out_specs=P(),
+    )
+
+    @jax.jit
+    def run(words_t, start_t, valid_t, mask):
+        return fn(words_t, start_t, valid_t, mask)
+
+    return run
+
+
+def compile_serve_count_coarse_pallas_batch(mesh: Mesh, tree_shape,
+                                            num_leaves: int, batch: int,
+                                            interpret: bool = False):
+    """Pallas twin of compile_serve_count_coarse for batch > 1 — the
+    plain (no leaf sharing assumed) herd-group program. Same call
+    contract: fn(words_t (L,), start_flat (B*L,) of (S,) int32,
+    valid_flat (B*L,) of (S,) uint32, mask (S,)) -> (2, B).
+
+    One compile serves every ad-hoc width-B herd of this tree shape
+    (the shared machinery's per-composition maps would recompile per
+    herd): the (b, s) grid picks each slot's row-run from the
+    scalar-prefetched starts table, so which rows the queries name is
+    DATA, not program. Sharing saves no reads here, but the grid
+    kernel still skips the XLA batch program's gathered HBM
+    intermediates and pipelines per-slice DMA under the B folds, which
+    is where the plain XLA batch spends its time at herd widths."""
+    from ..ops.kernels import coarse_count_identity_batch
+
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    slots = batch * num_leaves
+
+    def per_shard(words_t, start_flat, valid_flat, mask):
+        starts = jnp.stack([
+            jnp.where((valid_flat[k] != 0) & (mask != 0),
+                      start_flat[k], jnp.int32(-1))
+            for k in range(slots)])
+        per_bs = coarse_count_identity_batch(
+            tuple(words_t), starts, tree,
+            interpret=interpret).astype(jnp.uint32)      # (B, S_l)
+        lo = lax.psum(
+            (per_bs & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
+            SLICE_AXIS)
+        hi = lax.psum((per_bs >> 16).astype(jnp.int32).sum(axis=1),
+                      SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_leaves,
+                  (P(SLICE_AXIS),) * slots,
+                  (P(SLICE_AXIS),) * slots,
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+        # pallas_call can't annotate how its output varies over mesh
+        # axes, which the VMA checker requires.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(words_t, start_flat, valid_flat, mask):
+        return fn(tuple(words_t), tuple(start_flat), tuple(valid_flat),
+                  mask)
+
+    return run
+
+
+def compile_serve_count_batch_shared_pallas(mesh: Mesh, tree_shape,
+                                            leaf_map, num_unique: int,
+                                            interpret: bool = False):
+    """Pallas twin of compile_serve_count_batch_shared: identical call
+    contract — fn(words_t (U,), start_t (U,) of (S,) int32, valid_t
+    (U,) of (S,) uint32, mask (S,)) -> (2, B) limb columns — but the
+    shared-read fold runs as ONE pallas_call per shard
+    (ops.kernels.coarse_count_batch_per_slice). The XLA program's
+    lax.scan walks slices SEQUENTIALLY, each step doing microseconds
+    of compute behind an optimization_barrier; on the r5 chip that
+    latency-bound loop measured SLOWER than the plain per-query batch
+    (353 vs 569 QPS) even though it moves 7x less HBM traffic. The
+    pallas grid keeps the traffic win and pipelines the per-slice DMA
+    under compute. Selected by PILOSA_TPU_COUNT_BACKEND=pallas
+    (serve.MeshManager._shared_* machinery; key carries the backend)."""
+    from ..ops.kernels import coarse_count_batch_per_slice
+
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    leaf_map = tuple(tuple(m) for m in leaf_map)
+
+    def per_shard(words_t, start_t, valid_t, mask):
+        starts = jnp.stack([
+            jnp.where((valid_t[u] != 0) & (mask != 0),
+                      start_t[u], jnp.int32(-1))
+            for u in range(num_unique)])
+        per_bs = coarse_count_batch_per_slice(
+            tuple(words_t), starts, tree, leaf_map,
+            interpret=interpret).astype(jnp.uint32)      # (B, S_l)
+        lo = lax.psum(
+            (per_bs & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
+            SLICE_AXIS)
+        hi = lax.psum((per_bs >> 16).astype(jnp.int32).sum(axis=1),
+                      SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_unique,
+                  (P(SLICE_AXIS),) * num_unique,
+                  (P(SLICE_AXIS),) * num_unique,
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+        # pallas_call can't annotate how its output varies over mesh
+        # axes, which the VMA checker requires.
+        check_vma=False,
     )
 
     @jax.jit

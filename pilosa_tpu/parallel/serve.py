@@ -887,31 +887,59 @@ class MeshManager:
             lambda: compile_serve_count(self.mesh, json.loads(sig),
                                         num_leaves))
 
+    @staticmethod
+    def _count_backend() -> str:
+        """PILOSA_TPU_COUNT_BACKEND: "xla" (default), "pallas", or
+        "pallas_interpret" (CPU test path). r5 hardware measurements
+        (PROFILE_RELAY.md §4): with the pools streamed in native shape
+        the coarse Pallas kernels beat the XLA gather programs 1.7-2.7x
+        single-query, 2.2x at herd width 16, and 5.2x on the 28-pair
+        shared batch. The default stays XLA because a relay regression
+        re-introducing the r3/r4 Pallas-compile hang would wedge a
+        server at first query; bench.py probes Pallas IN-PROCESS under
+        a watchdog that re-execs the bench with pallas pinned off on a
+        hang (in-process state is lost; the decision rides the re-exec
+        env), and opts in when the probe passes — deployments on
+        attached TPUs should set pallas outright."""
+        import os
+
+        v = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+        return v if v in ("pallas", "pallas_interpret") else "xla"
+
     def _coarse_fn(self, sig: str, num_leaves: int, batch: int):
         """Get-or-compile the coarse whole-row-gather program.
 
         Backend dispatch (the kernels.use_pallas analog at the serving
-        layer): PILOSA_TPU_COUNT_BACKEND=pallas routes SINGLE coarse
+        layer): PILOSA_TPU_COUNT_BACKEND=pallas routes single coarse
         queries through the one-launch Pallas streaming kernel
-        (compile_serve_count_coarse_pallas — reads each leaf row once,
-        no gathered HBM intermediate); batches keep the XLA program
-        (the batched Pallas twin would take B*L block operands). Off
-        by default until hardware-validated: Pallas cannot compile
-        through the single-chip relay this rig benches on."""
-        import os
-
-        backend = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
-        if batch == 1 and backend in ("pallas", "pallas_interpret"):
-            from .mesh import compile_serve_count_coarse_pallas
-
+        (compile_serve_count_coarse_pallas) and herd groups through
+        the identity-map grid kernel
+        (compile_serve_count_coarse_pallas_batch) — both read each
+        leaf row HBM->VMEM once with no gathered intermediate. True
+        leaf-sharing compositions additionally upgrade to the shared
+        program (_shared_compile_*)."""
+        backend = self._count_backend()
+        if backend in ("pallas", "pallas_interpret"):
+            interpret = backend == "pallas_interpret"
             # The key carries the exact backend string: "pallas" and
             # "pallas_interpret" compile different programs, and an
             # env flip between them must not serve the other's.
+            key = (sig, num_leaves, batch, backend)
+            if batch == 1:
+                from .mesh import compile_serve_count_coarse_pallas
+
+                return self._get_or_compile(
+                    self._coarse_fns, key,
+                    lambda: compile_serve_count_coarse_pallas(
+                        self.mesh, json.loads(sig), num_leaves,
+                        interpret=interpret))
+            from .mesh import compile_serve_count_coarse_pallas_batch
+
             return self._get_or_compile(
-                self._coarse_fns, (sig, num_leaves, batch, backend),
-                lambda: compile_serve_count_coarse_pallas(
-                    self.mesh, json.loads(sig), num_leaves,
-                    interpret=backend == "pallas_interpret"))
+                self._coarse_fns, key,
+                lambda: compile_serve_count_coarse_pallas_batch(
+                    self.mesh, json.loads(sig), num_leaves, batch,
+                    interpret=interpret))
         return self._get_or_compile(
             self._coarse_fns, (sig, num_leaves, batch),
             lambda: compile_serve_count_coarse(self.mesh, json.loads(sig),
@@ -959,8 +987,34 @@ class MeshManager:
         total_slots = sum(len(m) for m in leaf_map)
         if len(uniques) >= total_slots:
             return None  # nothing shared: plain batch reads the same
+        # AOT compile accounting bills EVERY operand as its own buffer
+        # even when all U uniques alias one staged pool ("arguments:
+        # U x pool bytes" — observed as a compile-time HBM rejection at
+        # 30 GB for 32 aliases of the 1 GB headline pool). Skip the
+        # shared upgrade when the aliased-argument bill would crowd a
+        # 16 GB chip (PILOSA_TPU_SHARED_ARG_BUDGET_MB, default 11264);
+        # the plain batch program (L operands) serves instead. The
+        # 28-pair/8-row headline composition bills ~8 GB and passes.
+        import os
+
+        try:
+            arg_budget = int(os.environ.get(
+                "PILOSA_TPU_SHARED_ARG_BUDGET_MB", "11264")) << 20
+        except ValueError:
+            arg_budget = 11264 << 20
+        # Arguments shard over the slice axis, so each chip is billed
+        # global bytes / mesh size — budget the PER-CHIP bill, not the
+        # global one (a 4-chip mesh quarters the per-chip cost).
+        n_dev = max(1, self.mesh.shape.get(SLICE_AXIS, 1))
+        arg_bytes = sum(int(np.prod(u[0].shape)) * 4
+                        for u in uniques) // n_dev
+        if arg_bytes > arg_budget:
+            return None
         sig = group[0].args[0]
-        return ((sig, tuple(leaf_map), len(uniques)),
+        # The backend is part of the compile key: an env flip between
+        # xla and pallas must not serve the other's program.
+        return ((sig, tuple(leaf_map), len(uniques),
+                 self._count_backend()),
                 tuple(leaf_map), uniques, ordered)
 
     _SHARED_FNS_MAX = 32
@@ -983,6 +1037,20 @@ class MeshManager:
             while len(self._shared_fns) > self._SHARED_FNS_MAX:
                 self._shared_fns.popitem(last=False)
 
+    def _build_shared(self, tree_sig, leaf_map, num_unique, backend):
+        """Construct the shared-read batch program on `backend` — the
+        string baked into the caller's cache key by _shared_plan, NOT
+        re-read from the env here: a background build must cache the
+        program the key names even if the env flips mid-build."""
+        if backend in ("pallas", "pallas_interpret"):
+            from .mesh import compile_serve_count_batch_shared_pallas
+
+            return compile_serve_count_batch_shared_pallas(
+                self.mesh, json.loads(tree_sig), leaf_map, num_unique,
+                interpret=backend == "pallas_interpret")
+        return compile_serve_count_batch_shared(
+            self.mesh, json.loads(tree_sig), leaf_map, num_unique)
+
     def _shared_compile_sync(self, key, tree_sig, leaf_map, num_unique):
         """Inline compile for policy="sync" (tests/bench). _compile_mu
         dedupes racing first compiles; _shared_mu alone covers the dict
@@ -990,8 +1058,8 @@ class MeshManager:
         with self._compile_mu:
             fn = self._shared_get(key)
             if fn is None:
-                fn = compile_serve_count_batch_shared(
-                    self.mesh, json.loads(tree_sig), leaf_map, num_unique)
+                fn = self._build_shared(tree_sig, leaf_map, num_unique,
+                                        key[-1])
                 self._shared_put(key, fn)
         return fn
 
@@ -1013,8 +1081,8 @@ class MeshManager:
 
         def build():
             try:
-                fn = compile_serve_count_batch_shared(
-                    self.mesh, json.loads(tree_sig), leaf_map, num_unique)
+                fn = self._build_shared(tree_sig, leaf_map, num_unique,
+                                        key[-1])
                 self._shared_put(key, fn)
             finally:
                 with self._shared_mu:

@@ -1397,6 +1397,64 @@ class TestAdaptiveSharedBatching:
         assert len(mgr._shared_fns) == 1
         assert mgr.stats["shared_batch"] == 8
 
+    def test_plain_batch_pallas_backend_matches(self, holder, monkeypatch):
+        """With sharing OFF and the pallas backend selected, herd
+        groups run the identity-map grid kernel
+        (compile_serve_count_coarse_pallas_batch) padded to
+        _MAX_BATCH; results must match the host executor."""
+        monkeypatch.setenv("PILOSA_TPU_BATCH_SHARED", "off")
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
+        TestCoarseGather.seed_full_rows(holder, rows=(0, 1, 2, 3),
+                                        slices=(0, 1))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        pairs = [(0, 1), (1, 2), (2, 3)]
+        want = [host.execute("i", parse_string(
+            f"Count(Intersect(Bitmap(rowID={a}), Bitmap(rowID={b})))"))[0]
+            for a, b in pairs]
+        group = self._group(holder, mgr, pairs)
+        mgr._run_count_group(group)
+        assert [r.result for r in group] == want
+        assert mgr.stats["shared_batch"] == 0
+        assert mgr.stats["batched"] == 3
+        assert any(len(k) == 4 and k[3] == "pallas_interpret"
+                   and k[2] == mgr._MAX_BATCH
+                   for k in mgr._coarse_fns), list(mgr._coarse_fns)
+
+    def test_shared_pallas_backend_matches(self, holder, monkeypatch):
+        """PILOSA_TPU_COUNT_BACKEND=pallas_interpret routes the
+        shared-read batch through the one-launch Pallas grid kernel
+        (compile_serve_count_batch_shared_pallas); results must match
+        the host executor AND the XLA shared program, and the two
+        backends must cache under distinct keys."""
+        monkeypatch.setenv("PILOSA_TPU_BATCH_SHARED", "sync")
+        TestCoarseGather.seed_full_rows(holder, rows=(0, 1, 2, 3),
+                                        slices=(0, 1))
+        e = Executor(holder, use_device=True, device_min_work=0)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        pairs = [(0, 1), (1, 2), (2, 3), (0, 3)]
+        want = [host.execute("i", parse_string(
+            f"Count(Intersect(Bitmap(rowID={a}), Bitmap(rowID={b})))"))[0]
+            for a, b in pairs]
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
+        group = self._group(holder, mgr, pairs)
+        mgr._run_count_group(group)
+        assert [r.result for r in group] == want
+        assert mgr.stats["shared_batch"] == 4
+        keys = list(mgr._shared_fns)
+        assert keys and keys[0][-1] == "pallas_interpret"
+        # Same composition on the XLA backend: separate cache entry,
+        # same results.
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "xla")
+        group2 = self._group(holder, mgr, pairs)
+        mgr._run_count_group(group2)
+        assert [r.result for r in group2] == want
+        assert len(mgr._shared_fns) == 2
+        assert {k[-1] for k in mgr._shared_fns} == {"pallas_interpret",
+                                                    "xla"}
+
     def test_auto_policy_compiles_in_background(self, holder, monkeypatch):
         monkeypatch.setenv("PILOSA_TPU_BATCH_SHARED", "auto")
         TestCoarseGather.seed_full_rows(holder, rows=(0, 1, 2), slices=(0,))
