@@ -58,6 +58,17 @@ from .mesh import (
 from .plan import _tree_signature
 
 
+def _num_env(name: str, default, cast=int):
+    """Env-var number with parse-failure fallback — the one copy of
+    the try/cast/except idiom the tunables below share."""
+    import os
+
+    try:
+        return cast(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
@@ -367,13 +378,7 @@ class MeshManager:
         """Staged-pool HBM budget (PILOSA_TPU_HBM_BUDGET_MB env,
         default 8192 MB — half a v5e chip's 16 GB, leaving room for
         query intermediates). 0 disables eviction."""
-        import os
-
-        try:
-            mb = int(os.environ.get("PILOSA_TPU_HBM_BUDGET_MB", "8192"))
-        except ValueError:
-            mb = 8192
-        return mb << 20
+        return _num_env("PILOSA_TPU_HBM_BUDGET_MB", 8192) << 20
 
     @staticmethod
     def _view_bytes(sv: StagedView) -> int:
@@ -995,13 +1000,8 @@ class MeshManager:
         # 16 GB chip (PILOSA_TPU_SHARED_ARG_BUDGET_MB, default 11264);
         # the plain batch program (L operands) serves instead. The
         # 28-pair/8-row headline composition bills ~8 GB and passes.
-        import os
-
-        try:
-            arg_budget = int(os.environ.get(
-                "PILOSA_TPU_SHARED_ARG_BUDGET_MB", "11264")) << 20
-        except ValueError:
-            arg_budget = 11264 << 20
+        arg_budget = _num_env("PILOSA_TPU_SHARED_ARG_BUDGET_MB",
+                              11264) << 20
         # Arguments shard over the slice axis, so each chip is billed
         # global bytes / mesh size — budget the PER-CHIP bill, not the
         # global one (a 4-chip mesh quarters the per-chip cost).
@@ -1063,10 +1063,25 @@ class MeshManager:
                 self._shared_put(key, fn)
         return fn
 
+    @staticmethod
+    def _shared_seen_min() -> int:
+        """Sightings of one composition before the auto policy spends a
+        background compile on it (PILOSA_TPU_SHARED_SEEN_MIN, default
+        8). The threshold is deliberately high: on the relay a compile
+        RPC SERIALIZES with dispatch, so a background shared compile
+        stalls the whole batch pipeline for its duration (traced:
+        ~0.6 s dispatch stall per compile; closed-loop 16-client QPS
+        57.8 with the old threshold of 2 vs 267.6 with sharing off —
+        random herd fragmentation kept minting almost-never-repeating
+        compositions). A genuinely repeated composition (dashboard
+        refresh, a hot query set) reaches 8 sightings in moments and
+        earns the 5x shared program; drain-window noise does not."""
+        return max(1, _num_env("PILOSA_TPU_SHARED_SEEN_MIN", 8))
+
     def _shared_compile_async(self, key, tree_sig, leaf_map, num_unique):
-        """Kick a background compile of the shared program — only
-        once a composition has been seen TWICE (one-off groupings must
-        not churn compile threads), and bounded caches throughout."""
+        """Kick a background compile of the shared program — only once
+        a composition has repeated enough to be worth a pipeline stall
+        (_shared_seen_min), and bounded caches throughout."""
         with self._shared_mu:
             if key in self._shared_fns or key in self._shared_pending:
                 return
@@ -1075,7 +1090,7 @@ class MeshManager:
             self._shared_seen.move_to_end(key)
             while len(self._shared_seen) > self._SHARED_SEEN_MAX:
                 self._shared_seen.popitem(last=False)
-            if n < 2:
+            if n < self._shared_seen_min():
                 return
             self._shared_pending.add(key)
 
@@ -1131,13 +1146,7 @@ class MeshManager:
         fragmented herd groups nearly free. The workers only block in
         the PJRT client (GIL released), so the pool costs nothing on a
         1-core host."""
-        import os
-
-        try:
-            n = int(os.environ.get("PILOSA_TPU_FETCH_THREADS", "8"))
-        except ValueError:
-            n = 8
-        return max(1, n)
+        return max(1, _num_env("PILOSA_TPU_FETCH_THREADS", 8))
 
     def _ensure_batch_thread(self):
         if self._batch_thread is None:
@@ -1185,13 +1194,8 @@ class MeshManager:
         dispatch (~2.5 ms relay floor) plus the extra group's padded
         device time — the 3 ms wait is priced at about that dispatch
         floor."""
-        import os
-
-        try:
-            ms = float(os.environ.get("PILOSA_TPU_BATCH_WINDOW_MS", "3"))
-        except ValueError:
-            ms = 3.0
-        return max(0.0, ms) / 1e3
+        return max(0.0, _num_env("PILOSA_TPU_BATCH_WINDOW_MS", 3.0,
+                                 float)) / 1e3
 
     def _batch_loop(self):
         """Drain-and-group: take everything queued while the device was

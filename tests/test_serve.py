@@ -1457,6 +1457,11 @@ class TestAdaptiveSharedBatching:
 
     def test_auto_policy_compiles_in_background(self, holder, monkeypatch):
         monkeypatch.setenv("PILOSA_TPU_BATCH_SHARED", "auto")
+        # Pin the sighting threshold at its old value of 2 — the test
+        # drives exactly two sightings; the production default is
+        # higher (see _shared_seen_min: a relay compile stalls the
+        # dispatch pipeline, so auto waits for real repetition).
+        monkeypatch.setenv("PILOSA_TPU_SHARED_SEEN_MIN", "2")
         TestCoarseGather.seed_full_rows(holder, rows=(0, 1, 2), slices=(0,))
         e = Executor(holder, use_device=True, device_min_work=0)
         mgr = e.mesh_manager()
