@@ -54,17 +54,33 @@ class _MutationEpoch:
     and cross-holder bumps merely over-invalidate (a performance
     non-event), never under-invalidate. The bump is lock-guarded
     because `n += 1` on two threads can lose an update, and a LOST
-    bump is the one thing that could validate a stale entry."""
+    bump is the one thing that could validate a stale entry.
 
-    __slots__ = ("n", "_mu")
+    `s` is the STRUCTURAL sub-counter: it moves only when the SET of
+    fragments a query could touch — or how its tree lowers — changes
+    (fragment/frame/index create or delete, label or time-quantum
+    change). Plain bit writes move `n` alone, and pair each bump with
+    the touched fragment's own `generation` increment. That split
+    lets a query memo that recorded its fragments' generations
+    revalidate after an UNRELATED write: `s` unchanged means the
+    fragment set is intact, so comparing the recorded generations is
+    a complete staleness check (HostQueryCache.query_get)."""
+
+    __slots__ = ("n", "s", "_mu")
 
     def __init__(self):
         self.n = 0
+        self.s = 0
         self._mu = threading.Lock()
 
     def bump(self):
         with self._mu:
             self.n += 1
+
+    def bump_structural(self):
+        with self._mu:
+            self.n += 1
+            self.s += 1
 
 
 MUTATION_EPOCH = _MutationEpoch()

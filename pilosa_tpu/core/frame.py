@@ -100,12 +100,12 @@ class Frame:
 
     def set_time_quantum(self, q: TimeQuantum):
         self.time_quantum = q
-        MUTATION_EPOCH.bump()  # changes Range view covers
+        MUTATION_EPOCH.bump_structural()  # changes Range view covers
         self._save_meta()
 
     def set_row_label(self, label: str):
         self.row_label = validate_label(label)
-        MUTATION_EPOCH.bump()  # changes how Bitmap args lower
+        MUTATION_EPOCH.bump_structural()  # changes how Bitmap args lower
         self._save_meta()
 
     # -- views -------------------------------------------------------------

@@ -75,7 +75,7 @@ class Holder:
         idx.open()
         # Copy-on-write: readers iterate self.indexes without the lock.
         self.indexes = {**self.indexes, name: idx}
-        MUTATION_EPOCH.bump()
+        MUTATION_EPOCH.bump_structural()
         return idx
 
     def delete_index(self, name: str):
@@ -86,7 +86,7 @@ class Holder:
             rest = dict(self.indexes)
             idx = rest.pop(name, None)
             self.indexes = rest
-            MUTATION_EPOCH.bump()
+            MUTATION_EPOCH.bump_structural()
             if idx is not None:
                 idx.close()
                 shutil.rmtree(idx.path, ignore_errors=True)

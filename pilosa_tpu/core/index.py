@@ -82,12 +82,12 @@ class Index:
 
     def set_column_label(self, label: str):
         self.column_label = validate_label(label)
-        MUTATION_EPOCH.bump()  # changes how Bitmap args lower
+        MUTATION_EPOCH.bump_structural()  # changes how Bitmap args lower
         self._save_meta()
 
     def set_time_quantum(self, q: TimeQuantum):
         self.time_quantum = q
-        MUTATION_EPOCH.bump()  # changes Range view covers
+        MUTATION_EPOCH.bump_structural()  # changes Range view covers
         self._save_meta()
 
     # -- slices ------------------------------------------------------------
@@ -142,7 +142,7 @@ class Index:
         frame.open()
         # Copy-on-write: readers iterate self.frames without the lock.
         self.frames = {**self.frames, name: frame}
-        MUTATION_EPOCH.bump()
+        MUTATION_EPOCH.bump_structural()
         return frame
 
     def delete_frame(self, name: str):
@@ -150,7 +150,7 @@ class Index:
             rest = dict(self.frames)
             f = rest.pop(name, None)
             self.frames = rest
-            MUTATION_EPOCH.bump()
+            MUTATION_EPOCH.bump_structural()
             if f is not None:
                 f.close()
                 shutil.rmtree(f.path, ignore_errors=True)

@@ -15,7 +15,7 @@ from typing import Dict, Optional
 
 from .. import SLICE_WIDTH
 from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
-from .fragment import Fragment
+from .fragment import Fragment, MUTATION_EPOCH
 
 VIEW_STANDARD = "standard"
 VIEW_INVERSE = "inverse"
@@ -79,6 +79,10 @@ class View:
         # Copy-on-write: readers (max_slice, query fan-out) iterate
         # fragments without the lock.
         self.fragments = {**self.fragments, slice_: frag}
+        # A new fragment changes the SET a query could touch: memos
+        # that recorded generations of then-existing fragments can't
+        # see it, so their structural token must stop validating.
+        MUTATION_EPOCH.bump_structural()
         return frag
 
     def fragment(self, slice_: int) -> Optional[Fragment]:

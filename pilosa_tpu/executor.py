@@ -422,7 +422,7 @@ class Executor:
         # via the descriptor stream, so its rank-0 executor — which
         # has no cluster nodes — still qualifies; so does the default
         # server's one-node static cluster, where every write IS local.)
-        qkey = qepoch = None
+        qkey = qepoch = qsepoch = None
         nodes = self.cluster.nodes if self.cluster is not None else []
         if (not nodes
                 or (len(nodes) == 1 and nodes[0].host == self.host)):
@@ -432,7 +432,8 @@ class Executor:
 
                 qkey = (index, ck, tuple(slices))
                 qepoch = MUTATION_EPOCH.n
-                hit = self._host_cache.query_get(qkey, qepoch)
+                qsepoch = MUTATION_EPOCH.s
+                hit = self._host_cache.query_get(qkey, qepoch, qsepoch)
                 if hit is not None:
                     return hit
 
@@ -445,16 +446,25 @@ class Executor:
         # which beats the materializing Row path ~5x on small trees.
         lowered = None
         host_lowered = None
-        if self._device_backend_on():
+        qtoken = None
+        backend_on = self._device_backend_on()
+        if backend_on or qkey is not None:
+            # Lowering is pure host work; with the backend off it still
+            # runs when a memo entry will be stored, because the leaves
+            # name exactly the fragments the revalidation token must
+            # cover (a tokenless entry dies on every epoch bump).
             from .parallel.plan import _lower_tree
 
             leaves: list = []
             shape = _lower_tree(self.holder, index, child, leaves)
             if shape is not None and leaves:
-                if self._route_to_host(len(slices), len(leaves)):
-                    host_lowered = (shape, leaves)
-                else:
-                    lowered = (shape, leaves)
+                if backend_on:
+                    if self._route_to_host(len(slices), len(leaves)):
+                        host_lowered = (shape, leaves)
+                    else:
+                        lowered = (shape, leaves)
+                if qkey is not None:
+                    qtoken = self._query_token(index, leaves, slices)
 
         plan_cell: list = []
 
@@ -499,11 +509,36 @@ class Executor:
             index, slices, c, opt, map_fn, reduce_fn, batch_fn=batch_fn)
         n = int(result or 0)
         if qkey is not None:
-            # Stored against the PRE-compute epoch: a write racing the
-            # fold bumped it, so the entry can never validate — stale
-            # results invalidate, they don't serve.
-            self._host_cache.query_put(qkey, qepoch, n)
+            # Stored against the PRE-compute epoch (and PRE-compute
+            # fragment generations): a write racing the fold bumped
+            # them, so the entry can never validate — stale results
+            # invalidate, they don't serve.
+            self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
         return n
+
+    # Above this fan-out, gathering (fragment, generation) pairs for
+    # the memo token costs more than the occasional refold it saves;
+    # tokenless entries still epoch-validate (the r4 behavior).
+    _QUERY_TOKEN_MAX = 8192
+
+    def _query_token(self, index: str, leaves, slices) -> Optional[tuple]:
+        """((fragment, generation), ...) across every (slice, unique
+        leaf view) this Count touches — the revalidation token for
+        HostQueryCache.query_get. Read BEFORE the fold on purpose (see
+        query_put). Absent fragments are simply skipped: a fragment
+        appearing later bumps the structural epoch (View._open_fragment),
+        which already invalidates the token."""
+        uniq = list(dict.fromkeys((f, v) for f, v, _r, _q in leaves))
+        if len(uniq) * len(slices) > self._QUERY_TOKEN_MAX:
+            return None
+        pairs = []
+        holder = self.holder
+        for s in slices:
+            for frame, view in uniq:
+                frag = holder.fragment(index, frame, view, s)
+                if frag is not None:
+                    pairs.append((frag, frag.generation))
+        return tuple(pairs)
 
     def mesh_manager(self):
         """The mesh serving layer, or None when the device backend is

@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import weakref
 from typing import List, Optional
 
 import jax
@@ -160,7 +161,7 @@ class HostQueryCache:
         self._matrix_bytes = 0
         self.stats = {"block_hit": 0, "block_miss": 0,
                       "memo_hit": 0, "memo_miss": 0,
-                      "query_hit": 0, "query_miss": 0,
+                      "query_hit": 0, "query_miss": 0, "query_reval": 0,
                       "matrix_hit": 0, "matrix_miss": 0}
 
     # Leaf dense-matrix cache budget (bytes): a matrix is one leaf
@@ -201,30 +202,68 @@ class HostQueryCache:
                 _, (_, m) = self._matrix.popitem(last=False)
                 self._matrix_bytes -= m.nbytes
 
-    def query_get(self, key: tuple, epoch: int):
+    def query_get(self, key: tuple, epoch: int, s_epoch: Optional[int] = None):
         """Whole-QUERY count memo, validated by the process-wide
         MUTATION_EPOCH (core.fragment): the warm path for a repeated
         read-only Count is one dict probe + one int compare — no
         re-lowering, no plan construction, no per-slice generation
-        walk. Coarser than the per-slice memo below (ANY mutation
-        anywhere invalidates every entry), which is exactly the trade:
-        the per-slice layer still answers the slices an unrelated
-        write didn't touch, this layer answers the no-writes-at-all
-        steady state at host-fold speed. Entries from before any bump
-        can never validate (the epoch is monotonic), so a racing write
-        invalidates rather than corrupts."""
+        walk.
+
+        Second tier (r5): an entry stored with a TOKEN — the
+        structural epoch plus every touched fragment's generation at
+        store time — REVALIDATES after an epoch bump from an
+        unrelated write: if the structural epoch is unchanged (no
+        fragment/frame/index create/delete, no label or time-quantum
+        change anywhere), the fragment SET the query touches is
+        intact, so comparing recorded generations is a complete
+        staleness check. A pass re-stamps the entry at the current
+        epoch — sound because a generation can't move without bumping
+        MUTATION_EPOCH (fragment._log_append/_log_reset), so the next
+        bump forces another generation walk. Entries hold WEAK
+        fragment refs; a dead ref never validates. Without a token
+        (non-lowerable tree, oversized fan-out) any bump invalidates,
+        the r4 behavior."""
         with self._mu:
             e = self._query.get(key)
             if e is not None and e[0] == epoch:
                 self._query.move_to_end(key)
                 self.stats["query_hit"] += 1
                 return e[1]
-            self.stats["query_miss"] += 1
-            return None
-
-    def query_put(self, key: tuple, epoch: int, count: int) -> None:
+        if e is not None:
+            # The generation walk can span thousands of weakref derefs
+            # (token cap 8192): run it OUTSIDE the lock — this class
+            # promises dict-sized critical sections only — then re-take
+            # it to re-stamp, tolerating a concurrent replace (the walk
+            # validated OUR entry's count, so returning it is correct
+            # regardless of what the entry says now).
+            tok = e[2]
+            if (tok is not None and s_epoch is not None
+                    and tok[0] == s_epoch and all(
+                        (fr := f()) is not None and fr.generation == g
+                        for f, g in tok[1])):
+                with self._mu:
+                    if self._query.get(key) is e:
+                        self._query[key] = (epoch, e[1], tok)
+                        self._query.move_to_end(key)
+                    self.stats["query_reval"] += 1
+                return e[1]
         with self._mu:
-            self._query[key] = (epoch, count)
+            self.stats["query_miss"] += 1
+        return None
+
+    def query_put(self, key: tuple, epoch: int, count: int,
+                  s_epoch: Optional[int] = None,
+                  frag_gens: Optional[tuple] = None) -> None:
+        """`frag_gens`: ((fragment, generation), ...) read BEFORE the
+        fold — a write racing the fold moved some generation past its
+        recorded value, so the token can never validate (same
+        pre-compute rationale as `epoch`)."""
+        token = None
+        if frag_gens is not None and s_epoch is not None:
+            token = (s_epoch,
+                     tuple((weakref.ref(f), g) for f, g in frag_gens))
+        with self._mu:
+            self._query[key] = (epoch, count, token)
             self._query.move_to_end(key)
             while len(self._query) > self._QUERY_MAX:
                 self._query.popitem(last=False)
@@ -245,8 +284,6 @@ class HostQueryCache:
             return None
 
     def block_put(self, frag, row_id: int, gen: int, words) -> None:
-        import weakref
-
         key = (id(frag), int(row_id))
         with self._mu:
             self._blocks[key] = (weakref.ref(frag), gen, words)
@@ -269,8 +306,6 @@ class HostQueryCache:
             return None
 
     def memo_put(self, key: tuple, snapshot: tuple, count: int) -> None:
-        import weakref
-
         with self._mu:
             self._memo[key] = (tuple(
                 (weakref.ref(f) if f is not None else None, g)

@@ -528,6 +528,84 @@ class TestQueryLevelMemo:
         assert q(e, "i", "Count(Bitmap(rowID=7))", slices=[1])[0] == 1
 
 
+class TestQueryMemoRevalidation:
+    """r5 second tier: entries carry (structural epoch, fragment
+    generations); an epoch bump from an UNRELATED write revalidates in
+    a generation walk instead of refolding, while touched-fragment
+    writes and any structural change (new fragment/frame/index, label
+    or quantum change) still invalidate."""
+
+    def _exec(self, holder):
+        seed(holder, bits=[(r, c) for r in range(3) for c in (1, 2, 70000)])
+        # a second frame that exists BEFORE the memo is stored, so
+        # writing to it later is a plain bit write, not a create
+        holder.index("i").create_frame_if_not_exists("other")
+        holder.frame("i", "other").set_bit(0, 1)
+        return Executor(holder, use_device=True, device_min_work=10**9)
+
+    def test_unrelated_write_revalidates(self, holder):
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        assert q(e, "i", pql)[0] == 3
+        r0 = e.host_cache_stats["query_reval"]
+        m0 = e.host_cache_stats["query_miss"]
+        holder.frame("i", "other").set_bit(5, 99)  # bumps epoch only
+        assert q(e, "i", pql)[0] == 3
+        assert e.host_cache_stats["query_reval"] == r0 + 1
+        assert e.host_cache_stats["query_miss"] == m0
+
+    def test_revalidated_entry_restamps(self, holder):
+        # after one revalidation, an unmutated repeat takes the fast
+        # epoch path again (the entry was re-stamped)
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        assert q(e, "i", pql)[0] == 3
+        holder.frame("i", "other").set_bit(5, 99)
+        assert q(e, "i", pql)[0] == 3
+        h0 = e.host_cache_stats["query_hit"]
+        assert q(e, "i", pql)[0] == 3
+        assert e.host_cache_stats["query_hit"] == h0 + 1
+
+    def test_touched_write_refolds(self, holder):
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        assert q(e, "i", pql)[0] == 3
+        r0 = e.host_cache_stats["query_reval"]
+        holder.frame("i", "general").set_bit(0, 555)
+        assert q(e, "i", pql)[0] == 4
+        assert e.host_cache_stats["query_reval"] == r0
+
+    def test_noop_touched_write_refolds_same_count(self, holder):
+        # re-setting a set bit bumps the generation (logged) — the
+        # memo can't know it was a no-op, so it refolds, correctly
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        assert q(e, "i", pql)[0] == 3
+        r0 = e.host_cache_stats["query_reval"]
+        m0 = e.host_cache_stats["query_miss"]
+        holder.frame("i", "general").set_bit(0, 1)  # already set
+        assert q(e, "i", pql)[0] == 3
+        assert e.host_cache_stats["query_reval"] == r0
+        assert e.host_cache_stats["query_miss"] == m0 + 1
+
+    def test_structural_change_invalidates(self, holder):
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        assert q(e, "i", pql)[0] == 3
+        m0 = e.host_cache_stats["query_miss"]
+        holder.create_index("scratch")  # structural: token must die
+        assert q(e, "i", pql)[0] == 3
+        assert e.host_cache_stats["query_miss"] == m0 + 1
+
+    def test_new_fragment_in_queried_slices_recounts(self, holder):
+        e = self._exec(holder)
+        pql = "Count(Bitmap(rowID=0))"
+        # slice 1 has no fragment yet; memo over slices [0, 1]
+        assert q(e, "i", pql, slices=[0, 1])[0] == 3
+        holder.frame("i", "general").set_bit(0, SLICE_WIDTH + 8)
+        assert q(e, "i", pql, slices=[0, 1])[0] == 4
+
+
 class TestCallCacheKey:
     def test_structural_equality_across_parses(self):
         a = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")

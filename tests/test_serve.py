@@ -1211,7 +1211,7 @@ class TestHbmBudgetEviction:
             # without ever touching the mesh layer (correct, but this
             # test exists to drive staging/eviction): move the epoch so
             # every execute reaches the device path.
-            MUTATION_EPOCH.bump()
+            MUTATION_EPOCH.bump_structural()
             return (f"Count(Intersect(Bitmap(rowID=1, frame={fr}), "
                     f"Bitmap(rowID=2, frame={fr})))")
 
@@ -1251,11 +1251,11 @@ class TestHbmBudgetEviction:
                             staticmethod(lambda: 2 * one + one // 2))
         mgr.invalidate()
         before = mgr.stats["evicted"]
-        MUTATION_EPOCH.bump()  # past the query memo, to the device path
+        MUTATION_EPOCH.bump_structural()  # past the query memo, to the device path
         assert q(e, "i", q3)[0] == 16
         assert len(mgr._views) == 3  # over budget, but no mid-query evict
         assert mgr.stats["evicted"] == before
-        MUTATION_EPOCH.bump()
+        MUTATION_EPOCH.bump_structural()
         assert q(e, "i", q3)[0] == 16  # repeats stay staged: no thrash
         assert mgr.stats["evicted"] == before
         assert mgr.stats["stage"] == 6  # 3 initial + 3 after invalidate
