@@ -283,6 +283,152 @@ def coarse_count_identity_batch(pools, starts, tree, *,
     )(starts, *pools)
 
 
+def _uniform_pick_t(s_n: int, num_operands: int = 2) -> int:
+    """Slices fetched per grid step: the largest convenient divisor of
+    S that fits the 16 MB scoped-VMEM window. Bigger blocks amortize
+    per-step DMA issue cost — measured (PROBE_R5_bw.json, 3072
+    slices): t=1 reads 257 GB/s, t=8/t=32 read 355-360 GB/s, AT the
+    chip's XLA whole-pool streaming ceiling. Each operand's block is
+    t * 128 KB and Mosaic double-buffers it, so an 8-operand shared
+    batch at t=32 bills 64 MB and is rejected at compile time — the
+    budget caps t by operand count instead."""
+    # 12 MB of the 16 MB window: the SMEM output and scalar tables
+    # bill into the same scoped allocation (observed: +112 KB for a
+    # (28, 960) int32 output tipping an exactly-16 MB config over).
+    per_slice = num_operands * ROW_SPAN * 16 * _LANES * 4 * 2
+    cap = max(1, (12 << 20) // per_slice)
+    for t in (32, 16, 8, 4, 2):
+        if t <= cap and s_n % t == 0:
+            return t
+    return 1
+
+
+def _runs_view(v):
+    """(S, cap, 2048) -> (S, cap/16, 16, 2048): a leading-dim split is
+    layout-preserving (no lane retiling — contrast the (256, 128) view
+    coarse_count_per_slice's docstring warns about), and makes each
+    whole-row run a full trailing (16, 2048) block Mosaic can tile
+    into a multi-slice fetch."""
+    return v.reshape(v.shape[0], v.shape[1] // ROW_SPAN,
+                     ROW_SPAN, 16 * _LANES)
+
+
+def _uniform_kernel(tree, num_leaves, t, starts_ref, *refs):
+    o_ref = refs[num_leaves]
+    base = pl.program_id(0) * t
+
+    def leaf(i):
+        blk = refs[i][...]  # (t, 1, 16, 2048)
+        keep = starts_ref[i] >= 0
+        return jnp.where(keep, blk, jnp.uint32(0))
+
+    folded = fold_tree(tree, leaf)
+    # One full reduce per sub-slice: Mosaic lowers scalar full-reduces
+    # into SMEM, but not vector-element extracts (a partial
+    # axis=(1,2,3) reduce + per[j] store fails "Invalid input layout").
+    for j in range(t):
+        o_ref[0, base + j] = jnp.sum(
+            lax.population_count(folded[j]).astype(jnp.int32))
+
+
+def coarse_count_uniform(views, starts, tree, *,
+                         interpret: bool = False):
+    """ONE pallas_call of per-slice coarse counts for the UNIFORM
+    layout: every slice stores each leaf at the SAME row-run index —
+    true for any densely staged pool, detected host-side from the
+    keys (serve._leaf_arrays). The per-(leaf, slice) starts table
+    collapses to ONE scalar per leaf, so a grid step can fetch t
+    CONSECUTIVE slices as one (t, 1, 16, 2048) block: per-step DMA
+    issue cost amortizes t-fold, which is the whole gap between the
+    general kernel's 257 GB/s and the 360 GB/s streaming ceiling on
+    the r5 chip (PROBE_R5_bw.json).
+
+    views:  tuple per leaf of the NATIVE (S, cap_i, 2048) uint32 pool.
+    starts: (L,) int32 — one signed row-run index per leaf; negative =
+            leaf absent everywhere (counts all-zero).
+    Returns (1, S) int32 per-slice counts (slice ownership masks apply
+    AFTER, at the serving layer)."""
+    num_leaves = len(views)
+    s_n = views[0].shape[0]
+    t = _uniform_pick_t(s_n, num_leaves)
+    views = tuple(_runs_view(v) for v in views)
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (t, 1, ROW_SPAN, 16 * _LANES),
+            lambda i, starts_ref, leaf=leaf: (
+                i, jnp.maximum(starts_ref[leaf], 0), 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n // t,),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_uniform_kernel, tree, num_leaves, t),
+        out_shape=jax.ShapeDtypeStruct((1, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *views)
+
+
+def _uniform_batch_kernel(tree, num_leaves, t, starts_ref, *refs):
+    o_ref = refs[num_leaves]
+    b = pl.program_id(0)
+    base = pl.program_id(1) * t
+
+    def leaf(i):
+        blk = refs[i][...]
+        keep = starts_ref[b * num_leaves + i] >= 0
+        return jnp.where(keep, blk, jnp.uint32(0))
+
+    folded = fold_tree(tree, leaf)
+    for j in range(t):
+        o_ref[b, base + j] = jnp.sum(
+            lax.population_count(folded[j]).astype(jnp.int32))
+
+
+def coarse_count_uniform_batch(pools, starts, tree, *,
+                               interpret: bool = False):
+    """Uniform-layout twin of coarse_count_identity_batch: grid
+    (B, S/t), each step fetching t consecutive slices of each leaf
+    position's row as one block (see coarse_count_uniform).
+
+    pools:  tuple per LEAF POSITION of the NATIVE (S, cap_l, 2048)
+            uint32 pool.
+    starts: (B*L,) int32 scalar row-run index per slot (slot =
+            b*L + l); negative = absent.
+    Returns (B, S) int32 per-(query, slice) counts."""
+    slots = int(starts.shape[0])
+    num_leaves = len(pools)
+    batch = slots // num_leaves
+    assert batch * num_leaves == slots, (slots, num_leaves)
+    s_n = pools[0].shape[0]
+    t = _uniform_pick_t(s_n, num_leaves)
+    pools = tuple(_runs_view(v) for v in pools)
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (t, 1, ROW_SPAN, 16 * _LANES),
+            lambda b, i, starts_ref, leaf=leaf: (
+                i, jnp.maximum(starts_ref[b * num_leaves + leaf], 0),
+                0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, s_n // t),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_uniform_batch_kernel, tree, num_leaves, t),
+        out_shape=jax.ShapeDtypeStruct((batch, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *pools)
+
+
 def _coarse_batch_kernel(tree, leaf_map, num_unique, starts_ref, *refs):
     o_ref = refs[num_unique]
     s = pl.program_id(0)
@@ -349,6 +495,61 @@ def coarse_count_batch_per_slice(views, starts, tree, leaf_map, *,
     return pl.pallas_call(
         functools.partial(_coarse_batch_kernel, tree, tuple(leaf_map),
                           num_unique),
+        out_shape=jax.ShapeDtypeStruct((len(leaf_map), s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *views)
+
+
+def _shared_uniform_kernel(tree, leaf_map, num_unique, t,
+                           starts_ref, *refs):
+    o_ref = refs[num_unique]
+    base = pl.program_id(0) * t
+    blocks = []
+    for u in range(num_unique):
+        blk = refs[u][...]  # (t, 1, 16, 2048)
+        keep = starts_ref[u] >= 0
+        blocks.append(jnp.where(keep, blk, jnp.uint32(0)))
+    for b, lm in enumerate(leaf_map):
+        folded = fold_tree(tree, lambda i, lm=lm: blocks[lm[i]])
+        for j in range(t):
+            o_ref[b, base + j] = jnp.sum(
+                lax.population_count(folded[j]).astype(jnp.int32))
+
+
+def coarse_count_shared_uniform(views, starts, tree, leaf_map, *,
+                                interpret: bool = False):
+    """Uniform-layout twin of coarse_count_batch_per_slice: the U
+    unique rows stream as (t, 1, 16, 2048) multi-slice blocks (see
+    coarse_count_uniform) and all B folds for those t slices evaluate
+    from VMEM. Combines BOTH round-5 traffic wins: unique leaves read
+    once per slice AND per-step DMA issue cost amortized t-fold.
+
+    views:  tuple per UNIQUE leaf of the NATIVE (S, cap_u, 2048)
+            uint32 pool.
+    starts: (U,) int32 scalar row-run index per unique; negative =
+            absent everywhere.
+    Returns (B, S) int32."""
+    num_unique = len(views)
+    s_n = views[0].shape[0]
+    t = _uniform_pick_t(s_n, num_unique)
+    views = tuple(_runs_view(v) for v in views)
+
+    def leaf_spec(u):
+        return pl.BlockSpec(
+            (t, 1, ROW_SPAN, 16 * _LANES),
+            lambda i, starts_ref, u=u: (
+                i, jnp.maximum(starts_ref[u], 0), 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n // t,),
+        in_specs=[leaf_spec(u) for u in range(num_unique)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_shared_uniform_kernel, tree, tuple(leaf_map),
+                          num_unique, t),
         out_shape=jax.ShapeDtypeStruct((len(leaf_map), s_n), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -705,6 +705,20 @@ def _gather_leaf_rows(words_t, start_t, valid_t, i):
     return g * valid_t[i][:, None]
 
 
+def _limb_psum(per_bs):
+    """(B, S_l) uint32 per-(query, slice) counts -> (2, B) [lo, hi]
+    16-bit limb columns psum'd over the slice axis — the shared
+    epilogue of every serving count program (a per-slice count is
+    <= 2^20, so the 16-bit split keeps the int32 psum exact at any
+    slice fan-out)."""
+    lo = lax.psum(
+        (per_bs & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
+        SLICE_AXIS)
+    hi = lax.psum((per_bs >> 16).astype(jnp.int32).sum(axis=1),
+                  SLICE_AXIS)
+    return jnp.stack([lo, hi])
+
+
 def compile_serve_count_coarse(mesh: Mesh, tree_shape, num_leaves: int,
                                batch: int = 1):
     """Jit a masked Count (batch >= 1) where EVERY leaf is a coarse
@@ -813,6 +827,59 @@ def compile_serve_count_coarse_pallas(mesh: Mesh, tree_shape,
     @jax.jit
     def run(words_t, start_flat, valid_flat, mask):
         return fn(words_t, start_flat, valid_flat, mask)
+
+    return run
+
+
+def compile_serve_count_coarse_pallas_uniform(mesh: Mesh, tree_shape,
+                                              num_leaves: int,
+                                              batch: int = 1,
+                                              interpret: bool = False):
+    """Uniform-layout Pallas coarse count: fn(words_t (L,), starts
+    (B*L,) int32 scalar row-run per slot, mask (S,)) -> (2, B) limb
+    columns. Selected when the serving layer detects (host-side, from
+    the staged keys) that every leaf sits at ONE row-run index across
+    all slices — true for any densely staged pool — which lets the
+    kernel fetch multiple consecutive slices per grid step and reach
+    the chip's streaming ceiling (ops.kernels.coarse_count_uniform;
+    257 -> 360 GB/s measured, PROBE_R5_bw.json). Slice-ownership masks
+    apply AFTER the kernel: the per-slice counts are multiplied by the
+    mask before the limb psum, so validity never needs a per-slice
+    starts table."""
+    from ..ops.kernels import coarse_count_uniform, coarse_count_uniform_batch
+
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+
+    def per_shard(words_t, starts, mask):
+        own = (mask != 0).astype(jnp.int32)
+        if batch == 1:
+            per_slice = coarse_count_uniform(
+                tuple(words_t), starts, tree,
+                interpret=interpret)[0]
+            per_bs = (per_slice * own)[None, :].astype(jnp.uint32)
+        else:
+            per_bs = coarse_count_uniform_batch(
+                tuple(words_t), starts, tree,
+                interpret=interpret)
+            per_bs = (per_bs * own[None, :]).astype(jnp.uint32)
+        return _limb_psum(per_bs)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_leaves,
+                  P(),  # starts are global scalars, replicated
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+        # pallas_call can't annotate how its output varies over mesh
+        # axes, which the VMA checker requires.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(words_t, starts, mask):
+        return fn(tuple(words_t), starts, mask)
 
     return run
 
@@ -945,12 +1012,7 @@ def compile_serve_count_coarse_pallas_batch(mesh: Mesh, tree_shape,
         per_bs = coarse_count_identity_batch(
             tuple(words_t), starts, tree,
             interpret=interpret).astype(jnp.uint32)      # (B, S_l)
-        lo = lax.psum(
-            (per_bs & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
-            SLICE_AXIS)
-        hi = lax.psum((per_bs >> 16).astype(jnp.int32).sum(axis=1),
-                      SLICE_AXIS)
-        return jnp.stack([lo, hi])
+        return _limb_psum(per_bs)
 
     fn = jax.shard_map(
         per_shard,
@@ -1002,12 +1064,7 @@ def compile_serve_count_batch_shared_pallas(mesh: Mesh, tree_shape,
         per_bs = coarse_count_batch_per_slice(
             tuple(words_t), starts, tree, leaf_map,
             interpret=interpret).astype(jnp.uint32)      # (B, S_l)
-        lo = lax.psum(
-            (per_bs & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(axis=1),
-            SLICE_AXIS)
-        hi = lax.psum((per_bs >> 16).astype(jnp.int32).sum(axis=1),
-                      SLICE_AXIS)
-        return jnp.stack([lo, hi])
+        return _limb_psum(per_bs)
 
     fn = jax.shard_map(
         per_shard,
@@ -1025,6 +1082,49 @@ def compile_serve_count_batch_shared_pallas(mesh: Mesh, tree_shape,
     @jax.jit
     def run(words_t, start_t, valid_t, mask):
         return fn(words_t, start_t, valid_t, mask)
+
+    return run
+
+
+def compile_serve_count_batch_shared_pallas_uniform(
+        mesh: Mesh, tree_shape, leaf_map, num_unique: int,
+        interpret: bool = False):
+    """Uniform-layout shared-read batch: fn(words_t (U,), starts (U,)
+    int32 scalar row-run per unique, mask (S,)) -> (2, B). Combines
+    the shared program's unique-leaf traffic win with the uniform
+    kernel's multi-slice DMA amortization
+    (ops.kernels.coarse_count_shared_uniform); the serving layer
+    selects it when _shared_plan sees every unique leaf staged at one
+    row-run index across all slices."""
+    from ..ops.kernels import coarse_count_shared_uniform
+
+    sig = json.dumps(_tree_signature(tree_shape))
+    tree = json.loads(sig)
+    leaf_map = tuple(tuple(m) for m in leaf_map)
+
+    def per_shard(words_t, starts, mask):
+        per_bs = coarse_count_shared_uniform(
+            tuple(words_t), starts, tree, leaf_map,
+            interpret=interpret)
+        per_bs = (per_bs * (mask != 0).astype(jnp.int32)[None, :]
+                  ).astype(jnp.uint32)
+        return _limb_psum(per_bs)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=((P(SLICE_AXIS),) * num_unique,
+                  P(),  # starts are global scalars, replicated
+                  P(SLICE_AXIS)),
+        out_specs=P(),
+        # pallas_call can't annotate how its output varies over mesh
+        # axes, which the VMA checker requires.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(words_t, starts, mask):
+        return fn(tuple(words_t), starts, mask)
 
     return run
 

@@ -229,24 +229,28 @@ class HostQueryCache:
                 self._query.move_to_end(key)
                 self.stats["query_hit"] += 1
                 return e[1]
-        if e is not None:
-            # The generation walk can span thousands of weakref derefs
-            # (token cap 8192): run it OUTSIDE the lock — this class
-            # promises dict-sized critical sections only — then re-take
-            # it to re-stamp, tolerating a concurrent replace (the walk
-            # validated OUR entry's count, so returning it is correct
-            # regardless of what the entry says now).
-            tok = e[2]
-            if (tok is not None and s_epoch is not None
-                    and tok[0] == s_epoch and all(
-                        (fr := f()) is not None and fr.generation == g
-                        for f, g in tok[1])):
-                with self._mu:
-                    if self._query.get(key) is e:
-                        self._query[key] = (epoch, e[1], tok)
-                        self._query.move_to_end(key)
-                    self.stats["query_reval"] += 1
-                return e[1]
+            if e is None or e[2] is None or s_epoch is None:
+                # No token to walk: the miss is decided — count it in
+                # THIS critical section (the common path takes one
+                # lock round-trip, not two).
+                self.stats["query_miss"] += 1
+                return None
+        # The generation walk can span thousands of weakref derefs
+        # (token cap 8192): run it OUTSIDE the lock — this class
+        # promises dict-sized critical sections only — then re-take
+        # it to re-stamp, tolerating a concurrent replace (the walk
+        # validated OUR entry's count, so returning it is correct
+        # regardless of what the entry says now).
+        tok = e[2]
+        if tok[0] == s_epoch and all(
+                (fr := f()) is not None and fr.generation == g
+                for f, g in tok[1]):
+            with self._mu:
+                if self._query.get(key) is e:
+                    self._query[key] = (epoch, e[1], tok)
+                    self._query.move_to_end(key)
+                self.stats["query_reval"] += 1
+            return e[1]
         with self._mu:
             self.stats["query_miss"] += 1
         return None

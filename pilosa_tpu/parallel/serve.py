@@ -357,6 +357,7 @@ class MeshManager:
             "stage": 0, "incremental": 0, "evicted": 0,
             "staged_bytes": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
+            "coarse_uniform": 0,
             "fallback": 0, "stage_us": 0, "query_us": 0,
             "h2d_bytes": 0, "h2d_dispatch_us": 0,
             "refresh_pick_incremental": 0, "refresh_pick_restage": 0,
@@ -911,7 +912,23 @@ class MeshManager:
         v = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
         return v if v in ("pallas", "pallas_interpret") else "xla"
 
-    def _coarse_fn(self, sig: str, num_leaves: int, batch: int):
+    def _uniform_starts(self, coarse_ts):
+        """(B*L,) int32 scalar starts for the uniform Pallas programs,
+        or None when any leaf is non-uniform or the backend isn't
+        Pallas. coarse_ts: one coarse_t tuple per request (each leaf's
+        (starts, valid, uniform_scalar) from _leaf_arrays)."""
+        if self._count_backend() not in ("pallas", "pallas_interpret"):
+            return None
+        flat = []
+        for ct in coarse_ts:
+            for c in ct:
+                if c[2] is None:
+                    return None
+                flat.append(c[2])
+        return np.asarray(flat, dtype=np.int32)
+
+    def _coarse_fn(self, sig: str, num_leaves: int, batch: int,
+                   uniform: bool = False):
         """Get-or-compile the coarse whole-row-gather program.
 
         Backend dispatch (the kernels.use_pallas analog at the serving
@@ -920,16 +937,30 @@ class MeshManager:
         (compile_serve_count_coarse_pallas) and herd groups through
         the identity-map grid kernel
         (compile_serve_count_coarse_pallas_batch) — both read each
-        leaf row HBM->VMEM once with no gathered intermediate. True
-        leaf-sharing compositions additionally upgrade to the shared
-        program (_shared_compile_*)."""
+        leaf row HBM->VMEM once with no gathered intermediate. When
+        every leaf's layout is UNIFORM (one run index across slices —
+        _leaf_arrays detects it host-side), `uniform=True` selects the
+        multi-slice-fetch kernel instead, which amortizes per-step DMA
+        issue cost to the chip's streaming ceiling (257 -> 360 GB/s,
+        PROBE_R5_bw.json); its call contract differs (scalar starts +
+        mask, no valid arrays). True leaf-sharing compositions
+        additionally upgrade to the shared program
+        (_shared_compile_*)."""
         backend = self._count_backend()
         if backend in ("pallas", "pallas_interpret"):
             interpret = backend == "pallas_interpret"
             # The key carries the exact backend string: "pallas" and
             # "pallas_interpret" compile different programs, and an
             # env flip between them must not serve the other's.
-            key = (sig, num_leaves, batch, backend)
+            key = (sig, num_leaves, batch, backend, bool(uniform))
+            if uniform:
+                from .mesh import compile_serve_count_coarse_pallas_uniform
+
+                return self._get_or_compile(
+                    self._coarse_fns, key,
+                    lambda: compile_serve_count_coarse_pallas_uniform(
+                        self.mesh, json.loads(sig), num_leaves, batch,
+                        interpret=interpret))
             if batch == 1:
                 from .mesh import compile_serve_count_coarse_pallas
 
@@ -986,7 +1017,7 @@ class MeshManager:
                 u = uniq.get(k)
                 if u is None:
                     u = uniq[k] = len(uniques)
-                    uniques.append((wt, ct[0], ct[1]))
+                    uniques.append((wt, ct[0], ct[1], ct[2]))
                 row.append(u)
             leaf_map.append(tuple(row))
         total_slots = sum(len(m) for m in leaf_map)
@@ -1011,10 +1042,17 @@ class MeshManager:
         if arg_bytes > arg_budget:
             return None
         sig = group[0].args[0]
+        backend = self._count_backend()
+        # Uniform layout (every unique leaf at ONE row-run index across
+        # slices — _leaf_arrays detects it) upgrades the shared program
+        # to the multi-slice-fetch kernel. In the KEY because a restage
+        # can change the layout: a uniform program must never serve a
+        # non-uniform staging of the same composition.
+        uniform = (backend in ("pallas", "pallas_interpret")
+                   and all(u[3] is not None for u in uniques))
         # The backend is part of the compile key: an env flip between
         # xla and pallas must not serve the other's program.
-        return ((sig, tuple(leaf_map), len(uniques),
-                 self._count_backend()),
+        return ((sig, tuple(leaf_map), len(uniques), backend, uniform),
                 tuple(leaf_map), uniques, ordered)
 
     _SHARED_FNS_MAX = 32
@@ -1037,17 +1075,35 @@ class MeshManager:
             while len(self._shared_fns) > self._SHARED_FNS_MAX:
                 self._shared_fns.popitem(last=False)
 
-    def _build_shared(self, tree_sig, leaf_map, num_unique, backend):
+    def _build_shared(self, tree_sig, leaf_map, num_unique, backend,
+                      uniform: bool = False):
         """Construct the shared-read batch program on `backend` — the
         string baked into the caller's cache key by _shared_plan, NOT
         re-read from the env here: a background build must cache the
-        program the key names even if the env flips mid-build."""
+        program the key names even if the env flips mid-build. With
+        `uniform` (also from the key) the program takes (words_t,
+        scalar starts (U,), mask) — the dispatch site checks the
+        wrapper's .uniform attribute for the contract."""
         if backend in ("pallas", "pallas_interpret"):
+            interpret = backend == "pallas_interpret"
+            if uniform:
+                from .mesh import (
+                    compile_serve_count_batch_shared_pallas_uniform)
+
+                base = compile_serve_count_batch_shared_pallas_uniform(
+                    self.mesh, json.loads(tree_sig), leaf_map,
+                    num_unique, interpret=interpret)
+
+                def fn(words_t, starts, mask, _base=base):
+                    return _base(words_t, starts, mask)
+
+                fn.uniform = True  # jit wrappers reject attributes
+                return fn
             from .mesh import compile_serve_count_batch_shared_pallas
 
             return compile_serve_count_batch_shared_pallas(
                 self.mesh, json.loads(tree_sig), leaf_map, num_unique,
-                interpret=backend == "pallas_interpret")
+                interpret=interpret)
         return compile_serve_count_batch_shared(
             self.mesh, json.loads(tree_sig), leaf_map, num_unique)
 
@@ -1059,7 +1115,7 @@ class MeshManager:
             fn = self._shared_get(key)
             if fn is None:
                 fn = self._build_shared(tree_sig, leaf_map, num_unique,
-                                        key[-1])
+                                        key[-2], uniform=key[-1])
                 self._shared_put(key, fn)
         return fn
 
@@ -1097,7 +1153,7 @@ class MeshManager:
         def build():
             try:
                 fn = self._build_shared(tree_sig, leaf_map, num_unique,
-                                        key[-1])
+                                        key[-2], uniform=key[-1])
                 self._shared_put(key, fn)
             finally:
                 with self._shared_mu:
@@ -1118,6 +1174,16 @@ class MeshManager:
             return None
         sig, words_t, idx_t, hit_t, coarse_t, dev_mask = prepared
         if all(c is not None for c in coarse_t):
+            ustarts = self._uniform_starts([coarse_t])
+            if ustarts is not None:
+                # No stat bump: this zero-arg callable is invoked many
+                # times per build (bench best_of), while the group
+                # runner counts per served query — mixing the two would
+                # make coarse_uniform uninterpretable. The runner paths
+                # are the serving truth; this entry stays stats-silent
+                # like it always was.
+                fn = self._coarse_fn(sig, len(idx_t), 1, uniform=True)
+                return lambda: fn(words_t, ustarts, dev_mask)[:, 0]
             fn = self._coarse_fn(sig, len(idx_t), 1)
             start_flat = tuple(c[0] for c in coarse_t)
             valid_flat = tuple(c[1] for c in coarse_t)
@@ -1274,10 +1340,17 @@ class MeshManager:
         if b == 1:
             sig, words_t, idx_t, hit_t, dev_mask = group[0].args
             if coarse_ok:
-                fn = self._coarse_fn(sig, len(idx_t), 1)
                 ct = group[0].coarse_t
-                limbs = fn(words_t, tuple(c[0] for c in ct),
-                           tuple(c[1] for c in ct), dev_mask)[:, 0]
+                ustarts = self._uniform_starts([ct])
+                if ustarts is not None:
+                    fn = self._coarse_fn(sig, len(idx_t), 1,
+                                         uniform=True)
+                    limbs = fn(words_t, ustarts, dev_mask)[:, 0]
+                    self.stats["coarse_uniform"] += 1
+                else:
+                    fn = self._coarse_fn(sig, len(idx_t), 1)
+                    limbs = fn(words_t, tuple(c[0] for c in ct),
+                               tuple(c[1] for c in ct), dev_mask)[:, 0]
                 self.stats["coarse"] += 1
             else:
                 fn = self._count_fn(sig, len(idx_t))
@@ -1315,22 +1388,40 @@ class MeshManager:
                             self._shared_compile_async(
                                 key, sig, leaf_map, len(uniques))
                 if shared is not None:
-                    limbs = shared(
-                        tuple(u[0] for u in uniques),
-                        tuple(u[1] for u in uniques),
-                        tuple(u[2] for u in uniques), dev_mask)
+                    if getattr(shared, "uniform", False):
+                        limbs = shared(
+                            tuple(u[0] for u in uniques),
+                            _np.asarray([u[3] for u in uniques],
+                                        dtype=_np.int32),
+                            dev_mask)
+                    else:
+                        limbs = shared(
+                            tuple(u[0] for u in uniques),
+                            tuple(u[1] for u in uniques),
+                            tuple(u[2] for u in uniques), dev_mask)
                     # shared output columns follow the CANONICAL group
                     # order; distribute results in that order (exact
                     # width, no padding)
                     group = ordered_group
                     self.stats["shared_batch"] += b
                 else:
-                    fn = self._coarse_fn(sig, num_leaves, b_pad)
-                    start_flat = tuple(r.coarse_t[i][0] for r in padded
-                                       for i in range(num_leaves))
-                    valid_flat = tuple(r.coarse_t[i][1] for r in padded
-                                       for i in range(num_leaves))
-                    limbs = fn(words_t, start_flat, valid_flat, dev_mask)
+                    ustarts = self._uniform_starts(
+                        [r.coarse_t for r in padded])
+                    if ustarts is not None:
+                        fn = self._coarse_fn(sig, num_leaves, b_pad,
+                                             uniform=True)
+                        limbs = fn(words_t, ustarts, dev_mask)
+                        self.stats["coarse_uniform"] += b
+                    else:
+                        fn = self._coarse_fn(sig, num_leaves, b_pad)
+                        start_flat = tuple(
+                            r.coarse_t[i][0] for r in padded
+                            for i in range(num_leaves))
+                        valid_flat = tuple(
+                            r.coarse_t[i][1] for r in padded
+                            for i in range(num_leaves))
+                        limbs = fn(words_t, start_flat, valid_flat,
+                                   dev_mask)
                 self.stats["coarse"] += b
             else:
                 fn = self._get_or_compile(
@@ -1437,8 +1528,21 @@ class MeshManager:
         sharding = NamedSharding(self.mesh, P(SLICE_AXIS))
         coarse = coarse_row_starts(sv.keys_host, dense_id)
         if coarse is not None:
-            coarse = (jax.device_put(coarse[0], sharding),
-                      jax.device_put(coarse[1], sharding))
+            starts_h, valid_h = coarse
+            # Uniform layout: the row sits at ONE run index on every
+            # slice (or is absent everywhere). Detected here, on host
+            # keys, so the Pallas path can run the multi-slice-fetch
+            # uniform kernel (coarse_count_uniform) — the scalar rides
+            # the cache as a plain int (None = not uniform).
+            if valid_h.all() and (starts_h == starts_h[0]).all():
+                uniform = int(starts_h[0])
+            elif not valid_h.any():
+                uniform = -1
+            else:
+                uniform = None
+            coarse = (jax.device_put(starts_h, sharding),
+                      jax.device_put(valid_h, sharding),
+                      uniform)
         out = (jax.device_put(flat_idx, sharding),
                jax.device_put(hit, sharding),
                coarse)

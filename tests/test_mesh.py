@@ -608,3 +608,180 @@ def test_coarse_count_batch_pallas_kernel_differential():
                 return words[s, starts[u, s] * 16:(starts[u, s] + 1) * 16]
             want = int(np.bitwise_count(blk(u0) & blk(u1)).sum())
             assert got[b, s] == want, (b, s, got[b, s], want)
+
+
+def test_coarse_count_uniform_kernel_differential():
+    """Uniform-layout multi-slice-fetch kernel vs numpy: scalar starts
+    per leaf, an absent leaf (negative start) contributing zero, at an
+    S where t>1 is picked (S=8 -> t=8) and one where only t=2 divides
+    (S=6)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pilosa_tpu.ops.kernels import coarse_count_uniform, _uniform_pick_t
+
+    rng = np.random.default_rng(17)
+    for S in (8, 6):
+        assert _uniform_pick_t(S) == (8 if S == 8 else 2)
+        words = rng.integers(0, 2**32, (S, 64, 2048), dtype=np.uint32)
+        pool = jnp.asarray(words)
+        for starts, f in (
+            (np.array([0, 2], np.int32), lambda a, b: a & b),
+            (np.array([3, -1], np.int32), lambda a, b: a & b),
+        ):
+            got = np.asarray(coarse_count_uniform(
+                (pool, pool), jnp.asarray(starts),
+                ["and", ["leaf", 0], ["leaf", 1]], interpret=True))[0]
+            for s in range(S):
+                def blk(l):
+                    if starts[l] < 0:
+                        return np.zeros((16, 2048), np.uint32)
+                    return words[s, starts[l] * 16:(starts[l] + 1) * 16]
+                want = int(np.bitwise_count(f(blk(0), blk(1))).sum())
+                assert got[s] == want, (S, list(starts), s)
+
+
+def test_coarse_count_uniform_batch_kernel_differential():
+    """Uniform batch kernel: B queries with per-slot scalar starts over
+    the leaf-position pools, absent slots zeroed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pilosa_tpu.ops.kernels import coarse_count_uniform_batch
+
+    rng = np.random.default_rng(21)
+    S = 8
+    words = rng.integers(0, 2**32, (S, 64, 2048), dtype=np.uint32)
+    pool = jnp.asarray(words)
+    starts = np.array([0, 1, 2, 3, 1, -1], dtype=np.int32)  # B=3, L=2
+    got = np.asarray(coarse_count_uniform_batch(
+        (pool, pool), jnp.asarray(starts),
+        ["or", ["leaf", 0], ["leaf", 1]], interpret=True))
+    assert got.shape == (3, S)
+    for b in range(3):
+        for s in range(S):
+            def blk(l):
+                st = starts[b * 2 + l]
+                if st < 0:
+                    return np.zeros((16, 2048), np.uint32)
+                return words[s, st * 16:(st + 1) * 16]
+            want = int(np.bitwise_count(blk(0) | blk(1)).sum())
+            assert got[b, s] == want, (b, s)
+
+
+def test_serve_uniform_pallas_path_selected(mesh, tmp_path, monkeypatch):
+    """End-to-end: a uniformly-staged dense view takes the uniform
+    Pallas program (stats coarse_uniform moves) and matches the host;
+    a leaf ABSENT from one slice falls back to the per-slice coarse
+    program (coarse moves, coarse_uniform doesn't) with the same
+    answer."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pql import parse_string
+
+    h = Holder(str(tmp_path / "u"))
+    h.open()
+    f = h.create_index_if_not_exists("i").create_frame_if_not_exists("g")
+    for s in range(8):
+        for blk in range(16):
+            for b in (1, 5, 9):
+                f.set_bit(0, s * (1 << 20) + blk * 65536 + b)
+                f.set_bit(1, s * (1 << 20) + blk * 65536 + b + (s % 2))
+                if s != 7:  # row 2 absent from slice 7: non-uniform
+                    f.set_bit(2, s * (1 << 20) + blk * 65536 + b + 1)
+    host = Executor(h, use_device=False)
+    monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
+    ep = Executor(h, use_device=True, device_min_work=0)
+
+    uni_pql = "Count(Intersect(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=1)))"
+    want = host.execute("i", parse_string(uni_pql))[0]
+    assert ep.execute("i", parse_string(uni_pql))[0] == want
+    assert ep.mesh_manager().stats["coarse_uniform"] >= 1
+
+    before = ep.mesh_manager().stats["coarse_uniform"]
+    mixed_pql = "Count(Intersect(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=2)))"
+    want2 = host.execute("i", parse_string(mixed_pql))[0]
+    assert ep.execute("i", parse_string(mixed_pql))[0] == want2
+    assert ep.mesh_manager().stats["coarse_uniform"] == before
+    assert ep.mesh_manager().stats["coarse"] >= 2
+
+
+def test_coarse_count_shared_uniform_kernel_differential():
+    """Shared-read uniform kernel: B folds per t-slice block over U
+    unique scalar-start rows, aliased leaf_map, absent unique zeroed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pilosa_tpu.ops.kernels import coarse_count_shared_uniform
+
+    rng = np.random.default_rng(29)
+    S, U = 8, 3
+    words = rng.integers(0, 2**32, (S, 64, 2048), dtype=np.uint32)
+    pool = jnp.asarray(words)
+    views = tuple(pool for _ in range(U))
+    starts = np.array([0, 2, -1], dtype=np.int32)
+    tree = ["and", ["leaf", 0], ["leaf", 1]]
+    leaf_map = ((0, 1), (1, 2), (0, 0), (2, 1))
+    got = np.asarray(coarse_count_shared_uniform(
+        views, jnp.asarray(starts), tree, leaf_map, interpret=True))
+    assert got.shape == (len(leaf_map), S)
+    for b, (u0, u1) in enumerate(leaf_map):
+        for s in range(S):
+            def blk(u):
+                if starts[u] < 0:
+                    return np.zeros((16, 2048), np.uint32)
+                return words[s, starts[u] * 16:(starts[u] + 1) * 16]
+            want = int(np.bitwise_count(blk(u0) & blk(u1)).sum())
+            assert got[b, s] == want, (b, s)
+
+
+def test_serve_shared_uniform_upgrade(mesh, tmp_path, monkeypatch):
+    """End-to-end: a repeated SHARED composition over a uniformly
+    staged pool compiles the uniform shared program (key carries
+    uniform=True, wrapper has .uniform) and matches the host."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pql import parse_string
+
+    h = Holder(str(tmp_path / "su"))
+    h.open()
+    f = h.create_index_if_not_exists("i").create_frame_if_not_exists("g")
+    for s in range(8):
+        for blk in range(16):
+            for r in range(4):
+                for b in (1, 5, 9 + r):
+                    f.set_bit(r, s * (1 << 20) + blk * 65536 + b)
+    host = Executor(h, use_device=False)
+    monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
+    monkeypatch.setenv("PILOSA_TPU_BATCH_SHARED", "sync")
+    ep = Executor(h, use_device=True, device_min_work=0)
+    mgr = ep.mesh_manager()
+
+    pairs = [(0, 1), (1, 2), (0, 2), (2, 3)]
+    pqls = [("Count(Intersect(Bitmap(frame=g, rowID=%d), "
+             "Bitmap(frame=g, rowID=%d)))") % p for p in pairs]
+    want = [host.execute("i", parse_string(q))[0] for q in pqls]
+
+    # warm staging via one query, then drive a herd through the group
+    # runner so the shared plan forms
+    assert ep.execute("i", parse_string(pqls[0]))[0] == want[0]
+    reqs = []
+    for q in pqls:
+        t = parse_string(q).calls[0].children[0]
+        from pilosa_tpu.parallel.plan import _lower_tree
+        leaves = []
+        shape = _lower_tree(h, "i", t, leaves)
+        prepared = mgr._count_args("i", shape, leaves, list(range(8)), 8)
+        from pilosa_tpu.parallel.serve import _CountRequest
+        r = _CountRequest(*prepared)
+        r.leaf_keys = tuple(("g", "standard", rid) for rid in
+                            (pairs[pqls.index(q)]))
+        reqs.append(r)
+    mgr._run_count_group(reqs)
+    for r in reqs:
+        assert r.done.wait(60), "count request did not complete"
+        assert r.error is None, r.error
+    got = [int(r.result) for r in reqs]
+    assert got == want
+    assert any(len(k) >= 5 and k[-1] is True for k in mgr._shared_fns), \
+        list(mgr._shared_fns)

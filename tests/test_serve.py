@@ -1418,7 +1418,7 @@ class TestAdaptiveSharedBatching:
         assert [r.result for r in group] == want
         assert mgr.stats["shared_batch"] == 0
         assert mgr.stats["batched"] == 3
-        assert any(len(k) == 4 and k[3] == "pallas_interpret"
+        assert any(len(k) == 5 and k[3] == "pallas_interpret"
                    and k[2] == mgr._MAX_BATCH
                    for k in mgr._coarse_fns), list(mgr._coarse_fns)
 
@@ -1444,7 +1444,7 @@ class TestAdaptiveSharedBatching:
         assert [r.result for r in group] == want
         assert mgr.stats["shared_batch"] == 4
         keys = list(mgr._shared_fns)
-        assert keys and keys[0][-1] == "pallas_interpret"
+        assert keys and keys[0][-2] == "pallas_interpret"
         # Same composition on the XLA backend: separate cache entry,
         # same results.
         monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "xla")
@@ -1452,7 +1452,7 @@ class TestAdaptiveSharedBatching:
         mgr._run_count_group(group2)
         assert [r.result for r in group2] == want
         assert len(mgr._shared_fns) == 2
-        assert {k[-1] for k in mgr._shared_fns} == {"pallas_interpret",
+        assert {k[-2] for k in mgr._shared_fns} == {"pallas_interpret",
                                                     "xla"}
 
     def test_auto_policy_compiles_in_background(self, holder, monkeypatch):

@@ -1,0 +1,157 @@
+"""On-chip bandwidth probe for the coarse count kernels (round 5).
+
+Question: the per-slice coarse kernel fetches one 128 KB block per
+leaf per grid step; at 960-3072 steps, does per-step DMA issue
+overhead dominate, and does fetching T slices per step (possible
+whenever every slice stores the leaf at the SAME row-run index — true
+for every dense/staged-uniform pool) close the gap to the chip's HBM
+roofline?
+
+Rows printed per config: current per-slice kernel, T-blocked uniform
+variants, and the XLA whole-pool popcount (the no-gather bandwidth
+ceiling for this access pattern).
+
+Run: PYTHONPATH=/root/repo python tools/probe_r5_bw.py  (TPU; ~2 min)
+"""
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from pilosa_tpu.ops.bitops import fold_tree  # noqa: E402
+from pilosa_tpu.ops.kernels import coarse_count_per_slice  # noqa: E402
+
+ROW_SPAN = 16
+LANES = 2048
+TREE = ["and", ["leaf", 0], ["leaf", 1]]
+
+
+def _uniform_kernel(tree, num_leaves, t, starts_ref, *refs):
+    o_ref = refs[num_leaves]
+    base = pl.program_id(0) * t
+
+    def leaf(i):
+        blk = refs[i][...]
+        keep = starts_ref[i] >= 0
+        return jnp.where(keep, blk, jnp.uint32(0))
+
+    folded = fold_tree(tree, leaf)  # (T, 1, 16, 2048)
+    # One full reduce per sub-slice: Mosaic lowers scalar full-reduces
+    # into SMEM, but not vector-element extracts (the axis=(1,2,3)
+    # partial reduce + per[j] form fails with "Invalid input layout").
+    for j in range(t):
+        o_ref[0, base + j] = jnp.sum(
+            lax.population_count(folded[j]).astype(jnp.int32))
+
+
+def coarse_count_uniform(views, starts, tree, t, *, interpret=False):
+    """starts: (L,) int32 scalar row-run index per leaf (uniform across
+    slices; negative = absent leaf). Returns (1, S) int32."""
+    num_leaves = len(views)
+    s_n = views[0].shape[0]
+    assert s_n % t == 0, (s_n, t)
+    # (S, cap, 2048) -> (S, cap/16, 16, 2048): a leading-dim split is
+    # layout-preserving (no lane retiling), and makes the row-run a
+    # full trailing (16, 2048) block Mosaic can tile.
+    views = tuple(v.reshape(v.shape[0], v.shape[1] // ROW_SPAN,
+                            ROW_SPAN, LANES) for v in views)
+
+    def leaf_spec(leaf):
+        return pl.BlockSpec(
+            (t, 1, ROW_SPAN, LANES),
+            lambda i, starts_ref, leaf=leaf: (
+                i, jnp.maximum(starts_ref[leaf], 0), 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n // t,),
+        in_specs=[leaf_spec(leaf) for leaf in range(num_leaves)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_uniform_kernel, tree, num_leaves, t),
+        out_shape=jax.ShapeDtypeStruct((1, s_n), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *views)
+
+
+def best_of(call, reps=3, iters=10):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = call()
+        jax.block_until_ready(r)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    out = {"backend": jax.devices()[0].platform}
+    rng = np.random.default_rng(7)
+    for s_n in (960, 3072):
+        cap = 32  # two dense rows of 16 runs each
+        pool = jnp.asarray(
+            rng.integers(0, 2**32, size=(s_n, cap, LANES), dtype=np.uint32))
+        bytes_read = 2 * s_n * ROW_SPAN * LANES * 4  # both leaves
+        starts_u = jnp.asarray(np.array([0, 1], dtype=np.int32))
+        starts_ps = jnp.stack([jnp.zeros(s_n, jnp.int32),
+                               jnp.ones(s_n, jnp.int32)])
+
+        # reference result from XLA for correctness
+        a = pool[:, 0:16, :]
+        b = pool[:, 16:32, :]
+        want = int(jnp.sum(lax.population_count(a & b).astype(jnp.int32)))
+
+        cur = jax.jit(lambda p, st: coarse_count_per_slice(
+            (p, p), st, TREE))
+        got = int(jnp.sum(cur(pool, starts_ps)))
+        assert got == want, (got, want)
+        dt = best_of(lambda: cur(pool, starts_ps))
+        out[f"s{s_n}_per_slice_ms"] = round(dt * 1e3, 3)
+        out[f"s{s_n}_per_slice_gbps"] = round(bytes_read / dt / 1e9, 1)
+
+        for t in (4, 8, 16, 32):
+            uni = jax.jit(functools.partial(
+                coarse_count_uniform, t=t, tree=TREE))
+            got = int(jnp.sum(uni((pool, pool), starts_u)))
+            assert got == want, (t, got, want)
+            dt = best_of(lambda: uni((pool, pool), starts_u))
+            out[f"s{s_n}_uniform_t{t}_ms"] = round(dt * 1e3, 3)
+            out[f"s{s_n}_uniform_t{t}_gbps"] = round(bytes_read / dt / 1e9, 1)
+
+        # XLA ceiling: popcount the two static slices, no gather
+        ceil_fn = jax.jit(lambda p: jnp.sum(lax.population_count(
+            p[:, 0:16, :] & p[:, 16:32, :]).astype(jnp.int32)))
+        assert int(ceil_fn(pool)) == want
+        dt = best_of(lambda: ceil_fn(pool))
+        out[f"s{s_n}_xla_static_ms"] = round(dt * 1e3, 3)
+        out[f"s{s_n}_xla_static_gbps"] = round(bytes_read / dt / 1e9, 1)
+
+        # whole-pool popcount: the pure-stream roofline number
+        stream_fn = jax.jit(lambda p: jnp.sum(
+            lax.population_count(p).astype(jnp.int32)))
+        dt = best_of(lambda: stream_fn(pool))
+        pool_bytes = s_n * cap * LANES * 4
+        out[f"s{s_n}_stream_ms"] = round(dt * 1e3, 3)
+        out[f"s{s_n}_stream_gbps"] = round(pool_bytes / dt / 1e9, 1)
+        print(json.dumps(out), flush=True)
+
+    with open("PROBE_R5_bw.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
