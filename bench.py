@@ -348,20 +348,11 @@ def main():
                               dict(os.environ, PILOSA_TPU_PALLAS="off"))
 
             threading.Thread(target=pallas_watchdog, daemon=True).start()
-            try:
-                from jax.experimental import pallas as pl
+            from pilosa_tpu.ops.kernels import pallas_probe_ok
 
-                def _pk(x_ref, o_ref):
-                    o_ref[:] = x_ref[:] + 1
-
-                _pout = pl.pallas_call(
-                    _pk,
-                    out_shape=jax.ShapeDtypeStruct((8, 128), _jnp.int32))(
-                    _jnp.zeros((8, 128), _jnp.int32))
-                pallas_ok = bool((np.asarray(_pout) == 1).all())
-            except Exception as pe:  # noqa: BLE001 — any failure: xla
-                _progress(f"pallas probe failed ({pe}); staying on xla")
-                pallas_ok = False
+            pallas_ok = pallas_probe_ok()
+            if not pallas_ok:
+                _progress("pallas probe failed; staying on xla")
             pallas_done.set()
             if pallas_ok:
                 os.environ["PILOSA_TPU_COUNT_BACKEND"] = "pallas"

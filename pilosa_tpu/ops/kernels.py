@@ -26,6 +26,25 @@ from .pool import CONTAINER_WORDS, ROW_SPAN
 _BLOCK_M = 64
 
 
+def pallas_probe_ok() -> bool:
+    """Compile + run ONE trivial Pallas kernel and check the result —
+    the canary for 'can this rig compile Pallas at all' (the r3/r4
+    relay hung EVERY pallas compile; r5's does not). Blocks for the
+    compile; callers own their hang policy (bench.py: watchdog thread
+    that re-execs with pallas pinned off; serve._resolve_auto_backend:
+    daemon probe thread with a bounded wait and a cached verdict)."""
+    try:
+        import numpy as np
+
+        out = pl.pallas_call(
+            lambda x_ref, o_ref: o_ref.__setitem__(..., x_ref[...] + 1),
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(
+            jnp.zeros((8, 128), jnp.int32))
+        return bool((np.asarray(out) == 1).all())
+    except Exception:  # noqa: BLE001 — any failure means "no pallas"
+        return False
+
+
 def use_pallas() -> bool:
     """True when the Pallas TPU path should be used.
 

@@ -893,24 +893,87 @@ class MeshManager:
             lambda: compile_serve_count(self.mesh, json.loads(sig),
                                         num_leaves))
 
-    @staticmethod
-    def _count_backend() -> str:
-        """PILOSA_TPU_COUNT_BACKEND: "xla" (default), "pallas", or
-        "pallas_interpret" (CPU test path). r5 hardware measurements
-        (PROFILE_RELAY.md §4): with the pools streamed in native shape
-        the coarse Pallas kernels beat the XLA gather programs 1.7-2.7x
-        single-query, 2.2x at herd width 16, and 5.2x on the 28-pair
-        shared batch. The default stays XLA because a relay regression
-        re-introducing the r3/r4 Pallas-compile hang would wedge a
-        server at first query; bench.py probes Pallas IN-PROCESS under
-        a watchdog that re-execs the bench with pallas pinned off on a
-        hang (in-process state is lost; the decision rides the re-exec
-        env), and opts in when the probe passes — deployments on
-        attached TPUs should set pallas outright."""
+    # "auto" resolution cache: None = unresolved, else "pallas"/"xla".
+    # Process-wide (the probe compiles one trivial kernel; its verdict
+    # holds for every manager in the process).
+    _AUTO_BACKEND: "Optional[str]" = None
+    _AUTO_MU = threading.Lock()
+
+    @classmethod
+    def _count_backend(cls) -> str:
+        """PILOSA_TPU_COUNT_BACKEND: "xla" (default), "pallas",
+        "pallas_interpret" (CPU test path), or "auto". r5 hardware
+        measurements (PROFILE_RELAY.md §4): with the pools streamed in
+        native shape the coarse Pallas kernels beat the XLA gather
+        programs 1.7-2.7x single-query, 2.2x at herd width 16, and
+        5.2x on the 28-pair shared batch. The default stays XLA
+        because a relay regression re-introducing the r3/r4
+        Pallas-compile hang would wedge a server at first query;
+        bench.py probes Pallas IN-PROCESS under a watchdog that
+        re-execs the bench with pallas pinned off on a hang.
+
+        "auto" (opt-in) does that probe here, once, at first use: a
+        trivial kernel compiles under a watchdog
+        (PILOSA_TPU_PALLAS_PROBE_TIMEOUT_S, default 60); pass →
+        pallas, fail or non-TPU backend → xla. On a hang the probe
+        thread is abandoned (daemon) and pallas is pinned off for the
+        process — on rigs whose transport serializes compiles with
+        dispatch the hung compile can still wedge later traffic,
+        which is WHY auto is opt-in and the hang verdict is cached; on
+        direct-attached TPUs there is no known hang class and auto is
+        the recommended server setting."""
         import os
 
         v = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+        if v == "auto":
+            return cls._resolve_auto_backend()
         return v if v in ("pallas", "pallas_interpret") else "xla"
+
+    @classmethod
+    def _resolve_auto_backend(cls) -> str:
+        # Lock-free fast path: the verdict is written once, under the
+        # lock; reading a stale None merely re-enters the arbitration
+        # below. Queries arriving DURING the (up to 60 s) probe serve
+        # on xla instead of blocking behind it — the compile keys
+        # differ per backend, so the switch mid-stream is safe.
+        v = cls._AUTO_BACKEND
+        if v is not None:
+            return v
+        if not cls._AUTO_MU.acquire(blocking=False):
+            return "xla"
+        try:
+            if cls._AUTO_BACKEND is not None:
+                return cls._AUTO_BACKEND
+            import os
+
+            import jax
+
+            if jax.default_backend() != "tpu":
+                cls._AUTO_BACKEND = "xla"
+                return "xla"
+            try:
+                timeout = float(os.environ.get(
+                    "PILOSA_TPU_PALLAS_PROBE_TIMEOUT_S", "60"))
+            except ValueError:  # malformed env: degrade, don't crash
+                timeout = 60.0
+            ok_box = {"ok": False}
+            done = threading.Event()
+
+            def probe():
+                from ..ops.kernels import pallas_probe_ok
+
+                try:
+                    ok_box["ok"] = pallas_probe_ok()
+                finally:
+                    done.set()
+
+            threading.Thread(target=probe, daemon=True,
+                             name="pallas-auto-probe").start()
+            done.wait(timeout)
+            cls._AUTO_BACKEND = "pallas" if ok_box["ok"] else "xla"
+            return cls._AUTO_BACKEND
+        finally:
+            cls._AUTO_MU.release()
 
     def _uniform_starts(self, coarse_ts):
         """(B*L,) int32 scalar starts for the uniform Pallas programs,

@@ -1762,3 +1762,51 @@ class TestFailedStageClamp:
             _t.sleep(0.01)
         assert sv.last_stage_s is not None
         assert 0.25 <= sv.last_stage_s < mgr._FAILED_STAGE_FLOOR_S
+
+
+class TestAutoBackend:
+    """PILOSA_TPU_COUNT_BACKEND=auto: probe-once resolution. Every
+    test pins _AUTO_BACKEND via monkeypatch so a failing assertion
+    cannot leak a mutated class-level verdict into later tests."""
+
+    def test_auto_on_non_tpu_resolves_xla_without_probe(self, monkeypatch):
+        import jax
+
+        from pilosa_tpu.parallel.serve import MeshManager
+        monkeypatch.setattr(MeshManager, "_AUTO_BACKEND", None)
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "auto")
+        # Pin the non-TPU branch explicitly: on a TPU-attached rig the
+        # bare default_backend() would launch a real probe here.
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert MeshManager._count_backend() == "xla"
+        assert MeshManager._AUTO_BACKEND == "xla"
+
+    def test_auto_resolution_is_cached(self, monkeypatch):
+        from pilosa_tpu.parallel.serve import MeshManager
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "auto")
+        monkeypatch.setattr(MeshManager, "_AUTO_BACKEND", "pallas")
+        assert MeshManager._count_backend() == "pallas"
+
+    def test_malformed_probe_timeout_degrades_to_default(self, monkeypatch):
+        import jax
+
+        from pilosa_tpu.parallel.serve import MeshManager
+        monkeypatch.setattr(MeshManager, "_AUTO_BACKEND", None)
+        monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "auto")
+        monkeypatch.setenv("PILOSA_TPU_PALLAS_PROBE_TIMEOUT_S", "60s")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # the probe itself fails fast on the CPU rig (no TPU pallas),
+        # so resolution completes; the malformed timeout must not raise
+        monkeypatch.setattr(
+            "pilosa_tpu.ops.kernels.pallas_probe_ok", lambda: False)
+        assert MeshManager._count_backend() == "xla"
+
+    def test_explicit_values_bypass_auto(self, monkeypatch):
+        from pilosa_tpu.parallel.serve import MeshManager
+        monkeypatch.setattr(MeshManager, "_AUTO_BACKEND", None)
+        for v, want in (("pallas", "pallas"),
+                        ("pallas_interpret", "pallas_interpret"),
+                        ("xla", "xla"), ("bogus", "xla")):
+            monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", v)
+            assert MeshManager._count_backend() == want
+        assert MeshManager._AUTO_BACKEND is None  # auto never resolved
