@@ -82,6 +82,14 @@ class _MutationEpoch:
             self.n += 1
             self.s += 1
 
+    def read(self) -> tuple:
+        """Consistent (n, s) snapshot. Lock-guarded so a reader racing
+        bump_structural can't observe the new `n` with the old `s` —
+        a torn pair recorded as a validation stamp would mark state
+        validated that the stamping walk never saw."""
+        with self._mu:
+            return (self.n, self.s)
+
 
 MUTATION_EPOCH = _MutationEpoch()
 

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.fragment import MUTATION_EPOCH
 from ..ops.pool import fold_log_entries, plan_slice_mutations
 from .mesh import (
     SLICE_AXIS,
@@ -74,7 +75,8 @@ class StagedView:
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
                  "num_slices", "idx_cache", "last_used", "last_stage_s",
-                 "inc_spend_s", "inc_ewma_s", "inc_count")
+                 "inc_spend_s", "inc_ewma_s", "inc_count",
+                 "validated_epoch")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -113,6 +115,13 @@ class StagedView:
         # Incremental applies since this view was staged — drives the
         # deterministic (count-based) restage policy in SPMD mode.
         self.inc_count = 0
+        # MUTATION_EPOCH.read() pair captured BEFORE the last staleness
+        # walk that found (or made) this view current. refresh()'s O(1)
+        # fast path: while the process-wide pair hasn't moved, no
+        # fragment generation can have moved either (every generation
+        # bump pairs with an epoch bump — fragment.py:334-346), so the
+        # per-slice walk is skipped entirely. None = never validated.
+        self.validated_epoch: Optional[tuple] = None
 
     @property
     def padded_slices(self) -> int:
@@ -304,6 +313,8 @@ class MeshManager:
         self._measure_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._measure_thread: Optional[threading.Thread] = None
         self._mask_cache: "OrderedDict[bytes, object]" = OrderedDict()
+        # Replicated uniform-starts vectors, by value (_device_starts).
+        self._starts_cache: "OrderedDict[tuple, object]" = OrderedDict()
         self._batch_q: "queue.Queue[_CountRequest]" = queue.Queue()
         # Dispatched-but-unfetched batches (see _fetch_loop); maxsize is
         # the readback pipeline depth — one slot per fetch worker plus
@@ -562,13 +573,36 @@ class MeshManager:
         if idx is None or idx.frame(frame) is None:
             return None
         key = (index, frame, view)
+        # Epoch pair read BEFORE any staleness inspection: a write that
+        # lands mid-walk bumps the pair past `ep`, so stamping `ep`
+        # after the walk can never mark that write validated. Ordering
+        # on the write side: generation moves first, the epoch second
+        # (fragment.py:334-335) — any bump included in `ep` has its
+        # generation visible to the walk/snapshot below.
+        ep = MUTATION_EPOCH.read()
         with self._mu:
             sv = self._views.get(key)
             if sv is not None:
                 self._views.move_to_end(key)  # LRU: most recently used
                 sv.last_used = self._use_epoch
+                if (sv.validated_epoch == ep
+                        and sv.num_slices == num_slices):
+                    # O(1) fast path: nothing in the process has
+                    # mutated since the pair was stamped, so no
+                    # fragment generation can have moved — skip the
+                    # per-slice walk (960 lock-and-compare iterations
+                    # at headline scale, serialized under _mu; measured
+                    # as the dominant host cost of a concurrent herd).
+                    return sv
             if sv is None or sv.num_slices != num_slices:
-                return self._stage(key, num_slices)
+                fresh = self._stage(key, num_slices)
+                fresh.validated_epoch = ep
+                return fresh
+
+            def restage():
+                f = self._stage(key, num_slices)
+                f.validated_epoch = ep
+                return f
 
             pending: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
             new_gens = list(sv.slice_gens)
@@ -578,12 +612,12 @@ class MeshManager:
                 if frag is None:
                     if staged is None:
                         continue
-                    return self._stage(key, num_slices)  # fragment deleted
+                    return restage()  # fragment deleted
                 if staged is None or staged[0] is not frag:
                     # New fragment object (appeared, or the index was
                     # deleted and recreated): generations from a
                     # different object are meaningless — restage.
-                    return self._stage(key, num_slices)
+                    return restage()
                 staged_gen = staged[1]
                 with frag._mu:
                     gen = frag.generation
@@ -591,11 +625,12 @@ class MeshManager:
                         continue
                     entries = frag.log_since(staged_gen)
                 if entries is None or any(e[2] for e in entries):
-                    return self._stage(key, num_slices)
+                    return restage()
                 pending[s] = fold_log_entries(entries)
                 new_gens[s] = (frag, gen)
 
             if not pending:
+                sv.validated_epoch = ep
                 return sv
             # Cost gate (VERDICT r3 #7): incremental scatter vs full
             # restage, decided from MEASURED costs on THIS backend —
@@ -620,7 +655,7 @@ class MeshManager:
                 # on every rank.
                 if sv.inc_count >= self._DET_RESTAGE_EVERY:
                     self.stats["refresh_pick_restage"] += 1
-                    return self._stage(key, num_slices)
+                    return restage()
             else:
                 # Per-VIEW incremental estimate (ADVICE r4): comparing a
                 # per-view stage time against a manager-global EWMA let
@@ -654,7 +689,7 @@ class MeshManager:
                         # evidence against incremental, so it must not
                         # bias the estimate.)
                         sv.inc_ewma_s = inc_est * 0.9
-                    return self._stage(key, num_slices)
+                    return restage()
             t_inc = time.monotonic()
             per_slice = {}
             try:
@@ -662,7 +697,7 @@ class MeshManager:
                     per_slice[s] = plan_slice_mutations(
                         sv.keys_host[s], sv.row_ids, pos, val)
             except KeyError:
-                return self._stage(key, num_slices)
+                return restage()
             batches = pack_mutation_batches(
                 per_slice, sv.padded_slices, sv.keys_host.shape[1])
             if self._apply_fn is None:
@@ -680,6 +715,7 @@ class MeshManager:
             self._purge_memo(sv.sharded.words)
             sv.sharded = self._apply_fn(sv.sharded, *batches)
             sv.slice_gens = new_gens
+            sv.validated_epoch = ep
             sv.inc_count += 1
             self.stats["incremental"] += 1
             self.stats["refresh_pick_incremental"] += 1
@@ -806,10 +842,11 @@ class MeshManager:
 
     def _mask_for(self, sv: StagedView, slices: Sequence[int]):
         mask = np.zeros(sv.padded_slices, dtype=np.int32)
-        for s in slices:
-            if s >= sv.num_slices:
+        idx = np.asarray(slices, dtype=np.int64)
+        if idx.size:
+            if int(idx.max()) >= sv.num_slices:
                 return None  # staged image doesn't cover the request
-            mask[s] = 1
+            mask[idx] = 1
         return mask
 
     def _count_args(self, index: str, shape, leaves, slices: Sequence[int],
@@ -1228,10 +1265,11 @@ class MeshManager:
     def _count_call(self, index: str, shape, leaves, slices: Sequence[int],
                     num_slices: int):
         """A zero-arg callable running ONE compiled (unbatched) serving
-        count, returning the (2,) [lo, hi] limbs — the benchmarking
-        entry for the engine rate without queueing/readback. Picks the
-        coarse program when every leaf is eligible, exactly as the
-        batch loop does."""
+        count, returning [lo, hi] limbs in the program's native device
+        shape — (2, 1) coarse, (2,) general — the benchmarking entry
+        for the engine rate without queueing/readback. Picks the coarse
+        program when every leaf is eligible, exactly as the batch loop
+        does."""
         prepared = self._count_args(index, shape, leaves, slices, num_slices)
         if prepared is None:
             return None
@@ -1244,14 +1282,17 @@ class MeshManager:
                 # runner counts per served query — mixing the two would
                 # make coarse_uniform uninterpretable. The runner paths
                 # are the serving truth; this entry stays stats-silent
-                # like it always was.
+                # like it always was. Coarse calls return their native
+                # (2, 1) device shape — a device-side [:, 0] squeeze
+                # would be a second full program dispatch per call
+                # (~2.5 ms through the relay); callers slice host-side.
                 fn = self._coarse_fn(sig, len(idx_t), 1, uniform=True)
-                return lambda: fn(words_t, ustarts, dev_mask)[:, 0]
+                du = self._device_starts(ustarts)
+                return lambda: fn(words_t, du, dev_mask)
             fn = self._coarse_fn(sig, len(idx_t), 1)
             start_flat = tuple(c[0] for c in coarse_t)
             valid_flat = tuple(c[1] for c in coarse_t)
-            return lambda: fn(words_t, start_flat, valid_flat,
-                              dev_mask)[:, 0]
+            return lambda: fn(words_t, start_flat, valid_flat, dev_mask)
         fn = self._count_fn(sig, len(idx_t))
         return lambda: fn(words_t, idx_t, hit_t, dev_mask)
 
@@ -1403,17 +1444,22 @@ class MeshManager:
         if b == 1:
             sig, words_t, idx_t, hit_t, dev_mask = group[0].args
             if coarse_ok:
+                # Coarse singles keep their (2, 1) device shape: the
+                # [:, 0] squeeze is a SECOND program dispatch (~2.5 ms
+                # through the relay — a full extra floor on a lone
+                # query); finish() slices host-side after the fetch.
                 ct = group[0].coarse_t
                 ustarts = self._uniform_starts([ct])
                 if ustarts is not None:
                     fn = self._coarse_fn(sig, len(idx_t), 1,
                                          uniform=True)
-                    limbs = fn(words_t, ustarts, dev_mask)[:, 0]
+                    limbs = fn(words_t, self._device_starts(ustarts),
+                               dev_mask)
                     self.stats["coarse_uniform"] += 1
                 else:
                     fn = self._coarse_fn(sig, len(idx_t), 1)
                     limbs = fn(words_t, tuple(c[0] for c in ct),
-                               tuple(c[1] for c in ct), dev_mask)[:, 0]
+                               tuple(c[1] for c in ct), dev_mask)
                 self.stats["coarse"] += 1
             else:
                 fn = self._count_fn(sig, len(idx_t))
@@ -1454,8 +1500,9 @@ class MeshManager:
                     if getattr(shared, "uniform", False):
                         limbs = shared(
                             tuple(u[0] for u in uniques),
-                            _np.asarray([u[3] for u in uniques],
-                                        dtype=_np.int32),
+                            self._device_starts(_np.asarray(
+                                [u[3] for u in uniques],
+                                dtype=_np.int32)),
                             dev_mask)
                     else:
                         limbs = shared(
@@ -1473,7 +1520,8 @@ class MeshManager:
                     if ustarts is not None:
                         fn = self._coarse_fn(sig, num_leaves, b_pad,
                                              uniform=True)
-                        limbs = fn(words_t, ustarts, dev_mask)
+                        limbs = fn(words_t, self._device_starts(ustarts),
+                                   dev_mask)
                         self.stats["coarse_uniform"] += b
                     else:
                         fn = self._coarse_fn(sig, num_leaves, b_pad)
@@ -1614,24 +1662,54 @@ class MeshManager:
         sv.idx_cache[dense_id] = out
         return out
 
+    def _device_cached(self, cache: "OrderedDict", key, cap: int, make):
+        """Value-keyed LRU of device copies — the shared body of
+        _device_mask/_device_starts. Callers on the query path hold _mu
+        or run on the single batch thread; individual dict ops are
+        GIL-atomic, so a rare race costs one duplicate device_put."""
+        cached = cache.get(key)
+        if cached is not None:
+            cache.move_to_end(key)  # LRU, not FIFO
+            return cached
+        dev = make()
+        if len(cache) >= cap:
+            cache.popitem(last=False)
+        cache[key] = dev
+        return dev
+
     def _device_mask(self, mask: np.ndarray):
         """Slice-ownership masks are few (one per cluster split) and
         reused every query — cache the device copies. Call under _mu."""
         key = mask.tobytes()
-        cached = self._mask_cache.get(key)
-        if cached is not None:
-            self._mask_cache.move_to_end(key)  # LRU, not FIFO
-            self.stats["mask_cache_hit"] += 1
-            return cached
-        self.stats["mask_cache_miss"] += 1
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        hit = key in self._mask_cache
+        self.stats["mask_cache_hit" if hit else "mask_cache_miss"] += 1
 
-        dev = jax.device_put(mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
-        if len(self._mask_cache) >= 64:
-            self._mask_cache.popitem(last=False)
-        self._mask_cache[key] = dev
-        return dev
+        def make():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(
+                mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
+
+        return self._device_cached(self._mask_cache, key, 64, make)
+
+    def _device_starts(self, starts: np.ndarray):
+        """Replicated device copy of a uniform-starts vector, cached by
+        value. The uniform programs take starts as a replicated (B*L,)
+        int32 arg; passing the host ndarray re-uploads it every call —
+        free on attached chips, but one more transfer riding the
+        dispatch path through a relay. Herd compositions repeat, so a
+        small LRU (keyed by the scalar values) makes the steady state
+        all device-resident handles."""
+        key = (starts.shape[0], starts.tobytes())
+
+        def make():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(starts, NamedSharding(self.mesh, P()))
+
+        return self._device_cached(self._starts_cache, key, 256, make)
 
     def _row_counts_args(self, index: str, frame: str, view: str,
                          slices: Sequence[int], num_slices: int):

@@ -180,6 +180,72 @@ class TestIncrementalWrites:
         assert q(e, "i", "Count(Bitmap(rowID=10))") == [8]
 
 
+class TestRefreshFastPath:
+    """refresh()'s O(1) validation stamp: while the process-wide
+    mutation-epoch pair is unmoved, the per-slice staleness walk is
+    skipped entirely — no holder lookups, no fragment locks. At
+    headline scale (960 slices) that walk, serialized under the
+    manager lock, was the dominant host-side cost of a concurrent
+    read-only herd."""
+
+    def _spy(self, holder):
+        calls = []
+        orig = holder.fragment
+        holder.fragment = lambda *a: (calls.append(a), orig(*a))[1]
+        return calls, orig
+
+    def test_quiet_refresh_skips_fragment_walk(self, holder):
+        f = seed(holder, bits=[(1, 5), (2, 5), (2, SLICE_WIDTH + 3)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=2))") == [2]
+        mgr = e.mesh_manager()
+        ns = holder.index("i").max_slice() + 1
+        calls, orig = self._spy(holder)
+        try:
+            sv = mgr.refresh("i", "general", "standard", ns)
+            assert calls == [], "quiet refresh must skip the slice walk"
+            f.set_bit(1, 6)  # epoch moves: next refresh must re-walk
+            sv2 = mgr.refresh("i", "general", "standard", ns)
+            assert calls, "post-write refresh must walk the slices"
+            assert sv2 is sv  # existing container: incremental, no restage
+            calls.clear()
+            mgr.refresh("i", "general", "standard", ns)
+            assert calls == [], "walk re-stamps the validation epoch"
+        finally:
+            holder.fragment = orig
+
+    def test_unrelated_write_rewalks_once_then_quiet(self, holder):
+        seed(holder, bits=[(1, 5)])
+        other = seed(holder, index="j", bits=[(0, 1)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [1]
+        mgr = e.mesh_manager()
+        ns = holder.index("i").max_slice() + 1
+        calls, orig = self._spy(holder)
+        try:
+            mgr.refresh("i", "general", "standard", ns)
+            assert calls == []
+            other.set_bit(0, 2)  # unrelated index still moves the
+            #                      process-wide pair: conservative walk
+            mgr.refresh("i", "general", "standard", ns)
+            assert calls, "process-wide counter: unrelated write re-walks"
+            calls.clear()
+            mgr.refresh("i", "general", "standard", ns)
+            assert calls == [], "...but exactly once"
+        finally:
+            holder.fragment = orig
+
+    def test_counts_stay_correct_across_quiet_windows(self, holder):
+        f = seed(holder, bits=[(7, c) for c in range(20)])
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Bitmap(rowID=7))"
+        assert q(e, "i", pql) == q(host, "i", pql) == [20]
+        for col in (100, SLICE_WIDTH + 1, 5):  # 5 = already set
+            f.set_bit(7, col)
+            assert q(e, "i", pql) == q(host, "i", pql)
+
+
 class TestColdStartServing:
     def test_lazy_holder_stages_loaded_data(self, tmp_path):
         """A cold-reopened holder defers fragment parsing; staging must
