@@ -1,0 +1,38 @@
+#!/bin/sh
+# Gentle TPU-recovery watch: one patient probe per cycle, long quiet
+# gaps (rapid kill-retry cycles can wedge the relay — ROUND5.md), and
+# on recovery ONE full bench run + snapshot. Runs until it captures a
+# bench or MAX_CYCLES pass.
+#
+# Usage: nohup sh tools/tpu_recover_bench.sh <tag> &
+#   tag names the artifacts: BENCH_TPU_<tag>_snapshot.json, bench_<tag>.log
+set -u
+cd "$(dirname "$0")/.."
+TAG="${1:-r5e}"
+MAX_CYCLES="${MAX_CYCLES:-40}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-300}"
+GAP_S="${GAP_S:-900}"
+
+i=0
+while [ "$i" -lt "$MAX_CYCLES" ]; do
+    i=$((i + 1))
+    echo "[$(date -u +%H:%M:%S)] probe $i/$MAX_CYCLES" >> "tpu_recover_${TAG}.log"
+    if timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices())" \
+        >> "tpu_recover_${TAG}.log" 2>&1; then
+        echo "[$(date -u +%H:%M:%S)] relay up; running bench" \
+            >> "tpu_recover_${TAG}.log"
+        # lease released at probe exit; bench re-inits cleanly
+        if python bench.py > "bench_${TAG}.log" 2>&1; then
+            cp BENCH_DETAILS.json "BENCH_TPU_${TAG}_snapshot.json"
+            echo "[$(date -u +%H:%M:%S)] bench done; snapshot saved" \
+                >> "tpu_recover_${TAG}.log"
+            exit 0
+        fi
+        echo "[$(date -u +%H:%M:%S)] bench FAILED (see bench_${TAG}.log)" \
+            >> "tpu_recover_${TAG}.log"
+    fi
+    sleep "$GAP_S"
+done
+echo "[$(date -u +%H:%M:%S)] gave up after $MAX_CYCLES cycles" \
+    >> "tpu_recover_${TAG}.log"
+exit 1
