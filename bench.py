@@ -544,21 +544,30 @@ def main():
         hi = (pc >> 16).astype(jnp.int32).sum()
         return jnp.stack([lo, hi])
 
-    # Two iteration counts, differenced: the relay's fixed ~70 ms
+    # Iteration counts, differenced: the relay's fixed ~70 ms
     # result-notification cost rides every _sustained sample once, so
-    # (N2*t2 - N1*t1)/(N2 - N1) cancels it and prices one chained
+    # (Nj*tj - Ni*ti)/(Nj - Ni) cancels it and prices one chained
     # kernel honestly (PROBE_R5_bw.json: the floor-bound form read
     # 100 GB/s where the differenced read is ~360, AT the XLA
-    # whole-pool ceiling for this chip). Both forms are recorded.
-    n1, n2 = (8, 64) if on_tpu else (2, 4)
-    sdt1 = best_of(lambda: _stream(sv.sharded.words), 2, n1)
-    sdt2 = best_of(lambda: _stream(sv.sharded.words), 2, n2)
-    per_kernel = (n2 * sdt2 - n1 * sdt1) / (n2 - n1)
+    # whole-pool ceiling for this chip). THREE counts, median pairwise
+    # slope: a two-point difference amplifies relay mood drift between
+    # its samples into nonsense (one r5 partial run read 860 GB/s —
+    # above the chip's HBM spec); the median of the three pairwise
+    # slopes needs two drifted samples to lie. Both forms recorded.
+    ns = (8, 32, 64) if on_tpu else (2, 3, 4)
+    sds = [best_of(lambda: _stream(sv.sharded.words), 2, n) for n in ns]
+    slopes = sorted(
+        (nj * tj - ni * ti) / (nj - ni)
+        for (ni, ti), (nj, tj) in
+        [((ns[0], sds[0]), (ns[1], sds[1])),
+         ((ns[0], sds[0]), (ns[2], sds[2])),
+         ((ns[1], sds[1]), (ns[2], sds[2]))])
+    per_kernel = slopes[1]
     if per_kernel <= 0:  # relay mood swung between samples; don't divide by it
-        per_kernel = sdt2
+        per_kernel = sds[-1]
     details["diagnostics"]["stream_read_gbps"] = pool_bytes / 1e9 / per_kernel
     details["diagnostics"]["stream_read_gbps_floorbound"] = \
-        pool_bytes / 1e9 / sdt1
+        pool_bytes / 1e9 / sds[0]
 
     # single-stream: one query at a time (the r1/r2 headline; floor-bound)
     dt = best_of(call, reps, iters)
