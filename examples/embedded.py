@@ -9,6 +9,12 @@ Run:  python examples/embedded.py /tmp/embedded-demo
 
 import sys
 import tempfile
+from pathlib import Path
+
+try:
+    import pilosa_tpu  # noqa: F401 — installed or on PYTHONPATH
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np
 

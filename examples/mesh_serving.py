@@ -19,6 +19,12 @@ on CPU; on a TPU backend it is on automatically.)
 import os
 import sys
 import tempfile
+from pathlib import Path
+
+try:
+    import pilosa_tpu  # noqa: F401 — installed or on PYTHONPATH
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 os.environ.setdefault("PILOSA_TPU_USE_DEVICE", "1")
 
