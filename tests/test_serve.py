@@ -741,6 +741,27 @@ class TestConcurrentWriteQueryFuzz:
         assert mgr.stats["count"] > 0
 
 
+class TestDeviceStartsCache:
+    """_device_starts: value-keyed LRU of replicated uniform-starts
+    vectors — repeated herd compositions must reuse one device handle;
+    different values must not collide."""
+
+    def test_value_keyed_reuse_and_distinctness(self, holder):
+        seed(holder, bits=[(1, 5)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [1]
+        mgr = e.mesh_manager()
+        a = np.asarray([3, 7], dtype=np.int32)
+        b = np.asarray([3, 7], dtype=np.int32)  # equal value, new object
+        c = np.asarray([3, 8], dtype=np.int32)
+        da = mgr._device_starts(a)
+        assert mgr._device_starts(b) is da, "equal values share one handle"
+        dc = mgr._device_starts(c)
+        assert dc is not da
+        assert np.asarray(da).tolist() == [3, 7]
+        assert np.asarray(dc).tolist() == [3, 8]
+
+
 class TestDynamicBatching:
     def seed_many_rows(self, holder):
         bits = []
