@@ -192,21 +192,41 @@ def _sustained(fn, iters, warm=True):
     multi-MB arrays per call."""
     if warm:
         np.asarray(fn())  # compile + warm; device idle at t0
-    t0 = time.perf_counter()
-    outs = [fn() for _ in range(iters)]
+    # In-flight pipeline depth cap, CPU ONLY: unlike the old
+    # accumulator chain (whose data dependency serialized execution as
+    # a side effect), independent programs all run concurrently — on a
+    # virtual multi-device CPU mesh, ~16+ in-flight COLLECTIVE
+    # programs starve the all-reduce rendezvous thread pool and abort
+    # the process (observed on the 1-core 8-vdev rig; a dependency
+    # graph alone does NOT help — the host keeps enqueueing, so the
+    # cap must be a hard per-chunk sync). CPU fetches are
+    # microseconds, so the per-chunk materialization stays honest
+    # there. TPU executes programs in launch order with hardware
+    # collectives — no cross-program rendezvous — so it keeps the
+    # single end-of-run barrier and pays no per-chunk sync.
     import jax as _jax
 
-    if isinstance(outs[0], _jax.Array):
+    cpu_depth = 8 if _jax.default_backend() == "cpu" else None
+    t0 = time.perf_counter()
+    first = fn()
+    if isinstance(first, _jax.Array):
         import jax.numpy as _jnp
 
-        np.asarray(_jnp.stack(outs))  # one barrier: depends on all outs
+        outs = [first]
+        for _ in range(iters - 1):
+            outs.append(fn())
+            if cpu_depth is not None and len(outs) >= cpu_depth:
+                np.asarray(_jnp.stack(outs))  # hard sync: bounds depth
+                outs = []
+        if outs:
+            np.asarray(_jnp.stack(outs))  # barrier: depends on all outs
     else:
         # host outputs (ndarrays, ints, lists of Rows): keep the cheap
         # host accumulation — stacking through jax would device_put
         # multi-MB arrays per call
-        acc = outs[0]
-        for o in outs[1:]:
-            acc = acc + o
+        acc = first
+        for _ in range(iters - 1):
+            acc = acc + fn()
     dt = (time.perf_counter() - t0) / iters
     return dt
 

@@ -1,0 +1,54 @@
+"""Minimal on-chip validation of the round-5 third-session dispatch
+fixes: stage a 240-slice 2-row dense pool, then time the lone-query
+serving call (now ONE program dispatch — no device-side limb squeeze,
+device-resident uniform starts) and a quiet refresh. Writes one JSON
+line to stdout. ~5 minutes end-to-end on a healthy relay, vs ~40 for
+the full bench — the late-window fallback evidence.
+
+Run: python tools/probe_dispatch_fix.py
+"""
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+from bench import best_of, build_dense_holder, serve_count_call  # noqa: E402
+from pilosa_tpu.executor import Executor  # noqa: E402
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    n = 240
+    h = build_dense_holder(tempfile.mkdtemp(), n, num_rows=2, seed=7)
+    e = Executor(h, use_device=True, device_min_work=0)
+    mgr = e.mesh_manager()
+    t0 = time.perf_counter()
+    first, call = serve_count_call(
+        e, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
+        list(range(n)))
+    first_s = time.perf_counter() - t0
+    assert call is not None, "serving path unavailable (staging failed?)"
+    dt = best_of(call, 3, 30)
+    t0 = time.perf_counter()
+    reps = 200
+    for _ in range(reps):
+        mgr.refresh("i", "general", "standard", n)
+    refresh_us = (time.perf_counter() - t0) / reps * 1e6
+    print(json.dumps({
+        "backend": backend,
+        "slices": n,
+        "first_count_s": round(first_s, 2),
+        "first_count": first,
+        "single_dispatch_mean_ms": round(dt * 1e3, 3),
+        "refresh_quiet_us": round(refresh_us, 2),
+        "count_backend": mgr._count_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
