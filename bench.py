@@ -914,6 +914,15 @@ def main():
             "probe_restage": mgrw.stats["refresh_probe_restage"],
             "inc_ewma_us": mgrw.stats["inc_ewma_us"]}
 
+    # The serving sections below price the DEVICE path through
+    # executor.execute(). On a cpu-fallback run the backend-aware cost
+    # router would send these folds to the native host kernels
+    # (96 slices x 3 leaves clears the 192-work threshold, and the cpu
+    # backend now prefers native for large folds) — correct for
+    # production, wrong for a device-path benchmark. Pin the threshold
+    # off for this window; restored after the open-loop section.
+    e.device_min_work = 0
+
     with section("serving_executor_qps"):
         # executor-level per-call rate (includes per-query relay
         # readback). `qps` keeps its original meaning — a FRESH query
@@ -935,6 +944,116 @@ def main():
         details["serving_executor_qps"] = {
             "qps": 1.0 / exec_dt, "mean_ms": exec_dt * 1e3,
             "memo_repeat_qps": 1.0 / memo_exec_dt}
+
+    with section("lone_query_dispatch"):
+        # Single-dispatch fast path: an idle-manager Count ships its
+        # gather metadata and slice mask as HOST arguments to one fused
+        # jitted collective, instead of the chained
+        # upload-leaves -> upload-mask -> launch sequence. Three
+        # numbers: device dispatches per distinct query on each path
+        # (counter deltas), and fresh-query QPS on both paths under the
+        # serving_executor_qps methodology (structural epoch bump per
+        # call, executor end-to-end) so the ratio prices the path
+        # change and nothing else.
+        _progress("lone-query single-dispatch fast path")
+        assert mgr.lone_fused, "fused lone path off — nothing to measure"
+        n_lone = 10 if on_tpu else 3
+        q1 = parse_string(pql)
+
+        def _cold_rows():
+            # model a distinct-query stream over a row space much
+            # larger than the per-row metadata caches (the workload the
+            # fast path exists for): every query resolves its rows cold
+            with mgr._mu:
+                for sv_ in mgr._views.values():
+                    sv_.idx_cache.clear()
+                    sv_.host_idx_cache.clear()
+
+        def fresh_dt(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                MUTATION_EPOCH.bump_structural()
+                _cold_rows()
+                e.execute("i", q1)
+            return (time.perf_counter() - t0) / n
+
+        e.execute("i", q1)  # warm the fused plan for this tree shape
+        fused_dt = fresh_dt(n_lone)
+
+        # distinct queries on the warm plan shape: exactly ONE device
+        # dispatch each (per-row metadata rides the call host-side)
+        lone_deltas = []
+        for a, b in [(0, 2), (1, 3), (2, 3), (1, 2)]:
+            qd = parse_string("Count(Intersect(Bitmap(rowID={}), "
+                              "Bitmap(rowID={})))".format(a, b))
+            MUTATION_EPOCH.bump_structural()
+            d0 = mgr.stats["device_dispatches"]
+            e.execute("i", qd)
+            lone_deltas.append(mgr.stats["device_dispatches"] - d0)
+        assert all(d == 1 for d in lone_deltas), lone_deltas
+
+        # Range (time-quantum view OR) also collapses to one dispatch:
+        # absent views stage as empty host-side, no materialize hop.
+        # Own tiny holder — the 1 GB pool's frame has no time quantum.
+        from datetime import datetime
+
+        from pilosa_tpu.core import Holder
+
+        ht = Holder(os.path.join(tmp, "lone_range"))
+        ht.open()
+        ft = ht.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "events", time_quantum="YMD")
+        ft.set_bit(1, 3, datetime(2017, 4, 2, 9, 0))
+        ft.set_bit(1, 8, datetime(2017, 4, 3, 9, 0))
+        et = _reg(Executor(ht, use_device=True, device_min_work=0))
+        mgrt = et.mesh_manager()
+        qr = parse_string(
+            'Count(Range(rowID=1, frame=events, '
+            'start="2017-04-01T00:00", end="2017-04-30T00:00"))')
+        assert et.execute("i", qr) == [2]  # warm: stage + plan compile
+        qr2 = parse_string(
+            'Count(Range(rowID=1, frame=events, '
+            'start="2017-04-01T00:00", end="2017-04-03T00:00"))')
+        d0 = mgrt.stats["device_dispatches"]
+        assert et.execute("i", qr2) == [1]
+        range_delta = mgrt.stats["device_dispatches"] - d0
+        assert range_delta == 1, range_delta
+
+        # old chained path, same workload and holder: kill-switch the
+        # fused path, cold leaf metadata (device idx caches cleared),
+        # warm slice mask — the pre-fast-path serving cost.
+        mgr.lone_fused = False
+        try:
+            MUTATION_EPOCH.bump_structural()
+            e.execute("i", q1)  # warm chained: leaf uploads + launch
+            with mgr._mu:
+                for sv_ in mgr._views.values():
+                    sv_.idx_cache.clear()
+            qd = parse_string(
+                "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=3)))")
+            MUTATION_EPOCH.bump_structural()
+            d0 = mgr.stats["device_dispatches"]
+            e.execute("i", qd)
+            chained_delta = mgr.stats["device_dispatches"] - d0
+            # >= 3: two leaf uploads + launch; a coarse-eligible dense
+            # pool may add a starts-table upload on top.
+            assert chained_delta >= 3, chained_delta
+            chained_dt = fresh_dt(n_lone)
+        finally:
+            mgr.lone_fused = True
+        details["lone_query_dispatch"] = {
+            "dispatches_per_query": max(lone_deltas),
+            "dispatches_per_query_range": range_delta,
+            "chained_dispatches_per_query": chained_delta,
+            "qps": 1.0 / fused_dt, "mean_ms": fused_dt * 1e3,
+            "chained_qps": 1.0 / chained_dt,
+            "chained_mean_ms": chained_dt * 1e3,
+            # fused vs the old serving_executor_qps methodology (the
+            # chained path under the identical fresh distinct-query
+            # loop). The gap is the dispatch floor: decisive behind
+            # the 2.5-3.4 ms/dispatch relay, modest on local cpu
+            # where the 96-slice fold dominates each call.
+            "vs_serving_executor": chained_dt / fused_dt}
 
     with section("serving_concurrent16_qps"):
         # concurrent clients: 16 threads, every query a DISTINCT 3-leaf
@@ -968,6 +1087,9 @@ def main():
         shape3 = _lower_tree(h, "i", t3, leaves3)
         args3 = mgr._count_args("i", shape3, leaves3,
                                 list(range(num_slices)), num_slices)
+        assert args3 is not None, \
+            "width precompile: _count_args fell back to staging " \
+            "(view or slice mask unavailable for the 3-leaf tree)"
         sig3, words3_t, _i3, _h3, coarse3_t, dmask3 = args3
         mb = mgr._MAX_BATCH  # the one width every multi-request group runs
         if all(c is not None for c in coarse3_t):
@@ -1072,6 +1194,8 @@ def main():
             open_dt = time.perf_counter() - t0
         details["serving_openloop64_qps"] = {
             "qps": n_open / open_dt, "in_flight": n_open}
+
+    e.device_min_work = None  # cost routing back on (env/default)
 
     with section("count_bitmap"):
         # -- config 1: Count(Bitmap(row)) ----------------------------------------

@@ -24,7 +24,11 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 from typing import List, Optional
 
 from .parallel.cluster import DEFAULT_PARTITION_N, DEFAULT_REPLICA_N

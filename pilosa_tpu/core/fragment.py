@@ -25,6 +25,7 @@ import tarfile
 import io
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -167,7 +168,12 @@ class Fragment:
         self._lock_file = None
         self._pending_load = True
         self._loading = False
-        self._row_cache: Dict[int, Row] = {}
+        # Materialized-row LRU, bounded: a TopN over a wide row space
+        # (or a long-lived server touching many rows) must not pin one
+        # Row per row id forever — each cached Row holds its segment
+        # arrays. Hits re-rank (move_to_end); inserts evict the LRU
+        # entry at the cap.
+        self._row_cache: "OrderedDict[int, Row]" = OrderedDict()
 
         # Device compute image (built lazily; see `pool`).
         self._pool = None
@@ -266,16 +272,22 @@ class Fragment:
 
     # -- reads -------------------------------------------------------------
 
+    # Bound on materialized rows held by _row_cache (see __init__).
+    _ROW_CACHE_MAX = 512
+
     @_loaded
     def row(self, row_id: int) -> Row:
         """Materialize one row as a slice-local segment (fragment.go:332-367)."""
         cached = self._row_cache.get(row_id)
         if cached is not None:
+            self._row_cache.move_to_end(row_id)  # LRU, not FIFO
             return cached
         seg = self.storage.offset_range(
             0, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
         )
         r = Row.from_segment(self.slice, seg)
+        if len(self._row_cache) >= self._ROW_CACHE_MAX:
+            self._row_cache.popitem(last=False)
         self._row_cache[row_id] = r
         return r
 
@@ -585,21 +597,56 @@ class Fragment:
         consensus = all_pos[counts >= majority]
 
         out = []
-        base = self.slice * SLICE_WIDTH
         for i, mine in enumerate(participants):
             sets = np.setdiff1d(consensus, mine, assume_unique=True)
             clears = np.setdiff1d(mine, consensus, assume_unique=True)
             if i == 0:
-                for p in sets:
-                    self.set_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
-                for p in clears:
-                    self.clear_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
+                self._apply_consensus(sets, clears)
             else:
                 out.append((
                     (sets // SLICE_WIDTH, sets % SLICE_WIDTH),
                     (clears // SLICE_WIDTH, clears % SLICE_WIDTH),
                 ))
         return out
+
+    # Below this many diff bits the per-bit path wins: it preserves the
+    # WAL and the incremental device log, and the bulk path's forced
+    # snapshot costs more than a handful of appends.
+    _CONSENSUS_BULK_MIN = 128
+
+    def _apply_consensus(self, sets: np.ndarray, clears: np.ndarray):
+        """Apply a consensus diff (storage positions) locally. Small
+        diffs go bit-by-bit through set_bit/clear_bit (WAL-durable,
+        device-log incremental). Large diffs — anti-entropy after real
+        divergence, e.g. a replica restored from an old snapshot —
+        apply as WAL-detached bulk storage ops plus one forced
+        snapshot, mirroring import_bits: per bit, set_bit pays a WAL
+        append, a row rematerialization, and a cache update, which on a
+        100k-bit diff is minutes of Python loop against milliseconds of
+        add_many/remove_many."""
+        base = self.slice * SLICE_WIDTH
+        if len(sets) + len(clears) < self._CONSENSUS_BULK_MIN:
+            for p in sets:
+                self.set_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
+            for p in clears:
+                self.clear_bit(int(p) // SLICE_WIDTH, base + int(p) % SLICE_WIDTH)
+            return
+        sets = np.asarray(sets, dtype=np.uint64)
+        clears = np.asarray(clears, dtype=np.uint64)
+        self.storage.op_writer = None
+        try:
+            if sets.size:
+                self.storage.add_many(sets)
+            if clears.size:
+                self.storage.remove_many(clears)
+        finally:
+            self.storage.op_writer = self._op_file
+        self._mark_dirty(None)
+        for r in np.unique(np.concatenate([sets, clears])
+                           // np.uint64(SLICE_WIDTH)):
+            self.cache.bulk_add(int(r), self.row(int(r)).count())
+        self.cache.invalidate()
+        self.snapshot()
 
     # -- cache persistence ---------------------------------------------------
 

@@ -146,6 +146,11 @@ class Executor:
         # _route_to_host threshold, resolved once (the env lookup is
         # per-query overhead on the small-query path otherwise).
         self._min_work_resolved: Optional[int] = None
+        # Backend-aware routing verdict (cpu backend + live native
+        # kernels => large folds go to the host C++ path), resolved
+        # once — jax.default_backend() and the ctypes load don't change
+        # within a process.
+        self._cpu_route_native: Optional[bool] = None
 
     def set_spmd(self, spmd):
         """Wire the SPMD descriptor plane (rank 0 of a multi-host
@@ -636,7 +641,17 @@ class Executor:
         model applies in EVERY device mode — use_device picks which
         backends are available, not which engine a given query should
         pay for; 0 disables routing (every lowerable tree → mesh).
-        Routed queries count in /debug/vars mesh stats (routed_host)."""
+        Routed queries count in /debug/vars mesh stats (routed_host).
+
+        The router is BACKEND-AWARE above the threshold: on a `cpu`
+        JAX backend, large folds route to the host C++ kernels too —
+        JAX-on-CPU loses ~2x to the repo's own popcnt fold at every
+        size (BENCH r03-r05 cpu-fallback headlines; the Roaring papers'
+        host popcnt path is the CPU winner, arXiv:1611.07612), so with
+        no accelerator behind the mesh the dispatch floor buys nothing.
+        PILOSA_TPU_CPU_ROUTE_NATIVE=off pins large folds to the mesh
+        (measurement / regression escape hatch); thr <= 0 still
+        disables ALL routing."""
         thr = self.device_min_work
         if thr is None:
             thr = self._min_work_resolved
@@ -652,12 +667,35 @@ class Executor:
             if thr is None:
                 thr = self._DEFAULT_MIN_WORK
             self._min_work_resolved = thr
-        if thr <= 0 or num_slices * max(1, num_leaves) >= thr:
+        if thr <= 0:
+            return False
+        if (num_slices * max(1, num_leaves) >= thr
+                and not self._cpu_native_routes()):
             return False
         mgr = self.mesh_manager()
         if mgr is not None:
             mgr.stats["routed_host"] += 1
         return True
+
+    def _cpu_native_routes(self) -> bool:
+        """True when large folds should route to the host despite
+        clearing the work threshold: cpu JAX backend + native C++
+        kernels live + not opted out (see _route_to_host)."""
+        verdict = self._cpu_route_native
+        if verdict is None:
+            import os
+
+            import jax
+
+            from .ops import native
+
+            verdict = (
+                os.environ.get("PILOSA_TPU_CPU_ROUTE_NATIVE", "on").lower()
+                not in ("off", "0")
+                and jax.default_backend() == "cpu"
+                and native.has_native())
+            self._cpu_route_native = verdict
+        return verdict
 
     def _device_backend_on(self) -> bool:
         """use_device: True forces the device path, False forces host

@@ -40,6 +40,19 @@ def dense_row_count(row: jax.Array) -> jax.Array:
     return popcount_words(row)
 
 
+def flat_fold_op(tree):
+    """The single combining op of a depth-one tree whose leaves appear
+    in index order (``(op, (leaf,0), (leaf,1), ...)``) — the shape the
+    native fused fold kernel accepts — or None for anything nested,
+    unary, or reordered."""
+    if tree[0] == "leaf" or len(tree) < 3:
+        return None
+    for i, child in enumerate(tree[1:]):
+        if child[0] != "leaf" or child[1] != i:
+            return None
+    return tree[0]
+
+
 def fold_tree(tree, leaf_fn):
     """Fold a numbered op-shape tree (plan._tree_signature) over
     `leaf_fn(leaf_index) -> block`, combining with the n-ary bitwise

@@ -15,6 +15,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -100,8 +101,23 @@ def fused_pair_count(a, b, op: str = "and", *, force_pallas: bool | None = None,
     """popcount(op(a, b)) over (M, 2048) uint32 blocks, fused on device.
 
     Dispatches to the Pallas TPU kernel on TPU backends, fused XLA
-    elsewhere. `force_pallas`/`interpret` exist for differential tests.
+    elsewhere. On a cpu backend, host numpy inputs short-circuit to the
+    native C++ popcount-pair kernels (a Python int result) — JAX-on-CPU
+    pays a dispatch plus a device round-trip for what is one fused
+    memory pass. `force_pallas`/`interpret` exist for differential
+    tests and always take the device paths.
     """
+    if (force_pallas is None and not interpret
+            and isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+            and jax.default_backend() == "cpu"):
+        from . import native
+
+        if native.has_native() and a.shape == b.shape:
+            av = np.ascontiguousarray(a).reshape(-1).view(np.uint64)
+            bv = np.ascontiguousarray(b).reshape(-1).view(np.uint64)
+            fn = getattr(native, f"popcnt_{op}_slice", None)
+            if fn is not None:
+                return fn(av, bv)
     a = a.reshape(-1, CONTAINER_WORDS)
     b = b.reshape(-1, CONTAINER_WORDS)
     if force_pallas or (force_pallas is None and use_pallas()):

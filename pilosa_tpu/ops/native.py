@@ -194,6 +194,26 @@ def fold_blocks(leaves, op: str, block_words: int = 1024):
     return out, counts
 
 
+def fold_count(blocks, tree) -> int:
+    """Total popcount of a numbered op-tree (plan._tree_signature)
+    folded over numpy uint64 blocks. Flat trees — one op over leaves in
+    index order, the common Intersect/Union count — run through the
+    fused C++ fold+per-block-popcount kernel in a single pass; nested
+    or non-qualifying trees fall back to a numpy fold plus
+    popcnt_slice (one extra materialized intermediate per op level)."""
+    # Deferred import: bitops pulls in jax, and this module must stay
+    # importable (and fast) in jax-free host tooling.
+    from .bitops import flat_fold_op, fold_tree
+
+    op = flat_fold_op(tree)
+    if op is not None:
+        r = fold_blocks(list(blocks), op)
+        if r is not None:
+            return int(r[1].sum())
+    acc = fold_tree(tree, lambda i: blocks[i])
+    return popcnt_slice(np.ascontiguousarray(acc))
+
+
 def _popcnt_pair(name: str, np_op, s: np.ndarray, m: np.ndarray) -> int:
     lib = _get_lib()
     if (lib is not None and s.dtype == np.uint64 and m.dtype == np.uint64

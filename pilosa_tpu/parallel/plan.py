@@ -78,6 +78,56 @@ def _compiled_count(sig: str):
     return jax.jit(count)
 
 
+class CompiledPlanCache:
+    """LRU of fused single-dispatch serving programs (the lowered
+    PQL-tree → one-XLA-call fast path, mesh.compile_serve_count_fused).
+
+    Keyed by (tree signature, leaf count, fragment widths — the
+    per-leaf staged pool shapes — and backend): jit already keys
+    compilation on argument shapes, but an unbounded miss stream (every
+    novel width combination mints a program) would pin executables
+    forever; the LRU bounds live programs the same way _compiled_count
+    bounds the per-slice jits. The build runs under the lock so two
+    racing first queries of one shape pay ONE compile (the GIL keeps
+    the dict safe either way — the lock exists for the compile, exactly
+    like serve._get_or_compile)."""
+
+    def __init__(self, cap: int = 128):
+        import threading
+        from collections import OrderedDict
+
+        self._mu = threading.Lock()
+        self._fns: "OrderedDict[tuple, object]" = OrderedDict()
+        self.cap = cap
+        self.stats = {"hit": 0, "miss": 0}
+
+    @staticmethod
+    def key(sig: str, words_t) -> tuple:
+        """The canonical cache key for a fused count plan: tree shape,
+        leaf count, per-leaf staged widths, backend. One definition so
+        the serving layer and tests cannot disagree on it."""
+        return (sig, len(words_t),
+                tuple(tuple(w.shape) for w in words_t),
+                jax.default_backend())
+
+    def get_or_build(self, key: tuple, build):
+        with self._mu:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self._fns.move_to_end(key)  # LRU, not FIFO
+                self.stats["hit"] += 1
+                return fn
+            fn = build()
+            if len(self._fns) >= self.cap:
+                self._fns.popitem(last=False)
+            self._fns[key] = fn
+            self.stats["miss"] += 1
+            return fn
+
+    def __len__(self) -> int:
+        return len(self._fns)
+
+
 class CountPlan:
     """A compiled Count over one index's call tree. `count_slice` returns
     the slice's count, or None when this slice must fall back to the
@@ -409,7 +459,6 @@ class HostCountPlan:
 
     def count_slice(self, slice_: int) -> Optional[int]:
         from ..ops import native
-        from ..ops.bitops import fold_tree
 
         cache = self.cache
         key = snap = None
@@ -420,14 +469,16 @@ class HostCountPlan:
             if n is not None:
                 return n
 
-        # fold_tree combines with &, |, & ~ — numpy blocks support all
-        # three, so the host fold reuses the ONE shared combiner the
-        # XLA and Pallas paths use. It never mutates operands, so
-        # cached blocks are safe to feed directly.
+        # fold_count folds with the ONE shared combiner the XLA and
+        # Pallas paths use (bitops.fold_tree over numpy blocks), except
+        # that flat trees — one op, leaves in order, i.e. the common
+        # Intersect/Union count — run through the fused native
+        # fold+popcount kernel in a single pass with no materialized
+        # intermediate. It never mutates operands, so cached blocks are
+        # safe to feed directly.
         blocks = [self._leaf_words(frame, view, row_id, slice_)
                   for frame, view, row_id, _req in self.leaves]
-        acc = fold_tree(self._sig, lambda i: blocks[i])
-        n = native.popcnt_slice(acc)
+        n = native.fold_count(blocks, self._sig)
         if cache is not None:
             # Generations are monotonic: if a write raced between the
             # snapshot and the block reads, this entry's snapshot is

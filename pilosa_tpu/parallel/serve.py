@@ -47,6 +47,7 @@ from .mesh import (
     compile_serve_apply_writes,
     compile_serve_count,
     compile_serve_count_batch,
+    compile_serve_count_fused,
     compile_serve_count_batch_shared,
     compile_serve_count_coarse,
     compile_serve_row_counts,
@@ -56,7 +57,7 @@ from .mesh import (
     pack_mutation_batches,
     resolve_row_indices,
 )
-from .plan import _tree_signature
+from .plan import CompiledPlanCache, _tree_signature
 
 
 def _num_env(name: str, default, cast=int):
@@ -74,8 +75,8 @@ class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
-                 "num_slices", "idx_cache", "last_used", "last_stage_s",
-                 "inc_spend_s", "inc_ewma_s", "inc_count",
+                 "num_slices", "idx_cache", "host_idx_cache", "last_used",
+                 "last_stage_s", "inc_spend_s", "inc_ewma_s", "inc_count",
                  "validated_epoch")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
@@ -94,6 +95,12 @@ class StagedView:
         # ~6 ms through the TPU relay; cached, a repeat-row query pays
         # nothing.
         self.idx_cache: "OrderedDict[int, tuple]" = OrderedDict()
+        # dense_id -> HOST (idx, hit) numpy pair for the fused
+        # single-dispatch path, which passes gather metadata as jit
+        # arguments instead of device_put-ing it (the resolve itself is
+        # ~0.1 ms of searchsorted — cheap, but a hot repeated row should
+        # pay zero). Same lifetime argument as idx_cache above.
+        self.host_idx_cache: "OrderedDict[int, tuple]" = OrderedDict()
         # Use-epoch stamp (MeshManager._use_epoch at last access): the
         # evictor never evicts a view used by the RESOLUTION in
         # progress, so one query touching more frames than the budget
@@ -292,6 +299,22 @@ class MeshManager:
         self._rowcount_fns: Dict[int, object] = {}
         self._rowcount_src_fns: Dict[tuple, object] = {}
         self._tanimoto_fns: Dict[tuple, object] = {}
+        # Fused single-dispatch count programs (mesh.
+        # compile_serve_count_fused), LRU-keyed on (tree shape, leaf
+        # count, fragment widths, backend) — the compiled-plan cache
+        # the lone-query fast path serves from.
+        self._fused_plans = CompiledPlanCache()
+        # Lone-query gate state: a count takes the fused fast path only
+        # when it is the SOLE count in flight — a concurrent herd must
+        # keep flowing through the batch loop, where coalescing (not
+        # dispatch count) is what pays. PILOSA_TPU_LONE_FUSED=off kills
+        # the fast path (bench uses it to measure the chained floor).
+        import os as _os
+
+        self.lone_fused = _os.environ.get(
+            "PILOSA_TPU_LONE_FUSED", "on").lower() not in ("off", "0")
+        self._lone_mu = threading.Lock()
+        self._counts_inflight = 0
         self._apply_fn = None
         # EWMA (seconds) of measured incremental-apply cost — the other
         # side of refresh()'s cost gate (vs StagedView.last_stage_s) —
@@ -377,6 +400,12 @@ class MeshManager:
             "idx_cache_hit": 0, "idx_cache_miss": 0,
             "mask_cache_hit": 0, "mask_cache_miss": 0,
             "routed_host": 0, "shared_batch": 0, "fetch_threads": 0,
+            # Device operations issued on the query path: +1 per leaf
+            # metadata upload group, per mask/starts upload, per program
+            # launch. A distinct cold-metadata 2-leaf query costs 3 on
+            # the chained path; the fused lone path costs exactly 1
+            # (bench lone_query_dispatch measures the delta).
+            "device_dispatches": 0, "lone_fused": 0,
         }
 
     @property
@@ -573,14 +602,21 @@ class MeshManager:
         if idx is None or idx.frame(frame) is None:
             return None
         key = (index, frame, view)
-        # Epoch pair read BEFORE any staleness inspection: a write that
-        # lands mid-walk bumps the pair past `ep`, so stamping `ep`
-        # after the walk can never mark that write validated. Ordering
-        # on the write side: generation moves first, the epoch second
-        # (fragment.py:334-335) — any bump included in `ep` has its
-        # generation visible to the walk/snapshot below.
-        ep = MUTATION_EPOCH.read()
         with self._mu:
+            # Epoch pair read UNDER _mu, before any staleness
+            # inspection: a write that lands mid-walk bumps the pair
+            # past `ep`, so stamping `ep` after the walk can never mark
+            # that write validated. Ordering on the write side:
+            # generation moves first, the epoch second
+            # (fragment.py:334-335) — any bump included in `ep` has its
+            # generation visible to the walk/snapshot below. The read
+            # must sit INSIDE the lock: validators serialize on _mu, so
+            # an in-lock read is always >= any pair a finished
+            # validator stamped — read outside, a reader that stalled
+            # before the lock could stamp its stale pair OVER a newer
+            # one and silently disable the O(1) fast path until the
+            # next write.
+            ep = MUTATION_EPOCH.read()
             sv = self._views.get(key)
             if sv is not None:
                 self._views.move_to_end(key)  # LRU: most recently used
@@ -1546,6 +1582,9 @@ class MeshManager:
                 limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
             self.stats["batched"] += b
 
+        # Every branch above launched exactly ONE compiled program.
+        self.stats["device_dispatches"] += 1
+
         # Start the D2H copy NOW: by the time the completion
         # notification lands (~70 ms period on the relay; microseconds
         # attached), the bytes are already host-side and the worker's
@@ -1594,6 +1633,13 @@ class MeshManager:
         required) in depth-first order; each leaf gathers from its own
         staged view (trees may span frames and time-quantum views).
 
+        A LONE count (no other count in flight) takes the fused
+        single-dispatch path: gather metadata and mask ride the one
+        jitted call as host arguments (compile_serve_count_fused), so a
+        distinct query pays one dispatch + one fetch instead of the
+        chained metadata-upload + program sequence (VERDICT r5's "three
+        chained ~2.5 ms dispatches").
+
         Concurrent same-shape counts COALESCE: the request goes through
         the batch loop, which drains whatever queued while the device
         was busy and runs up to _MAX_BATCH queries as one program.
@@ -1602,19 +1648,109 @@ class MeshManager:
         throughput (measured 310 → 583 QPS at batch 16 on a 1B-column
         index) while a lone request runs immediately."""
         t0 = time.monotonic()
-        prepared = self._count_args(index, shape, leaves, slices, num_slices)
-        if prepared is None:
-            return None
-        req = _CountRequest(*prepared)
-        req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
-        self._ensure_batch_thread()
-        self._batch_q.put(req)
-        req.done.wait()
-        if req.error is not None:
-            _reraise_shared("batched device count", req.error)
-        self.stats["count"] += 1
-        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
-        return req.result
+        with self._lone_mu:
+            self._counts_inflight += 1
+            lone = self._counts_inflight == 1
+        try:
+            if lone and self.lone_fused:
+                out = self._lone_count(index, shape, leaves, slices,
+                                       num_slices)
+                if out is not None:
+                    self.stats["count"] += 1
+                    self.stats["query_us"] += \
+                        int((time.monotonic() - t0) * 1e6)
+                    return out[0]
+            prepared = self._count_args(index, shape, leaves, slices,
+                                        num_slices)
+            if prepared is None:
+                return None
+            req = _CountRequest(*prepared)
+            req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
+            self._ensure_batch_thread()
+            self._batch_q.put(req)
+            req.done.wait()
+            if req.error is not None:
+                _reraise_shared("batched device count", req.error)
+            self.stats["count"] += 1
+            self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+            return req.result
+        finally:
+            with self._lone_mu:
+                self._counts_inflight -= 1
+
+    def _lone_count(self, index: str, shape, leaves,
+                    slices: Sequence[int], num_slices: int):
+        """The fused single-dispatch count: resolve every leaf's gather
+        metadata on the HOST (cached per view), look the program up in
+        the compiled-plan LRU, and launch it with the metadata and mask
+        as jit arguments — no standalone device_put ever runs. Returns
+        a 1-tuple (count,) so a legitimate zero survives the truthiness
+        at the call site, or None to fall through to the chained path
+        (which re-resolves and reports its own fallback)."""
+        try:
+            with self._mu:
+                self._use_epoch += 1
+                out = self._stage_leaves_host(index, leaves, num_slices)
+                if out is None:
+                    return None
+                words_t, idx_all, hit_all, first = out
+                mask = self._mask_for(first, slices)
+                if mask is None:
+                    return None
+            sig = json.dumps(_tree_signature(shape))
+            key = CompiledPlanCache.key(sig, words_t)
+            fn = self._fused_plans.get_or_build(
+                key, lambda: compile_serve_count_fused(
+                    self.mesh, json.loads(sig), len(leaves)))
+            limbs = fn(words_t, idx_all, hit_all, mask)
+            self.stats["device_dispatches"] += 1
+            self.stats["lone_fused"] += 1
+            return (combine_count(limbs),)
+        except Exception:  # noqa: BLE001 — fast path only; chained path
+            return None    # re-resolves and surfaces real errors
+
+    def _stage_leaves_host(self, index: str, leaves, num_slices: int):
+        """_stage_leaves for the fused path: identical staging and
+        absent-row semantics, but the resolved gather metadata stays on
+        the host — (words_t, idx_all (L, S, 16) int32, hit_all
+        (L, S, 16) uint32, first_staged_view) or None. Call under _mu
+        (same snapshot-consistency contract as _stage_leaves)."""
+        staged: Dict[Tuple[str, str], tuple] = {}
+        words_t, idx_l, hit_l = [], [], []
+        for frame, view, row_id, _req in leaves:
+            vkey = (frame, view)
+            if vkey not in staged:
+                sv = self.refresh(index, frame, view, num_slices)
+                if sv is None:
+                    self.stats["fallback"] += 1
+                    return None
+                staged[vkey] = (sv, sv.sharded.words)
+            sv, words = staged[vkey]
+            i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
+            if i >= len(sv.row_ids) or sv.row_ids[i] != np.uint64(row_id):
+                i = len(sv.row_ids)  # absent row: resolver yields hit=0
+            idx, hit = self._leaf_host_arrays(sv, i)
+            words_t.append(words)
+            idx_l.append(idx)
+            hit_l.append(hit)
+        first = next(iter(staged.values()))[0]
+        return (tuple(words_t), np.stack(idx_l), np.stack(hit_l), first)
+
+    def _leaf_host_arrays(self, sv: StagedView, dense_id: int):
+        """HOST (idx, hit) numpy pair for one leaf row, cached per view
+        with the same LRU bound as the device-side idx_cache. Call
+        under _mu (eviction safety, as _leaf_arrays)."""
+        cached = sv.host_idx_cache.pop(dense_id, None)
+        if cached is not None:
+            sv.host_idx_cache[dense_id] = cached  # reinsert at MRU end
+            self.stats["idx_cache_hit"] += 1
+            return cached
+        self.stats["idx_cache_miss"] += 1
+        out = resolve_row_indices(sv.keys_host, dense_id)
+        if len(sv.host_idx_cache) >= self._IDX_CACHE_MAX:
+            sv.host_idx_cache.popitem(last=False)
+        sv.host_idx_cache[dense_id] = out
+        return out
 
     # Bound on cached (row -> gather indices) entries per staged view:
     # each costs 2 * S * 16 * 4 bytes of HBM (~120 KB at 960 slices).
@@ -1632,6 +1768,10 @@ class MeshManager:
             self.stats["idx_cache_hit"] += 1
             return cached
         self.stats["idx_cache_miss"] += 1
+        # One leaf metadata upload GROUP (the device_puts below issue
+        # back-to-back as one logical device operation) — a unit of the
+        # per-query dispatch accounting the fused path eliminates.
+        self.stats["device_dispatches"] += 1
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1666,10 +1806,15 @@ class MeshManager:
         """Value-keyed LRU of device copies — the shared body of
         _device_mask/_device_starts. Callers on the query path hold _mu
         or run on the single batch thread; individual dict ops are
-        GIL-atomic, so a rare race costs one duplicate device_put."""
-        cached = cache.get(key)
+        GIL-atomic, so a rare race costs one duplicate device_put.
+        The hit path is pop+reinsert, NOT get+move_to_end: between a
+        get and its move_to_end a concurrent eviction (popitem below)
+        can remove the key, and move_to_end on a missing key raises —
+        pop is one atomic dict op, and reinserting lands the entry at
+        the MRU end exactly like move_to_end would."""
+        cached = cache.pop(key, None)
         if cached is not None:
-            cache.move_to_end(key)  # LRU, not FIFO
+            cache[key] = cached  # reinsert at the MRU end
             return cached
         dev = make()
         if len(cache) >= cap:
@@ -1688,6 +1833,7 @@ class MeshManager:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            self.stats["device_dispatches"] += 1
             return jax.device_put(
                 mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
 
@@ -1700,13 +1846,17 @@ class MeshManager:
         free on attached chips, but one more transfer riding the
         dispatch path through a relay. Herd compositions repeat, so a
         small LRU (keyed by the scalar values) makes the steady state
-        all device-resident handles."""
-        key = (starts.shape[0], starts.tobytes())
+        all device-resident handles. The key carries dtype and the FULL
+        shape, not just tobytes(): equal bytes from different dtypes
+        (int32 vs int64 scalars) or a reshaped vector must not alias to
+        one device array of the wrong type."""
+        key = (starts.dtype.str, starts.shape, starts.tobytes())
 
         def make():
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            self.stats["device_dispatches"] += 1
             return jax.device_put(starts, NamedSharding(self.mesh, P()))
 
         return self._device_cached(self._starts_cache, key, 256, make)

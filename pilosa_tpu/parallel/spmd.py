@@ -464,7 +464,9 @@ class SpmdServer:
 
         fp = (np.int64(0) if fingerprint_blob is None
               else np.int64(zlib.crc32(fingerprint_blob) + 1))
-        fps = multihost_utils.process_allgather(fp)
+        # older jax returns a 0-d array for a scalar single-process
+        # allgather — normalize before indexing
+        fps = np.atleast_1d(multihost_utils.process_allgather(fp))
         return int(fp) != 0 and bool(np.all(fps == fps[0]))
 
     def _execute_count(self, desc: dict) -> Optional[int]:

@@ -168,6 +168,19 @@ class Container:
             self.normalize()
         return self.n - before
 
+    def remove_many(self, vals: np.ndarray) -> int:
+        """Bulk remove low-bits values; returns #bits actually cleared."""
+        before = self.n
+        if self.array is not None:
+            self.array = np.setdiff1d(
+                self.array, vals.astype(_U32), assume_unique=False
+            ).astype(_U32)
+        else:
+            drop = values_to_bitmap_words(vals)
+            np.bitwise_and(self.bitmap, ~drop, out=self.bitmap)
+            self.normalize()
+        return before - self.n
+
     # -- range ops ---------------------------------------------------------
 
     def count_range(self, start: int, end: int) -> int:
@@ -371,6 +384,33 @@ class Bitmap:
         for s, e in zip(starts, ends):
             c = self._writable_container_for(int(keys[s]), create=True)
             total += c.add_many(low[s:e])
+        return total
+
+    def remove_many(self, values: np.ndarray) -> int:
+        """Bulk remove without WAL ops (mirror of add_many).
+
+        Returns the number of bits actually cleared.
+        """
+        values = np.asarray(values, dtype=_U64)
+        if values.size == 0:
+            return 0
+        values = np.unique(values)
+        keys = (values >> _U64(16)).astype(np.int64)
+        low = (values & _U64(0xFFFF)).astype(_U32)
+        total = 0
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [len(keys)]))
+        for s, e in zip(starts, ends):
+            key = int(keys[s])
+            c = self._writable_container_for(key)
+            if c is None:
+                continue
+            total += c.remove_many(low[s:e])
+            if c.n == 0:
+                i = self._find_key(key)
+                del self.keys[i]
+                del self.containers[i]
         return total
 
     # -- queries -----------------------------------------------------------

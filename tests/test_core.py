@@ -145,6 +145,73 @@ def test_fragment_blocks_and_merge(tmp_path):
         f1.close(), f2.close()
 
 
+def test_fragment_merge_large_divergence(tmp_path):
+    """100k-bit consensus diffs apply through the bulk
+    add_many/remove_many path (per-bit set_bit/clear_bit loops took
+    minutes here) and still converge to exact majority state that
+    survives a reopen."""
+    f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    f1.open()
+    try:
+        # Local state A: 100k bits across rows 0..9. Two remotes agree
+        # on a DISJOINT state B — majority (2 of 3) clears all of A and
+        # sets all of B.
+        n = 100_000
+        rows_a = np.arange(n, dtype=np.uint64) % 10
+        cols_a = np.arange(n, dtype=np.uint64) * 2
+        f1.import_bits(rows_a, cols_a)
+        rows_b = np.arange(n, dtype=np.uint64) % 10
+        cols_b = np.arange(n, dtype=np.uint64) * 2 + 1
+        diffs = f1.merge_block(0, [(rows_b, cols_b), (rows_b, cols_b)])
+        want = set(zip(rows_b.tolist(), cols_b.tolist()))
+        assert set(f1.for_each_bit()) == want
+        assert len(diffs) == 2  # remotes already hold the consensus
+        for (sets, clears) in diffs:
+            assert len(sets[0]) == 0
+            assert len(clears[0]) == 0
+        f1.close()
+        f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+        f1.open()  # the bulk path snapshotted: state is durable
+        assert f1.storage.count() == n
+        assert set(f1.for_each_bit()) == want
+    finally:
+        f1.close()
+
+
+def test_fragment_merge_small_diff_uses_wal(tmp_path):
+    """Diffs below the bulk threshold keep the per-bit WAL path: no
+    forced snapshot, ops appended."""
+    f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    f1.open()
+    try:
+        f1.set_bit(1, 1)
+        op_n0 = f1.op_n
+        f1.merge_block(0, [(np.asarray([1, 1]), np.asarray([1, 2])),
+                           (np.asarray([1, 1]), np.asarray([1, 2]))])
+        assert set(f1.for_each_bit()) == {(1, 1), (1, 2)}
+        assert f1.op_n > op_n0  # WAL appended, not snapshot-reset
+    finally:
+        f1.close()
+
+
+def test_fragment_row_cache_bounded_lru(frag, monkeypatch):
+    """_row_cache holds at most _ROW_CACHE_MAX materialized rows and
+    evicts least-recently-USED (a re-read refreshes recency)."""
+    monkeypatch.setattr(Fragment, "_ROW_CACHE_MAX", 4)
+    for r in range(6):
+        frag.set_bit(r, r)
+    for r in range(4):
+        frag.row(r)
+    assert set(frag._row_cache) == {0, 1, 2, 3}
+    frag.row(0)  # refresh row 0's recency
+    frag.row(4)  # evicts row 1 (LRU), not row 0
+    assert set(frag._row_cache) == {0, 2, 3, 4}
+    frag.row(5)
+    assert set(frag._row_cache) == {0, 3, 4, 5}
+    assert len(frag._row_cache) == 4
+    assert frag.row(1).count() == 1  # evicted rows rematerialize fine
+
+
 def test_fragment_checksum_changes_on_write(frag):
     c0 = frag.checksum()
     frag.set_bit(0, 0)

@@ -536,11 +536,13 @@ def test_serve_coarse_pallas_matches_xla(mesh, tmp_path, monkeypatch):
         want = host.execute("i", parse_string(pql))[0]
         monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
         ep = Executor(h, use_device=True, device_min_work=0)
+        ep.mesh_manager().lone_fused = False  # coarse path under test
         got_p = ep.execute("i", parse_string(pql))[0]
         assert ep.mesh_manager().stats["coarse"] >= 1, \
             "query did not take the coarse path"
         monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "xla")
         ex = Executor(h, use_device=True, device_min_work=0)
+        ex.mesh_manager().lone_fused = False
         got_x = ex.execute("i", parse_string(pql))[0]
         assert got_p == got_x == want, (pql, got_p, got_x, want)
 
@@ -692,6 +694,7 @@ def test_serve_uniform_pallas_path_selected(mesh, tmp_path, monkeypatch):
     host = Executor(h, use_device=False)
     monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas_interpret")
     ep = Executor(h, use_device=True, device_min_work=0)
+    ep.mesh_manager().lone_fused = False  # coarse-path selection under test
 
     uni_pql = "Count(Intersect(Bitmap(frame=g, rowID=0), Bitmap(frame=g, rowID=1)))"
     want = host.execute("i", parse_string(uni_pql))[0]

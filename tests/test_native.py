@@ -142,3 +142,51 @@ class TestBlockKernels:
         assert nat.fold_blocks([a, a], "xor") is None       # unknown op
         b32 = a.astype(np.uint32)
         assert nat.fold_blocks([b32, b32], "and") is None   # wrong dtype
+
+
+class TestFoldCount:
+    """fold_count: flat op-trees take the fused native fold+popcount
+    kernel; nested trees fall back to a numpy fold — both must agree
+    with a straight per-op numpy model."""
+
+    def test_flat_tree_matches_numpy(self, rng):
+        blocks = [rng.integers(0, 2**63, 16 * 1024, dtype=np.uint64)
+                  for _ in range(3)]
+        for op, np_fn in [("and", lambda a, b: a & b),
+                          ("or", lambda a, b: a | b),
+                          ("andnot", lambda a, b: a & ~b)]:
+            tree = (op, ("leaf", 0), ("leaf", 1), ("leaf", 2))
+            want = np_fn(np_fn(blocks[0], blocks[1]), blocks[2])
+            assert nat.fold_count(blocks, tree) == \
+                int(np.bitwise_count(want).sum())
+
+    def test_nested_tree_and_single_leaf(self, rng):
+        blocks = [rng.integers(0, 2**63, 16 * 1024, dtype=np.uint64)
+                  for _ in range(3)]
+        tree = ("and", ("leaf", 0), ("or", ("leaf", 1), ("leaf", 2)))
+        want = blocks[0] & (blocks[1] | blocks[2])
+        assert nat.fold_count(blocks, tree) == \
+            int(np.bitwise_count(want).sum())
+        assert nat.fold_count(blocks, ("leaf", 0)) == \
+            int(np.bitwise_count(blocks[0]).sum())
+
+    def test_matches_without_native(self, rng, monkeypatch):
+        monkeypatch.setattr(nat, "_lib", None)
+        monkeypatch.setattr(nat, "_load_attempted", True)
+        blocks = [rng.integers(0, 2**63, 16 * 1024, dtype=np.uint64)
+                  for _ in range(2)]
+        tree = ("and", ("leaf", 0), ("leaf", 1))
+        assert nat.fold_count(blocks, tree) == \
+            int(np.bitwise_count(blocks[0] & blocks[1]).sum())
+
+
+def test_flat_fold_op_classification():
+    from pilosa_tpu.ops.bitops import flat_fold_op
+
+    assert flat_fold_op(("and", ("leaf", 0), ("leaf", 1))) == "and"
+    assert flat_fold_op(("or", ("leaf", 0), ("leaf", 1), ("leaf", 2))) == "or"
+    assert flat_fold_op(("leaf", 0)) is None                 # bare leaf
+    assert flat_fold_op(("and", ("leaf", 0))) is None        # unary
+    assert flat_fold_op(("and", ("leaf", 1), ("leaf", 0))) is None  # reordered
+    assert flat_fold_op(
+        ("and", ("leaf", 0), ("or", ("leaf", 1), ("leaf", 2)))) is None

@@ -79,6 +79,25 @@ def test_fused_pair_count_matches_host(op, setop):
     assert got == expected
 
 
+@pytest.mark.parametrize("op,npop", [
+    ("and", lambda a, b: a & b),
+    ("or", lambda a, b: a | b),
+    ("xor", lambda a, b: a ^ b),
+    ("andnot", lambda a, b: a & ~b),
+])
+def test_fused_pair_count_cpu_native_shortcut(op, npop):
+    """Host numpy inputs on the cpu backend short-circuit to the native
+    popcount-pair kernels — same count, no device round trip."""
+    rng = np.random.default_rng(19)
+    a = rng.integers(0, 2**32, size=(8, 2048), dtype=np.uint64).astype(np.uint32)
+    b = rng.integers(0, 2**32, size=(8, 2048), dtype=np.uint64).astype(np.uint32)
+    expected = int(np.bitwise_count(npop(a, b)).sum())
+    got = fused_pair_count(a, b, op)
+    assert int(got) == expected
+    # device inputs keep the XLA path and agree
+    assert int(fused_pair_count(jnp.asarray(a), jnp.asarray(b), op)) == expected
+
+
 def test_fused_pair_count_nonaligned_block():
     # M not a multiple of the kernel block: padding must not change counts.
     rng = np.random.default_rng(3)

@@ -53,6 +53,47 @@ def test_add_remove_single():
     assert b.max() == 100000
 
 
+@pytest.mark.parametrize("n_add,n_rm", [(10, 5), (5000, 3000), (70000, 70000)])
+def test_remove_many_differential(n_add, n_rm):
+    rng = np.random.default_rng(n_add * 7 + n_rm)
+    vals = random_values(rng, n_add)
+    b = Bitmap(vals)
+    # half present, half absent — removals must tolerate both
+    drop = np.unique(np.concatenate([
+        rng.choice(vals, size=min(n_rm, len(vals)), replace=False)
+        if len(vals) else vals,
+        random_values(rng, n_rm // 2, lo=1 << 22, hi=1 << 23),
+    ]))
+    removed = b.remove_many(drop)
+    model = set(int(v) for v in vals) - set(int(v) for v in drop)
+    assert removed == len(vals) - len(model)
+    assert b.count() == len(model)
+    assert np.array_equal(b.slice(),
+                          np.asarray(sorted(model), dtype=np.uint64))
+    assert not b.check()
+
+
+def test_remove_many_drops_emptied_containers():
+    b = Bitmap()
+    b.add_many(np.asarray([5, 70000, 140000], dtype=np.uint64))
+    assert len(b.keys) == 3
+    b.remove_many(np.asarray([70000, 140000], dtype=np.uint64))
+    assert len(b.keys) == 1
+    assert b.count() == 1 and b.contains(5)
+    assert b.remove_many(np.asarray([], dtype=np.uint64)) == 0
+
+
+def test_remove_many_bitmap_form_renormalizes():
+    b = Bitmap()
+    b.add_many(np.arange(ARRAY_MAX_SIZE + 10, dtype=np.uint64))
+    assert not b.containers[0].is_array()
+    b.remove_many(np.arange(20, dtype=np.uint64))
+    # back under the threshold: container converts to array form
+    assert b.containers[0].is_array()
+    assert b.count() == ARRAY_MAX_SIZE - 10
+    assert not b.check()
+
+
 def test_array_bitmap_conversion_threshold():
     b = Bitmap()
     vals = np.arange(ARRAY_MAX_SIZE + 1, dtype=np.uint64)

@@ -567,6 +567,10 @@ class TestCostRouting:
         assert mgr.stats["count"] == 0  # the mesh never served it
 
     def test_large_query_stays_on_device(self, holder, monkeypatch):
+        # The suite runs on a cpu backend, where backend-aware routing
+        # would send an above-threshold fold to the native host kernels
+        # too — pin the escape hatch off so this prices the DEVICE leg.
+        monkeypatch.setenv("PILOSA_TPU_CPU_ROUTE_NATIVE", "off")
         seed(holder, bits=self.BITS)
         poison_per_slice(monkeypatch)
         e = Executor(holder, use_device=True, device_min_work=1)
@@ -574,6 +578,35 @@ class TestCostRouting:
         mgr = e.mesh_manager()
         assert mgr.stats["routed_host"] == 0
         assert mgr.stats["count"] == 1
+
+    def test_large_query_routes_to_host_on_cpu_backend(self, holder,
+                                                       monkeypatch):
+        # Backend-aware routing: above the work threshold, a cpu
+        # backend serves from the native C++ kernels — JAX-on-CPU has
+        # no accelerator to win the fold back.
+        from pilosa_tpu.ops import native
+        if not native.has_native():
+            pytest.skip("native kernels unavailable")
+        seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True, device_min_work=1)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))"
+        assert q(e, "i", pql) == q(host, "i", pql) == [25]
+        mgr = e.mesh_manager()
+        assert mgr.stats["routed_host"] == 1
+        assert mgr.stats["count"] == 0
+
+    def test_backend_aware_routing_skips_tpu(self, holder, monkeypatch):
+        # On a tpu backend the above-threshold query must NOT route.
+        import jax
+
+        seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True, device_min_work=1)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert e._route_to_host(num_slices=1, num_leaves=1) is False
+        # verdict is cached: flipping the backend later cannot re-route
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert e._route_to_host(num_slices=1, num_leaves=1) is False
 
     def test_zero_threshold_disables_routing(self, holder):
         seed(holder, bits=self.BITS)
@@ -590,6 +623,125 @@ class TestCostRouting:
         e = Executor(holder, use_device=True)
         assert q(e, "i", "Count(Bitmap(rowID=1))") == [50]
         assert e.mesh_manager().stats["routed_host"] == 1
+
+
+class TestLoneFusedDispatch:
+    """Single-dispatch serving fast path: a LONE Count runs as one
+    fused jitted program whose gather metadata and slice mask ride the
+    call as host arguments — the per-query device-dispatch counter
+    must read exactly 1, vs 3 for the chained upload+launch path."""
+
+    # rows: 0 -> 41 bits, 1 -> 20 bits, 2 -> 2 bits, 3 -> 5 bits
+    BITS = ([(0, c) for c in range(40)] + [(0, 2 * SLICE_WIDTH + 7)]
+            + [(1, c) for c in range(0, 40, 2)]
+            + [(2, SLICE_WIDTH + 3), (2, 2 * SLICE_WIDTH + 7)]
+            + [(3, c) for c in range(5)])
+
+    @staticmethod
+    def _lower(holder, pql):
+        from pilosa_tpu.parallel.plan import _lower_tree
+
+        tree = parse_string(pql).calls[0].children[0]
+        leaves = []
+        shape = _lower_tree(holder, "i", tree, leaves)
+        assert shape is not None, pql
+        return shape, leaves
+
+    def test_lone_count_is_one_dispatch(self, holder):
+        seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        warm = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        assert q(e, "i", warm) == q(host, "i", warm) == [20]
+        assert mgr.stats["lone_fused"] == 1
+        # DISTINCT queries (cold per-row metadata, and for the union/
+        # difference shapes a cold compiled plan): one dispatch each.
+        for pql, want in [
+            ("Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=2)))", 1),
+            ("Count(Union(Bitmap(rowID=1), Bitmap(rowID=2)))", 22),
+            ("Count(Difference(Bitmap(rowID=0), Bitmap(rowID=1)))", 21),
+        ]:
+            shape, leaves = self._lower(holder, pql)
+            d0 = mgr.stats["device_dispatches"]
+            got = mgr.count("i", shape, leaves, [0, 1, 2], 3)
+            assert got == q(host, "i", pql)[0] == want, pql
+            assert mgr.stats["device_dispatches"] - d0 == 1, pql
+        # repeat of a seen query: still one dispatch, now all-cache-hit
+        shape, leaves = self._lower(
+            holder, "Count(Union(Bitmap(rowID=1), Bitmap(rowID=2)))")
+        d0 = mgr.stats["device_dispatches"]
+        assert mgr.count("i", shape, leaves, [0, 1, 2], 3) == 22
+        assert mgr.stats["device_dispatches"] - d0 == 1
+        # one plan per distinct (shape, widths, backend) key
+        assert mgr._fused_plans.stats["miss"] == 3
+        assert mgr._fused_plans.stats["hit"] >= 1
+
+    def test_chained_path_pays_three_dispatches(self, holder):
+        seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True)
+        mgr = e.mesh_manager()
+        mgr.lone_fused = False
+        # warm: stages the view, uploads the slice mask, compiles
+        q(e, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))")
+        assert mgr.stats["lone_fused"] == 0
+        # distinct query with two never-resolved rows, warm mask:
+        # 2 leaf metadata uploads + 1 program launch
+        pql = "Count(Intersect(Bitmap(rowID=2), Bitmap(rowID=3)))"
+        shape, leaves = self._lower(holder, pql)
+        d0 = mgr.stats["device_dispatches"]
+        assert mgr.count("i", shape, leaves, [0, 1, 2], 3) == 0
+        assert mgr.stats["device_dispatches"] - d0 == 3
+
+    def test_range_lone_count_is_one_dispatch(self, holder):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general", time_quantum="YMD")
+        from datetime import datetime
+
+        f.set_bit(1, 3, datetime(2017, 4, 2, 9, 0))
+        f.set_bit(1, SLICE_WIDTH + 8, datetime(2017, 4, 3, 9, 0))
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        mgr = e.mesh_manager()
+        pql = ("Count(Range(rowID=1, frame=general, "
+               "start=\"2017-04-01T00:00\", end=\"2017-04-30T00:00\"))")
+        assert q(e, "i", pql) == q(host, "i", pql) == [2]
+        assert mgr.stats["lone_fused"] == 1
+        # distinct Range (different window -> different view-OR tree):
+        # fused, one dispatch, no materialize-then-count hop
+        pql2 = ("Count(Range(rowID=1, frame=general, "
+                "start=\"2017-04-01T00:00\", end=\"2017-04-03T00:00\"))")
+        shape, leaves = self._lower(holder, pql2)
+        d0 = mgr.stats["device_dispatches"]
+        assert mgr.count("i", shape, leaves, [0, 1], 2) \
+            == q(host, "i", pql2)[0] == 1
+        assert mgr.stats["device_dispatches"] - d0 == 1
+
+    def test_lone_fused_env_kill_switch(self, holder, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_LONE_FUSED", "off")
+        seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        assert q(e, "i", pql) == q(host, "i", pql) == [20]
+        mgr = e.mesh_manager()
+        assert mgr.lone_fused is False
+        assert mgr.stats["lone_fused"] == 0
+        assert mgr.stats["count"] == 1  # chained mesh path served it
+
+    def test_fused_matches_chained_after_writes(self, holder):
+        f = seed(holder, bits=self.BITS)
+        e = Executor(holder, use_device=True)
+        mgr = e.mesh_manager()
+        pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+        assert q(e, "i", pql) == [20]
+        f.clear_bit(1, 0)
+        f.set_bit(0, 41)
+        shape, leaves = self._lower(holder, pql)
+        got = mgr.count("i", shape, leaves, [0, 1, 2], 3)
+        host = Executor(holder, use_device=False)
+        assert got == q(host, "i", pql)[0] == 19
+        assert mgr.stats["lone_fused"] >= 2
 
 
 class TestFragmentPoolIncremental:
@@ -760,6 +912,28 @@ class TestDeviceStartsCache:
         assert dc is not da
         assert np.asarray(da).tolist() == [3, 7]
         assert np.asarray(dc).tolist() == [3, 8]
+
+    def test_key_includes_dtype_and_shape(self, holder):
+        """Same raw bytes, different dtype or shape, must not collide:
+        int32 [1, 0] and int64 [1] share a byte string, as do a flat
+        vector and its 2-D reshape."""
+        seed(holder, bits=[(1, 5)])
+        e = Executor(holder, use_device=True)
+        assert q(e, "i", "Count(Bitmap(rowID=1))") == [1]
+        mgr = e.mesh_manager()
+        a32 = np.asarray([1, 0], dtype=np.int32)
+        a64 = np.asarray([1], dtype=np.int64)
+        assert a32.tobytes() == a64.tobytes()  # the collision this guards
+        da = mgr._device_starts(a32)
+        db = mgr._device_starts(a64)  # must NOT alias da's [1, 0]
+        assert np.asarray(da).tolist() == [1, 0]
+        assert np.asarray(db).tolist() == [1]
+        flat = np.asarray([3, 7, 1, 2], dtype=np.int32)
+        grid = flat.reshape(2, 2)
+        dflat = mgr._device_starts(flat)
+        dgrid = mgr._device_starts(grid)
+        assert np.asarray(dflat).shape == (4,)
+        assert np.asarray(dgrid).shape == (2, 2)
 
 
 class TestDynamicBatching:
@@ -1082,6 +1256,7 @@ class TestCoarseGather:
         e = Executor(holder, use_device=True, device_min_work=0)
         host = Executor(holder, use_device=False)
         mgr = e.mesh_manager()
+        mgr.lone_fused = False  # pin the chained coarse path under test
         pql = "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
         got = q(e, "i", pql)[0]
         assert got == q(host, "i", pql)[0]
@@ -1095,6 +1270,7 @@ class TestCoarseGather:
         e = Executor(holder, use_device=True, device_min_work=0)
         host = Executor(holder, use_device=False)
         mgr = e.mesh_manager()
+        mgr.lone_fused = False  # pin the chained coarse path under test
         for pql in ("Count(Union(Bitmap(rowID=0), Bitmap(rowID=1)))",
                     "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
                     "Count(Difference(Bitmap(rowID=0), Bitmap(rowID=1)))"):

@@ -108,13 +108,27 @@ def test_spmd_server_two_process_boot(tmp_path):
         # schema + writes + queries, all against rank 0
         _post(http[0], "/index/si", "{}")
         _post(http[0], "/index/si/frame/f1", "{}")
+        # The first mutation doubles as a RUNTIME probe: a jax whose
+        # CPU backend has no multiprocess collectives (no gloo) boots
+        # both HTTP servers fine, then every descriptor broadcast
+        # errors — that's the runtime missing, not the SPMD plane
+        # broken, so skip exactly like the boot-failure guard above.
+        probe = _post(http[0], "/index/si/query",
+                      f"SetBit(frame=f1, rowID=1, columnID={SLICE_WIDTH + 9})")
+        if "results" not in probe:
+            for p in procs:
+                p.kill()
+            outs = [p.communicate(timeout=10) for p in procs]
+            detail = "\n".join(e[-1500:] for _, e in outs)
+            if ("Multiprocess computations aren't implemented" in detail
+                    or "gloo" in detail.lower()):
+                pytest.skip(f"multi-process runtime unavailable:\n{detail}")
+            raise AssertionError(f"first SetBit failed: {probe}\n{detail}")
         for col in (5, SLICE_WIDTH + 5, 2 * SLICE_WIDTH + 5):
             for row in (0, 1):
                 out = _post(http[0], "/index/si/query",
                             f"SetBit(frame=f1, rowID={row}, columnID={col})")
                 assert out["results"][0] is True, out
-        _post(http[0], "/index/si/query",
-              f"SetBit(frame=f1, rowID=1, columnID={SLICE_WIDTH + 9})")
 
         out = _post(http[0], "/index/si/query",
                     "Count(Intersect(Bitmap(frame=f1, rowID=0), "
