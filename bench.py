@@ -1055,6 +1055,46 @@ def main():
             # where the 96-slice fold dominates each call.
             "vs_serving_executor": chained_dt / fused_dt}
 
+    with section("tracing_overhead"):
+        # Observability guard: a live trace per query (root span
+        # active, the full span fan-out through executor + mesh, trace
+        # finished into the rings — exactly the handler's per-query
+        # cost) must stay under ~3% of the untraced lone-query fast
+        # path. Same fresh distinct-query methodology as
+        # lone_query_dispatch; untraced/traced rounds alternate so
+        # machine drift hits both sides, best-of-rounds each.
+        _progress("tracing overhead on the lone-query fast path")
+        from pilosa_tpu.obs import Tracer as _Tracer
+
+        _tracer = _Tracer()
+        span_counts = []
+
+        def traced_dt(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                MUTATION_EPOCH.bump_structural()
+                _cold_rows()
+                tr = _tracer.start("query", index="i")
+                with tr.root:
+                    e.execute("i", q1)
+                _tracer.finish(tr)
+                span_counts.append(len(tr.spans))
+            return (time.perf_counter() - t0) / n
+
+        base_best = traced_best = float("inf")
+        for _ in range(7):
+            base_best = min(base_best, fresh_dt(n_lone))
+            traced_best = min(traced_best, traced_dt(n_lone))
+        overhead = traced_best / base_best - 1.0
+        details["tracing_overhead"] = {
+            "untraced_ms": base_best * 1e3,
+            "traced_ms": traced_best * 1e3,
+            "overhead_frac": overhead,
+            "spans_per_trace": max(span_counts)}
+        assert max(span_counts) >= 3, span_counts  # spans really taken
+        assert overhead < 0.03, \
+            f"tracing overhead {overhead:.1%} exceeds the 3% guard"
+
     with section("serving_concurrent16_qps"):
         # concurrent clients: 16 threads, every query a DISTINCT 3-leaf
         # Intersect (each query text appears exactly once across

@@ -17,6 +17,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..errors import PilosaError
+from ..obs import current_span
 from ..wire import pb, result_from_proto, PROTOBUF_CT
 
 
@@ -41,7 +42,9 @@ class InternalClient:
 
     def _do(self, method: str, path: str,
             params: Optional[dict] = None, body: bytes = b"",
-            content_type: str = "", accept: str = "") -> Tuple[int, bytes]:
+            content_type: str = "", accept: str = "",
+            headers: Optional[dict] = None,
+            resp_headers: Optional[dict] = None) -> Tuple[int, bytes]:
         url = self.host + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
@@ -50,8 +53,12 @@ class InternalClient:
             req.add_header("Content-Type", content_type)
         if accept:
             req.add_header("Accept", accept)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp_headers is not None:
+                    resp_headers.update(resp.headers.items())
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
@@ -76,9 +83,29 @@ class InternalClient:
         client is already bound to one host."""
         req = pb.QueryRequest(query=query, remote=remote)
         req.slices.extend(int(s) for s in slices)
+        # Trace propagation: with a span active (the executor's fan-out
+        # span), ship its (trace id, span id) so the remote leg joins
+        # the coordinator's trace; its spans come back as a JSON
+        # response header and are grafted under the fan-out span.
+        cur = current_span()
+        hdrs = None
+        rhdrs: dict = {}
+        if cur is not None:
+            hdrs = {"X-Pilosa-Trace":
+                    f"{cur.trace.trace_id}:{cur.span_id}"}
         status, data = self._do(
             "POST", f"/index/{index}/query", body=req.SerializeToString(),
-            content_type=PROTOBUF_CT, accept=PROTOBUF_CT)
+            content_type=PROTOBUF_CT, accept=PROTOBUF_CT,
+            headers=hdrs, resp_headers=rhdrs if cur is not None else None)
+        if cur is not None:
+            wire = {k.lower(): v for k, v in rhdrs.items()}.get(
+                "x-pilosa-trace-spans", "")
+            if wire:
+                try:
+                    cur.trace.graft(json.loads(wire), cur.span_id,
+                                    node=self.host)
+                except (ValueError, KeyError, TypeError):
+                    pass  # malformed remote spans never fail the query
         resp = pb.QueryResponse()
         try:
             resp.ParseFromString(data)

@@ -29,6 +29,8 @@ Routes (reference handler.go:81-121):
     GET    /status                               cluster status
     GET    /version
     GET    /debug/vars                           stats snapshot
+    GET    /debug/queries                        recent/slow query traces
+    GET    /debug/traces/{id}                    one query trace (spans)
     POST   /internal/message                     broadcast receive (this
                                                  framework's internal plane —
                                                  replaces the reference's
@@ -69,6 +71,8 @@ from ..errors import (
 from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
 from ..utils.stats import ExpvarStats
+from .. import obs
+from ..obs import Tracer
 from ..wire import (
     PROTOBUF_CT,
     attrs_to_proto,
@@ -376,7 +380,7 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, host: str = "",
                  broadcaster=None, broadcast_handler=None,
                  status_handler=None, client_factory=None, stats=None,
-                 logger=None):
+                 logger=None, tracer=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -390,6 +394,10 @@ class Handler:
         # client_factory(host) -> InternalClient, used by frame restore.
         self.client_factory = client_factory
         self.stats = stats if stats is not None else ExpvarStats()
+        # Per-query trace rings behind /debug/queries (+ /debug/traces)
+        # — servers pass a config-sized Tracer; a default one keeps
+        # handler-only tests and embedded use working.
+        self.tracer = tracer if tracer is not None else Tracer()
         self.logger = logger
         self.version = VERSION
         # SPMD descriptor plane (server wiring): bulk imports must ride
@@ -439,6 +447,8 @@ class Handler:
         r("GET", r"/status", self._get_status)
         r("GET", r"/version", self._get_version)
         r("GET", r"/debug/vars", self._get_expvar)
+        r("GET", r"/debug/queries", self._get_debug_queries)
+        r("GET", r"/debug/traces/(?P<tid>[^/]+)", self._get_debug_trace)
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
         r("GET", r"/debug/pprof/heap", self._get_heap_profile)
         r("GET", r"/debug/pprof/allocs", self._get_heap_profile)
@@ -519,6 +529,27 @@ class Handler:
         if hc:
             snap = dict(snap, host_cache=dict(hc))
         return _json_resp(snap)
+
+    def _get_debug_queries(self, pv, params, headers, body) -> Response:
+        """Recent + slow query trace rings (newest first). The slow
+        ring uses the tracer's configured threshold; pass
+        ?threshold_us=N to re-filter the recent ring ad hoc without
+        touching server config."""
+        snap = self.tracer.snapshot()
+        if "threshold_us" in params:
+            thr = float(params["threshold_us"])
+            snap["slow"] = [t for t in snap["recent"]
+                            if t["duration_us"] >= thr]
+            snap["slow_threshold_us"] = thr
+        return _json_resp(snap)
+
+    def _get_debug_trace(self, pv, params, headers, body) -> Response:
+        """One trace in full: every span with parent links, relative
+        start, duration, and tags. 404 once evicted from both rings."""
+        tr = self.tracer.get(pv["tid"])
+        if tr is None:
+            return _json_resp({"error": "trace not found"}, 404)
+        return _json_resp(tr.to_dict())
 
     def _get_cpu_profile(self, pv, params, headers, body) -> Response:
         """Sampling CPU profile across ALL threads — the analog of the
@@ -658,7 +689,14 @@ class Handler:
             "(?seconds=N; open in Perfetto)\n"
             "  goroutine     per-thread stack dump\n"
             "  threadcreate  live thread table\n"
-            "  cmdline       process command line\n\n")
+            "  cmdline       process command line\n\n"
+            "other /debug endpoints:\n"
+            "  /debug/vars         stats snapshot (counters + query "
+            "latency p50/p95/p99)\n"
+            "  /debug/queries      recent + slow query trace rings "
+            "(?threshold_us=N re-filters)\n"
+            "  /debug/traces/<id>  one query trace, all spans with "
+            "timings and tags\n\n")
         dump = self._thread_dump_text()
         return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
                         (index + dump).encode())
@@ -926,25 +964,52 @@ class Handler:
             column_attrs = params.get("columnAttrs") == "true"
             remote = False
 
+        # Trace lifecycle: every query records a trace into the
+        # bounded rings behind /debug/queries. A remote fan-out leg
+        # joins the coordinator's trace id (X-Pilosa-Trace) and ships
+        # its spans back in the X-Pilosa-Trace-Spans response header,
+        # where InternalClient grafts them under the fan-out span.
+        th = headers.get("x-pilosa-trace", "") if remote else ""
+        trace = self.tracer.start(
+            "query", trace_id=th.partition(":")[0] or None,
+            index=index, query=query[:256], remote=bool(remote),
+            node=self.host)
+        try:
+            with trace.root:
+                resp = self._run_query(index, query, slices, column_attrs,
+                                       remote, headers)
+        finally:
+            self.tracer.finish(trace)
+        if th:
+            resp.headers["X-Pilosa-Trace-Spans"] = json.dumps(
+                trace.serialize_spans(), separators=(",", ":"))
+        return resp
+
+    def _run_query(self, index, query, slices, column_attrs, remote,
+                   headers) -> Response:
         try:
             # Parsed-query LRU (pql.parse_string_cached): repeat PQL
             # texts skip the ~100 us parse, which dominates a
             # memo-served Count. The shared Query is immutable by
             # convention (see the cache's docstring).
-            q = parse_string_cached(query)
+            with obs.span("parse", bytes=len(query)):
+                q = parse_string_cached(query)
             t0 = time.monotonic()
             results = self.executor.execute(
                 index, q, slices or None, ExecOptions(remote=remote))
             # Per-call-name query stats, visible at /debug/vars
             # (observability parity: reference tag-scoped StatsClient,
             # stats.go:33-54). Remote fan-out legs are skipped so a
-            # clustered query counts once, at its coordinator.
+            # clustered query counts once, at its coordinator. The
+            # untagged timing keeps a stable `query.us.p50/p95/p99`
+            # key in /debug/vars regardless of index names.
             if not remote:
                 dt_us = int((time.monotonic() - t0) * 1e6)
                 tagged = self.stats.with_tags(f"index:{index}")
                 for call in q.calls:
                     tagged.count(f"query.{call.name}", 1)
                 tagged.timing("query", dt_us)
+                self.stats.timing("query", dt_us)
         except PilosaError as e:
             return self._query_error(e, headers)
         except ParseError as e:

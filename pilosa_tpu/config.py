@@ -15,6 +15,10 @@ TOML schema:
     [anti-entropy]
     interval = "10m"
 
+    [obs]
+    slow-query-threshold = "250ms"
+    trace-ring = 256
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -110,6 +114,12 @@ class Config:
         # so the field is vestigial there and deliberately inert here —
         # accepted so reference TOML files load unchanged, never read.
         self.plugins_path: str = ""
+        # [obs] — query tracing: slow-query threshold (queries at/over
+        # it land in the /debug/queries slow ring; overridable at
+        # runtime by PILOSA_TPU_SLOW_QUERY_US) and the recent-trace
+        # ring size.
+        self.slow_query_threshold: float = 0.25
+        self.trace_ring: int = 256
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -147,6 +157,11 @@ class Config:
             c.anti_entropy_interval = parse_duration(ae["interval"])
         c.plugins_path = str(data.get("plugins", {}).get("path",
                                                          c.plugins_path))
+        ob = data.get("obs", {})
+        if "slow-query-threshold" in ob:
+            c.slow_query_threshold = parse_duration(
+                ob["slow-query-threshold"])
+        c.trace_ring = int(ob.get("trace-ring", c.trace_ring))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -180,4 +195,8 @@ class Config:
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'
+            f"\n[obs]\n"
+            f'slow-query-threshold = '
+            f'"{int(self.slow_query_threshold * 1000)}ms"\n'
+            f"trace-ring = {self.trace_ring}\n"
         )

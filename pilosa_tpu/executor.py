@@ -17,6 +17,7 @@ materialize-then-count gap, SURVEY.md §3.2 note).
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from datetime import datetime
 from typing import Callable, List, Optional, Sequence
@@ -38,6 +39,7 @@ from .errors import (
 from .parallel.cluster import NODE_STATE_UP
 from .pql import Call, Query
 from . import SLICE_WIDTH
+from . import obs
 
 # Frame used when a query doesn't specify one (executor.go:35).
 DEFAULT_FRAME = "general"
@@ -427,6 +429,7 @@ class Executor:
         # via the descriptor stream, so its rank-0 executor — which
         # has no cluster nodes — still qualifies; so does the default
         # server's one-node static cluster, where every write IS local.)
+        psp = obs.span("plan", call="Count", slices=len(slices))
         qkey = qepoch = qsepoch = None
         nodes = self.cluster.nodes if self.cluster is not None else []
         if (not nodes
@@ -440,6 +443,7 @@ class Executor:
                 qsepoch = MUTATION_EPOCH.s
                 hit = self._host_cache.query_get(qkey, qepoch, qsepoch)
                 if hit is not None:
+                    psp.tag(route="memo").finish()
                     return hit
 
         # Lower the tree ONCE; every count engine shares it. The
@@ -470,6 +474,27 @@ class Executor:
                         lowered = (shape, leaves)
                 if qkey is not None:
                     qtoken = self._query_token(index, leaves, slices)
+
+        # Routing decision, recorded for trace attribution: which
+        # engine serves, and which kill-switches steered it there.
+        route = ("host-fold" if host_lowered is not None
+                 else "mesh" if lowered is not None else "roaring")
+        psp.tag(route=route, backend_on=backend_on,
+                leaves=len(leaves) if backend_on or qkey is not None
+                else 0)
+        switches = []
+        if os.environ.get("PILOSA_TPU_USE_DEVICE", ""):
+            switches.append("use_device="
+                            + os.environ["PILOSA_TPU_USE_DEVICE"])
+        if os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK", ""):
+            switches.append("device_min_work="
+                            + os.environ["PILOSA_TPU_DEVICE_MIN_WORK"])
+        if os.environ.get("PILOSA_TPU_CPU_ROUTE_NATIVE", ""):
+            switches.append("cpu_route_native="
+                            + os.environ["PILOSA_TPU_CPU_ROUTE_NATIVE"])
+        if switches:
+            psp.tag(kill_switches=switches)
+        psp.finish()
 
         plan_cell: list = []
 
@@ -674,7 +699,7 @@ class Executor:
             return False
         mgr = self.mesh_manager()
         if mgr is not None:
-            mgr.stats["routed_host"] += 1
+            mgr.stats.inc("routed_host")
         return True
 
     def _cpu_native_routes(self) -> bool:
@@ -1086,7 +1111,8 @@ class Executor:
         if not nodes:
             return
         futures = [
-            self._pool.submit(self._exec_remote, node, index, q, None, opt)
+            self._pool.submit(obs.wrap_ctx(self._exec_remote),
+                              node, index, q, None, opt)
             for node in nodes
         ]
         for fut in futures:
@@ -1100,8 +1126,10 @@ class Executor:
         The query travels as its canonical PQL serialization."""
         if self.client is None:
             raise SliceUnavailableError()
-        return self.client.execute_query(
-            node, index, str(q), slices or [], remote=True)
+        with obs.span("fanout", node=node.host,
+                      slices=len(slices) if slices else 0):
+            return self.client.execute_query(
+                node, index, str(q), slices or [], remote=True)
 
     def _slices_by_node(self, nodes, index: str, slices: Sequence[int]):
         """node -> slices owned, restricted to `nodes`
@@ -1148,12 +1176,17 @@ class Executor:
 
         futures = {}
         for node, node_slices in m.items():
+            # wrap_ctx: pool workers inherit the active trace span (a
+            # fresh contextvars copy per submit), so the gather/fan-out
+            # spans attach under this query, not nowhere.
             if node.host == self.host:
-                fut = self._pool.submit(self._mapper_local, node_slices,
-                                        map_fn, reduce_fn, batch_fn)
+                fut = self._pool.submit(
+                    obs.wrap_ctx(self._mapper_local), node_slices,
+                    map_fn, reduce_fn, batch_fn)
             elif not opt.remote:
-                fut = self._pool.submit(self._exec_remote_one, node, index, c,
-                                        node_slices, opt)
+                fut = self._pool.submit(
+                    obs.wrap_ctx(self._exec_remote_one), node, index, c,
+                    node_slices, opt)
             else:
                 continue
             futures[fut] = (node, node_slices)
@@ -1197,23 +1230,31 @@ class Executor:
         feeds reduce_fn directly — one device collective replaces the
         per-slice fan-out."""
         slices = list(slices)
-        if batch_fn is not None and slices:
-            v = batch_fn(slices)
-            if v is not None:
-                return reduce_fn(None, v)
-        result = None
-        if len(slices) <= 1:
-            for slice_ in slices:
-                result = reduce_fn(result, map_fn(slice_))
+        with obs.span("gather", slices=len(slices)) as gsp:
+            if batch_fn is not None and slices:
+                v = batch_fn(slices)
+                if v is not None:
+                    gsp.tag(mode="batch")
+                    return reduce_fn(None, v)
+            result = None
+            if len(slices) <= 1:
+                with obs.span("map", slices=len(slices)):
+                    for slice_ in slices:
+                        result = reduce_fn(result, map_fn(slice_))
+                gsp.tag(mode="inline")
+                return result
+            gsp.tag(mode="fanout")
+            futures = [self._slice_pool.submit(obs.wrap_ctx(map_fn), s)
+                       for s in slices]
+            try:
+                with obs.span("reduce", slices=len(slices)):
+                    for fut in futures:
+                        result = reduce_fn(result, fut.result())
+            except BaseException:
+                # Don't leave orphaned slice tasks burning pool workers
+                # while the node-failure re-split re-executes these
+                # slices.
+                for fut in futures:
+                    fut.cancel()
+                raise
             return result
-        futures = [self._slice_pool.submit(map_fn, s) for s in slices]
-        try:
-            for fut in futures:
-                result = reduce_fn(result, fut.result())
-        except BaseException:
-            # Don't leave orphaned slice tasks burning pool workers
-            # while the node-failure re-split re-executes these slices.
-            for fut in futures:
-                fut.cancel()
-            raise
-        return result

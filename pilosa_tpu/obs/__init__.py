@@ -1,0 +1,35 @@
+"""Observability primitives: spans/traces (trace.py) and lock-cheap
+metric containers (metrics.py).
+
+Everything here is stdlib-only and import-light so any layer of the
+codebase (roaring leaves up to the HTTP handler) can instrument itself
+without dependency cycles. The cardinal rule is that instrumentation
+must be near-free when nobody is looking: `span()` with no active
+trace is a single ContextVar read returning a shared no-op object, and
+`jax_scope()` resolves its env gate once per process.
+"""
+
+from .metrics import Histogram, StatMap
+from .trace import (
+    NOOP_SPAN,
+    Span,
+    Trace,
+    Tracer,
+    current_span,
+    jax_scope,
+    span,
+    wrap_ctx,
+)
+
+__all__ = [
+    "Histogram",
+    "NOOP_SPAN",
+    "Span",
+    "StatMap",
+    "Trace",
+    "Tracer",
+    "current_span",
+    "jax_scope",
+    "span",
+    "wrap_ctx",
+]

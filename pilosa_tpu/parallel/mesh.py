@@ -53,6 +53,7 @@ except AttributeError:  # older jax: no VMA checker, marking is a no-op
         return x
 
 from .. import SLICE_WIDTH
+from ..obs import span as obs_span
 from ..ops.pool import CONTAINER_WORDS, INVALID_KEY, ROW_SPAN, FragmentPool
 from .plan import _tree_signature, eval_tree
 
@@ -174,6 +175,7 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     cap = -(-cap // ROW_SPAN) * ROW_SPAN
 
     t0 = _time.monotonic()
+    h2d_sp = obs_span("h2d", slices=s_pad)
     # Keys (small, s_pad*cap*4 B) pack fully on every host; the sorted
     # container order is kept for the words pack below.
     keys = np.full((s_pad, cap), INVALID_KEY, dtype=np.int32)
@@ -260,6 +262,8 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
         stats_out["h2d_dispatch_s"] = _time.monotonic() - t0
         stats_out["h2d_bytes"] = h2d_bytes + keys.nbytes
         stats_out["h2d_chunk_slices"] = chunk_slices
+    h2d_sp.tag(h2d_bytes=h2d_bytes + keys.nbytes,
+               chunk_slices=chunk_slices).finish()
     idx = ShardedIndex(keys=keys_arr, words=words_arr)
     if with_host_keys:
         return idx, row_ids, keys

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import span as obs_span
 from ..ops.pool import gather_row
 from ..core.view import VIEW_INVERSE, VIEW_STANDARD
 
@@ -495,13 +496,16 @@ class HostCountPlan:
         declining slice (count_slice -> None, per its contract) makes
         the whole batch decline: the executor then falls back to the
         per-slice map_fn, which handles None slice-by-slice."""
-        total = 0
-        for s in slices:
-            n = self.count_slice(s)
-            if n is None:
-                return None
-            total += n
-        return total
+        slices = list(slices)
+        with obs_span("host_fold", slices=len(slices)) as sp:
+            total = 0
+            for s in slices:
+                n = self.count_slice(s)
+                if n is None:
+                    sp.tag(declined=True)
+                    return None
+                total += n
+            return total
 
 
 class HostMaterializePlan(HostCountPlan):

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.fragment import MUTATION_EPOCH
+from ..obs import StatMap, jax_scope, span
 from ..ops.pool import fold_log_entries, plan_slice_mutations
 from .mesh import (
     SLICE_AXIS,
@@ -386,8 +387,11 @@ class MeshManager:
         # Serving-path stats, surfaced at /debug/vars (SURVEY.md §5
         # observability): counts of staged/incremental refreshes and
         # served device queries, plus cumulative timings and cache
-        # hit/miss/size gauges.
-        self.stats = {
+        # hit/miss/size gauges. StatMap because these are bumped from
+        # serving threads, the batch thread, the fetch pool, and the
+        # cost-measure worker concurrently — bare `+=` on a dict drops
+        # increments under that contention.
+        self.stats = StatMap({
             "stage": 0, "incremental": 0, "evicted": 0,
             "staged_bytes": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
@@ -406,7 +410,7 @@ class MeshManager:
             # the chained path; the fused lone path costs exactly 1
             # (bench lone_query_dispatch measures the delta).
             "device_dispatches": 0, "lone_fused": 0,
-        }
+        })
 
     @property
     def mesh(self):
@@ -446,7 +450,7 @@ class MeshManager:
                 sv = self._views.pop(key)
                 self._purge_memo(sv.sharded.words)
                 total -= self._view_bytes(sv)
-                self.stats["evicted"] += 1
+                self.stats.inc("evicted")
         self.stats["staged_bytes"] = total
 
     # -- staging -------------------------------------------------------------
@@ -475,6 +479,8 @@ class MeshManager:
     def _stage(self, key, num_slices: int) -> StagedView:
         index, frame, view = key
         t0 = time.monotonic()
+        sp = span("stage", index=index, frame=frame, view=view,
+                  slices=num_slices)
         old = self._views.get(key)
         if old is not None:
             self._purge_memo(old.sharded.words)
@@ -482,11 +488,15 @@ class MeshManager:
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
         stage_io: dict = {}
-        sharded, row_ids, keys_host = build_sharded_index(
-            bitmaps, self.mesh, with_host_keys=True, stats_out=stage_io)
-        self.stats["h2d_bytes"] += stage_io.get("h2d_bytes", 0)
-        self.stats["h2d_dispatch_us"] += int(
-            stage_io.get("h2d_dispatch_s", 0.0) * 1e6)
+        with jax_scope("pilosa:h2d_stage"):
+            sharded, row_ids, keys_host = build_sharded_index(
+                bitmaps, self.mesh, with_host_keys=True, stats_out=stage_io)
+        self.stats.inc("h2d_bytes", stage_io.get("h2d_bytes", 0))
+        self.stats.inc("h2d_dispatch_us", int(
+            stage_io.get("h2d_dispatch_s", 0.0) * 1e6))
+        sp.tag(h2d_bytes=stage_io.get("h2d_bytes", 0),
+               h2d_dispatch_us=int(stage_io.get("h2d_dispatch_s", 0.0)
+                                   * 1e6))
         sv = StagedView(
             sharded=sharded,
             row_ids=row_ids,
@@ -501,9 +511,9 @@ class MeshManager:
         sv.inc_ewma_s = inherit_inc_ewma
         self._views[key] = sv
         self._evict_over_budget()
-        self.stats["stage"] += 1
+        self.stats.inc("stage")
         dispatch_s = time.monotonic() - t0
-        self.stats["stage_us"] += int(dispatch_s * 1e6)
+        self.stats.inc("stage_us", int(dispatch_s * 1e6))
         # Cost-gate measurement must include DEVICE completion (the
         # async H2D), not just host dispatch — but blocking here would
         # serialize the cold-start pipeline (transfer overlapping the
@@ -515,6 +525,7 @@ class MeshManager:
             sv.sharded.words, t0,
             lambda elapsed, ok=True, sv=sv:
                 self._record_stage_sample(sv, elapsed, ok))
+        sp.finish()
         return sv
 
     def _record_stage_sample(self, sv: StagedView, elapsed: float,
@@ -690,7 +701,7 @@ class MeshManager:
                 # incremental. Same stream -> same counter -> same pick
                 # on every rank.
                 if sv.inc_count >= self._DET_RESTAGE_EVERY:
-                    self.stats["refresh_pick_restage"] += 1
+                    self.stats.inc("refresh_pick_restage")
                     return restage()
             else:
                 # Per-VIEW incremental estimate (ADVICE r4): comparing a
@@ -712,9 +723,9 @@ class MeshManager:
                 if probe or (inc_est is not None
                              and sv.last_stage_s is not None
                              and sv.last_stage_s < inc_est):
-                    self.stats["refresh_pick_restage"] += 1
+                    self.stats.inc("refresh_pick_restage")
                     if probe:
-                        self.stats["refresh_probe_restage"] += 1
+                        self.stats.inc("refresh_probe_restage")
                     elif inc_est is not None:
                         # Decay the incremental estimate on a GATE-chosen
                         # restage: one anomalous slow scatter sample must
@@ -749,12 +760,15 @@ class MeshManager:
             fresh_compile = shapes not in self._apply_shapes
             self._apply_shapes.add(shapes)
             self._purge_memo(sv.sharded.words)
-            sv.sharded = self._apply_fn(sv.sharded, *batches)
+            sp = span("incremental", index=index, frame=frame, view=view)
+            with jax_scope("pilosa:apply_writes"):
+                sv.sharded = self._apply_fn(sv.sharded, *batches)
+            sp.finish()
             sv.slice_gens = new_gens
             sv.validated_epoch = ep
             sv.inc_count += 1
-            self.stats["incremental"] += 1
-            self.stats["refresh_pick_incremental"] += 1
+            self.stats.inc("incremental")
+            self.stats.inc("refresh_pick_incremental")
             if not fresh_compile:
                 # Like staging, measure to DEVICE completion on the
                 # measurement worker — host dispatch alone is a
@@ -834,7 +848,7 @@ class MeshManager:
             if hit is None:
                 return None
             self._topn_memo.move_to_end(key)
-            self.stats["memo_hit"] += 1
+            self.stats.inc("memo_hit")
             return hit[0]
 
     def _memo_put(self, key: tuple, limbs, refs: tuple, epoch: int):
@@ -860,7 +874,7 @@ class MeshManager:
             if len(self._topn_memo) >= self._TOPN_MEMO_MAX:
                 self._topn_memo.popitem(last=False)
             self._topn_memo[key] = (limbs, refs)
-            self.stats["memo_store"] += 1
+            self.stats.inc("memo_store")
             self.stats["memo_size"] = len(self._topn_memo)
 
     def _purge_memo(self, words):
@@ -902,7 +916,7 @@ class MeshManager:
             words_t, idx_t, hit_t, coarse_t, first = out
             mask = self._mask_for(first, slices)
             if mask is None:
-                self.stats["fallback"] += 1
+                self.stats.inc("fallback")
                 return None
             dev_mask = self._device_mask(mask)
 
@@ -926,7 +940,7 @@ class MeshManager:
             if vkey not in staged:
                 sv = self.refresh(index, frame, view, num_slices)
                 if sv is None:
-                    self.stats["fallback"] += 1
+                    self.stats.inc("fallback")
                     return None
                 staged[vkey] = (sv, sv.sharded.words)
             sv, words = staged[vkey]
@@ -1462,7 +1476,7 @@ class MeshManager:
             else:
                 uniq[key] = r
         group = list(uniq.values())
-        self.stats["deduped"] += len(dups)
+        self.stats.inc("deduped", len(dups))
 
         def _propagate():
             for r, key in dups:
@@ -1491,12 +1505,12 @@ class MeshManager:
                                          uniform=True)
                     limbs = fn(words_t, self._device_starts(ustarts),
                                dev_mask)
-                    self.stats["coarse_uniform"] += 1
+                    self.stats.inc("coarse_uniform")
                 else:
                     fn = self._coarse_fn(sig, len(idx_t), 1)
                     limbs = fn(words_t, tuple(c[0] for c in ct),
                                tuple(c[1] for c in ct), dev_mask)
-                self.stats["coarse"] += 1
+                self.stats.inc("coarse")
             else:
                 fn = self._count_fn(sig, len(idx_t))
                 limbs = fn(words_t, idx_t, hit_t, dev_mask)
@@ -1549,7 +1563,7 @@ class MeshManager:
                     # order; distribute results in that order (exact
                     # width, no padding)
                     group = ordered_group
-                    self.stats["shared_batch"] += b
+                    self.stats.inc("shared_batch", b)
                 else:
                     ustarts = self._uniform_starts(
                         [r.coarse_t for r in padded])
@@ -1558,7 +1572,7 @@ class MeshManager:
                                              uniform=True)
                         limbs = fn(words_t, self._device_starts(ustarts),
                                    dev_mask)
-                        self.stats["coarse_uniform"] += b
+                        self.stats.inc("coarse_uniform", b)
                     else:
                         fn = self._coarse_fn(sig, num_leaves, b_pad)
                         start_flat = tuple(
@@ -1569,7 +1583,7 @@ class MeshManager:
                             for i in range(num_leaves))
                         limbs = fn(words_t, start_flat, valid_flat,
                                    dev_mask)
-                self.stats["coarse"] += b
+                self.stats.inc("coarse", b)
             else:
                 fn = self._get_or_compile(
                     self._batch_fns, (sig, num_leaves, b_pad),
@@ -1579,11 +1593,12 @@ class MeshManager:
                                  for i in range(num_leaves))
                 hit_flat = tuple(r.args[3][i] for r in padded
                                  for i in range(num_leaves))
-                limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
-            self.stats["batched"] += b
+                with jax_scope("pilosa:count_batch"):
+                    limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
+            self.stats.inc("batched", b)
 
         # Every branch above launched exactly ONE compiled program.
-        self.stats["device_dispatches"] += 1
+        self.stats.inc("device_dispatches")
 
         # Start the D2H copy NOW: by the time the completion
         # notification lands (~70 ms period on the relay; microseconds
@@ -1648,6 +1663,10 @@ class MeshManager:
         throughput (measured 310 → 583 QPS at batch 16 on a 1B-column
         index) while a lone request runs immediately."""
         t0 = time.monotonic()
+        sp = span("dispatch", engine="mesh", leaves=len(leaves),
+                  slices=len(slices))
+        if not self.lone_fused:
+            sp.tag(kill_switch="lone_fused=off")
         with self._lone_mu:
             self._counts_inflight += 1
             lone = self._counts_inflight == 1
@@ -1656,13 +1675,15 @@ class MeshManager:
                 out = self._lone_count(index, shape, leaves, slices,
                                        num_slices)
                 if out is not None:
-                    self.stats["count"] += 1
-                    self.stats["query_us"] += \
-                        int((time.monotonic() - t0) * 1e6)
+                    self.stats.inc("count")
+                    self.stats.inc("query_us",
+                                   int((time.monotonic() - t0) * 1e6))
+                    sp.tag(mode="fused", dispatches=1)
                     return out[0]
             prepared = self._count_args(index, shape, leaves, slices,
                                         num_slices)
             if prepared is None:
+                sp.tag(mode="fallback")
                 return None
             req = _CountRequest(*prepared)
             req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
@@ -1671,10 +1692,12 @@ class MeshManager:
             req.done.wait()
             if req.error is not None:
                 _reraise_shared("batched device count", req.error)
-            self.stats["count"] += 1
-            self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+            self.stats.inc("count")
+            self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
+            sp.tag(mode="batched")
             return req.result
         finally:
+            sp.finish()
             with self._lone_mu:
                 self._counts_inflight -= 1
 
@@ -1702,9 +1725,10 @@ class MeshManager:
             fn = self._fused_plans.get_or_build(
                 key, lambda: compile_serve_count_fused(
                     self.mesh, json.loads(sig), len(leaves)))
-            limbs = fn(words_t, idx_all, hit_all, mask)
-            self.stats["device_dispatches"] += 1
-            self.stats["lone_fused"] += 1
+            with jax_scope("pilosa:count_fused"):
+                limbs = fn(words_t, idx_all, hit_all, mask)
+            self.stats.inc("device_dispatches")
+            self.stats.inc("lone_fused")
             return (combine_count(limbs),)
         except Exception:  # noqa: BLE001 — fast path only; chained path
             return None    # re-resolves and surfaces real errors
@@ -1722,7 +1746,7 @@ class MeshManager:
             if vkey not in staged:
                 sv = self.refresh(index, frame, view, num_slices)
                 if sv is None:
-                    self.stats["fallback"] += 1
+                    self.stats.inc("fallback")
                     return None
                 staged[vkey] = (sv, sv.sharded.words)
             sv, words = staged[vkey]
@@ -1743,9 +1767,9 @@ class MeshManager:
         cached = sv.host_idx_cache.pop(dense_id, None)
         if cached is not None:
             sv.host_idx_cache[dense_id] = cached  # reinsert at MRU end
-            self.stats["idx_cache_hit"] += 1
+            self.stats.inc("idx_cache_hit")
             return cached
-        self.stats["idx_cache_miss"] += 1
+        self.stats.inc("idx_cache_miss")
         out = resolve_row_indices(sv.keys_host, dense_id)
         if len(sv.host_idx_cache) >= self._IDX_CACHE_MAX:
             sv.host_idx_cache.popitem(last=False)
@@ -1765,13 +1789,13 @@ class MeshManager:
         cached = sv.idx_cache.get(dense_id)
         if cached is not None:
             sv.idx_cache.move_to_end(dense_id)  # LRU, not FIFO
-            self.stats["idx_cache_hit"] += 1
+            self.stats.inc("idx_cache_hit")
             return cached
-        self.stats["idx_cache_miss"] += 1
+        self.stats.inc("idx_cache_miss")
         # One leaf metadata upload GROUP (the device_puts below issue
         # back-to-back as one logical device operation) — a unit of the
         # per-query dispatch accounting the fused path eliminates.
-        self.stats["device_dispatches"] += 1
+        self.stats.inc("device_dispatches")
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1827,13 +1851,13 @@ class MeshManager:
         reused every query — cache the device copies. Call under _mu."""
         key = mask.tobytes()
         hit = key in self._mask_cache
-        self.stats["mask_cache_hit" if hit else "mask_cache_miss"] += 1
+        self.stats.inc("mask_cache_hit" if hit else "mask_cache_miss")
 
         def make():
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self.stats["device_dispatches"] += 1
+            self.stats.inc("device_dispatches")
             return jax.device_put(
                 mask, NamedSharding(self.mesh, P(SLICE_AXIS)))
 
@@ -1856,7 +1880,7 @@ class MeshManager:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self.stats["device_dispatches"] += 1
+            self.stats.inc("device_dispatches")
             return jax.device_put(starts, NamedSharding(self.mesh, P()))
 
         return self._device_cached(self._starts_cache, key, 256, make)
@@ -1873,12 +1897,12 @@ class MeshManager:
             self._use_epoch += 1
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
-                self.stats["fallback"] += 1
+                self.stats.inc("fallback")
                 return None
             sharded = sv.sharded  # snapshot before releasing _mu
             mask = self._mask_for(sv, slices)
             if mask is None:
-                self.stats["fallback"] += 1
+                self.stats.inc("fallback")
                 return None
             if len(sv.row_ids) == 0:
                 return ("empty", sv.row_ids)
@@ -1935,7 +1959,7 @@ class MeshManager:
         if not leader:
             pending[0].wait()
             with self._inflight_mu:
-                self.stats["inflight_shared"] += 1
+                self.stats.inc("inflight_shared")
             if pending[2] is not None:
                 _reraise_shared("shared device query", pending[2])
             return pending[1]
@@ -1966,8 +1990,8 @@ class MeshManager:
             return row_ids, np.zeros(0, dtype=np.int64)
         limbs = np.asarray(call())
         counts = combine_limbs(limbs, len(row_ids))
-        self.stats["topn"] += 1
-        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        self.stats.inc("topn")
+        self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
         return row_ids, counts
 
     def _top_n_tanimoto(self, index: str, frame: str, view: str, src,
@@ -2001,8 +2025,8 @@ class MeshManager:
         full = combine_limbs(limbs, r)
         inter = combine_limbs(limbs, r, start=padded)
         src_count = int(combine_limbs(limbs, 1, start=2 * padded)[0])
-        self.stats["topn"] += 1
-        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        self.stats.inc("topn")
+        self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
         return tanimoto_rank(all_rows, full, inter, src_count, n,
                              tanimoto, row_ids, attr_predicate)
 
@@ -2020,12 +2044,12 @@ class MeshManager:
             self._use_epoch += 1
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
-                self.stats["fallback"] += 1
+                self.stats.inc("fallback")
                 return None
             sharded = sv.sharded
             mask = self._mask_for(sv, slices)
             if mask is None:
-                self.stats["fallback"] += 1
+                self.stats.inc("fallback")
                 return None
             if len(sv.row_ids) == 0:
                 return ("empty", sv.row_ids)
@@ -2100,8 +2124,8 @@ class MeshManager:
         if limbs is None:
             return row_ids, np.zeros(0, dtype=np.int64)
         counts = combine_limbs(limbs, len(row_ids))
-        self.stats["topn"] += 1
-        self.stats["query_us"] += int((time.monotonic() - t0) * 1e6)
+        self.stats.inc("topn")
+        self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
         return row_ids, counts
 
     def top_n(self, index: str, frame: str, view: str,

@@ -35,6 +35,7 @@ from .parallel.cluster import (
     Cluster,
     Node,
 )
+from .obs import Tracer
 from .utils.stats import ExpvarStats
 from .wire import pb
 
@@ -73,6 +74,11 @@ class Server:
         self.closing = Closing()
 
         self.stats = ExpvarStats()
+        # Query trace rings ([obs] config; PILOSA_TPU_SLOW_QUERY_US
+        # still wins inside Tracer) — served at /debug/queries.
+        self.tracer = Tracer(
+            ring=self.config.trace_ring,
+            slow_us=self.config.slow_query_threshold * 1e6)
         self.holder = Holder(self.config.expanded_data_dir(),
                              stats=self.stats)
         self.cluster = Cluster(
@@ -176,7 +182,7 @@ class Server:
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
             client_factory=self.client.for_host, stats=self.stats,
-            logger=self.logger)
+            logger=self.logger, tracer=self.tracer)
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd

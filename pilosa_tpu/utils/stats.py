@@ -7,6 +7,8 @@ import threading
 from collections import defaultdict
 from typing import Dict, Iterable, Optional
 
+from ..obs.metrics import Histogram
+
 
 class StatsClient:
     """Interface: Count/Gauge/Histogram/Set/Timing + tag scoping."""
@@ -35,7 +37,13 @@ class NopStats(StatsClient):
 
 
 class ExpvarStats(StatsClient):
-    """In-process counters, exposed at /debug/vars (stats.go:70-131)."""
+    """In-process counters, exposed at /debug/vars (stats.go:70-131).
+
+    `histogram()`/`timing()` record into log-bucketed Histograms
+    (obs.metrics) instead of bare sum/count accumulators, so
+    /debug/vars can expose p50/p95/p99 alongside the legacy
+    `.sum`/`.count` keys, which are preserved verbatim in snapshot().
+    """
 
     def __init__(self, tags: Optional[Iterable[str]] = None, parent=None):
         self._parent = parent
@@ -44,10 +52,12 @@ class ExpvarStats(StatsClient):
             self._lock = threading.Lock()
             self.values: Dict[str, float] = defaultdict(float)
             self.sets: Dict[str, str] = {}
+            self.hists: Dict[str, Histogram] = {}
         else:
             self._lock = parent._lock
             self.values = parent.values
             self.sets = parent.sets
+            self.hists = parent.hists
 
     def _key(self, name: str) -> str:
         return ",".join(self.tags + (name,)) if self.tags else name
@@ -65,8 +75,12 @@ class ExpvarStats(StatsClient):
             self.values[self._key(name)] = value
 
     def histogram(self, name: str, value: float):
-        self.count(name + ".sum", value)
-        self.count(name + ".count", 1)
+        key = self._key(name)
+        with self._lock:
+            h = self.hists.get(key)
+            if h is None:
+                h = self.hists[key] = Histogram()
+        h.observe(value)
 
     def set(self, name: str, value: str):
         with self._lock:
@@ -77,7 +91,11 @@ class ExpvarStats(StatsClient):
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {**self.values, **self.sets}
+            out = {**self.values, **self.sets}
+            hists = list(self.hists.items())
+        for key, h in hists:
+            out.update(h.snapshot(key))
+        return out
 
 
 class MultiStats(StatsClient):

@@ -1,0 +1,407 @@
+"""Observability layer tests: span nesting/ordering, trace ring
+eviction, log-bucketed histogram percentile math at bucket edges,
+StatMap increment atomicity, the /debug/queries + /debug/traces JSON
+surface, and X-Pilosa-Trace propagation over a two-node HTTP fan-out.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, obs
+from pilosa_tpu.api import Handler, InternalClient
+from pilosa_tpu.config import Config
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.obs import Histogram, StatMap, Tracer
+from pilosa_tpu.parallel import new_test_cluster
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.stats import ExpvarStats
+
+
+class TestSpans:
+    def test_nesting_and_ordering(self):
+        tracer = Tracer()
+        trace = tracer.start("query", index="i")
+        with trace.root:
+            with obs.span("plan") as plan:
+                with obs.span("lower"):
+                    pass
+            with obs.span("gather", slices=3) as gather:
+                pass
+        tracer.finish(trace)
+
+        by_name = {s.name: s for s in trace.spans}
+        assert by_name["query"].parent_id is None
+        assert by_name["plan"].parent_id == by_name["query"].span_id
+        assert by_name["lower"].parent_id == by_name["plan"].span_id
+        assert by_name["gather"].parent_id == by_name["query"].span_id
+        assert gather.tags == {"slices": 3}
+        # Monotonic ordering: creation order == start order; every
+        # span finished with a non-negative duration inside its parent.
+        starts = [s.start_ns for s in trace.spans]
+        assert starts == sorted(starts)
+        for s in trace.spans:
+            assert s.end_ns is not None and s.end_ns >= s.start_ns
+        assert plan.start_ns >= by_name["query"].start_ns
+        assert trace.duration_us >= 0
+
+    def test_span_without_trace_is_noop(self):
+        assert obs.current_span() is None
+        sp = obs.span("anything", key="val")
+        assert sp is obs.NOOP_SPAN
+        with sp as inner:  # enter/exit/tag all work and do nothing
+            inner.tag(more="tags")
+        assert obs.current_span() is None
+
+    def test_error_tagged_on_exception(self):
+        tracer = Tracer()
+        trace = tracer.start("query")
+        with pytest.raises(ValueError):
+            with trace.root:
+                with obs.span("boom"):
+                    raise ValueError("x")
+        tracer.finish(trace)
+        boom = next(s for s in trace.spans if s.name == "boom")
+        assert boom.tags["error"] == "ValueError"
+
+    def test_wrap_ctx_carries_span_across_threads(self):
+        tracer = Tracer()
+        trace = tracer.start("query")
+        seen = []
+
+        def work():
+            with obs.span("worker"):
+                seen.append(obs.current_span().name)
+
+        with trace.root:
+            fn = obs.wrap_ctx(work)
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+        tracer.finish(trace)
+        assert seen == ["worker"]
+        worker = next(s for s in trace.spans if s.name == "worker")
+        assert worker.parent_id == trace.root.span_id
+
+    def test_wrap_ctx_without_trace_returns_fn(self):
+        def fn():
+            pass
+
+        assert obs.wrap_ctx(fn) is fn
+
+
+class TestTracerRings:
+    def test_ring_eviction(self):
+        tracer = Tracer(ring=3, slow_us=10**12)
+        traces = [tracer.start(f"q{i}") for i in range(5)]
+        for tr in traces:
+            tracer.finish(tr)
+        snap = tracer.snapshot()
+        # Newest first, bounded at 3; evicted ids are gone.
+        assert [t["name"] for t in snap["recent"]] == ["q4", "q3", "q2"]
+        assert snap["slow"] == []
+        assert tracer.get(traces[0].trace_id) is None
+        assert tracer.get(traces[4].trace_id) is traces[4]
+
+    def test_slow_ring_threshold(self):
+        tracer = Tracer(ring=8, slow_us=0.0)  # everything is "slow"
+        tr = tracer.start("q")
+        tracer.finish(tr)
+        snap = tracer.snapshot()
+        assert [t["id"] for t in snap["slow"]] == [tr.trace_id]
+
+    def test_env_overrides_slow_threshold(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_SLOW_QUERY_US", "123")
+        assert Tracer(slow_us=10**9).slow_us == 123.0
+
+    def test_graft_remote_spans(self):
+        tracer = Tracer()
+        trace = tracer.start("query")
+        with trace.root:
+            with obs.span("fanout") as fo:
+                remote = [
+                    {"id": 1, "parent": None, "name": "query",
+                     "start_us": 0.0, "duration_us": 50.0, "tags": {}},
+                    {"id": 2, "parent": 1, "name": "parse",
+                     "start_us": 3.0, "duration_us": 7.0, "tags": {}},
+                ]
+                trace.graft(remote, fo.span_id, node="http://n2")
+        tracer.finish(trace)
+        grafted = [s for s in trace.spans if s.tags.get("node")]
+        assert {s.name for s in grafted} == {"query", "parse"}
+        g_query = next(s for s in grafted if s.name == "query")
+        g_parse = next(s for s in grafted if s.name == "parse")
+        # Remote tree re-rooted under the fan-out span, internal
+        # parent links preserved through id remapping.
+        assert g_query.parent_id == fo.span_id
+        assert g_parse.parent_id == g_query.span_id
+        assert g_query.start_ns >= fo.start_ns
+
+
+class TestHistogram:
+    def test_single_value_exact_at_every_quantile(self):
+        h = Histogram()
+        for _ in range(100):
+            h.observe(100)
+        # min/max clamping keeps a constant stream exact despite the
+        # value sitting mid-bucket ([64, 128)).
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            assert h.percentile(q) == 100
+
+    def test_bucket_edge_interpolation(self):
+        h = Histogram()
+        for v in range(1, 9):  # 1..8: buckets b1:{1} b2:{2,3} b3:{4..7} b4:{8}
+            h.observe(v)
+        # rank(p50) = 0.5 * 7 = 3.5 -> bucket [4, 8), frac
+        # (3.5 - 3 + 0.5)/4 = 0.25 -> 4 + 0.25*4 = 5.0
+        assert h.percentile(0.50) == 5.0
+        # Extremes clamp to observed min/max.
+        assert h.percentile(0.0) == 1.0
+        assert h.percentile(1.0) == 8.0
+        assert h.total == 8 and h.sum == 36.0
+
+    def test_zero_and_empty(self):
+        h = Histogram()
+        assert h.percentile(0.5) == 0.0
+        h.observe(0)
+        assert h.percentile(0.99) == 0.0
+        assert h.min == 0.0 and h.max == 0.0
+
+    def test_snapshot_keys(self):
+        h = Histogram()
+        h.observe(10)
+        h.observe(20)
+        snap = h.snapshot("query.us")
+        assert snap["query.us.sum"] == 30.0
+        assert snap["query.us.count"] == 2.0
+        assert snap["query.us.min"] == 10.0
+        assert snap["query.us.max"] == 20.0
+        for k in ("p50", "p95", "p99"):
+            assert 10.0 <= snap[f"query.us.{k}"] <= 20.0
+
+    def test_expvar_back_compat_and_percentiles(self):
+        s = ExpvarStats()
+        tagged = s.with_tags("index:i")
+        for us in (100, 200, 300):
+            tagged.timing("query", us)
+        snap = s.snapshot()
+        # Legacy keys preserved (PR 1-era consumers), percentiles new.
+        assert snap["index:i,query.us.sum"] == 600.0
+        assert snap["index:i,query.us.count"] == 3.0
+        assert 100.0 <= snap["index:i,query.us.p50"] <= 300.0
+        assert snap["index:i,query.us.p99"] <= 300.0
+
+
+class TestStatMap:
+    def test_dict_interface_preserved(self):
+        m = StatMap({"a": 1})
+        m.inc("a")
+        m.inc("b", 5)
+        assert m["a"] == 2 and m["b"] == 5
+        assert dict(m) == {"a": 2, "b": 5}
+        m["gauge"] = 7  # plain assignment still allowed
+        assert m.copy()["gauge"] == 7
+
+    def test_concurrent_increments_exact(self):
+        m = StatMap({"n": 0})
+        threads = [
+            threading.Thread(
+                target=lambda: [m.inc("n") for _ in range(10_000)])
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert m["n"] == 80_000
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    cluster = new_test_cluster(1)
+    ex = Executor(holder, host=cluster.nodes[0].host, cluster=cluster,
+                  use_device=False)
+    handler = Handler(holder, ex, cluster=cluster,
+                      host=cluster.nodes[0].host)
+    yield holder, handler
+    holder.close()
+
+
+class TestDebugQueries:
+    def _seed_and_count(self, h):
+        assert h.handle("POST", "/index/i").status == 200
+        assert h.handle("POST", "/index/i/frame/f").status == 200
+        assert h.handle(
+            "POST", "/index/i/query",
+            body=b"SetBit(rowID=1, frame=f, columnID=5)").status == 200
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=1, frame=f))")
+        assert r.status == 200 and r.json()["results"] == [1]
+
+    def test_debug_queries_shape(self, env):
+        _, h = env
+        self._seed_and_count(h)
+        snap = h.handle("GET", "/debug/queries").json()
+        assert set(snap) == {"slow_threshold_us", "recent", "slow"}
+        assert snap["slow_threshold_us"] > 0
+        assert len(snap["recent"]) == 2  # SetBit + Count, newest first
+        for t in snap["recent"]:
+            assert set(t) >= {"id", "name", "start", "duration_us",
+                              "spans", "tags"}
+        count_tr = snap["recent"][0]
+        assert count_tr["tags"]["query"].startswith("Count(")
+        # ?threshold_us=0 reclassifies everything as slow, ad hoc.
+        refiltered = h.handle("GET", "/debug/queries",
+                              params={"threshold_us": "0"}).json()
+        assert len(refiltered["slow"]) == 2
+
+    def test_count_trace_has_pipeline_stages(self, env):
+        """A coordinator-served Count yields >= 4 distinct span stages:
+        parse, plan/route, gather, map (host) — more on device."""
+        _, h = env
+        self._seed_and_count(h)
+        tid = h.handle("GET", "/debug/queries").json()["recent"][0]["id"]
+        tr = h.handle("GET", f"/debug/traces/{tid}").json()
+        names = {s["name"] for s in tr["spans"]}
+        assert {"query", "parse", "plan", "gather"} <= names
+        assert len(names) >= 4
+        plan = next(s for s in tr["spans"] if s["name"] == "plan")
+        assert plan["tags"]["route"] in ("roaring", "memo", "host-fold",
+                                         "mesh")
+        # Spans are sorted by relative start and carry durations.
+        starts = [s["start_us"] for s in tr["spans"]]
+        assert starts == sorted(starts)
+        assert all(s["duration_us"] >= 0 for s in tr["spans"])
+
+    def test_unknown_trace_404(self, env):
+        _, h = env
+        assert h.handle("GET", "/debug/traces/nope").status == 404
+
+    def test_expvar_query_percentiles(self, env):
+        _, h = env
+        self._seed_and_count(h)
+        dv = h.handle("GET", "/debug/vars").json()
+        for k in ("query.us.p50", "query.us.p95", "query.us.p99",
+                  "query.us.sum", "query.us.count"):
+            assert k in dv
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    ports = _free_ports(2)
+    hosts = [f"127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i, h in enumerate(hosts):
+        c = Config()
+        c.data_dir = str(tmp_path / f"node{i}")
+        c.host = h
+        c.cluster_hosts = hosts
+        c.replica_n = 1
+        c.anti_entropy_interval = 3600
+        c.polling_interval = 3600
+        s = Server(c)
+        s.open()
+        servers.append(s)
+    yield servers, hosts
+    for s in servers:
+        s.close()
+
+
+class TestTracePropagation:
+    def test_remote_child_spans_grafted(self, cluster2):
+        """A fanned-out Count over two nodes: the remote leg joins the
+        coordinator's trace via X-Pilosa-Trace and its spans come back
+        grafted under the fan-out span (tagged with the remote node)."""
+        servers, hosts = cluster2
+        cli0 = InternalClient(hosts[0])
+        cli0.create_index("i")
+        cli0.create_frame("i", "f")
+        n = 8  # bits across 8 slices -> both nodes own some
+        q = "".join(
+            f"SetBit(rowID=1, frame=f, columnID={s * SLICE_WIDTH + s})"
+            for s in range(n))
+        assert cli0.execute_query(None, "i", q, [],
+                                  remote=False) == [True] * n
+        res = cli0.execute_query(
+            None, "i", "Count(Bitmap(rowID=1, frame=f))", [], remote=False)
+        assert res == [n]
+
+        # Coordinator ring: newest trace is the Count.
+        snap = servers[0].handler.handle("GET", "/debug/queries").json()
+        count_tr = next(t for t in snap["recent"]
+                        if t["tags"].get("query", "").startswith("Count("))
+        tid = count_tr["id"]
+        tr = servers[0].handler.handle(
+            "GET", f"/debug/traces/{tid}").json()
+        names = {s["name"] for s in tr["spans"]}
+        assert "fanout" in names
+        fanout = next(s for s in tr["spans"] if s["name"] == "fanout")
+        assert fanout["tags"]["node"] == hosts[1]
+        # Grafted remote spans: tagged with the remote node URL and
+        # re-rooted under the fan-out span.
+        grafted = [s for s in tr["spans"]
+                   if str(s["tags"].get("node", "")).startswith("http://")]
+        assert grafted, f"no grafted remote spans in {names}"
+        g_names = {s["name"] for s in grafted}
+        assert {"query", "parse"} <= g_names
+        g_root = next(s for s in grafted if s["parent"] == fanout["id"])
+        assert g_root["name"] == "query"
+
+        # The remote node retained the SAME trace id in its own ring,
+        # marked as a remote leg.
+        remote_tr = servers[1].handler.tracer.get(tid)
+        assert remote_tr is not None
+        assert remote_tr.tags.get("remote") is True
+
+    def test_remote_leg_not_double_counted(self, cluster2):
+        servers, hosts = cluster2
+        cli0 = InternalClient(hosts[0])
+        cli0.create_index("i")
+        cli0.create_frame("i", "f")
+        cli0.execute_query(
+            None, "i",
+            f"SetBit(rowID=1, frame=f, columnID={3 * SLICE_WIDTH})",
+            [], remote=False)
+        cli0.execute_query(None, "i", "Count(Bitmap(rowID=1, frame=f))",
+                           [], remote=False)
+        # Untagged query latency accrues only at the coordinator.
+        snap0 = servers[0].stats.snapshot()
+        snap1 = servers[1].stats.snapshot()
+        assert snap0.get("query.us.count", 0) >= 1
+        assert snap1.get("query.us.count", 0) == 0
+
+
+class TestObsConfig:
+    def test_obs_section_parse_and_roundtrip(self):
+        c = Config.from_toml(
+            '[obs]\nslow-query-threshold = "50ms"\ntrace-ring = 16\n',
+            is_text=True)
+        assert c.slow_query_threshold == 0.05
+        assert c.trace_ring == 16
+        c2 = Config.from_toml(c.to_toml(), is_text=True)
+        assert c2.slow_query_threshold == 0.05
+        assert c2.trace_ring == 16
+
+    def test_server_wires_tracer_from_config(self, tmp_path):
+        c = Config()
+        c.data_dir = str(tmp_path / "d")
+        c.slow_query_threshold = 0.002
+        c.trace_ring = 4
+        s = Server(c)
+        assert s.tracer.slow_us == 2000.0
+        assert s.handler.tracer is s.tracer
+        assert s.tracer._recent.maxlen == 4
