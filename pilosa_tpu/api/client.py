@@ -10,19 +10,168 @@ the pilosa_tpu.wire protobufs.
 
 from __future__ import annotations
 
+import random
+import threading
+import time
 import json
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..errors import PilosaError
-from ..obs import current_span
+from ..errors import DeadlineExceededError, PilosaError
+from ..obs import StatMap, current_span
+from .. import fault
 from ..wire import pb, result_from_proto, PROTOBUF_CT
+
+# Shared transport counters (retries, breaker transitions, transport
+# errors) for clients constructed without an explicit StatMap; the
+# server's ClusterClient passes one snapshot-able map to every client
+# so /debug/vars has a single `cluster` section.
+STATS = StatMap()
+
+# HTTP statuses treated as transient transport failures (retryable,
+# breaker-countable): the node or an intermediary is overloaded/
+# restarting, not telling us the request is wrong.
+_TRANSIENT_STATUS = frozenset((502, 503))
+
+# Retry backoff jitter draws don't need cryptographic strength, and a
+# shared seeded Random keeps scheduling deterministic under test.
+_RAND = random.Random()
 
 
 class ClientError(PilosaError):
-    """Transport or remote-side failure of an internal RPC."""
+    """Transport or remote-side failure of an internal RPC.
+
+    Structured fields (so callers classify without parsing messages):
+    `host` — the node the RPC targeted; `status` — HTTP status when the
+    failure was a remote response (None for transport errors);
+    `transient` — True when retrying elsewhere could help (connect
+    refused/reset, timeout, 502/503, breaker open), False when the
+    request itself is bad (4xx: bad PQL, missing frame) and re-split
+    across replicas would fail identically.
+    """
+
+    def __init__(self, msg: str, host: Optional[str] = None,
+                 status: Optional[int] = None, transient: bool = False):
+        super().__init__(msg)
+        self.host = host
+        self.status = status
+        self.transient = transient
+
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Per-node circuit breaker: closed -> open after `threshold`
+    consecutive failures -> (after `cooldown` seconds) half-open, where
+    exactly one probe request is admitted; probe success closes the
+    breaker, probe failure re-opens it. `threshold <= 0` disables.
+
+    The breaker is advisory backpressure for the routing layer: an open
+    breaker fails calls fast with a TRANSIENT ClientError, which the
+    executor's re-split treats like any dead-node error, and
+    `_slices_by_node` prefers replicas whose breaker is closed."""
+
+    def __init__(self, host: str, threshold: int = 5,
+                 cooldown: float = 5.0, stats: Optional[StatMap] = None):
+        self.host = host
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.stats = stats if stats is not None else STATS
+        self._mu = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            if (self._state == BREAKER_OPEN
+                    and time.monotonic() - self._opened_at >= self.cooldown):
+                return BREAKER_HALF_OPEN  # a probe would be admitted
+            return self._state
+
+    def allow(self) -> None:
+        """Gate one request attempt; raises a transient ClientError
+        when the breaker is open (or a half-open probe is in flight)."""
+        if self.threshold <= 0:
+            return
+        with self._mu:
+            if self._state == BREAKER_OPEN:
+                if time.monotonic() - self._opened_at >= self.cooldown:
+                    self._state = BREAKER_HALF_OPEN
+                    self._probing = True
+                    self.stats.inc("breaker.half_open")
+                    return  # this caller is the probe
+            elif self._state == BREAKER_HALF_OPEN:
+                if not self._probing:
+                    self._probing = True
+                    return
+            else:
+                return
+            self.stats.inc("breaker.reject")
+            raise ClientError(
+                f"{self.host}: circuit breaker open", host=self.host,
+                transient=True)
+
+    def record_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._mu:
+            if self._state != BREAKER_CLOSED:
+                self.stats.inc("breaker.close")
+            self._state = BREAKER_CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._mu:
+            self._failures += 1
+            self._probing = False
+            if (self._state == BREAKER_HALF_OPEN
+                    or self._failures >= self.threshold):
+                if self._state != BREAKER_OPEN:
+                    self.stats.inc("breaker.open")
+                self._state = BREAKER_OPEN
+                self._opened_at = time.monotonic()
+
+
+class BreakerRegistry:
+    """host -> CircuitBreaker, created on first use with one shared
+    (threshold, cooldown, stats) policy."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 5.0,
+                 stats: Optional[StatMap] = None):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.stats = stats
+        self._mu = threading.Lock()
+        self._by_host: Dict[str, CircuitBreaker] = {}
+
+    def for_host(self, host: str) -> CircuitBreaker:
+        with self._mu:
+            b = self._by_host.get(host)
+            if b is None:
+                b = self._by_host[host] = CircuitBreaker(
+                    host, self.threshold, self.cooldown, stats=self.stats)
+            return b
+
+    def state(self, host: str) -> str:
+        with self._mu:
+            b = self._by_host.get(host)
+        return b.state if b is not None else BREAKER_CLOSED
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._mu:
+            hosts = list(self._by_host)
+        return {h: self.state(h) for h in hosts}
 
 
 def _host_url(host: str) -> str:
@@ -32,38 +181,113 @@ def _host_url(host: str) -> str:
 
 
 class InternalClient:
-    """HTTP client bound to one remote node."""
+    """HTTP client bound to one remote node.
 
-    def __init__(self, host: str, timeout: float = 30.0):
+    Transient transport failures (connect refused/reset, timeout,
+    502/503) are retried up to `retry_max` times with capped
+    exponential backoff + jitter — within the request's remaining
+    deadline budget when one is set. Every attempt is gated by and
+    reported to the optional per-node `breaker`."""
+
+    def __init__(self, host: str, timeout: float = 30.0,
+                 retry_max: int = 2, retry_backoff: float = 0.05,
+                 breaker: Optional[CircuitBreaker] = None,
+                 stats: Optional[StatMap] = None):
         self.host = _host_url(host)
         self.timeout = timeout
+        self.retry_max = retry_max
+        self.retry_backoff = retry_backoff
+        self.breaker = breaker
+        self.stats = stats if stats is not None else STATS
 
     # -- low level -----------------------------------------------------------
+
+    # Backoff for retry N (1-based) never exceeds this many seconds.
+    _BACKOFF_CAP = 2.0
+
+    def _deadline_left(self, deadline: Optional[float],
+                       what: str) -> Optional[float]:
+        if deadline is None:
+            return None
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise DeadlineExceededError(
+                f"{what}: deadline exceeded by {-left * 1e6:.0f}us")
+        return left
 
     def _do(self, method: str, path: str,
             params: Optional[dict] = None, body: bytes = b"",
             content_type: str = "", accept: str = "",
             headers: Optional[dict] = None,
-            resp_headers: Optional[dict] = None) -> Tuple[int, bytes]:
+            resp_headers: Optional[dict] = None,
+            deadline: Optional[float] = None) -> Tuple[int, bytes]:
         url = self.host + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, data=body or None, method=method)
-        if content_type:
-            req.add_header("Content-Type", content_type)
-        if accept:
-            req.add_header("Accept", accept)
-        for k, v in (headers or {}).items():
-            req.add_header(k, v)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                if resp_headers is not None:
-                    resp_headers.update(resp.headers.items())
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            return e.code, e.read()
-        except (urllib.error.URLError, OSError) as e:
-            raise ClientError(f"{method} {url}: {e}") from e
+        what = f"{method} {url}"
+        attempt = 0
+        while True:
+            left = self._deadline_left(deadline, what)
+            if self.breaker is not None:
+                self.breaker.allow()
+            err: ClientError
+            try:
+                fault.point("client.do", host=self.host, method=method,
+                            path=path, attempt=attempt)
+                req = urllib.request.Request(url, data=body or None,
+                                             method=method)
+                if content_type:
+                    req.add_header("Content-Type", content_type)
+                if accept:
+                    req.add_header("Accept", accept)
+                for k, v in (headers or {}).items():
+                    req.add_header(k, v)
+                timeout = self.timeout
+                if left is not None:
+                    timeout = min(timeout, left)
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    if resp_headers is not None:
+                        resp_headers.update(resp.headers.items())
+                    data = resp.read()
+                    if self.breaker is not None:
+                        self.breaker.record_success()
+                    return resp.status, data
+            except urllib.error.HTTPError as e:
+                data = e.read()
+                if e.code not in _TRANSIENT_STATUS:
+                    # The node answered: it is alive, the request is
+                    # the problem. Callers raise via _check.
+                    if self.breaker is not None:
+                        self.breaker.record_success()
+                    return e.code, data
+                err = ClientError(f"{what}: status={e.code}",
+                                  host=self.host, status=e.code,
+                                  transient=True)
+            except (urllib.error.URLError, OSError) as e:
+                err = ClientError(f"{what}: {e}", host=self.host,
+                                  transient=True)
+                err.__cause__ = e
+            # Transient failure: count it, maybe retry with backoff.
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            self.stats.inc("client.transport_error")
+            attempt += 1
+            if attempt > self.retry_max:
+                raise err
+            delay = min(self.retry_backoff * (1 << (attempt - 1)),
+                        self._BACKOFF_CAP)
+            delay *= 0.5 + _RAND.random()  # jitter in [0.5x, 1.5x)
+            if deadline is not None \
+                    and time.monotonic() + delay >= deadline:
+                raise DeadlineExceededError(
+                    f"{what}: deadline leaves no retry budget") from err
+            self.stats.inc("client.retry")
+            cur = current_span()
+            if cur is not None:
+                cur.tag(retries=attempt,
+                        breaker_state=self.breaker.state
+                        if self.breaker is not None else BREAKER_CLOSED)
+            time.sleep(delay)
 
     def _check(self, status: int, data: bytes, what: str):
         if status >= 400:
@@ -71,16 +295,22 @@ class InternalClient:
                 msg = json.loads(data.decode()).get("error", "")
             except Exception:
                 msg = data[:200].decode(errors="replace")
-            raise ClientError(f"{what}: status={status} {msg}")
+            raise ClientError(f"{what}: status={status} {msg}",
+                              host=self.host, status=status,
+                              transient=status in _TRANSIENT_STATUS)
 
     # -- query plane ---------------------------------------------------------
 
     def execute_query(self, node, index: str, query: str,
-                      slices: Sequence[int], remote: bool = True) -> list:
+                      slices: Sequence[int], remote: bool = True,
+                      deadline: Optional[float] = None) -> list:
         """POST /index/{i}/query with protobuf QueryRequest, PQL
         re-serialized to a string (executor.go:1000-1083). `node` is
         accepted for interface parity with the executor seam; this
-        client is already bound to one host."""
+        client is already bound to one host. `deadline` is an absolute
+        time.monotonic() instant: the REMAINING budget rides to the
+        peer as X-Pilosa-Deadline-Us so every downstream hop inherits
+        it, and bounds this call's own socket waits/retries."""
         req = pb.QueryRequest(query=query, remote=remote)
         req.slices.extend(int(s) for s in slices)
         # Trace propagation: with a span active (the executor's fan-out
@@ -88,15 +318,24 @@ class InternalClient:
         # the coordinator's trace; its spans come back as a JSON
         # response header and are grafted under the fan-out span.
         cur = current_span()
-        hdrs = None
+        hdrs = {}
         rhdrs: dict = {}
         if cur is not None:
-            hdrs = {"X-Pilosa-Trace":
-                    f"{cur.trace.trace_id}:{cur.span_id}"}
+            hdrs["X-Pilosa-Trace"] = \
+                f"{cur.trace.trace_id}:{cur.span_id}"
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise DeadlineExceededError(
+                    f"query to {self.host}: deadline exceeded by "
+                    f"{-left * 1e6:.0f}us")
+            hdrs["X-Pilosa-Deadline-Us"] = str(int(left * 1e6))
         status, data = self._do(
             "POST", f"/index/{index}/query", body=req.SerializeToString(),
             content_type=PROTOBUF_CT, accept=PROTOBUF_CT,
-            headers=hdrs, resp_headers=rhdrs if cur is not None else None)
+            headers=hdrs or None,
+            resp_headers=rhdrs if cur is not None else None,
+            deadline=deadline)
         if cur is not None:
             wire = {k.lower(): v for k, v in rhdrs.items()}.get(
                 "x-pilosa-trace-spans", "")
@@ -113,7 +352,11 @@ class InternalClient:
             self._check(status, data, "query")
             raise
         if resp.err:
-            raise ClientError(resp.err)
+            # The peer answered with an application error: it is alive
+            # and a replica would fail the same way (bad PQL, missing
+            # frame) — non-transient, so the executor propagates it
+            # instead of re-splitting.
+            raise ClientError(resp.err, host=self.host, transient=False)
         self._check(status, data, "query")
         return [result_from_proto(r) for r in resp.results]
 

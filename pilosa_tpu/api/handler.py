@@ -60,6 +60,7 @@ from ..core.attr import diff_blocks
 from ..core.row import Row
 from ..core.timequantum import parse_time_quantum
 from ..errors import (
+    DeadlineExceededError,
     FragmentNotFoundError,
     FrameExistsError,
     FrameNotFoundError,
@@ -71,6 +72,7 @@ from ..errors import (
 from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
 from ..utils.stats import ExpvarStats
+from .. import fault
 from .. import obs
 from ..obs import Tracer
 from ..wire import (
@@ -353,6 +355,8 @@ def _proto_resp(msg, status: int = 200) -> Response:
 
 
 def _error_status(err: Exception) -> int:
+    if isinstance(err, DeadlineExceededError):
+        return 504
     if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
                         FragmentNotFoundError)):
         return 404
@@ -400,6 +404,10 @@ class Handler:
         self.tracer = tracer if tracer is not None else Tracer()
         self.logger = logger
         self.version = VERSION
+        # Default per-query deadline in seconds (config query_deadline;
+        # 0 = none). Applies to coordinator-side queries only — remote
+        # fan-out legs get their budget from X-Pilosa-Deadline-Us.
+        self.default_deadline = 0.0
         # SPMD descriptor plane (server wiring): bulk imports must ride
         # the descriptor stream so every rank's replica gets the bits;
         # None outside spmd mode. spmd_worker marks non-zero ranks,
@@ -528,6 +536,18 @@ class Handler:
         hc = getattr(self.executor, "host_cache_stats", None)
         if hc:
             snap = dict(snap, host_cache=dict(hc))
+        # Cluster transport health: retry/transport-error/breaker
+        # counters plus each peer's current breaker state, via the
+        # executor's injected ClusterClient (absent under test fakes).
+        cc = getattr(self.executor, "client", None)
+        cstats = getattr(cc, "stats", None)
+        if cstats is not None and hasattr(cstats, "copy"):
+            cluster = dict(cstats.copy())
+            breakers = getattr(cc, "breakers", None)
+            if breakers is not None:
+                cluster["breakers"] = breakers.snapshot()
+            if cluster:
+                snap = dict(snap, cluster=cluster)
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:
@@ -963,6 +983,9 @@ class Handler:
                       if s != ""]
             column_attrs = params.get("columnAttrs") == "true"
             remote = False
+        fault.point("handler.query", host=self.host, index=index,
+                    remote=bool(remote))
+        opt = self._exec_options(params, headers, remote)
 
         # Trace lifecycle: every query records a trace into the
         # bounded rings behind /debug/queries. A remote fan-out leg
@@ -977,7 +1000,7 @@ class Handler:
         try:
             with trace.root:
                 resp = self._run_query(index, query, slices, column_attrs,
-                                       remote, headers)
+                                       remote, headers, opt)
         finally:
             self.tracer.finish(trace)
         if th:
@@ -985,8 +1008,30 @@ class Handler:
                 trace.serialize_spans(), separators=(",", ":"))
         return resp
 
+    def _exec_options(self, params, headers, remote) -> ExecOptions:
+        """Per-query ExecOptions from the request: deadline from the
+        X-Pilosa-Deadline-Us header (remaining budget in µs, set by an
+        upstream coordinator hop) or the ?deadline= param (Go duration,
+        e.g. "50ms"), falling back to the configured default for
+        coordinator-side queries; ?partial=true opts into graceful
+        degradation (missing slices reported, not fatal)."""
+        deadline = None
+        hdr = headers.get("x-pilosa-deadline-us", "")
+        if hdr:
+            deadline = time.monotonic() + int(hdr) / 1e6
+        elif params.get("deadline"):
+            from ..config import parse_duration
+
+            deadline = time.monotonic() + parse_duration(params["deadline"])
+        elif not remote and self.default_deadline > 0:
+            deadline = time.monotonic() + self.default_deadline
+        return ExecOptions(remote=remote, deadline=deadline,
+                           partial=params.get("partial") == "true")
+
     def _run_query(self, index, query, slices, column_attrs, remote,
-                   headers) -> Response:
+                   headers, opt=None) -> Response:
+        if opt is None:
+            opt = ExecOptions(remote=remote)
         try:
             # Parsed-query LRU (pql.parse_string_cached): repeat PQL
             # texts skip the ~100 us parse, which dominates a
@@ -995,8 +1040,7 @@ class Handler:
             with obs.span("parse", bytes=len(query)):
                 q = parse_string_cached(query)
             t0 = time.monotonic()
-            results = self.executor.execute(
-                index, q, slices or None, ExecOptions(remote=remote))
+            results = self.executor.execute(index, q, slices or None, opt)
             # Per-call-name query stats, visible at /debug/vars
             # (observability parity: reference tag-scoped StatsClient,
             # stats.go:33-54). Remote fan-out legs are skipped so a
@@ -1032,12 +1076,18 @@ class Handler:
         if column_attrs:
             out["columnAttrs"] = [{"id": cid, "attrs": attrs}
                                   for cid, attrs in col_sets]
+        if opt.partial:
+            # ?partial=true responses always say whether degradation
+            # happened, so clients don't have to infer it from absence.
+            out["partial"] = bool(opt.missing_slices)
+            out["missing_slices"] = sorted(set(opt.missing_slices))
         return _json_resp(out)
 
     def _query_error(self, e, headers) -> Response:
+        status = 504 if isinstance(e, DeadlineExceededError) else 400
         if self._accepts_proto(headers):
-            return _proto_resp(pb.QueryResponse(err=str(e)), 400)
-        return _json_resp({"error": str(e)}, 400)
+            return _proto_resp(pb.QueryResponse(err=str(e)), status)
+        return _json_resp({"error": str(e)}, status)
 
     def _column_attr_sets(self, index: str, results) -> List[Tuple[int, dict]]:
         """Attrs for every column appearing in row results

@@ -11,6 +11,20 @@ TOML schema:
     partitions = 16
     hosts = ["localhost:10101"]
     polling-interval = "60s"
+    # -- fault tolerance (see README "Fault tolerance") --
+    client-timeout = "30s"      # per-attempt HTTP timeout, node-to-node
+    query-deadline = "0s"       # default per-query budget; 0 = none.
+                                # Overridable per request (deadline=
+                                # param / X-Pilosa-Deadline-Us header);
+                                # remaining budget rides every remote
+                                # hop, expiry raises DeadlineExceeded.
+    retries = 2                 # retry attempts for TRANSIENT transport
+                                # errors (refused/reset/timeout/502/503)
+    retry-backoff = "50ms"      # base of the capped exponential
+                                # backoff (jittered, doubles per retry)
+    breaker-threshold = 5       # consecutive failures that open a
+                                # node's circuit breaker; 0 disables
+    breaker-cooldown = "5s"     # open -> half-open probe delay
 
     [anti-entropy]
     interval = "10m"
@@ -44,8 +58,9 @@ DEFAULT_POLLING_INTERVAL = 60.0
 # plane binds UDP+TCP here.
 DEFAULT_GOSSIP_PORT = 14000
 
-_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")
-_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|\u00b5s|ms|h|m|s)")
+_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3,
+           "us": 1e-6, "\u00b5s": 1e-6, "ns": 1e-9}
 
 
 def parse_duration(s) -> float:
@@ -107,6 +122,15 @@ class Config:
         self.spmd_process_id: int = -1
         self.replica_n: int = DEFAULT_REPLICA_N
         self.partition_n: int = DEFAULT_PARTITION_N
+        # [cluster] fault tolerance (module docstring): per-attempt
+        # client timeout, default query deadline (0 = none), transient
+        # retry count + backoff base, per-node circuit breaker.
+        self.client_timeout: float = 30.0
+        self.query_deadline: float = 0.0
+        self.retry_max: int = 2
+        self.retry_backoff: float = 0.05
+        self.breaker_threshold: int = 5
+        self.breaker_cooldown: float = 5.0
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
         self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
         # Parity-only (reference config.go:50, cmd/server.go:96): the
@@ -150,6 +174,17 @@ class Config:
                                           c.spmd_num_processes))
         c.spmd_process_id = int(cl.get("spmd-process-id",
                                        c.spmd_process_id))
+        if "client-timeout" in cl:
+            c.client_timeout = parse_duration(cl["client-timeout"])
+        if "query-deadline" in cl:
+            c.query_deadline = parse_duration(cl["query-deadline"])
+        c.retry_max = int(cl.get("retries", c.retry_max))
+        if "retry-backoff" in cl:
+            c.retry_backoff = parse_duration(cl["retry-backoff"])
+        c.breaker_threshold = int(cl.get("breaker-threshold",
+                                         c.breaker_threshold))
+        if "breaker-cooldown" in cl:
+            c.breaker_cooldown = parse_duration(cl["breaker-cooldown"])
         if "polling-interval" in cl:
             c.polling_interval = parse_duration(cl["polling-interval"])
         ae = data.get("anti-entropy", {})
@@ -192,6 +227,12 @@ class Config:
             f'spmd-coordinator = "{self.spmd_coordinator}"\n'
             f"spmd-processes = {self.spmd_num_processes}\n"
             f"spmd-process-id = {self.spmd_process_id}\n"
+            f'client-timeout = "{int(self.client_timeout * 1000)}ms"\n'
+            f'query-deadline = "{int(self.query_deadline * 1000)}ms"\n'
+            f"retries = {self.retry_max}\n"
+            f'retry-backoff = "{int(self.retry_backoff * 1000)}ms"\n'
+            f"breaker-threshold = {self.breaker_threshold}\n"
+            f'breaker-cooldown = "{int(self.breaker_cooldown * 1000)}ms"\n'
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'

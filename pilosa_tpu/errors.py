@@ -46,3 +46,29 @@ class SliceUnavailableError(PilosaError):
 
 class QueryError(PilosaError):
     """Invalid query arguments/shape."""
+
+
+class DeadlineExceededError(PilosaError):
+    """The query's deadline expired (the distributed path fails fast
+    instead of riding out a flat per-hop client timeout). Maps to HTTP
+    504. `transient = False`: retrying or re-splitting an expired query
+    only burns more of a budget that is already gone."""
+
+    transient = False
+
+    def __init__(self, msg: str = "deadline exceeded"):
+        super().__init__(msg)
+
+
+class BroadcastError(PilosaError):
+    """A write broadcast failed on one or more peers. Carries every
+    per-node outcome (`failures`: list of (host, exception)) instead of
+    first-error-wins, so operators see the full blast radius."""
+
+    def __init__(self, failures, total: int):
+        self.failures = list(failures)
+        self.total = total
+        detail = "; ".join(f"{h}: {e}" for h, e in self.failures)
+        super().__init__(
+            f"broadcast failed on {len(self.failures)}/{total} nodes: "
+            f"{detail}")

@@ -18,6 +18,7 @@ materialize-then-count gap, SURVEY.md §3.2 note).
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from datetime import datetime
 from typing import Callable, List, Optional, Sequence
@@ -30,15 +31,18 @@ from .core.index import DEFAULT_COLUMN_LABEL
 from .core.row import Row
 from .core.view import VIEW_INVERSE, VIEW_STANDARD
 from .errors import (
+    BroadcastError,
+    DeadlineExceededError,
     FrameNotFoundError,
     IndexNotFoundError,
     IndexRequiredError,
     QueryError,
     SliceUnavailableError,
 )
-from .parallel.cluster import NODE_STATE_UP
+from .parallel.cluster import NODE_STATE_UP, preferred_owner
 from .pql import Call, Query
 from . import SLICE_WIDTH
+from . import fault
 from . import obs
 
 # Frame used when a query doesn't specify one (executor.go:35).
@@ -54,10 +58,37 @@ _WRITE_CALLS = ("ClearBit", "SetBit", "SetRowAttrs", "SetColumnAttrs")
 
 
 class ExecOptions:
-    """Per-Execute context (executor.go:1253-1256)."""
+    """Per-Execute context (executor.go:1253-1256).
 
-    def __init__(self, remote: bool = False):
+    `deadline` — absolute time.monotonic() instant by which the whole
+    query must finish; every remote hop is given only the REMAINING
+    budget and expiry raises DeadlineExceededError instead of riding
+    out the flat per-hop client timeout. None = no deadline.
+    `partial` — opt-in graceful degradation: a slice with no reachable
+    owner is skipped and collected in `missing_slices` instead of
+    failing the query with SliceUnavailableError."""
+
+    def __init__(self, remote: bool = False,
+                 deadline: Optional[float] = None, partial: bool = False):
         self.remote = remote
+        self.deadline = deadline
+        self.partial = partial
+        # Slices this query could not serve (partial mode only); the
+        # handler surfaces them as {partial: true, missing_slices}.
+        self.missing_slices: List[int] = []
+
+    def deadline_left(self) -> Optional[float]:
+        """Remaining budget in seconds (negative when expired), or
+        None when no deadline is set."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def check_deadline(self, what: str = "query") -> None:
+        left = self.deadline_left()
+        if left is not None and left <= 0:
+            raise DeadlineExceededError(
+                f"{what}: deadline exceeded by {-left * 1e6:.0f}us")
 
 
 def parse_time(s: str) -> datetime:
@@ -209,6 +240,7 @@ class Executor:
 
         results = []
         for call in q.calls:
+            opt.check_deadline(call.name)
             call_slices = slices
             if call.supports_inverse() and need:
                 frame = call.args.get("frame") or DEFAULT_FRAME
@@ -1105,33 +1137,67 @@ class Executor:
         return None
 
     def _broadcast_query(self, index: str, q: Query, opt: ExecOptions):
-        """Forward a write to every other node in parallel; first error
-        wins (executor.go:833-855)."""
+        """Forward a write to every other node in parallel. EVERY
+        future is awaited before any error is raised (the reference's
+        first-error-wins, executor.go:833-855, leaks unawaited futures
+        behind one slow replica), and the error lists every failed
+        host. The client layer owns per-node retry; nodes that still
+        fail are reported together via BroadcastError."""
         nodes = self._other_nodes()
         if not nodes:
             return
         futures = [
-            self._pool.submit(obs.wrap_ctx(self._exec_remote),
-                              node, index, q, None, opt)
+            (node, self._pool.submit(obs.wrap_ctx(self._exec_remote),
+                                     node, index, q, None, opt))
             for node in nodes
         ]
-        for fut in futures:
-            fut.result()
+        failures = []
+        for node, fut in futures:
+            try:
+                fut.result()
+            except Exception as err:  # noqa: BLE001 — collected below
+                failures.append((node.host, err))
+        if failures:
+            raise BroadcastError(failures, len(nodes))
 
     # -- distributed fan-out -------------------------------------------------
 
     def _exec_remote(self, node, index: str, q: Query,
                      slices: Optional[Sequence[int]], opt: ExecOptions) -> list:
         """Remote execution via the injected client (executor.go:1000-1083).
-        The query travels as its canonical PQL serialization."""
+        The query travels as its canonical PQL serialization, plus the
+        REMAINING deadline budget when one is set (the client forwards
+        it as X-Pilosa-Deadline-Us so downstream hops inherit it)."""
         if self.client is None:
             raise SliceUnavailableError()
-        with obs.span("fanout", node=node.host,
-                      slices=len(slices) if slices else 0):
-            return self.client.execute_query(
-                node, index, str(q), slices or [], remote=True)
+        sp = obs.span("fanout", node=node.host,
+                      slices=len(slices) if slices else 0)
+        try:
+            with sp:
+                fault.point("executor.fanout", node=node.host)
+                opt.check_deadline(f"fanout to {node.host}")
+                kw = {}
+                if opt.deadline is not None:
+                    # Only pass the kwarg when set: test fakes implement
+                    # the positional execute_query seam without it.
+                    kw["deadline"] = opt.deadline
+                return self.client.execute_query(
+                    node, index, str(q), slices or [], remote=True, **kw)
+        finally:
+            left = opt.deadline_left()
+            if left is not None:
+                # Tagged on exit so an expired hop shows a NEGATIVE
+                # remaining budget in /debug/queries.
+                sp.tag(deadline_left_us=int(left * 1e6))
 
-    def _slices_by_node(self, nodes, index: str, slices: Sequence[int]):
+    def _breaker_callable(self):
+        """The injected client's breaker_state(host) callable, or None
+        when it has no breaker registry (test fakes, single client)."""
+        state = getattr(self.client, "breaker_state", None)
+        return state if callable(state) else None
+
+    def _slices_by_node(self, nodes, index: str, slices: Sequence[int],
+                        opt: Optional[ExecOptions] = None):
         """node -> slices owned, restricted to `nodes`
         (executor.go:1087-1101)."""
         m = {}
@@ -1139,13 +1205,18 @@ class Executor:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
                       if o in nodes]
             if not owners:
+                if opt is not None and opt.partial:
+                    # Graceful degradation: the response reports the
+                    # slice as missing instead of failing the query.
+                    opt.missing_slices.append(slice_)
+                    continue
                 raise SliceUnavailableError()
-            # Prefer replicas the status-poll daemon currently sees UP;
-            # a slice whose owners are all marked DOWN still tries one
-            # (liveness is advisory — the reactive re-split below is
-            # the authority, executor.go:1140-1151).
-            up = [o for o in owners if o.state == NODE_STATE_UP]
-            pick = (up or owners)[0]
+            # Prefer replicas the status-poll daemon currently sees UP
+            # AND whose circuit breaker is closed; a slice whose owners
+            # are all marked DOWN/open still tries one (liveness is
+            # advisory — the reactive re-split below is the authority,
+            # executor.go:1140-1151).
+            pick = preferred_owner(owners, self._breaker_callable())
             m.setdefault(pick, []).append(slice_)
         return m
 
@@ -1159,7 +1230,8 @@ class Executor:
         back to the per-slice map_fn fan-out. Remote nodes always go
         through the RPC path — each runs its own batch_fn on arrival."""
         if self.cluster is None or not self.cluster.nodes:
-            return self._mapper_local(slices, map_fn, reduce_fn, batch_fn)
+            return self._mapper_local(slices, map_fn, reduce_fn, batch_fn,
+                                      opt.deadline)
 
         if opt.remote:
             # Already forwarded: restrict to the local node.
@@ -1170,9 +1242,26 @@ class Executor:
         return self._mapper(nodes, index, slices, c, opt, map_fn, reduce_fn,
                             batch_fn)
 
+    @staticmethod
+    def _transient_error(err: BaseException) -> bool:
+        """Should this node failure trigger a replica re-split?
+        Duck-typed on the `transient` attribute so the executor never
+        imports the HTTP client (api -> handler -> executor cycle) and
+        never parses messages: structured ClientErrors say so
+        themselves, DeadlineExceededError says False, and anything
+        unannotated (socket errors from fakes, pool crashes) defaults
+        to transient — matching the reference's retry-anything
+        behavior (executor.go:1140-1151). Non-transient remote errors
+        (bad PQL, missing frame) would fail identically on every
+        replica, so they propagate immediately."""
+        transient = getattr(err, "transient", None)
+        if transient is not None:
+            return bool(transient)
+        return not isinstance(err, QueryError)
+
     def _mapper(self, nodes, index: str, slices: Sequence[int], c: Call,
                 opt: ExecOptions, map_fn, reduce_fn, batch_fn=None):
-        m = self._slices_by_node(nodes, index, slices)
+        m = self._slices_by_node(nodes, index, slices, opt)
 
         futures = {}
         for node, node_slices in m.items():
@@ -1182,7 +1271,7 @@ class Executor:
             if node.host == self.host:
                 fut = self._pool.submit(
                     obs.wrap_ctx(self._mapper_local), node_slices,
-                    map_fn, reduce_fn, batch_fn)
+                    map_fn, reduce_fn, batch_fn, opt.deadline)
             elif not opt.remote:
                 fut = self._pool.submit(
                     obs.wrap_ctx(self._exec_remote_one), node, index, c,
@@ -1194,20 +1283,48 @@ class Executor:
         result = None
         pending = set(futures)
         while pending:
-            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            left = opt.deadline_left()
+            if left is not None and left <= 0:
+                for fut in pending:
+                    fut.cancel()
+                raise DeadlineExceededError(
+                    f"fan-out wait: deadline exceeded by "
+                    f"{-left * 1e6:.0f}us")
+            done, pending = wait(pending, timeout=left,
+                                 return_when=FIRST_COMPLETED)
             for fut in done:
                 node, node_slices = futures[fut]
                 try:
                     v = fut.result()
                 except Exception as err:
-                    # Re-split this node's slices across remaining replicas
-                    # (executor.go:1140-1151).
+                    if not self._transient_error(err):
+                        for f in pending:
+                            f.cancel()
+                        raise
+                    # Re-split this node's slices across remaining
+                    # replicas (executor.go:1140-1151). The resplit
+                    # span (resplit=1) makes the double failure visible
+                    # in traces.
                     remaining = [n for n in nodes if n is not node]
                     try:
-                        v = self._mapper(remaining, index, node_slices, c,
-                                         opt, map_fn, reduce_fn, batch_fn)
-                    except SliceUnavailableError:
-                        raise err
+                        with obs.span("resplit", node=node.host,
+                                      slices=len(node_slices), resplit=1):
+                            v = self._mapper(remaining, index, node_slices,
+                                             c, opt, map_fn, reduce_fn,
+                                             batch_fn)
+                    except SliceUnavailableError as resplit_err:
+                        if opt.partial:
+                            # No replica left for these slices: report
+                            # them missing instead of failing.
+                            opt.missing_slices.extend(node_slices)
+                            continue
+                        # Chain the re-split failure so the trace shows
+                        # BOTH the root cause and the exhausted re-split.
+                        raise err from resplit_err
+                    if v is None:
+                        # A partial-mode re-split that lost EVERY slice
+                        # produced no result; nothing to fold.
+                        continue
                 result = reduce_fn(result, v)
         return result
 
@@ -1217,7 +1334,7 @@ class Executor:
         return results[0] if results else None
 
     def _mapper_local(self, slices: Sequence[int], map_fn, reduce_fn,
-                      batch_fn=None):
+                      batch_fn=None, deadline: Optional[float] = None):
         """Local per-slice map + reduce (executor.go:1200-1236 runs a
         goroutine per slice; here the map fans out on the dedicated
         _slice_pool — NOT self._pool, see __init__ — and the reduce
@@ -1228,7 +1345,8 @@ class Executor:
 
         When batch_fn serves the whole batch (mesh path), its result
         feeds reduce_fn directly — one device collective replaces the
-        per-slice fan-out."""
+        per-slice fan-out. `deadline` bounds each slice-result wait
+        with the remaining budget (absolute monotonic instant)."""
         slices = list(slices)
         with obs.span("gather", slices=len(slices)) as gsp:
             if batch_fn is not None and slices:
@@ -1249,7 +1367,20 @@ class Executor:
             try:
                 with obs.span("reduce", slices=len(slices)):
                     for fut in futures:
-                        result = reduce_fn(result, fut.result())
+                        if deadline is None:
+                            result = reduce_fn(result, fut.result())
+                            continue
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            raise DeadlineExceededError(
+                                f"slice wait: deadline exceeded by "
+                                f"{-left * 1e6:.0f}us")
+                        try:
+                            v = fut.result(timeout=left)
+                        except TimeoutError:
+                            raise DeadlineExceededError(
+                                "slice wait: deadline exceeded")
+                        result = reduce_fn(result, v)
             except BaseException:
                 # Don't leave orphaned slice tasks burning pool workers
                 # while the node-failure re-split re-executes these

@@ -1,0 +1,276 @@
+"""Fault-injection harness: named injection points wired into the
+client and executor so tests (and operators) can inject timeouts,
+connection resets, slow responses, and mid-query node death without
+monkeypatching internals.
+
+Library code calls `fault.point("client.do", host=..., ...)` at each
+seam; with nothing armed that is one module-global truthiness check.
+Faults are armed either programmatically::
+
+    rule = fault.arm("client.do", error=ConnectionResetError,
+                     times=2, host="127.0.0.1:10101")
+    ...
+    fault.reset()
+
+or from the environment (parsed once, at first use or via
+`fault.load_env()`)::
+
+    PILOSA_TPU_FAULT="client.do:error=ConnectionError,host=h:1,times=3;\
+handler.query:delay=500ms,host=h:2"
+    PILOSA_TPU_FAULT_SEED=0   # seeds the prob= draw schedule
+
+Rule knobs: `error=` (exception class, instance, or builtin name),
+`delay=` (seconds or Go duration — fires as a sleep, composable with
+error), `times=N` (fire at most N times), `after=N` (skip the first N
+matches — "die mid-query"), `prob=P` (fire with probability P drawn
+from ONE seeded RNG, so a fixed PILOSA_TPU_FAULT_SEED makes the whole
+chaos schedule deterministic), plus any `key=value` context match
+(e.g. `host=`) compared against the kwargs the injection point passes.
+
+Injection points currently wired:
+
+    client.do         every InternalClient HTTP attempt (host, method,
+                      path) — including each retry attempt
+    handler.query     server side of POST /index/{i}/query (host,
+                      index, remote) — a delay here is a slow node
+    executor.fanout   coordinator-side remote fan-out (node)
+
+Every fired fault is counted in `fault.STATS` and recorded in the
+bounded `fault.log()` ring for assertions.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Type
+
+from .obs import StatMap
+
+# Exception names accepted by the env spec (error=Name).
+_ERROR_NAMES: Dict[str, Type[BaseException]] = {
+    "ConnectionError": ConnectionError,
+    "ConnectionResetError": ConnectionResetError,
+    "ConnectionRefusedError": ConnectionRefusedError,
+    "TimeoutError": TimeoutError,
+    "OSError": OSError,
+}
+
+STATS = StatMap()
+
+
+class Rule:
+    """One armed fault. Mutable counters are guarded by the registry
+    lock; reads of the immutable spec fields are free."""
+
+    __slots__ = ("point", "error", "delay", "times", "after", "prob",
+                 "match", "fired", "seen")
+
+    def __init__(self, point: str, error=None, delay: float = 0.0,
+                 times: Optional[int] = None, after: int = 0,
+                 prob: float = 1.0, match: Optional[Dict[str, Any]] = None):
+        self.point = point
+        self.error = error
+        self.delay = float(delay)
+        self.times = times  # None = unbounded
+        self.after = int(after)
+        self.prob = float(prob)
+        self.match = dict(match or {})
+        self.fired = 0  # times this rule actually fired
+        self.seen = 0   # times this rule matched (incl. after/prob skips)
+
+    def _matches(self, ctx: Dict[str, Any]) -> bool:
+        return all(str(ctx.get(k)) == str(v) for k, v in self.match.items())
+
+    def _make_error(self) -> BaseException:
+        err = self.error
+        if isinstance(err, BaseException):
+            return err
+        if isinstance(err, type) and issubclass(err, BaseException):
+            return err(f"fault injected at {self.point}")
+        return ConnectionError(f"fault injected at {self.point}: {err}")
+
+
+class Injector:
+    """Registry of armed rules + the seeded schedule RNG."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._mu = threading.Lock()
+        self._rules: List[Rule] = []
+        if seed is None:
+            env = os.environ.get("PILOSA_TPU_FAULT_SEED", "")
+            seed = int(env) if env else 0
+        self._rand = random.Random(seed)
+        self._log: "deque[tuple]" = deque(maxlen=256)
+
+    def arm(self, point: str, *, error=None, delay: float = 0.0,
+            times: Optional[int] = None, after: int = 0, prob: float = 1.0,
+            match: Optional[Dict[str, Any]] = None, **ctx_match) -> Rule:
+        m = dict(match or {})
+        m.update(ctx_match)
+        rule = Rule(point, error=error, delay=delay, times=times,
+                    after=after, prob=prob, match=m)
+        with self._mu:
+            self._rules.append(rule)
+        _set_active(True)
+        return rule
+
+    def disarm(self, rule: Rule) -> None:
+        with self._mu:
+            if rule in self._rules:
+                self._rules.remove(rule)
+            empty = not self._rules
+        if empty:
+            _set_active(False)
+
+    def reset(self, seed: Optional[int] = None) -> None:
+        """Drop every rule and (optionally) reseed the schedule."""
+        with self._mu:
+            self._rules.clear()
+            self._log.clear()
+            if seed is not None:
+                self._rand = random.Random(seed)
+        _set_active(False)
+
+    def log(self) -> List[tuple]:
+        """Recent fired faults: (point, ctx dict) newest last."""
+        with self._mu:
+            return list(self._log)
+
+    def fire(self, point: str, ctx: Dict[str, Any]) -> None:
+        """Evaluate every rule for `point`; sleeps/raises per the first
+        delay/error rule that fires (delay rules all sleep, then at
+        most one error raises)."""
+        to_raise: Optional[BaseException] = None
+        delay = 0.0
+        with self._mu:
+            for rule in self._rules:
+                if rule.point != point or not rule._matches(ctx):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self._rand.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                self._log.append((point, dict(ctx)))
+                STATS.inc(f"fault.{point}")
+                if rule.delay > 0.0:
+                    delay = max(delay, rule.delay)
+                if rule.error is not None and to_raise is None:
+                    to_raise = rule._make_error()
+        if delay > 0.0:
+            time.sleep(delay)
+        if to_raise is not None:
+            raise to_raise
+
+
+# Module-global active flag: point() must be near-free when nothing is
+# armed — one global read, no lock, no registry walk.
+_ACTIVE = False
+_INJECTOR = Injector()
+_ENV_LOADED = False
+
+
+def _set_active(on: bool) -> None:
+    global _ACTIVE
+    _ACTIVE = on
+
+
+def injector() -> Injector:
+    return _INJECTOR
+
+
+def arm(point: str, **kw) -> Rule:
+    _load_env_once()
+    return _INJECTOR.arm(point, **kw)
+
+
+def disarm(rule: Rule) -> None:
+    _INJECTOR.disarm(rule)
+
+
+def reset(seed: Optional[int] = None) -> None:
+    _INJECTOR.reset(seed)
+
+
+def log() -> List[tuple]:
+    return _INJECTOR.log()
+
+
+def point(name: str, **ctx) -> None:
+    """The injection seam. Near-free when nothing is armed."""
+    if not _ACTIVE:
+        if not _ENV_LOADED:
+            _load_env_once()
+            if not _ACTIVE:
+                return
+        else:
+            return
+    _INJECTOR.fire(name, ctx)
+
+
+def active() -> bool:
+    _load_env_once()
+    return _ACTIVE
+
+
+def _load_env_once() -> None:
+    """Arm rules from PILOSA_TPU_FAULT exactly once per process (call
+    load_env() to re-read after changing the env mid-process)."""
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    _ENV_LOADED = True
+    spec = os.environ.get("PILOSA_TPU_FAULT", "")
+    if spec:
+        load_spec(spec)
+
+
+def load_env() -> None:
+    """Force a re-read of PILOSA_TPU_FAULT (tests that set the env
+    after import)."""
+    global _ENV_LOADED
+    _ENV_LOADED = False
+    _load_env_once()
+
+
+def load_spec(spec: str) -> List[Rule]:
+    """Parse and arm a `point:key=val,...;point2:...` spec string."""
+    from .config import parse_duration
+
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        pt, _, body = part.partition(":")
+        kw: Dict[str, Any] = {"match": {}}
+        for item in body.split(","):
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "error":
+                if v not in _ERROR_NAMES:
+                    raise ValueError(f"unknown fault error {v!r} "
+                                     f"(want one of {sorted(_ERROR_NAMES)})")
+                kw["error"] = _ERROR_NAMES[v]
+            elif k == "delay":
+                kw["delay"] = parse_duration(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            elif k == "after":
+                kw["after"] = int(v)
+            elif k == "prob":
+                kw["prob"] = float(v)
+            else:
+                kw["match"][k] = v
+        rules.append(_INJECTOR.arm(pt.strip(), **kw))
+    return rules

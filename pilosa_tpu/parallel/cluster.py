@@ -160,6 +160,21 @@ class Cluster:
                           for n in self.nodes]}
 
 
+def preferred_owner(owners: List[Node], breaker_state=None) -> Node:
+    """Routing preference among a slice's replica owners: UP nodes
+    whose circuit breaker is closed, then any UP node, then anyone —
+    both gossip liveness and breaker state are advisory, so a slice
+    whose owners all look bad still tries one (the executor's reactive
+    re-split is the authority). `breaker_state(host) -> str` comes from
+    the cluster client; None means no breaker info."""
+    up = [o for o in owners if o.state == NODE_STATE_UP]
+    if breaker_state is not None:
+        healthy = [o for o in up if breaker_state(o.host) == "closed"]
+        if healthy:
+            return healthy[0]
+    return (up or owners)[0]
+
+
 def new_test_cluster(n: int) -> Cluster:
     """n fake nodes host0..host{n-1} with ModHasher — the reference's
     deterministic test cluster (cluster_test.go:146-177)."""

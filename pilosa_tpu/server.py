@@ -23,6 +23,7 @@ import time
 from typing import Dict, Optional
 
 from .api import APIServer, Handler, InternalClient
+from .api.client import BreakerRegistry
 from .config import Config
 from .core.holder import Holder
 from .core.syncer import Closing, HolderSyncer
@@ -35,7 +36,7 @@ from .parallel.cluster import (
     Cluster,
     Node,
 )
-from .obs import Tracer
+from .obs import StatMap, Tracer
 from .utils.stats import ExpvarStats
 from .wire import pb
 
@@ -45,10 +46,20 @@ CACHE_FLUSH_INTERVAL = 60.0
 class ClusterClient:
     """Routes executor remote calls to per-node InternalClients (the
     reference passes node hosts into Client per call; here one routing
-    object satisfies the executor's client seam)."""
+    object satisfies the executor's client seam). All per-node clients
+    share ONE StatMap and ONE BreakerRegistry, so /debug/vars has a
+    single `cluster` section and `_slices_by_node` can consult breaker
+    state via `breaker_state(host)`."""
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, retry_max: int = 2,
+                 retry_backoff: float = 0.05, breaker_threshold: int = 5,
+                 breaker_cooldown: float = 5.0):
         self.timeout = timeout
+        self.retry_max = retry_max
+        self.retry_backoff = retry_backoff
+        self.stats = StatMap()
+        self.breakers = BreakerRegistry(
+            breaker_threshold, breaker_cooldown, stats=self.stats)
         self._clients: Dict[str, InternalClient] = {}
         self._lock = threading.Lock()
 
@@ -57,12 +68,20 @@ class ClusterClient:
             c = self._clients.get(host)
             if c is None:
                 c = self._clients[host] = InternalClient(
-                    host, timeout=self.timeout)
+                    host, timeout=self.timeout, retry_max=self.retry_max,
+                    retry_backoff=self.retry_backoff,
+                    breaker=self.breakers.for_host(host), stats=self.stats)
             return c
 
-    def execute_query(self, node, index, query, slices, remote=True):
+    def breaker_state(self, host: str) -> str:
+        """Executor seam: current breaker state for a node host (raw
+        "host:port" form, as Node.host carries it)."""
+        return self.breakers.state(host)
+
+    def execute_query(self, node, index, query, slices, remote=True,
+                      deadline=None):
         return self.for_host(node.host).execute_query(
-            node, index, query, slices, remote=remote)
+            node, index, query, slices, remote=remote, deadline=deadline)
 
 
 class Server:
@@ -87,7 +106,12 @@ class Server:
             partition_n=self.config.partition_n,
         )
         self.host = self.config.host
-        self.client = ClusterClient()
+        self.client = ClusterClient(
+            timeout=self.config.client_timeout,
+            retry_max=self.config.retry_max,
+            retry_backoff=self.config.retry_backoff,
+            breaker_threshold=self.config.breaker_threshold,
+            breaker_cooldown=self.config.breaker_cooldown)
 
         # Transport selection (reference server/server.go:150-187:
         # static | http | gossip; plus the TPU-native "spmd" multi-host
@@ -183,6 +207,8 @@ class Server:
             broadcast_handler=self, status_handler=self,
             client_factory=self.client.for_host, stats=self.stats,
             logger=self.logger, tracer=self.tracer)
+        # Default per-query budget ([cluster] query-deadline; 0 = none).
+        self.handler.default_deadline = self.config.query_deadline
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd

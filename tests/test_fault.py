@@ -1,0 +1,655 @@
+"""Fault-tolerance tests: the fault-injection harness itself, client
+retry + circuit breakers, per-query deadlines, replica re-split under
+injected node death, partial results, and broadcast outcome reporting.
+
+Deterministic chaos: conftest pins PILOSA_TPU_FAULT_SEED=0, and every
+test arms/resets the registry explicitly.
+"""
+
+import time
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, fault
+from pilosa_tpu.api.client import (
+    BreakerRegistry,
+    CircuitBreaker,
+    ClientError,
+    InternalClient,
+)
+from pilosa_tpu.core import Holder
+from pilosa_tpu.errors import (
+    BroadcastError,
+    DeadlineExceededError,
+    QueryError,
+    SliceUnavailableError,
+)
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.obs import StatMap, Tracer
+from pilosa_tpu.parallel import Cluster, ModHasher, Node
+from pilosa_tpu.pql import parse_string
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    fault.reset(seed=0)
+    yield
+    fault.reset(seed=0)
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def seed(holder, index="i", frame="general", bits=()):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    for row, col in bits:
+        f.set_bit(row, col)
+    return f
+
+
+def q(executor, index, pql, slices=None, opt=None):
+    return executor.execute(index, parse_string(pql), slices, opt)
+
+
+def two_node_cluster(replica_n=1):
+    return Cluster(nodes=[Node("host0"), Node("host1")],
+                   hasher=ModHasher(), partition_n=4, replica_n=replica_n)
+
+
+# ---- fault registry ---------------------------------------------------------
+
+class TestInjector:
+    def test_point_noop_when_nothing_armed(self):
+        fault.point("client.do", host="h")  # must not raise
+        assert not fault.active()
+
+    def test_armed_error_fires_and_counts(self):
+        fault.arm("client.do", error=ConnectionResetError, host="h:1")
+        before = fault.STATS.copy().get("fault.client.do", 0)
+        with pytest.raises(ConnectionResetError):
+            fault.point("client.do", host="h:1")
+        assert fault.STATS.copy()["fault.client.do"] == before + 1
+        assert fault.log()[-1][0] == "client.do"
+
+    def test_match_restricts_to_context(self):
+        fault.arm("client.do", error=ConnectionError, host="h:1")
+        fault.point("client.do", host="h:2")  # no match, no fire
+        with pytest.raises(ConnectionError):
+            fault.point("client.do", host="h:1")
+
+    def test_times_bounds_firings(self):
+        rule = fault.arm("client.do", error=ConnectionError, times=2)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                fault.point("client.do")
+        fault.point("client.do")  # exhausted
+        assert rule.fired == 2
+
+    def test_after_skips_first_matches(self):
+        fault.arm("client.do", error=ConnectionError, after=2)
+        fault.point("client.do")
+        fault.point("client.do")
+        with pytest.raises(ConnectionError):
+            fault.point("client.do")
+
+    def test_delay_sleeps(self):
+        fault.arm("client.do", delay=0.05)
+        t0 = time.monotonic()
+        fault.point("client.do")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_disarm_and_reset(self):
+        rule = fault.arm("client.do", error=ConnectionError)
+        fault.disarm(rule)
+        assert not fault.active()
+        fault.point("client.do")
+        fault.arm("client.do", error=ConnectionError)
+        fault.reset(seed=0)
+        fault.point("client.do")
+
+    def test_seeded_prob_schedule_is_deterministic(self):
+        def schedule():
+            fault.reset(seed=7)
+            fault.arm("p", error=ConnectionError, prob=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    fault.point("p")
+                    out.append(0)
+                except ConnectionError:
+                    out.append(1)
+            return out
+
+        first = schedule()
+        assert schedule() == first
+        assert 0 < sum(first) < 32  # actually probabilistic
+
+    def test_load_spec(self):
+        rules = fault.load_spec(
+            "client.do:error=ConnectionResetError,times=3,host=h:1;"
+            "handler.query:delay=250ms,after=1,prob=0.5")
+        assert len(rules) == 2
+        r0, r1 = rules
+        assert r0.point == "client.do" and r0.error is ConnectionResetError
+        assert r0.times == 3 and r0.match == {"host": "h:1"}
+        assert r1.point == "handler.query" and r1.delay == 0.25
+        assert r1.after == 1 and r1.prob == 0.5
+
+    def test_load_spec_rejects_unknown_error(self):
+        with pytest.raises(ValueError):
+            fault.load_spec("client.do:error=SystemExit")
+
+
+# ---- circuit breaker --------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        b = CircuitBreaker("h:1", threshold=3, cooldown=60, stats=StatMap())
+        for _ in range(2):
+            b.record_failure()
+        b.allow()  # still closed
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(ClientError) as ei:
+            b.allow()
+        assert ei.value.transient and ei.value.host == "h:1"
+
+    def test_success_resets_failure_count(self):
+        b = CircuitBreaker("h:1", threshold=2, cooldown=60, stats=StatMap())
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_single_probe_then_close(self):
+        b = CircuitBreaker("h:1", threshold=1, cooldown=0.02,
+                           stats=StatMap())
+        b.record_failure()
+        assert b.state == "open"
+        time.sleep(0.03)
+        assert b.state == "half-open"
+        b.allow()  # the probe is admitted
+        with pytest.raises(ClientError):
+            b.allow()  # second concurrent request is not
+        b.record_success()
+        assert b.state == "closed"
+        b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        b = CircuitBreaker("h:1", threshold=1, cooldown=0.02,
+                           stats=StatMap())
+        b.record_failure()
+        time.sleep(0.03)
+        b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(ClientError):
+            b.allow()
+
+    def test_threshold_zero_disables(self):
+        b = CircuitBreaker("h:1", threshold=0, cooldown=0, stats=StatMap())
+        for _ in range(10):
+            b.record_failure()
+        b.allow()
+        assert b.state == "closed"
+
+    def test_registry(self):
+        reg = BreakerRegistry(threshold=1, cooldown=60, stats=StatMap())
+        assert reg.state("unknown") == "closed"
+        reg.for_host("h:1").record_failure()
+        assert reg.state("h:1") == "open"
+        assert reg.snapshot() == {"h:1": "open"}
+        assert reg.for_host("h:1") is reg.for_host("h:1")
+
+
+# ---- client retry -----------------------------------------------------------
+
+class TestClientRetry:
+    def test_transient_fault_retried_to_success(self):
+        """A connection reset on the first attempt is retried; the
+        second attempt (fault exhausted) reaches a real listener."""
+        import http.server
+        import threading
+
+        class OK(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), OK)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            host = f"127.0.0.1:{srv.server_address[1]}"
+            stats = StatMap()
+            c = InternalClient(host, timeout=5, retry_max=2,
+                               retry_backoff=0.001, stats=stats)
+            fault.arm("client.do", error=ConnectionResetError, times=1)
+            status, data = c._do("GET", "/version")
+            assert status == 200 and data == b"{}"
+            snap = stats.copy()
+            assert snap["client.retry"] == 1
+            assert snap["client.transport_error"] == 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_retries_exhausted_raises_transient_client_error(self):
+        stats = StatMap()
+        c = InternalClient("127.0.0.1:1", timeout=0.2, retry_max=1,
+                           retry_backoff=0.001, stats=stats)
+        with pytest.raises(ClientError) as ei:
+            c._do("GET", "/version")
+        assert ei.value.transient
+        assert stats.copy()["client.transport_error"] == 2  # 1 + 1 retry
+
+    def test_breaker_open_fails_fast_without_attempt(self):
+        stats = StatMap()
+        b = CircuitBreaker("127.0.0.1:1", threshold=2, cooldown=60,
+                           stats=stats)
+        c = InternalClient("127.0.0.1:1", timeout=0.2, retry_max=0,
+                           breaker=b, stats=stats)
+        for _ in range(2):
+            with pytest.raises(ClientError):
+                c._do("GET", "/version")
+        assert b.state == "open"
+        rule = fault.arm("client.do", error=ConnectionError)
+        with pytest.raises(ClientError) as ei:
+            c._do("GET", "/version")
+        assert "circuit breaker open" in str(ei.value)
+        assert rule.fired == 0  # rejected before the attempt seam
+        assert stats.copy()["breaker.reject"] >= 1
+
+    def test_deadline_expired_before_attempt(self):
+        c = InternalClient("127.0.0.1:1", retry_max=0)
+        with pytest.raises(DeadlineExceededError):
+            c._do("GET", "/version", deadline=time.monotonic() - 0.01)
+
+    def test_deadline_cuts_retry_budget(self):
+        """With the remaining budget smaller than the backoff sleep,
+        the retry loop raises DeadlineExceededError instead of sleeping
+        through the deadline."""
+        c = InternalClient("127.0.0.1:1", timeout=0.2, retry_max=5,
+                           retry_backoff=0.2, stats=StatMap())
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            c._do("GET", "/version", deadline=time.monotonic() + 0.25)
+        assert time.monotonic() - t0 < 2.0
+
+
+# ---- executor: deadlines ----------------------------------------------------
+
+class SlowClient:
+    """Remote seam that serves correctly but slowly."""
+
+    def __init__(self, delay=0.5):
+        self.delay = delay
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote,
+                      deadline=None):
+        self.calls.append((node.host, tuple(slices), deadline))
+        time.sleep(self.delay)
+        return [len(slices)]
+
+
+class TestDeadline:
+    def test_slow_node_trips_deadline_fast(self, holder):
+        """50ms budget vs a 500ms-slow node: DeadlineExceededError in
+        well under the old flat 30s client timeout, and the fanout span
+        shows the budget going NEGATIVE (acceptance criterion)."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        fault.arm("executor.fanout", delay=0.5, node="host1")
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(), use_device=False)
+        tracer = Tracer()
+        trace = tracer.start("query", index="i")
+        t0 = time.monotonic()
+        with trace.root:
+            with pytest.raises(DeadlineExceededError):
+                q(e, "i", "Count(Bitmap(rowID=10))",
+                  opt=ExecOptions(deadline=time.monotonic() + 0.05))
+        tracer.finish(trace)
+        assert time.monotonic() - t0 < 5.0
+
+        # The coordinator fails fast while the slow fanout thread is
+        # still riding out its injected delay; that thread tags its
+        # span on exit, so poll briefly for the negative budget.
+        def tagged():
+            return any(s.tags.get("deadline_left_us", 0) < 0
+                       for s in trace.spans if s.name == "fanout")
+
+        for _ in range(100):
+            if tagged():
+                break
+            time.sleep(0.02)
+        assert tagged()
+
+    def test_deadline_not_exceeded_passes_through(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(delay=0), use_device=False)
+        n = q(e, "i", "Count(Bitmap(rowID=10))",
+              opt=ExecOptions(deadline=time.monotonic() + 30))[0]
+        assert n == 4
+
+    def test_remaining_budget_forwarded_to_client(self, holder):
+        """The client seam receives the absolute deadline so each hop
+        rides only the remaining budget."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        client = SlowClient(delay=0)
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=client, use_device=False)
+        deadline = time.monotonic() + 30
+        q(e, "i", "Count(Bitmap(rowID=10))",
+          opt=ExecOptions(deadline=deadline))
+        assert client.calls and all(d == deadline
+                                    for _, _, d in client.calls)
+
+    def test_deadline_is_not_passed_to_legacy_seams(self, holder):
+        """Test fakes with the positional 5-arg execute_query signature
+        keep working when no deadline is set."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+
+        class LegacyClient:
+            def execute_query(self, node, index, query, slices, remote):
+                return [len(slices)]
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=LegacyClient(), use_device=False)
+        assert q(e, "i", "Count(Bitmap(rowID=10))")[0] == 4
+
+
+# ---- executor: re-split under injected death --------------------------------
+
+class TestResplit:
+    def test_replica_death_mid_query_returns_correct_count(self, holder):
+        """Acceptance: fault injection kills one of two replica nodes
+        mid-query; a 3-slice Count over the cluster still returns the
+        correct total via the re-split path."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(3)])
+        cluster = two_node_cluster(replica_n=2)
+        fault.arm("executor.fanout", error=ConnectionResetError,
+                  node="host1", times=1)
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(delay=0), use_device=False)
+        assert q(e, "i", "Count(Bitmap(rowID=10))",
+                 slices=[0, 1, 2])[0] == 3
+        assert any(p == "executor.fanout" for p, _ in fault.log())
+
+    def test_resplit_span_tagged_and_root_cause_chained(self, holder):
+        """Satellite: when the re-split also dies, the ORIGINAL error
+        is raised chained from the re-split failure, and the trace
+        carries a resplit=1 span."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster(replica_n=1)
+
+        class DeadClient:
+            def execute_query(self, node, index, query, slices, remote,
+                              deadline=None):
+                raise ClientError("boom", host=node.host, transient=True)
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=DeadClient(), use_device=False)
+        tracer = Tracer()
+        trace = tracer.start("query", index="i")
+        with trace.root:
+            with pytest.raises(ClientError) as ei:
+                q(e, "i", "Count(Bitmap(rowID=10))")
+        tracer.finish(trace)
+        assert isinstance(ei.value.__cause__, SliceUnavailableError)
+        assert any(s.tags.get("resplit") == 1 for s in trace.spans)
+
+    def test_non_transient_remote_error_propagates_without_resplit(
+            self, holder):
+        """Satellite: a structured non-transient ClientError (bad PQL,
+        missing frame on the remote) must NOT re-split across replicas
+        — one call per owning node, error surfaces directly."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster(replica_n=2)
+        calls = []
+
+        class BadRequestClient:
+            def execute_query(self, node, index, query, slices, remote,
+                              deadline=None):
+                calls.append(node.host)
+                raise ClientError("frame not found", host=node.host,
+                                  status=400, transient=False)
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=BadRequestClient(), use_device=False)
+        with pytest.raises(ClientError):
+            q(e, "i", "Count(Bitmap(rowID=10))")
+        assert calls == ["host1"]
+
+    def test_query_error_propagates_without_resplit(self, holder):
+        seed(holder, bits=[(10, 0)])
+        cluster = two_node_cluster(replica_n=2)
+        calls = []
+
+        class QueryErrorClient:
+            def execute_query(self, node, index, query, slices, remote,
+                              deadline=None):
+                calls.append(node.host)
+                raise QueryError("unknown call")
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=QueryErrorClient(), use_device=False)
+        with pytest.raises(QueryError):
+            q(e, "i", "Count(Bitmap(rowID=10))", slices=[0, 1, 2, 3])
+        assert calls == ["host1"]
+
+    def test_breaker_state_steers_slice_placement(self, holder):
+        """_slices_by_node prefers replicas whose breaker is closed."""
+        seed(holder, bits=[(10, 0)])
+        cluster = two_node_cluster(replica_n=2)
+
+        class BreakerAwareClient(SlowClient):
+            def breaker_state(self, host):
+                return "open" if host == "host1" else "closed"
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=BreakerAwareClient(delay=0), use_device=False)
+        m = e._slices_by_node(cluster.nodes, "i", [0, 1, 2, 3])
+        assert {n.host for n in m} == {"host0"}
+        # And with every breaker closed, both nodes get their slices.
+        e2 = Executor(holder, host="host0", cluster=cluster,
+                      client=SlowClient(delay=0), use_device=False)
+        m2 = e2._slices_by_node(cluster.nodes, "i", [0, 1, 2, 3])
+        assert {n.host for n in m2} == {"host0", "host1"}
+
+
+# ---- partial results --------------------------------------------------------
+
+class TestPartialResults:
+    def _executor_without_remote(self, holder):
+        """Two-node replica_n=1 cluster with NO client: every slice
+        owned by host1 is unreachable (client=None raises
+        SliceUnavailableError at the remote seam)."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster(replica_n=1)
+        return Executor(holder, host="host0", cluster=cluster,
+                        client=None, use_device=False), cluster
+
+    def test_default_mode_raises_slice_unavailable(self, holder):
+        e, _ = self._executor_without_remote(holder)
+        with pytest.raises(SliceUnavailableError):
+            q(e, "i", "Count(Bitmap(rowID=10))", slices=[0, 1, 2, 3])
+
+    def test_partial_mode_returns_remaining_count_and_missing(self, holder):
+        """Acceptance: with all owners of some slices down,
+        partial=true returns the live slices' count and reports exactly
+        the dead slices in missing_slices."""
+        e, cluster = self._executor_without_remote(holder)
+        opt = ExecOptions(partial=True)
+        n = q(e, "i", "Count(Bitmap(rowID=10))", slices=[0, 1, 2, 3],
+              opt=opt)[0]
+        local = [s for s in range(4)
+                 if cluster.fragment_nodes("i", s)[0].host == "host0"]
+        remote = [s for s in range(4) if s not in local]
+        assert n == len(local)
+        assert sorted(opt.missing_slices) == remote and remote
+
+    def test_partial_http_response_shape(self, holder):
+        """HTTP layer: ?partial=true responses carry partial +
+        missing_slices; the default stays a 400-with-error."""
+        from pilosa_tpu.api.handler import Handler
+
+        e, _ = self._executor_without_remote(holder)
+        h = Handler(holder, e, cluster=e.cluster, host="host0")
+        resp = h.handle("POST", "/index/i/query",
+                        params={"partial": "true", "slices": "0,1,2,3"},
+                        body=b"Count(Bitmap(rowID=10))")
+        assert resp.status == 200
+        doc = resp.json()
+        assert doc["partial"] is True
+        assert doc["missing_slices"] and doc["results"][0] >= 1
+        bad = h.handle("POST", "/index/i/query",
+                       params={"slices": "0,1,2,3"},
+                       body=b"Count(Bitmap(rowID=10))")
+        assert bad.status == 400
+        assert "slice unavailable" in bad.json()["error"]
+
+    def test_partial_false_when_nothing_missing(self, holder):
+        from pilosa_tpu.api.handler import Handler
+
+        seed(holder, bits=[(10, 0), (10, 1)])
+        e = Executor(holder, use_device=False)
+        h = Handler(holder, e, host="host0")
+        resp = h.handle("POST", "/index/i/query",
+                        params={"partial": "true"},
+                        body=b"Count(Bitmap(rowID=10))")
+        doc = resp.json()
+        assert doc["results"] == [2]
+        assert doc["partial"] is False and doc["missing_slices"] == []
+
+
+# ---- HTTP deadline plumbing -------------------------------------------------
+
+class TestHandlerDeadline:
+    def test_deadline_param_maps_to_504(self, holder):
+        from pilosa_tpu.api.handler import Handler
+
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        fault.arm("executor.fanout", delay=0.3, node="host1")
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(delay=0), use_device=False)
+        h = Handler(holder, e, cluster=cluster, host="host0")
+        t0 = time.monotonic()
+        resp = h.handle("POST", "/index/i/query",
+                        params={"deadline": "50ms"},
+                        body=b"Count(Bitmap(rowID=10))")
+        assert resp.status == 504
+        assert "deadline exceeded" in resp.json()["error"]
+        assert time.monotonic() - t0 < 5.0
+
+    def test_deadline_header_microseconds(self, holder):
+        from pilosa_tpu.api.handler import Handler
+
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        fault.arm("executor.fanout", delay=0.3, node="host1")
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(delay=0), use_device=False)
+        h = Handler(holder, e, cluster=cluster, host="host0")
+        resp = h.handle("POST", "/index/i/query",
+                        headers={"X-Pilosa-Deadline-Us": "50000"},
+                        body=b"Count(Bitmap(rowID=10))")
+        assert resp.status == 504
+
+    def test_default_deadline_from_config(self, holder):
+        from pilosa_tpu.api.handler import Handler
+
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        fault.arm("executor.fanout", delay=0.3, node="host1")
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=SlowClient(delay=0), use_device=False)
+        h = Handler(holder, e, cluster=cluster, host="host0")
+        h.default_deadline = 0.05
+        resp = h.handle("POST", "/index/i/query",
+                        body=b"Count(Bitmap(rowID=10))")
+        assert resp.status == 504
+
+
+# ---- broadcast outcome reporting --------------------------------------------
+
+class TestBroadcastOutcomes:
+    def test_all_failed_hosts_reported(self, holder):
+        """Satellite: _broadcast_query awaits EVERY future and lists
+        every failed host instead of first-error-wins."""
+        seed(holder)
+        cluster = Cluster(nodes=[Node("host0"), Node("host1"),
+                                 Node("host2")],
+                          hasher=ModHasher(), partition_n=3, replica_n=1)
+
+        class PartialFailClient:
+            def execute_query(self, node, index, query, slices, remote,
+                              deadline=None):
+                raise ClientError(f"down: {node.host}", host=node.host,
+                                  transient=True)
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=PartialFailClient(), use_device=False)
+        with pytest.raises(BroadcastError) as ei:
+            q(e, "i", 'SetRowAttrs(frame="general", rowID=1, x="y")')
+        err = ei.value
+        assert err.total == 2 and len(err.failures) == 2
+        assert {h for h, _ in err.failures} == {"host1", "host2"}
+        assert "host1" in str(err) and "host2" in str(err)
+
+    def test_partial_broadcast_failure_names_only_failed(self, holder):
+        seed(holder)
+        cluster = Cluster(nodes=[Node("host0"), Node("host1"),
+                                 Node("host2")],
+                          hasher=ModHasher(), partition_n=3, replica_n=1)
+
+        class OneDownClient:
+            def execute_query(self, node, index, query, slices, remote,
+                              deadline=None):
+                if node.host == "host2":
+                    raise ClientError("down", host=node.host,
+                                      transient=True)
+                return [None]
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=OneDownClient(), use_device=False)
+        with pytest.raises(BroadcastError) as ei:
+            q(e, "i", 'SetRowAttrs(frame="general", rowID=1, x="y")')
+        assert [h for h, _ in ei.value.failures] == ["host2"]
+        assert ei.value.total == 2
+
+
+# ---- structured ClientError -------------------------------------------------
+
+class TestClientErrorFields:
+    def test_fields_default(self):
+        e = ClientError("msg")
+        assert e.host is None and e.status is None and not e.transient
+
+    def test_transient_classification_is_duck_typed(self):
+        assert Executor._transient_error(
+            ClientError("x", transient=True))
+        assert not Executor._transient_error(
+            ClientError("x", transient=False))
+        assert not Executor._transient_error(DeadlineExceededError())
+        assert not Executor._transient_error(QueryError("bad"))
+        assert Executor._transient_error(ConnectionError("reset"))

@@ -55,6 +55,10 @@ class TestConfig:
         assert parse_duration("1h30m") == 5400
         assert parse_duration("250ms") == 0.25
         assert parse_duration(5) == 5.0
+        # Sub-millisecond Go units (?deadline= budgets go this small).
+        assert abs(parse_duration("50us") - 50e-6) < 1e-12
+        assert abs(parse_duration("50µs") - 50e-6) < 1e-12
+        assert abs(parse_duration("100ns") - 100e-9) < 1e-15
         with pytest.raises(ValueError):
             parse_duration("5x")
 
