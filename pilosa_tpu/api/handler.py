@@ -28,6 +28,7 @@ Routes (reference handler.go:81-121):
     GET    /slices/max                           per-index max slice
     GET    /status                               cluster status
     GET    /version
+    GET    /metrics                              Prometheus exposition
     GET    /debug/vars                           stats snapshot
     GET    /debug/queries                        recent/slow query traces
     GET    /debug/traces/{id}                    one query trace (spans)
@@ -419,6 +420,19 @@ class Handler:
         # the lock could stop a trace another request thinks it owns.
         self._tracemalloc_mu = threading.Lock()
         self._tracemalloc_ours = False
+        # Prometheus exposition (GET /metrics): one registry, fed by
+        # collect-time bridges over the existing stat stores — the hot
+        # write paths stay untouched; the scrape pays the bridge cost.
+        self._start_time = time.monotonic()
+        # Fragment-walk gauges (row-cache sizes, cardinality) refresh
+        # at most once per this many seconds ([obs]
+        # metrics-sample-interval, server wiring): the walk is cheap
+        # but O(fragments), and scrapers poll.
+        self.metrics_sample_interval = 10.0
+        self._frag_sample: Tuple[float, list] = (0.0, [])
+        self._frag_sample_mu = threading.Lock()
+        self._prom = obs.prom.Registry()
+        self._register_collectors()
         self._routes: List[Route] = []
         r = self._add_route
         r("GET", r"/", self._get_webui)
@@ -454,6 +468,7 @@ class Handler:
         r("GET", r"/slices/max", self._get_slice_max)
         r("GET", r"/status", self._get_status)
         r("GET", r"/version", self._get_version)
+        r("GET", r"/metrics", self._get_metrics)
         r("GET", r"/debug/vars", self._get_expvar)
         r("GET", r"/debug/queries", self._get_debug_queries)
         r("GET", r"/debug/traces/(?P<tid>[^/]+)", self._get_debug_trace)
@@ -526,8 +541,226 @@ class Handler:
     def _get_version(self, pv, params, headers, body) -> Response:
         return _json_resp({"version": self.version})
 
+    # -- /metrics ------------------------------------------------------------
+
+    def _get_metrics(self, pv, params, headers, body) -> Response:
+        """Prometheus text exposition over every stat store: the
+        ExpvarStats bridge, mesh/compile/device-memory telemetry,
+        cache + dispatch + breaker counters, backend-labeled query
+        latency histograms, build info. All bridged at scrape time."""
+        text = self._prom.render()
+        return Response(
+            200,
+            {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+            text.encode())
+
+    def _register_collectors(self):
+        reg = self._prom
+        reg.register_collector(
+            lambda: obs.prom.expvar_families(self.stats))
+        reg.register_collector(self._collect_runtime)
+        reg.register_collector(self._collect_device)
+        reg.register_collector(self._collect_caches)
+        reg.register_collector(self._collect_cluster)
+        reg.register_collector(self._collect_fragments)
+
+    def _collect_runtime(self) -> list:
+        prom = obs.prom
+        info = prom.MetricFamily("pilosa_build_info", "gauge",
+                                 "Build metadata; the value is always 1.")
+        info.add(1, {"version": self.version})
+        up = prom.MetricFamily("pilosa_uptime_seconds", "gauge",
+                               "Seconds since this handler started.")
+        up.add(time.monotonic() - self._start_time)
+        return [info, up]
+
+    def _collect_device(self) -> list:
+        """Mesh serving-layer telemetry: raw StatMap gauges, per-entry
+        compile counters, dispatch-mode counters, and the per-device
+        HBM residency report. Absent stores (device off, fake
+        executors) contribute nothing."""
+        prom = obs.prom
+        fams: list = []
+        ex = self.executor
+        mesh = getattr(ex, "device_stats", None)
+        if mesh is not None:
+            stats = dict(mesh.copy())
+            fams.extend(prom.statmap_families(stats, "pilosa_mesh_"))
+            disp = prom.MetricFamily(
+                "pilosa_dispatch_total", "counter",
+                "Device dispatches by serving mode.")
+            for mode, key in (("fused", "lone_fused"),
+                              ("batched", "batched"),
+                              ("coarse", "coarse"),
+                              ("shared_batch", "shared_batch"),
+                              ("fallback", "fallback"),
+                              ("routed_host", "routed_host")):
+                disp.add(stats.get(key, 0), {"mode": mode})
+            fams.append(disp)
+        mgr = getattr(ex, "_mesh_mgr", None)
+        cs = getattr(mgr, "compile_stats", None)
+        if cs is not None:
+            stats = dict(cs.copy())
+            counts = prom.MetricFamily(
+                "pilosa_compile_total", "counter",
+                "Device program compiles by entry point.")
+            secs = prom.MetricFamily(
+                "pilosa_compile_seconds_total", "counter",
+                "Cumulative compile wall time by entry point.")
+            for k, v in sorted(stats.items()):
+                if k.endswith("_count"):
+                    counts.add(v, {"entry": k[:-6]})
+                elif k.endswith("_us"):
+                    secs.add(v / 1e6, {"entry": k[:-3]})
+            fams += [counts, secs]
+        if mgr is not None:
+            try:
+                dm = mgr.device_memory()
+            except Exception:  # noqa: BLE001 — telemetry never fails scrape
+                dm = None
+            if dm is not None:
+                res = prom.MetricFamily(
+                    "pilosa_hbm_resident_bytes", "gauge",
+                    "Staged fragment-pool bytes resident per device.")
+                for dev, n in sorted(dm["per_device"].items()):
+                    res.add(n, {"device": dev})
+                fams.append(res)
+                fams.append(prom.MetricFamily(
+                    "pilosa_hbm_padded_bytes", "gauge",
+                    "Total staged pool bytes including padding slots.")
+                    .add(dm["padded_bytes"]))
+                fams.append(prom.MetricFamily(
+                    "pilosa_hbm_live_bytes", "gauge",
+                    "Staged bytes backing live containers only.")
+                    .add(dm["live_bytes"]))
+                fams.append(prom.MetricFamily(
+                    "pilosa_hbm_staged_views", "gauge",
+                    "Fragment views currently staged on-device.")
+                    .add(dm["views"]))
+        return fams
+
+    def _collect_caches(self) -> list:
+        """Plan-cache LRU events, host-path cache counters, and the
+        backend-labeled query latency histograms + route counters."""
+        prom = obs.prom
+        fams: list = []
+        ex = self.executor
+        hc = getattr(ex, "host_cache_stats", None)
+        if hc is not None:
+            fams.extend(prom.statmap_families(dict(hc),
+                                              "pilosa_host_cache_"))
+        plans = getattr(getattr(ex, "_mesh_mgr", None), "_fused_plans",
+                        None)
+        if plans is not None:
+            stats = dict(plans.stats)
+            ev = prom.MetricFamily(
+                "pilosa_plan_cache_total", "counter",
+                "Compiled-plan LRU events.")
+            for event in ("hit", "miss", "evicted"):
+                ev.add(stats.get(event, 0), {"event": event})
+            fams.append(ev)
+            fams.append(prom.MetricFamily(
+                "pilosa_plan_cache_compile_seconds_total", "counter",
+                "Wall time spent compiling fused plans.")
+                .add(stats.get("compile_us", 0) / 1e6))
+        rs = getattr(ex, "route_stats", None)
+        if rs is not None:
+            routes = prom.MetricFamily(
+                "pilosa_query_route_total", "counter",
+                "Count queries by serving backend.")
+            for k, v in sorted(dict(rs.copy()).items()):
+                if k.startswith("count_"):
+                    routes.add(v, {"backend": k[len("count_"):]})
+            fams.append(routes)
+        hists = getattr(ex, "route_latency_hists", None)
+        if hists:
+            lat = prom.MetricFamily(
+                "pilosa_query_route_duration_microseconds", "histogram",
+                "Count latency by serving backend (log2 buckets, µs).")
+            for route, h in sorted(hists.items()):
+                lat.add_histogram(h, {"backend": route})
+            fams.append(lat)
+        return fams
+
+    def _collect_cluster(self) -> list:
+        """Cluster transport counters and per-peer breaker state
+        (0=closed, 1=half-open, 2=open — alertable as a number, the
+        state string rides along as a label)."""
+        prom = obs.prom
+        fams: list = []
+        cc = getattr(self.executor, "client", None)
+        cstats = getattr(cc, "stats", None)
+        if cstats is not None and hasattr(cstats, "copy"):
+            fams.extend(prom.statmap_families(dict(cstats.copy()),
+                                              "pilosa_cluster_"))
+        snap = getattr(getattr(cc, "breakers", None), "snapshot", None)
+        if callable(snap):
+            order = {"closed": 0, "half-open": 1, "half_open": 1,
+                     "open": 2}
+            f = prom.MetricFamily(
+                "pilosa_breaker_state", "gauge",
+                "Circuit breaker per peer: 0=closed, 1=half-open, "
+                "2=open.")
+            for host, state in sorted(snap().items()):
+                f.add(order.get(state, -1),
+                      {"host": host, "state": state})
+            fams.append(f)
+        return fams
+
+    def _collect_fragments(self) -> list:
+        """Sampled fragment gauges, cached for metrics_sample_interval
+        seconds: scrapers poll, and even a cheap walk is O(fragments)."""
+        now = time.monotonic()
+        with self._frag_sample_mu:
+            stamp, fams = self._frag_sample
+            if fams and now - stamp < self.metrics_sample_interval:
+                return fams
+        fams = self._sample_fragments()
+        with self._frag_sample_mu:
+            self._frag_sample = (now, fams)
+        return fams
+
+    def _sample_fragments(self) -> list:
+        """Per-frame row-cache entries, bitmap cardinality, and
+        fragment counts. Lazily-pending fragments are counted but
+        never parsed — a scrape must not force a many-GB demand-load —
+        so cardinality covers loaded fragments only."""
+        prom = obs.prom
+        rc = prom.MetricFamily(
+            "pilosa_fragment_row_cache_entries", "gauge",
+            "Materialized-row LRU entries per frame (sampled).")
+        card = prom.MetricFamily(
+            "pilosa_fragment_cardinality", "gauge",
+            "Bits set per frame, loaded fragments only (sampled).")
+        nf = prom.MetricFamily(
+            "pilosa_fragments", "gauge",
+            "Fragments per frame by load state (sampled).")
+        # Copy-on-write dicts throughout core: lock-free iteration is
+        # the documented reader protocol.
+        for iname, idx in sorted(self.holder.indexes.items()):
+            for fname, frame in sorted(idx.frames.items()):
+                labels = {"index": iname, "frame": fname}
+                rows = bits = loaded = pending = 0
+                for view in frame.views.values():
+                    for frag in view.fragments.values():
+                        with frag._mu:
+                            if frag._pending_load:
+                                pending += 1
+                                continue
+                            loaded += 1
+                            rows += len(frag._row_cache)
+                            bits += frag.storage.count()
+                rc.add(rows, labels)
+                card.add(bits, labels)
+                nf.add(loaded, dict(labels, state="loaded"))
+                nf.add(pending, dict(labels, state="pending"))
+        return [rc, card, nf]
+
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        snap["uptime_seconds"] = round(
+            time.monotonic() - self._start_time, 3)
+        snap["version"] = self.version
         # Mesh serving-layer counters (stage/incremental/count/topn/
         # fallback + cumulative timings) — SURVEY.md §5 observability.
         mesh = getattr(self.executor, "device_stats", None)
@@ -987,6 +1220,12 @@ class Handler:
                     remote=bool(remote))
         opt = self._exec_options(params, headers, remote)
 
+        # ?explain=true: return the PLANNED execution — routing with
+        # cost-model inputs, breaker-aware placement, cache peeks,
+        # staging estimate — without dispatching any device work.
+        if params.get("explain") == "true" and not remote:
+            return self._explain_query(index, query, slices, headers, opt)
+
         # Trace lifecycle: every query records a trace into the
         # bounded rings behind /debug/queries. A remote fan-out leg
         # joins the coordinator's trace id (X-Pilosa-Trace) and ships
@@ -1007,6 +1246,23 @@ class Handler:
             resp.headers["X-Pilosa-Trace-Spans"] = json.dumps(
                 trace.serialize_spans(), separators=(",", ":"))
         return resp
+
+    def _explain_query(self, index, query, slices, headers,
+                       opt) -> Response:
+        """EXPLAIN surface (executor.explain): parses the PQL, plans
+        every call, executes nothing."""
+        explain = getattr(self.executor, "explain", None)
+        if not callable(explain):
+            return _json_resp(
+                {"error": "explain unsupported by this executor"}, 400)
+        try:
+            with obs.span("parse", bytes=len(query)):
+                q = parse_string_cached(query)
+            plan = explain(index, q, slices or None, opt)
+        except (PilosaError, ParseError) as e:
+            return self._query_error(e, headers)
+        plan["query"] = query[:1024]
+        return _json_resp(plan)
 
     def _exec_options(self, params, headers, remote) -> ExecOptions:
         """Per-query ExecOptions from the request: deadline from the

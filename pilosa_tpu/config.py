@@ -144,6 +144,10 @@ class Config:
         # ring size.
         self.slow_query_threshold: float = 0.25
         self.trace_ring: int = 256
+        # Refresh cadence for the sampled fragment gauges on /metrics
+        # (row-cache sizes, cardinality): the walk is cheap but
+        # O(fragments), and Prometheus scrapes on a timer.
+        self.metrics_sample_interval: float = 10.0
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -197,6 +201,9 @@ class Config:
             c.slow_query_threshold = parse_duration(
                 ob["slow-query-threshold"])
         c.trace_ring = int(ob.get("trace-ring", c.trace_ring))
+        if "metrics-sample-interval" in ob:
+            c.metrics_sample_interval = parse_duration(
+                ob["metrics-sample-interval"])
         return c
 
     def expanded_data_dir(self) -> str:
@@ -240,4 +247,6 @@ class Config:
             f'slow-query-threshold = '
             f'"{int(self.slow_query_threshold * 1000)}ms"\n'
             f"trace-ring = {self.trace_ring}\n"
+            f'metrics-sample-interval = '
+            f'"{int(self.metrics_sample_interval)}s"\n'
         )

@@ -184,6 +184,11 @@ class Executor:
         # once — jax.default_backend() and the ctypes load don't change
         # within a process.
         self._cpu_route_native: Optional[bool] = None
+        # Route-level Count telemetry: which engine served (memo /
+        # host-fold / mesh / roaring) and the end-to-end latency per
+        # engine — the backend-labeled latency histogram at /metrics.
+        self.route_stats = obs.StatMap()
+        self._route_hists: dict = {}
 
     def set_spmd(self, spmd):
         """Wire the SPMD descriptor plane (rank 0 of a multi-host
@@ -448,6 +453,7 @@ class Executor:
         if len(c.children) > 1:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
+        t0 = time.monotonic()
 
         # Whole-query memo (the Range/nary routed-path answer to the
         # reference's rank cache): a repeated read-only Count on an
@@ -476,6 +482,7 @@ class Executor:
                 hit = self._host_cache.query_get(qkey, qepoch, qsepoch)
                 if hit is not None:
                     psp.tag(route="memo").finish()
+                    self._record_route("memo", t0)
                     return hit
 
         # Lower the tree ONCE; every count engine shares it. The
@@ -514,16 +521,7 @@ class Executor:
         psp.tag(route=route, backend_on=backend_on,
                 leaves=len(leaves) if backend_on or qkey is not None
                 else 0)
-        switches = []
-        if os.environ.get("PILOSA_TPU_USE_DEVICE", ""):
-            switches.append("use_device="
-                            + os.environ["PILOSA_TPU_USE_DEVICE"])
-        if os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK", ""):
-            switches.append("device_min_work="
-                            + os.environ["PILOSA_TPU_DEVICE_MIN_WORK"])
-        if os.environ.get("PILOSA_TPU_CPU_ROUTE_NATIVE", ""):
-            switches.append("cpu_route_native="
-                            + os.environ["PILOSA_TPU_CPU_ROUTE_NATIVE"])
+        switches = self._kill_switches()
         if switches:
             psp.tag(kill_switches=switches)
         psp.finish()
@@ -576,6 +574,7 @@ class Executor:
             # them, so the entry can never validate — stale results
             # invalidate, they don't serve.
             self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
+        self._record_route(route, t0)
         return n
 
     # Above this fan-out, gathering (fragment, generation) pairs for
@@ -639,6 +638,198 @@ class Executor:
     def host_cache_stats(self):
         """Routed-host-path cache counters for /debug/vars."""
         return self._host_cache.stats
+
+    def _record_route(self, route: str, t0: float):
+        self.route_stats.inc(f"count_{route}")
+        h = self._route_hists.get(route)
+        if h is None:
+            # setdefault: two first-observers race benignly to one.
+            h = self._route_hists.setdefault(route, obs.Histogram())
+        h.observe((time.monotonic() - t0) * 1e6)
+
+    @property
+    def route_latency_hists(self) -> dict:
+        """route name -> Histogram of Count latencies (µs), for the
+        /metrics backend-labeled histogram."""
+        return dict(self._route_hists)
+
+    @staticmethod
+    def _kill_switches() -> list:
+        """The routing kill-switch env vars currently set, for trace
+        attribution and EXPLAIN output."""
+        switches = []
+        for env, name in (("PILOSA_TPU_USE_DEVICE", "use_device"),
+                          ("PILOSA_TPU_DEVICE_MIN_WORK", "device_min_work"),
+                          ("PILOSA_TPU_CPU_ROUTE_NATIVE",
+                           "cpu_route_native")):
+            if os.environ.get(env, ""):
+                switches.append(f"{name}={os.environ[env]}")
+        return switches
+
+    # -- explain -------------------------------------------------------------
+
+    def explain(self, index: str, q: Query,
+                slices: Optional[Sequence[int]] = None,
+                opt: Optional[ExecOptions] = None) -> dict:
+        """The PLANNED execution of `q` as a JSON-able dict: per-call
+        routing decision with its cost-model inputs, slice→owner
+        placement (breaker-aware, exactly the picks _slices_by_node
+        would make), cache peeks, and estimated staging bytes — WITHOUT
+        dispatching device work or mutating executor state. Every probe
+        is a peek: no LRU reorder, no stats bumps, no staging, no
+        compiles, no manager construction. Serves `?explain=true` on
+        POST /index/{index}/query."""
+        if not index:
+            raise IndexRequiredError()
+        idx = self.holder.index(index)
+        if slices:
+            slices = list(slices)
+        else:
+            slices = []
+            if needs_slices(q.calls):
+                if idx is None:
+                    raise IndexNotFoundError()
+                slices = list(range(idx.max_slice() + 1))
+        return {
+            "index": index,
+            "slices": len(slices),
+            "calls": [self._explain_call(index, c, slices)
+                      for c in q.calls],
+        }
+
+    def _explain_call(self, index: str, c: Call,
+                      slices: Sequence[int]) -> dict:
+        import json as _json
+
+        info: dict = {"call": c.name}
+        if c.name in _WRITE_CALLS:
+            info["route"] = "write"
+            info["placement"] = self._explain_placement(index, slices)
+            return info
+        if c.name != "Count" or len(c.children) != 1:
+            # Non-Count reads run the per-slice roaring map-reduce.
+            info["route"] = "roaring"
+            info["placement"] = self._explain_placement(index, slices)
+            return info
+
+        child = c.children[0]
+        backend_on = self._device_backend_on()
+        from .parallel.plan import _lower_tree, _tree_signature
+
+        leaves: list = []
+        shape = _lower_tree(self.holder, index, child, leaves)
+        lowerable = shape is not None and bool(leaves)
+
+        # Memo peek mirrors _execute_count's single-node gate.
+        memo_hit = False
+        nodes = self.cluster.nodes if self.cluster is not None else []
+        single = (not nodes
+                  or (len(nodes) == 1 and nodes[0].host == self.host))
+        ck = c.cache_key()
+        if single and ck is not None:
+            from .core.fragment import MUTATION_EPOCH
+
+            memo_hit = self._host_cache.query_peek(
+                (index, ck, tuple(slices)), MUTATION_EPOCH.n)
+
+        if memo_hit:
+            route = "memo"
+        elif lowerable and backend_on:
+            route = ("host-fold"
+                     if self._would_route_to_host(len(slices), len(leaves))
+                     else "mesh")
+        else:
+            route = "roaring"
+        info["route"] = route
+        info["cost_model"] = {
+            "backend_on": backend_on,
+            "lowerable": lowerable,
+            "leaves": len(leaves),
+            "work_units": len(slices) * max(1, len(leaves)),
+            "min_work": self._min_work(),
+            "cpu_native_routes": self._cpu_native_routes(),
+        }
+        info["kill_switches"] = self._kill_switches()
+        info["memo_hit"] = memo_hit
+
+        mgr = self._mesh_mgr  # peek only: never force construction
+        plan_hit = False
+        if lowerable and mgr is not None:
+            sig = _json.dumps(_tree_signature(shape))
+            plan_hit = mgr._fused_plans.contains_sig(sig)
+        info["plan_cache"] = {"checked": mgr is not None, "hit": plan_hit}
+        if lowerable:
+            info["staging"] = self._explain_staging(index, leaves, slices)
+        info["placement"] = self._explain_placement(index, slices)
+        return info
+
+    def _explain_staging(self, index: str, leaves,
+                         slices: Sequence[int]) -> dict:
+        """Which of the Count's (frame, view) images are already
+        resident on-device, and a host-side byte estimate for the ones
+        a dispatch would have to stage. Loaded fragments estimate from
+        live container counts (exactly what build_sharded_index
+        uploads); lazily-opened ones fall back to storage file size —
+        EXPLAIN never forces a parse."""
+        from .ops.pool import CONTAINER_WORDS
+
+        mgr = self._mesh_mgr
+        uniq = list(dict.fromkeys((f, v) for f, v, _r, _q in leaves))
+        staged = unstaged = est = 0
+        for frame, view in uniq:
+            if mgr is not None and (index, frame, view) in mgr._views:
+                staged += 1
+                continue
+            unstaged += 1
+            for s in slices:
+                frag = self.holder.fragment(index, frame, view, s)
+                if frag is None:
+                    continue
+                with frag._mu:
+                    if not frag._pending_load:
+                        est += len(frag.storage.keys) * (
+                            CONTAINER_WORDS * 4 + 4)
+                    else:
+                        try:
+                            est += os.path.getsize(frag.path)
+                        except OSError:
+                            pass
+        return {"staged_views": staged, "unstaged_views": unstaged,
+                "estimated_h2d_bytes": est}
+
+    def _explain_placement(self, index: str,
+                           slices: Sequence[int]) -> dict:
+        """slice→owner picks as _slices_by_node would make them —
+        breaker/liveness-aware — plus each host's current breaker
+        state. Slice lists are sampled (first 16) so a 960-slice
+        explain stays readable."""
+        if self.cluster is None or not self.cluster.nodes:
+            return {"mode": "local", "slices": len(slices)}
+        state = self._breaker_callable()
+        nodes = list(self.cluster.nodes)
+        per_host: dict = {}
+        unowned: list = []
+        for slice_ in slices:
+            owners = [o for o in self.cluster.fragment_nodes(index, slice_)
+                      if o in nodes]
+            if not owners:
+                unowned.append(slice_)
+                continue
+            pick = preferred_owner(owners, state)
+            ent = per_host.setdefault(pick.host,
+                                      {"slices": 0, "sample": []})
+            ent["slices"] += 1
+            if len(ent["sample"]) < 16:
+                ent["sample"].append(slice_)
+        out = {"mode": "cluster", "nodes": per_host}
+        if unowned:
+            out["unowned_count"] = len(unowned)
+            out["unowned_sample"] = unowned[:16]
+        breakers = getattr(self.client, "breakers", None)
+        snap = getattr(breakers, "snapshot", None)
+        if callable(snap):
+            out["breakers"] = snap()
+        return out
 
     def _batch_num_slices(self, index: str, batch_slices) -> int:
         idx = self.holder.index(index)
@@ -709,6 +900,15 @@ class Executor:
         PILOSA_TPU_CPU_ROUTE_NATIVE=off pins large folds to the mesh
         (measurement / regression escape hatch); thr <= 0 still
         disables ALL routing."""
+        if not self._would_route_to_host(num_slices, num_leaves):
+            return False
+        mgr = self.mesh_manager()
+        if mgr is not None:
+            mgr.stats.inc("routed_host")
+        return True
+
+    def _min_work(self) -> int:
+        """The resolved cost-routing threshold (see _route_to_host)."""
         thr = self.device_min_work
         if thr is None:
             thr = self._min_work_resolved
@@ -724,14 +924,17 @@ class Executor:
             if thr is None:
                 thr = self._DEFAULT_MIN_WORK
             self._min_work_resolved = thr
+        return thr
+
+    def _would_route_to_host(self, num_slices: int, num_leaves: int) -> bool:
+        """The pure routing decision — no stats, no manager
+        construction — shared by _route_to_host and explain()."""
+        thr = self._min_work()
         if thr <= 0:
             return False
         if (num_slices * max(1, num_leaves) >= thr
                 and not self._cpu_native_routes()):
             return False
-        mgr = self.mesh_manager()
-        if mgr is not None:
-            mgr.stats.inc("routed_host")
         return True
 
     def _cpu_native_routes(self) -> bool:

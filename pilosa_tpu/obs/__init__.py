@@ -10,6 +10,7 @@ trace is a single ContextVar read returning a shared no-op object, and
 """
 
 from .metrics import Histogram, StatMap
+from . import prom
 from .trace import (
     NOOP_SPAN,
     Span,
@@ -30,6 +31,7 @@ __all__ = [
     "Tracer",
     "current_span",
     "jax_scope",
+    "prom",
     "span",
     "wrap_ctx",
 ]

@@ -91,6 +91,13 @@ class Histogram:
             cum += n
         return self.max if self.max is not None else 0.0
 
+    def bucket_snapshot(self):
+        """(counts, total, sum) under the lock — the raw log₂ buckets
+        for exporters that need them (Prometheus cumulative `le`
+        buckets: bucket b's upper bound is 2^b, see obs.prom)."""
+        with self._mu:
+            return list(self.counts), self.total, self.sum
+
     def snapshot(self, prefix: str) -> Dict[str, float]:
         """Expvar-style flat dict. Keeps the legacy `.sum`/`.count`
         keys and adds percentiles + extrema."""

@@ -100,7 +100,8 @@ class CompiledPlanCache:
         self._mu = threading.Lock()
         self._fns: "OrderedDict[tuple, object]" = OrderedDict()
         self.cap = cap
-        self.stats = {"hit": 0, "miss": 0}
+        self.stats = {"hit": 0, "miss": 0, "evicted": 0,
+                      "compile_us": 0}
 
     @staticmethod
     def key(sig: str, words_t) -> tuple:
@@ -112,18 +113,31 @@ class CompiledPlanCache:
                 jax.default_backend())
 
     def get_or_build(self, key: tuple, build):
+        import time as _time
+
         with self._mu:
             fn = self._fns.get(key)
             if fn is not None:
                 self._fns.move_to_end(key)  # LRU, not FIFO
                 self.stats["hit"] += 1
                 return fn
+            t0 = _time.monotonic()
             fn = build()
+            self.stats["compile_us"] += int(
+                (_time.monotonic() - t0) * 1e6)
             if len(self._fns) >= self.cap:
                 self._fns.popitem(last=False)
+                self.stats["evicted"] += 1
             self._fns[key] = fn
             self.stats["miss"] += 1
             return fn
+
+    def contains_sig(self, sig: str) -> bool:
+        """Whether ANY cached plan was compiled for this tree shape —
+        the EXPLAIN-surface peek (executor.explain). Key-prefix scan
+        only: no staging, no mutation, no LRU reorder."""
+        with self._mu:
+            return any(k[0] == sig for k in self._fns)
 
     def __len__(self) -> int:
         return len(self._fns)
@@ -305,6 +319,16 @@ class HostQueryCache:
         with self._mu:
             self.stats["query_miss"] += 1
         return None
+
+    def query_peek(self, key: tuple, epoch: int) -> bool:
+        """EXPLAIN-surface probe: would a repeat of this query serve
+        from the whole-query memo at the CURRENT epoch? No stats
+        mutation, no LRU reorder, no token walk (a token-revalidating
+        entry reports False — EXPLAIN under-promises rather than
+        touching generations)."""
+        with self._mu:
+            e = self._query.get(key)
+            return e is not None and e[0] == epoch
 
     def query_put(self, key: tuple, epoch: int, count: int,
                   s_epoch: Optional[int] = None,

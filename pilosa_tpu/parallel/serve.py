@@ -39,7 +39,12 @@ import numpy as np
 
 from ..core.fragment import MUTATION_EPOCH
 from ..obs import StatMap, jax_scope, span
-from ..ops.pool import fold_log_entries, plan_slice_mutations
+from ..ops.pool import (
+    CONTAINER_WORDS,
+    INVALID_KEY,
+    fold_log_entries,
+    plan_slice_mutations,
+)
 from .mesh import (
     SLICE_AXIS,
     build_sharded_index,
@@ -410,7 +415,18 @@ class MeshManager:
             # the chained path; the fused lone path costs exactly 1
             # (bench lone_query_dispatch measures the delta).
             "device_dispatches": 0, "lone_fused": 0,
+            # Program-compile telemetry: every entry-point compile
+            # funnels through _timed_build (serve-side caches AND the
+            # fused-plan LRU), so first-shape stalls are attributable
+            # from /metrics without a profiler run.
+            "compile_count": 0, "compile_us": 0,
+            "h2d_chunk_slices": 0,
         })
+        # Per-entry-point compile counters ({entry}_count/{entry}_us:
+        # count, count_batch, coarse, row_counts, row_counts_src,
+        # tanimoto, shared, fused) — the label-bearing face of the
+        # compile_count/compile_us totals above.
+        self.compile_stats = StatMap()
 
     @property
     def mesh(self):
@@ -453,6 +469,40 @@ class MeshManager:
                 self.stats.inc("evicted")
         self.stats["staged_bytes"] = total
 
+    def device_memory(self) -> dict:
+        """HBM residency report for /metrics: padded bytes (what the
+        pool actually allocates, INVALID_KEY slots included), live
+        bytes (valid containers only — padding overhead is the gap),
+        and a per-device breakdown from JAX shard placement. Reads a
+        GIL-atomic snapshot of the view dict WITHOUT taking _mu, so a
+        scrape never stalls behind a multi-second stage; shard shape
+        reads are metadata-only (no device transfer)."""
+        views = list(self._views.values())
+        padded = live = 0
+        per_device: Dict[str, int] = {}
+        for sv in views:
+            padded += self._view_bytes(sv)
+            if sv.keys_host is not None:
+                live += int((sv.keys_host != INVALID_KEY).sum()) * (
+                    CONTAINER_WORDS * 4 + 4)
+            placed = False
+            try:
+                for arr in (sv.sharded.words, sv.sharded.keys):
+                    for shard in arr.addressable_shards:
+                        n = int(np.prod(shard.data.shape)) * 4
+                        dev = str(shard.device)
+                        per_device[dev] = per_device.get(dev, 0) + n
+                        placed = True
+            except (AttributeError, TypeError):
+                placed = False
+            if not placed:
+                devs = [str(d) for d in np.asarray(self.mesh.devices).flat]
+                share = self._view_bytes(sv) // max(1, len(devs))
+                for dev in devs:
+                    per_device[dev] = per_device.get(dev, 0) + share
+        return {"views": len(views), "padded_bytes": padded,
+                "live_bytes": live, "per_device": per_device}
+
     # -- staging -------------------------------------------------------------
 
     def _snapshot_fragments(self, index: str, frame: str, view: str,
@@ -494,6 +544,8 @@ class MeshManager:
         self.stats.inc("h2d_bytes", stage_io.get("h2d_bytes", 0))
         self.stats.inc("h2d_dispatch_us", int(
             stage_io.get("h2d_dispatch_s", 0.0) * 1e6))
+        self.stats.set("h2d_chunk_slices",
+                       stage_io.get("h2d_chunk_slices", 0))
         sp.tag(h2d_bytes=stage_io.get("h2d_bytes", 0),
                h2d_dispatch_us=int(stage_io.get("h2d_dispatch_s", 0.0)
                                    * 1e6))
@@ -956,20 +1008,37 @@ class MeshManager:
         return (tuple(words_t), tuple(idx_t), tuple(hit_t),
                 tuple(coarse_t), first)
 
-    def _get_or_compile(self, cache: dict, key, build):
+    def _get_or_compile(self, cache: dict, key, build,
+                        entry: str = "other"):
         """Get-or-compile under _compile_mu so a given program compiles
         ONCE even when two first queries of the same shape race
         (ADVICE r2: the GIL kept the dicts safe but let both pay the
         multi-second compile). The fast path stays lock-free; _mu is
-        never acquired here, so compiles don't block staging."""
+        never acquired here, so compiles don't block staging. `entry`
+        names the program family for the compile telemetry."""
         fn = cache.get(key)
         if fn is not None:
             return fn
         with self._compile_mu:
             fn = cache.get(key)
             if fn is None:
-                fn = build()
+                fn = self._timed_build(entry, build)
                 cache[key] = fn
+        return fn
+
+    def _timed_build(self, entry: str, build):
+        """The one choke point every program compile passes through:
+        wall-time + count, both per entry point (compile_stats) and in
+        aggregate (stats compile_count/compile_us), so /metrics can
+        attribute first-shape serving stalls to the program family
+        that paid them."""
+        t0 = time.monotonic()
+        fn = build()
+        us = int((time.monotonic() - t0) * 1e6)
+        self.compile_stats.inc(f"{entry}_count")
+        self.compile_stats.inc(f"{entry}_us", us)
+        self.stats.inc("compile_count")
+        self.stats.inc("compile_us", us)
         return fn
 
     def _count_fn(self, sig: str, num_leaves: int):
@@ -978,7 +1047,8 @@ class MeshManager:
         return self._get_or_compile(
             self._count_fns, (sig, num_leaves),
             lambda: compile_serve_count(self.mesh, json.loads(sig),
-                                        num_leaves))
+                                        num_leaves),
+            entry="count")
 
     # "auto" resolution cache: None = unresolved, else "pallas"/"xla".
     # Process-wide (the probe compiles one trivial kernel; its verdict
@@ -1110,7 +1180,8 @@ class MeshManager:
                     self._coarse_fns, key,
                     lambda: compile_serve_count_coarse_pallas_uniform(
                         self.mesh, json.loads(sig), num_leaves, batch,
-                        interpret=interpret))
+                        interpret=interpret),
+                    entry="coarse")
             if batch == 1:
                 from .mesh import compile_serve_count_coarse_pallas
 
@@ -1118,18 +1189,21 @@ class MeshManager:
                     self._coarse_fns, key,
                     lambda: compile_serve_count_coarse_pallas(
                         self.mesh, json.loads(sig), num_leaves,
-                        interpret=interpret))
+                        interpret=interpret),
+                    entry="coarse")
             from .mesh import compile_serve_count_coarse_pallas_batch
 
             return self._get_or_compile(
                 self._coarse_fns, key,
                 lambda: compile_serve_count_coarse_pallas_batch(
                     self.mesh, json.loads(sig), num_leaves, batch,
-                    interpret=interpret))
+                    interpret=interpret),
+                entry="coarse")
         return self._get_or_compile(
             self._coarse_fns, (sig, num_leaves, batch),
             lambda: compile_serve_count_coarse(self.mesh, json.loads(sig),
-                                               num_leaves, batch))
+                                               num_leaves, batch),
+            entry="coarse")
 
     @staticmethod
     def _shared_policy() -> str:
@@ -1264,8 +1338,11 @@ class MeshManager:
         with self._compile_mu:
             fn = self._shared_get(key)
             if fn is None:
-                fn = self._build_shared(tree_sig, leaf_map, num_unique,
-                                        key[-2], uniform=key[-1])
+                fn = self._timed_build(
+                    "shared",
+                    lambda: self._build_shared(tree_sig, leaf_map,
+                                               num_unique, key[-2],
+                                               uniform=key[-1]))
                 self._shared_put(key, fn)
         return fn
 
@@ -1302,8 +1379,11 @@ class MeshManager:
 
         def build():
             try:
-                fn = self._build_shared(tree_sig, leaf_map, num_unique,
-                                        key[-2], uniform=key[-1])
+                fn = self._timed_build(
+                    "shared",
+                    lambda: self._build_shared(tree_sig, leaf_map,
+                                               num_unique, key[-2],
+                                               uniform=key[-1]))
                 self._shared_put(key, fn)
             finally:
                 with self._shared_mu:
@@ -1588,7 +1668,8 @@ class MeshManager:
                 fn = self._get_or_compile(
                     self._batch_fns, (sig, num_leaves, b_pad),
                     lambda: compile_serve_count_batch(
-                        self.mesh, json.loads(sig), num_leaves, b_pad))
+                        self.mesh, json.loads(sig), num_leaves, b_pad),
+                    entry="count_batch")
                 idx_flat = tuple(r.args[2][i] for r in padded
                                  for i in range(num_leaves))
                 hit_flat = tuple(r.args[3][i] for r in padded
@@ -1723,8 +1804,9 @@ class MeshManager:
             sig = json.dumps(_tree_signature(shape))
             key = CompiledPlanCache.key(sig, words_t)
             fn = self._fused_plans.get_or_build(
-                key, lambda: compile_serve_count_fused(
-                    self.mesh, json.loads(sig), len(leaves)))
+                key, lambda: self._timed_build(
+                    "fused", lambda: compile_serve_count_fused(
+                        self.mesh, json.loads(sig), len(leaves))))
             with jax_scope("pilosa:count_fused"):
                 limbs = fn(words_t, idx_all, hit_all, mask)
             self.stats.inc("device_dispatches")
@@ -1929,7 +2011,8 @@ class MeshManager:
         # not block staging/serving of every other query.
         fn = self._get_or_compile(
             self._rowcount_fns, padded,
-            lambda: compile_serve_row_counts(self.mesh, padded))
+            lambda: compile_serve_row_counts(self.mesh, padded),
+            entry="row_counts")
         key = ("rc", id(sharded.words), id(dev_mask), padded)
         memo = self._memo_get(key)
         if memo is not None:
@@ -2093,7 +2176,8 @@ class MeshManager:
         fn = self._get_or_compile(
             fn_cache, (sig, len(idx_t), padded),
             lambda: compiler(self.mesh, json.loads(sig),
-                             len(idx_t), padded))
+                             len(idx_t), padded),
+            entry="tanimoto" if kind == "tan" else "row_counts_src")
         key = (kind, id(sharded.words), id(dev_mask), padded, sig,
                tuple(id(w) for w in words_t), tuple(id(a) for a in idx_t))
         out = self._memo_get(key)

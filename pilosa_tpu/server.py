@@ -209,6 +209,10 @@ class Server:
             logger=self.logger, tracer=self.tracer)
         # Default per-query budget ([cluster] query-deadline; 0 = none).
         self.handler.default_deadline = self.config.query_deadline
+        # Sampled-gauge cadence for /metrics ([obs]
+        # metrics-sample-interval).
+        self.handler.metrics_sample_interval = (
+            self.config.metrics_sample_interval)
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd

@@ -43,6 +43,12 @@ class ExpvarStats(StatsClient):
     (obs.metrics) instead of bare sum/count accumulators, so
     /debug/vars can expose p50/p95/p99 alongside the legacy
     `.sum`/`.count` keys, which are preserved verbatim in snapshot().
+
+    Entries are keyed STRUCTURED — (name, tags tuple) — so
+    label-bearing exporters (obs.prom /metrics bridge) see real label
+    pairs instead of parsing comma-joined strings back apart.
+    snapshot() reconstructs the legacy flat `"t1,t2,name"` key shape,
+    so /debug/vars consumers see byte-identical keys.
     """
 
     def __init__(self, tags: Optional[Iterable[str]] = None, parent=None):
@@ -50,17 +56,28 @@ class ExpvarStats(StatsClient):
         self.tags = tuple(tags or ())
         if parent is None:
             self._lock = threading.Lock()
-            self.values: Dict[str, float] = defaultdict(float)
-            self.sets: Dict[str, str] = {}
-            self.hists: Dict[str, Histogram] = {}
+            # (name, tags) -> value/str/Histogram.
+            self.values: Dict[tuple, float] = defaultdict(float)
+            self.sets: Dict[tuple, str] = {}
+            self.hists: Dict[tuple, Histogram] = {}
+            # name -> "counter" | "gauge": count() and gauge() share
+            # the values dict; exporters need to tell an accumulating
+            # series from a set-style one. First writer wins.
+            self.kinds: Dict[str, str] = {}
         else:
             self._lock = parent._lock
             self.values = parent.values
             self.sets = parent.sets
             self.hists = parent.hists
+            self.kinds = parent.kinds
 
-    def _key(self, name: str) -> str:
-        return ",".join(self.tags + (name,)) if self.tags else name
+    def _key(self, name: str) -> tuple:
+        return (name, self.tags)
+
+    @staticmethod
+    def _flat(key: tuple) -> str:
+        name, tags = key
+        return ",".join(tags + (name,)) if tags else name
 
     def with_tags(self, *tags: str) -> "ExpvarStats":
         child = ExpvarStats(self.tags + tags, parent=self)
@@ -69,10 +86,12 @@ class ExpvarStats(StatsClient):
     def count(self, name: str, value: int = 1):
         with self._lock:
             self.values[self._key(name)] += value
+            self.kinds.setdefault(name, "counter")
 
     def gauge(self, name: str, value: float):
         with self._lock:
             self.values[self._key(name)] = value
+            self.kinds.setdefault(name, "gauge")
 
     def histogram(self, name: str, value: float):
         key = self._key(name)
@@ -89,12 +108,22 @@ class ExpvarStats(StatsClient):
     def timing(self, name: str, value_us: int):
         self.histogram(name + ".us", value_us)
 
+    def structured(self):
+        """(values, sets, hists, kinds) snapshots keyed (name, tags) —
+        the label-preserving view the /metrics bridge renders from.
+        Histogram objects are shared (observe-safe, snapshot under
+        their own lock); the dicts are copies."""
+        with self._lock:
+            return (dict(self.values), dict(self.sets),
+                    dict(self.hists), dict(self.kinds))
+
     def snapshot(self) -> dict:
         with self._lock:
-            out = {**self.values, **self.sets}
+            out = {self._flat(k): v for k, v in self.values.items()}
+            out.update((self._flat(k), v) for k, v in self.sets.items())
             hists = list(self.hists.items())
         for key, h in hists:
-            out.update(h.snapshot(key))
+            out.update(h.snapshot(self._flat(key)))
         return out
 
 
