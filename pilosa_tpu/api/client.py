@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..errors import DeadlineExceededError, PilosaError
 from ..obs import StatMap, current_span
+from ..obs import profile as obs_profile
 from .. import fault
 from ..wire import pb, result_from_proto, PROTOBUF_CT
 
@@ -323,6 +324,13 @@ class InternalClient:
         if cur is not None:
             hdrs["X-Pilosa-Trace"] = \
                 f"{cur.trace.trace_id}:{cur.span_id}"
+        # Profile propagation mirrors the trace: with a profile active
+        # (the coordinator is measuring), ask the remote leg to measure
+        # too; its section comes back in X-Pilosa-Profile and merges
+        # under this profile's `remotes`.
+        prof = obs_profile.current()
+        if prof is not None:
+            hdrs["X-Pilosa-Profile"] = "1"
         if deadline is not None:
             left = deadline - time.monotonic()
             if left <= 0:
@@ -334,17 +342,25 @@ class InternalClient:
             "POST", f"/index/{index}/query", body=req.SerializeToString(),
             content_type=PROTOBUF_CT, accept=PROTOBUF_CT,
             headers=hdrs or None,
-            resp_headers=rhdrs if cur is not None else None,
+            resp_headers=rhdrs
+            if (cur is not None or prof is not None) else None,
             deadline=deadline)
+        rh_lower = {k.lower(): v for k, v in rhdrs.items()}
         if cur is not None:
-            wire = {k.lower(): v for k, v in rhdrs.items()}.get(
-                "x-pilosa-trace-spans", "")
+            wire = rh_lower.get("x-pilosa-trace-spans", "")
             if wire:
                 try:
                     cur.trace.graft(json.loads(wire), cur.span_id,
                                     node=self.host)
                 except (ValueError, KeyError, TypeError):
                     pass  # malformed remote spans never fail the query
+        if prof is not None:
+            pwire = rh_lower.get("x-pilosa-profile", "")
+            if pwire:
+                try:
+                    prof.merge_remote(self.host, json.loads(pwire))
+                except (ValueError, KeyError, TypeError):
+                    pass  # malformed remote profile never fails the query
         resp = pb.QueryResponse()
         try:
             resp.ParseFromString(data)

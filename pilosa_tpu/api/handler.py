@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import binascii
 import io
+import itertools
 import json
 import os
 import re
@@ -178,7 +179,12 @@ POST /index/{i}/frame/{f}          create frame      GET  /schema
 GET  /status    GET /hosts         cluster state     GET  /slices/max
 POST /import                       protobuf bulk     GET  /export            CSV
 GET  /fragment/data                fragment snapshot GET  /debug/vars        stats
-GET  /debug/pprof/profile          sampling profiler GET  /version
+GET  /metrics                      Prometheus text   GET  /version
+POST /index/{i}/query?explain=true predicted plan (routing, no dispatch)
+POST /index/{i}/query?profile=true measured profile (phase times, bytes, roofline)
+GET  /debug/queries                recent + slow     GET  /debug/traces/{id} spans
+GET  /debug/pprof/profile          sampling profiler
+GET  /debug/pprof/heap?start=1     alloc tracing (opt-in: PILOSA_TPU_HEAP_TRACE=1)
 </pre>
 <p class="dim">Full upstream documentation: <a href="https://www.pilosa.com/docs/">pilosa.com/docs</a></p>
 </div>
@@ -431,6 +437,13 @@ class Handler:
         self.metrics_sample_interval = 10.0
         self._frag_sample: Tuple[float, list] = (0.0, [])
         self._frag_sample_mu = threading.Lock()
+        # Continuous profiling cadence ([obs] profile-sample-rate,
+        # server wiring): 0 = only on explicit ?profile=true; N = every
+        # Nth query is profiled (device bracketing and all), feeding
+        # the pilosa_query_phase_us histograms without a response
+        # section. The counter is monotonic across all queries.
+        self.profile_sample_rate = 0
+        self._profile_seq = itertools.count(1)
         self._prom = obs.prom.Registry()
         self._register_collectors()
         self._routes: List[Route] = []
@@ -563,6 +576,9 @@ class Handler:
         reg.register_collector(self._collect_caches)
         reg.register_collector(self._collect_cluster)
         reg.register_collector(self._collect_fragments)
+        # Measured-profile histograms (process-wide: every profiled
+        # query records into obs.profile.STATS regardless of handler).
+        reg.register_collector(obs.profile.STATS.families)
 
     def _collect_runtime(self) -> list:
         prom = obs.prom
@@ -1236,15 +1252,41 @@ class Handler:
             "query", trace_id=th.partition(":")[0] or None,
             index=index, query=query[:256], remote=bool(remote),
             node=self.host)
+
+        # Measured profile (the EXPLAIN ANALYZE counterpart): explicit
+        # ?profile=true, a coordinator's X-Pilosa-Profile request
+        # header on a remote leg, or the sampled 1-in-N cadence. The
+        # profile activates via contextvar exactly like the tracer;
+        # with none of the three, profiling code below never allocates.
+        want_profile = params.get("profile") == "true" and not remote
+        remote_profile = bool(remote
+                              and headers.get("x-pilosa-profile"))
+        sampled = (self.profile_sample_rate > 0 and not remote
+                   and next(self._profile_seq)
+                   % self.profile_sample_rate == 0)
+        prof = ptoken = None
+        if want_profile or remote_profile or sampled:
+            prof = obs.profile.QueryProfile()
+            ptoken = obs.profile.activate(prof)
         try:
             with trace.root:
                 resp = self._run_query(index, query, slices, column_attrs,
-                                       remote, headers, opt)
+                                       remote, headers, opt,
+                                       profile_section=want_profile)
         finally:
+            if prof is not None:
+                obs.profile.deactivate(ptoken)
+                prof.finish()
+                obs.profile.STATS.record(prof)
             self.tracer.finish(trace)
         if th:
             resp.headers["X-Pilosa-Trace-Spans"] = json.dumps(
                 trace.serialize_spans(), separators=(",", ":"))
+        if remote_profile:
+            # Ship the leg's measured section back; the coordinator's
+            # client grafts it under its own profile (merge_remote).
+            resp.headers["X-Pilosa-Profile"] = json.dumps(
+                prof.to_dict(), separators=(",", ":"))
         return resp
 
     def _explain_query(self, index, query, slices, headers,
@@ -1285,7 +1327,7 @@ class Handler:
                            partial=params.get("partial") == "true")
 
     def _run_query(self, index, query, slices, column_attrs, remote,
-                   headers, opt=None) -> Response:
+                   headers, opt=None, profile_section=False) -> Response:
         if opt is None:
             opt = ExecOptions(remote=remote)
         try:
@@ -1293,7 +1335,8 @@ class Handler:
             # texts skip the ~100 us parse, which dominates a
             # memo-served Count. The shared Query is immutable by
             # convention (see the cache's docstring).
-            with obs.span("parse", bytes=len(query)):
+            with obs.span("parse", bytes=len(query)), \
+                    obs.profile.phase("parse"):
                 q = parse_string_cached(query)
             t0 = time.monotonic()
             results = self.executor.execute(index, q, slices or None, opt)
@@ -1337,6 +1380,14 @@ class Handler:
             # happened, so clients don't have to infer it from absence.
             out["partial"] = bool(opt.missing_slices)
             out["missing_slices"] = sorted(set(opt.missing_slices))
+        if profile_section:
+            prof = obs.profile.current()
+            if prof is not None:
+                # Snapshotted BEFORE serialization: total_us is
+                # execution wall time, and the phases must sum to
+                # >= 90% of it (the acceptance bar) without charging
+                # the profile for rendering its own report.
+                out["profile"] = prof.to_dict()
         return _json_resp(out)
 
     def _query_error(self, e, headers) -> Response:

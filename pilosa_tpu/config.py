@@ -32,6 +32,14 @@ TOML schema:
     [obs]
     slow-query-threshold = "250ms"
     trace-ring = 256
+    profile-sample-rate = 0     # 0 = profile only on ?profile=true;
+                                # N = also profile every Nth query
+                                # (feeds the /metrics phase histograms)
+
+    [log]
+    level = "info"              # debug | info | warning | error
+    format = "text"             # text | json (trace/span-id injected)
+    path = ""                   # empty = stderr; overrides log-path
 
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
@@ -42,6 +50,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 
 try:
     import tomllib
@@ -148,6 +157,17 @@ class Config:
         # (row-cache sizes, cardinality): the walk is cheap but
         # O(fragments), and Prometheus scrapes on a timer.
         self.metrics_sample_interval: float = 10.0
+        # Continuous production profiling: 0 profiles only on explicit
+        # ?profile=true; N profiles every Nth query (block_until_ready
+        # bracketing and all), feeding pilosa_query_phase_us.
+        self.profile_sample_rate: int = 0
+        # [log] — structured logging (obs/log.py). `log_format` "json"
+        # injects the active trace/span id into every record so log
+        # lines join against /debug/traces. `log_file` empty falls back
+        # to the top-level log-path, then stderr.
+        self.log_level: str = "info"
+        self.log_format: str = "text"
+        self.log_file: str = ""
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -204,6 +224,12 @@ class Config:
         if "metrics-sample-interval" in ob:
             c.metrics_sample_interval = parse_duration(
                 ob["metrics-sample-interval"])
+        c.profile_sample_rate = int(ob.get("profile-sample-rate",
+                                           c.profile_sample_rate))
+        lg = data.get("log", {})
+        c.log_level = str(lg.get("level", c.log_level))
+        c.log_format = str(lg.get("format", c.log_format))
+        c.log_file = str(lg.get("path", c.log_file))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -249,4 +275,62 @@ class Config:
             f"trace-ring = {self.trace_ring}\n"
             f'metrics-sample-interval = '
             f'"{int(self.metrics_sample_interval)}s"\n'
+            f"profile-sample-rate = {self.profile_sample_rate}\n"
+            f"\n[log]\n"
+            f'level = "{self.log_level}"\n'
+            f'format = "{self.log_format}"\n'
+            f'path = "{self.log_file}"\n'
         )
+
+
+# -- roofline peak table (obs/profile.py) ---------------------------------
+#
+# Per-backend peak memory bandwidth in bytes/s. TPU entries are the
+# per-chip HBM spec (v5e: ~819 GB/s — PROFILE_ROOFLINE.md uses the same
+# number); the roofline judges a single chip's stream, the profile
+# reports bytes touched across all local devices, so fractions > 1 on a
+# multi-chip mesh mean "faster than one chip", which is the honest
+# per-dispatch reading until per-device attribution lands.
+HBM_PEAK_BYTES_PER_S = {
+    "tpu": 819e9,        # default TPU guess: v5e per-chip HBM
+    "tpu-v5e": 819e9,
+    "tpu-v4": 1228e9,
+    "gpu": 2039e9,       # A100-80G class
+}
+
+_HOST_PEAK: Optional[float] = None
+_HOST_PEAK_MU = threading.Lock()
+
+
+def _measure_host_bandwidth() -> float:
+    """Measured-on-first-use host fallback: best-of-3 memcpy of a
+    buffer comfortably larger than L3 (64 MB). Coarse by design — the
+    roofline needs the right order of magnitude, not a STREAM score."""
+    import time as _time
+
+    import numpy as _np
+
+    src = _np.ones(64 * 1024 * 1024 // 8, dtype=_np.uint64)
+    dst = _np.empty_like(src)
+    best = float("inf")
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        _np.copyto(dst, src)
+        dt = _time.perf_counter() - t0
+        best = min(best, dt)
+    # copy reads + writes the buffer once each.
+    return (2 * src.nbytes) / best if best > 0 else 1e9
+
+
+def peak_memory_bandwidth(backend: str) -> float:
+    """Peak bytes/s for a backend name ("tpu", "cpu", "host", ...).
+    Unknown accelerators fall back to the TPU default; cpu/host use the
+    measured (cached) host memcpy bandwidth."""
+    b = (backend or "").lower()
+    if b in ("cpu", "host", ""):
+        global _HOST_PEAK
+        with _HOST_PEAK_MU:
+            if _HOST_PEAK is None:
+                _HOST_PEAK = _measure_host_bandwidth()
+            return _HOST_PEAK
+    return HBM_PEAK_BYTES_PER_S.get(b, HBM_PEAK_BYTES_PER_S["tpu"])

@@ -11,6 +11,7 @@ Subcommands (reference cmd/*.go + ctl/*.go, SURVEY.md §2.6):
     check     offline consistency check of fragment data files
     inspect   per-container stats dump of a data file
     sort      sort an import CSV in fragment/position order
+    top       live /metrics summary (QPS, phase percentiles, roofline)
     config    print the default TOML config
 
 Flag precedence mirrors the reference's viper wiring (cmd/root.go:
@@ -78,8 +79,6 @@ def build_config(args) -> Config:
 # ---- server ----------------------------------------------------------------
 
 def cmd_server(args) -> int:
-    import logging
-
     cfg = build_config(args)
     if getattr(args, "dry_run", False):
         # Hidden config seam (reference cmd/root.go:59-71): print the
@@ -88,12 +87,15 @@ def cmd_server(args) -> int:
         # never pays (or needs) the jax/device stack.
         sys.stdout.write(cfg.to_toml())
         return 0
+    from ..obs import log as obs_log
     from ..server import Server
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(message)s",
-        filename=args.log_path or None)
+    # One logging pipeline ([log] config section): level/format from
+    # config, destination precedence --log-path flag > [log] path >
+    # top-level log-path > stderr. JSON format injects the active
+    # trace/span id into every record (obs/log.py).
+    obs_log.setup(level=cfg.log_level, fmt=cfg.log_format,
+                  path=args.log_path or cfg.log_file or cfg.log_path)
     srv = Server(cfg)
     srv.open()
     print(f"pilosa-tpu listening on http://{srv.host} "
@@ -360,6 +362,180 @@ def cmd_config(args) -> int:
     return 0
 
 
+# ---- top -------------------------------------------------------------------
+
+def _parse_prom(text: str) -> dict:
+    """Prometheus 0.0.4 text -> {(name, ((label, value), ...)): float}.
+    Labels come back sorted so lookups are order-independent. Comment
+    and malformed lines are skipped (an operator tool must survive a
+    partially-garbled scrape)."""
+    import re as _re
+
+    label_re = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                           r'"((?:[^"\\]|\\.)*)"')
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$",
+                      line)
+        if m is None:
+            continue
+        name, rawlabels, value = m.groups()
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        labels = tuple(sorted(
+            (k, lv.replace('\\"', '"').replace("\\\\", "\\")
+                  .replace("\\n", "\n"))
+            for k, lv in label_re.findall(rawlabels or "")))
+        out[(name, labels)] = v
+    return out
+
+
+def _hist_percentiles(metrics: dict, name: str, fixed: dict):
+    """(p50, p95, p99, count) from `name`_bucket cumulative-le samples
+    whose labels include `fixed`. Percentile = the smallest le whose
+    cumulative count covers the quantile (exact for the log2 exporter,
+    an upper bound in general)."""
+    buckets = []
+    for (mname, labels), v in metrics.items():
+        if mname != name + "_bucket":
+            continue
+        d = dict(labels)
+        if any(d.get(k) != str(val) for k, val in fixed.items()):
+            continue
+        le = d.get("le", "")
+        buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return (0.0, 0.0, 0.0, 0)
+    out = []
+    for q in (0.50, 0.95, 0.99):
+        thresh = q * total
+        out.append(next((le for le, cum in buckets if cum >= thresh),
+                        buckets[-1][0]))
+    return (*out, int(total))
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _fmt_us(us: float) -> str:
+    if us == float("inf"):
+        return "inf"
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}us"
+
+
+def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
+    """One screenful from two consecutive /metrics scrapes. Pure —
+    tests feed it canned scrapes."""
+    lines = [f"pilosa-tpu top — {host}"]
+
+    up = cur.get(("pilosa_uptime_seconds", ()), 0.0)
+    qtot = cur.get(("pilosa_query_us_count", ()), 0.0)
+    qprev = prev.get(("pilosa_query_us_count", ()), 0.0) if prev else 0.0
+    qps = (qtot - qprev) / dt if prev and dt > 0 else 0.0
+    lines.append(f"uptime {up:.0f}s   queries {int(qtot)}   "
+                 f"qps {qps:.1f}")
+
+    # Per-phase measured percentiles (pilosa_query_phase_us{phase,
+    # backend}) — only present once something has been profiled.
+    pairs = sorted({(dict(labels).get("phase", ""),
+                     dict(labels).get("backend", ""))
+                    for (name, labels) in cur
+                    if name == "pilosa_query_phase_us_bucket"})
+    if pairs:
+        lines.append("")
+        lines.append(f"{'phase':<16}{'backend':<10}{'p50':>9}"
+                     f"{'p95':>9}{'p99':>9}{'count':>8}")
+        for phase, backend in pairs:
+            pct = _hist_percentiles(cur, "pilosa_query_phase_us",
+                                    {"phase": phase, "backend": backend})
+            if pct is None:
+                continue
+            p50, p95, p99, n = pct
+            lines.append(f"{phase:<16}{backend:<10}{_fmt_us(p50):>9}"
+                         f"{_fmt_us(p95):>9}{_fmt_us(p99):>9}{n:>8}")
+    else:
+        lines.append("(no profiled queries yet — POST ?profile=true or "
+                     "set [obs] profile-sample-rate)")
+
+    roofs = [(dict(labels).get("backend", ""), v)
+             for (name, labels), v in sorted(cur.items())
+             if name == "pilosa_roofline_fraction"]
+    if roofs:
+        lines.append("")
+        for backend, frac in roofs:
+            bps = cur.get(("pilosa_roofline_bytes_per_second",
+                           (("backend", backend),)), 0.0)
+            lines.append(f"roofline {backend}: {frac:.3f} of peak "
+                         f"({_fmt_bytes(bps)}/s)")
+
+    brk = [(dict(labels).get("host", ""), v)
+           for (name, labels), v in sorted(cur.items())
+           if name == "pilosa_breaker_state"]
+    if brk:
+        state_names = {0: "closed", 1: "half-open", 2: "open"}
+        lines.append("breakers: " + "  ".join(
+            f"{h}={state_names.get(int(v), '?')}" for h, v in brk))
+
+    hbm = [(dict(labels).get("device", ""), v)
+           for (name, labels), v in sorted(cur.items())
+           if name == "pilosa_hbm_resident_bytes"]
+    if hbm:
+        total = sum(v for _, v in hbm)
+        lines.append(f"hbm resident: {_fmt_bytes(total)} across "
+                     f"{len(hbm)} device(s)  " + "  ".join(
+                         f"{d}={_fmt_bytes(v)}" for d, v in hbm[:8]))
+    return "\n".join(lines) + "\n"
+
+
+def cmd_top(args) -> int:
+    """Scrape /metrics on an interval and render a one-screen summary
+    (QPS, per-phase percentiles, roofline, breakers, HBM residency) —
+    the operator's first-response tool."""
+    import urllib.request
+
+    url = f"http://{args.host}/metrics"
+    prev: dict = {}
+    t_prev = 0.0
+    n = 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                text = resp.read().decode()
+        except OSError as e:
+            print(f"scrape {url}: {e}", file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        cur = _parse_prom(text)
+        out = render_top(args.host, cur, prev, now - t_prev)
+        if sys.stdout.isatty() and args.n != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        prev, t_prev = cur, now
+        n += 1
+        if args.n and n >= args.n:
+            return 0
+        time.sleep(args.interval)
+
+
 # ---- argument parsing ------------------------------------------------------
 
 def _add_host(p):
@@ -447,6 +623,14 @@ def make_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("sort", help="sort import CSV in fragment order")
     p.add_argument("path", help="CSV file ('-' for stdin)")
     p.set_defaults(fn=cmd_sort)
+
+    p = sub.add_parser("top", help="live /metrics summary for a node")
+    _add_host(p)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between scrapes (default 2)")
+    p.add_argument("-n", type=int, default=0,
+                   help="number of scrapes, 0 = until interrupted")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("config", help="print the default config")
     p.set_defaults(fn=cmd_config)

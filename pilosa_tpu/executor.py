@@ -217,27 +217,33 @@ class Executor:
         (executor.go:62-145)."""
         if not index:
             raise IndexRequiredError()
-        opt = opt or ExecOptions()
+        # Slice-cover derivation is planning work: the max_slice scan
+        # is the measurable part of query setup at headline slice
+        # counts, so the plan phase brackets it (union-interval merges
+        # with the per-call plan bracket in _execute_count).
+        with obs.profile.phase("plan"):
+            opt = opt or ExecOptions()
 
-        need = needs_slices(q.calls)
-        # Built lazily on the first inverse call: most queries touch no
-        # inverse view, and at headline slice counts (960) the eager
-        # list was a measurable per-query tax on the routed fast path.
-        inverse_slices: Optional[List[int]] = None
-        column_label = DEFAULT_COLUMN_LABEL
+            need = needs_slices(q.calls)
+            # Built lazily on the first inverse call: most queries
+            # touch no inverse view, and at headline slice counts (960)
+            # the eager list was a measurable per-query tax on the
+            # routed fast path.
+            inverse_slices: Optional[List[int]] = None
+            column_label = DEFAULT_COLUMN_LABEL
 
-        idx = self.holder.index(index)
-        defaulted = False
-        if slices:
-            slices = list(slices)
-        else:
-            slices = []
-            if need:
-                if idx is None:
-                    raise IndexNotFoundError()
-                defaulted = True
-                slices = list(range(idx.max_slice() + 1))
-                column_label = idx.column_label
+            idx = self.holder.index(index)
+            defaulted = False
+            if slices:
+                slices = list(slices)
+            else:
+                slices = []
+                if need:
+                    if idx is None:
+                        raise IndexNotFoundError()
+                    defaulted = True
+                    slices = list(range(idx.max_slice() + 1))
+                    column_label = idx.column_label
 
         # Bulk attribute insertion fast path (executor.go:857-941).
         if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
@@ -468,6 +474,7 @@ class Executor:
         # has no cluster nodes — still qualifies; so does the default
         # server's one-node static cluster, where every write IS local.)
         psp = obs.span("plan", call="Count", slices=len(slices))
+        pph = obs.profile.phase("plan").start()
         qkey = qepoch = qsepoch = None
         nodes = self.cluster.nodes if self.cluster is not None else []
         if (not nodes
@@ -482,6 +489,7 @@ class Executor:
                 hit = self._host_cache.query_get(qkey, qepoch, qsepoch)
                 if hit is not None:
                     psp.tag(route="memo").finish()
+                    pph.stop()
                     self._record_route("memo", t0)
                     return hit
 
@@ -525,6 +533,7 @@ class Executor:
         if switches:
             psp.tag(kill_switches=switches)
         psp.finish()
+        pph.stop()
 
         plan_cell: list = []
 
@@ -565,15 +574,23 @@ class Executor:
         else:
             batch_fn = self._mesh_count_batch(index, lowered)
 
-        result = self._map_reduce(
-            index, slices, c, opt, map_fn, reduce_fn, batch_fn=batch_fn)
-        n = int(result or 0)
-        if qkey is not None:
-            # Stored against the PRE-compute epoch (and PRE-compute
-            # fragment generations): a write racing the fold bumped
-            # them, so the entry can never validate — stale results
-            # invalidate, they don't serve.
-            self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
+        # Host routes (roaring fold or the fused host popcount) do all
+        # their gather work on host threads: the whole map-reduce is
+        # host_fold time. The mesh route instead accrues device_exec /
+        # stage_h2d / compile inside the serving layer (union-interval
+        # accounting absorbs HostCountPlan's own nested bracket).
+        gph = (obs.profile.phase("host_fold") if lowered is None
+               else obs.profile.NOOP_PHASE)
+        with gph:
+            result = self._map_reduce(
+                index, slices, c, opt, map_fn, reduce_fn, batch_fn=batch_fn)
+            n = int(result or 0)
+            if qkey is not None:
+                # Stored against the PRE-compute epoch (and PRE-compute
+                # fragment generations): a write racing the fold bumped
+                # them, so the entry can never validate — stale results
+                # invalidate, they don't serve.
+                self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
         self._record_route(route, t0)
         return n
 
@@ -976,9 +993,7 @@ class Executor:
             except ValueError as e:
                 if not getattr(self, "_warned_env", False):
                     self._warned_env = True
-                    import logging
-
-                    logging.getLogger("pilosa_tpu.executor").warning(
+                    obs.get_logger("executor").warning(
                         "ignoring PILOSA_TPU_USE_DEVICE: %s", e)
                 forced = None
             if forced is not None:
@@ -1376,7 +1391,7 @@ class Executor:
         sp = obs.span("fanout", node=node.host,
                       slices=len(slices) if slices else 0)
         try:
-            with sp:
+            with sp, obs.profile.phase("fanout_remote"):
                 fault.point("executor.fanout", node=node.host)
                 opt.check_deadline(f"fanout to {node.host}")
                 kw = {}

@@ -10,7 +10,10 @@ trace is a single ContextVar read returning a shared no-op object, and
 """
 
 from .metrics import Histogram, StatMap
+from . import log
+from . import profile
 from . import prom
+from .log import get_logger
 from .trace import (
     NOOP_SPAN,
     Span,
@@ -30,7 +33,10 @@ __all__ = [
     "Trace",
     "Tracer",
     "current_span",
+    "get_logger",
     "jax_scope",
+    "log",
+    "profile",
     "prom",
     "span",
     "wrap_ctx",

@@ -1,0 +1,395 @@
+"""Measured per-query profiling: the EXPLAIN ANALYZE to trace.py's
+distributed flight recorder.
+
+A `QueryProfile` is an accumulator threaded (by contextvar, like the
+tracer) through the same seams the tracer instruments — parse, plan,
+H2D staging, compile, device dispatch, D2H readback, host fold, remote
+fan-out — but where spans record *shape* (who called what, when), the
+profile records *cost*: per-phase wall time unioned across threads,
+bytes moved per direction, and the achieved-bytes/s-vs-peak roofline
+that PROFILE_ROOFLINE.md used to compute by hand.
+
+Same cardinal rule as the tracer: near-free when nobody is looking.
+`phase("x")` with no active profile is one ContextVar read returning a
+shared no-op; byte counters early-return. Device phases are only real
+when a profile is active — callers gate their `block_until_ready`
+bracketing on `current() is not None`, so the async-dispatch fast path
+is byte-identical when profiling is off (bench.py guards < 2%).
+
+Phase accounting is a per-phase *union of intervals*: each phase keeps
+an active-entry depth, and only the outermost enter/exit pair (across
+all threads touching the profile) contributes wall time. Nested or
+concurrent same-name phases — serve._stage wrapping
+mesh.build_sharded_index, or parallel slice workers overlapping —
+therefore never double-count.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import Histogram
+
+# The canonical phase set, in pipeline order. to_dict() emits phases in
+# this order (then any ad-hoc extras) so profiles diff cleanly.
+PHASES = ("parse", "plan", "stage_h2d", "compile", "device_exec",
+          "readback_d2h", "host_fold", "fanout_remote")
+
+BYTE_COUNTERS = ("bytes_staged", "bytes_touched_hbm", "bytes_read_back")
+
+# The active profile for this thread/context. trace.wrap_ctx() carries
+# it across pool submit() boundaries alongside the active span.
+CURRENT_PROFILE: "contextvars.ContextVar[Optional[QueryProfile]]" = \
+    contextvars.ContextVar("pilosa_tpu_profile", default=None)
+
+
+class _NoopPhase:
+    """Shared do-nothing phase timer returned when no profile is
+    active — the identity of this singleton is itself asserted by
+    tests as proof the fast path pays one ContextVar read and nothing
+    else."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def start(self):
+        return self
+
+    def stop(self):
+        return None
+
+
+NOOP_PHASE = _NoopPhase()
+
+
+class _Phase:
+    """Context manager for one enter/exit of a named phase."""
+
+    __slots__ = ("_prof", "_name")
+
+    def __init__(self, prof: "QueryProfile", name: str):
+        self._prof = prof
+        self._name = name
+
+    def __enter__(self):
+        self._prof._enter(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        self._prof._exit(self._name)
+        return None
+
+    # Explicit form for regions with early returns (mirrors Span
+    # .finish()). stop() is idempotent-safe only pairwise with start().
+    def start(self):
+        self._prof._enter(self._name)
+        return self
+
+    def stop(self):
+        self._prof._exit(self._name)
+        return None
+
+
+class QueryProfile:
+    """Measured cost accumulator for one query.
+
+    Thread-safe: staging and slice folds run on pool workers, so every
+    mutation takes the profile's lock. That lock is only ever taken
+    when a profile IS active — the no-profile fast path never reaches
+    here.
+    """
+
+    __slots__ = ("_mu", "_phase_ns", "_active", "_bytes", "_slices",
+                 "remotes", "start_ns", "end_ns", "backend", "tags")
+
+    def __init__(self, backend: Optional[str] = None):
+        self._mu = threading.Lock()
+        self._phase_ns: Dict[str, int] = {}
+        # phase -> [depth, outermost_start_ns]
+        self._active: Dict[str, List[int]] = {}
+        self._bytes: Dict[str, int] = {}
+        self._slices: List[Dict[str, Any]] = []
+        self.remotes: List[Dict[str, Any]] = []
+        self.start_ns = time.monotonic_ns()
+        self.end_ns: Optional[int] = None
+        self.backend = backend or default_backend()
+        self.tags: Dict[str, Any] = {}
+
+    # -- phase timers ----------------------------------------------------
+
+    def _enter(self, name: str) -> None:
+        now = time.monotonic_ns()
+        with self._mu:
+            ent = self._active.get(name)
+            if ent is None:
+                self._active[name] = [1, now]
+            else:
+                ent[0] += 1
+
+    def _exit(self, name: str) -> None:
+        now = time.monotonic_ns()
+        with self._mu:
+            ent = self._active.get(name)
+            if ent is None:  # unbalanced exit: ignore rather than raise
+                return
+            ent[0] -= 1
+            if ent[0] <= 0:
+                del self._active[name]
+                self._phase_ns[name] = (self._phase_ns.get(name, 0)
+                                        + now - ent[1])
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def add_phase_ns(self, name: str, ns: int) -> None:
+        """Credit already-measured wall time to a phase (for callers
+        that timed a region themselves, e.g. staging stats)."""
+        with self._mu:
+            self._phase_ns[name] = self._phase_ns.get(name, 0) + int(ns)
+
+    # -- byte counters / breakdowns --------------------------------------
+
+    def add_bytes(self, counter: str, n: int) -> None:
+        with self._mu:
+            self._bytes[counter] = self._bytes.get(counter, 0) + int(n)
+
+    def add_slice(self, **kv) -> None:
+        """One row of the per-slice / per-device breakdown. Bounded:
+        a 1B-column index has ~1000 slices and the breakdown is for
+        humans, so keep the first 256 rows and count the rest."""
+        with self._mu:
+            if len(self._slices) < 256:
+                self._slices.append(kv)
+            else:
+                self.tags["slices_truncated"] = \
+                    self.tags.get("slices_truncated", 0) + 1
+
+    def tag(self, **kv) -> "QueryProfile":
+        with self._mu:
+            self.tags.update(kv)
+        return self
+
+    def merge_remote(self, host: str, section: Dict[str, Any]) -> None:
+        """Attach a remote node's profile section (parsed from the
+        X-Pilosa-Profile response header). Remote phases stay in their
+        own section — the coordinator's fanout_remote phase already
+        brackets the remote wall time, so folding them into the local
+        totals would double-count."""
+        with self._mu:
+            self.remotes.append({"host": host, **section})
+
+    # -- lifecycle / output ----------------------------------------------
+
+    def finish(self) -> None:
+        if self.end_ns is None:
+            self.end_ns = time.monotonic_ns()
+
+    @property
+    def total_us(self) -> float:
+        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        return (end - self.start_ns) / 1e3
+
+    def phase_us(self, name: str) -> float:
+        with self._mu:
+            return self._phase_ns.get(name, 0) / 1e3
+
+    def roofline(self) -> Dict[str, Any]:
+        """Achieved bytes/s against the backend's peak.
+
+        The engine that touched the bytes decides the denominator: a
+        device-dispatched query is judged against HBM peak over the
+        device_exec phase; a host-folded one against the measured host
+        memory bandwidth over the host_fold phase.
+        """
+        with self._mu:
+            dev_ns = self._phase_ns.get("device_exec", 0)
+            host_ns = self._phase_ns.get("host_fold", 0)
+            touched = self._bytes.get("bytes_touched_hbm", 0)
+        if dev_ns > 0:
+            engine, ns = "device", dev_ns
+        else:
+            engine, ns = "host", host_ns
+        out: Dict[str, Any] = {"engine": engine,
+                               "bytes_touched": touched}
+        if ns <= 0 or touched <= 0:
+            out["achieved_bytes_per_s"] = 0.0
+            out["fraction_of_peak"] = 0.0
+            return out
+        achieved = touched / (ns / 1e9)
+        peak = peak_bytes_per_s(self.backend if engine == "device"
+                                else "host")
+        out["achieved_bytes_per_s"] = round(achieved, 1)
+        out["peak_bytes_per_s"] = round(peak, 1)
+        out["fraction_of_peak"] = round(achieved / peak, 6) if peak else 0.0
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._mu:
+            phase_ns = dict(self._phase_ns)
+            # Credit still-open phases up to now so a mid-flight dump
+            # (or a caller that forgot an exit) stays roughly honest.
+            now = time.monotonic_ns()
+            for name, (_, t0) in self._active.items():
+                phase_ns[name] = phase_ns.get(name, 0) + now - t0
+            bts = dict(self._bytes)
+            slices = list(self._slices)
+            remotes = list(self.remotes)
+            tags = dict(self.tags)
+        ordered = {name: round(phase_ns[name] / 1e3, 1)
+                   for name in PHASES if name in phase_ns}
+        for name in sorted(phase_ns):
+            if name not in ordered:
+                ordered[name] = round(phase_ns[name] / 1e3, 1)
+        out: Dict[str, Any] = {
+            "backend": self.backend,
+            "total_us": round(self.total_us, 1),
+            "phases_us": ordered,
+            "bytes": bts,
+            "roofline": self.roofline(),
+        }
+        if slices:
+            out["slices"] = slices
+        if remotes:
+            out["remotes"] = remotes
+        if tags:
+            out["tags"] = tags
+        return out
+
+
+# -- contextvar plumbing -------------------------------------------------
+
+
+def current() -> Optional[QueryProfile]:
+    return CURRENT_PROFILE.get()
+
+
+def activate(prof: QueryProfile):
+    """Make `prof` the ambient profile; returns the reset token."""
+    return CURRENT_PROFILE.set(prof)
+
+
+def deactivate(token) -> None:
+    CURRENT_PROFILE.reset(token)
+
+
+def phase(name: str):
+    """Phase timer on the ambient profile, or the shared no-op when
+    none is active. The inactive case is the fast path: one ContextVar
+    read, no allocation."""
+    prof = CURRENT_PROFILE.get()
+    if prof is None:
+        return NOOP_PHASE
+    return _Phase(prof, name)
+
+
+def add_bytes(counter: str, n: int) -> None:
+    prof = CURRENT_PROFILE.get()
+    if prof is not None:
+        prof.add_bytes(counter, n)
+
+
+def add_slice(**kv) -> None:
+    prof = CURRENT_PROFILE.get()
+    if prof is not None:
+        prof.add_slice(**kv)
+
+
+# -- backend + peak resolution -------------------------------------------
+
+_BACKEND: Optional[str] = None
+
+
+def default_backend() -> str:
+    """Cached jax.default_backend(); "cpu" when jax is unavailable or
+    uninitialized (config printing, docs builds)."""
+    global _BACKEND
+    b = _BACKEND
+    if b is None:
+        try:
+            import jax
+            b = str(jax.default_backend())
+        except Exception:
+            b = "cpu"
+        _BACKEND = b
+    return b
+
+
+def peak_bytes_per_s(backend: str) -> float:
+    """Per-backend peak memory bandwidth (config.py owns the table;
+    lazy import — config imports parallel which imports obs)."""
+    from .. import config as _config
+    return _config.peak_memory_bandwidth(backend)
+
+
+# -- process-wide phase histograms (exported at /metrics) ----------------
+
+
+class ProfileStats:
+    """log₂ histograms per (phase, backend) plus the latest roofline
+    measurement per backend. Every profiled query — explicit
+    ?profile=true or sampled via [obs] profile-sample-rate — records
+    here, so /metrics carries continuous cost attribution."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._phase: Dict[tuple, Histogram] = {}
+        # backend -> (fraction_of_peak, achieved_bytes_per_s, count)
+        self._roofline: Dict[str, tuple] = {}
+
+    def record(self, prof: QueryProfile) -> None:
+        d = prof.to_dict()
+        backend = d["backend"]
+        with self._mu:
+            for name, us in d["phases_us"].items():
+                h = self._phase.get((name, backend))
+                if h is None:
+                    h = self._phase[(name, backend)] = Histogram()
+                h.observe(us)
+        rf = d["roofline"]
+        if rf.get("fraction_of_peak"):
+            with self._mu:
+                prev = self._roofline.get(backend, (0.0, 0.0, 0))
+                self._roofline[backend] = (rf["fraction_of_peak"],
+                                           rf["achieved_bytes_per_s"],
+                                           prev[2] + 1)
+
+    def snapshot(self):
+        with self._mu:
+            return dict(self._phase), dict(self._roofline)
+
+    def families(self):
+        """MetricFamily bridge for a /metrics collector."""
+        from .prom import MetricFamily
+        phases, roofs = self.snapshot()
+        fams = []
+        if phases:
+            fam = MetricFamily(
+                "pilosa_query_phase_us", "histogram",
+                "Measured per-phase query wall time (microseconds).")
+            for (name, backend), h in sorted(phases.items()):
+                fam.add_histogram(h, {"phase": name, "backend": backend})
+            fams.append(fam)
+        if roofs:
+            fam = MetricFamily(
+                "pilosa_roofline_fraction", "gauge",
+                "Most recent measured fraction of peak memory bandwidth.")
+            bw = MetricFamily(
+                "pilosa_roofline_bytes_per_second", "gauge",
+                "Most recent measured achieved bytes/s.")
+            for backend, (frac, bps, _n) in sorted(roofs.items()):
+                fam.add(frac, {"backend": backend})
+                bw.add(bps, {"backend": backend})
+            fams.append(fam)
+            fams.append(bw)
+        return fams
+
+
+STATS = ProfileStats()

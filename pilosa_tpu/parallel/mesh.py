@@ -53,6 +53,7 @@ except AttributeError:  # older jax: no VMA checker, marking is a no-op
         return x
 
 from .. import SLICE_WIDTH
+from ..obs import get_logger, profile
 from ..obs import span as obs_span
 from ..ops.pool import CONTAINER_WORDS, INVALID_KEY, ROW_SPAN, FragmentPool
 from .plan import _tree_signature, eval_tree
@@ -176,6 +177,7 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
 
     t0 = _time.monotonic()
     h2d_sp = obs_span("h2d", slices=s_pad)
+    h2d_ph = profile.phase("stage_h2d").start()
     # Keys (small, s_pad*cap*4 B) pack fully on every host; the sorted
     # container order is kept for the words pack below.
     keys = np.full((s_pad, cap), INVALID_KEY, dtype=np.int32)
@@ -245,9 +247,7 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
             # transfer would stack partial + whole pool in HBM. Loudly
             # recorded — a silent fallback would read as a mysterious
             # staging regression.
-            import logging
-
-            logging.getLogger("pilosa_tpu.mesh").warning(
+            get_logger("mesh").warning(
                 "per-device staging failed (%s: %s); falling back to "
                 "whole-pool placement", type(fb_err).__name__, fb_err)
             if stats_out is not None:
@@ -264,6 +264,8 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
         stats_out["h2d_chunk_slices"] = chunk_slices
     h2d_sp.tag(h2d_bytes=h2d_bytes + keys.nbytes,
                chunk_slices=chunk_slices).finish()
+    h2d_ph.stop()
+    profile.add_bytes("bytes_staged", h2d_bytes + keys.nbytes)
     idx = ShardedIndex(keys=keys_arr, words=words_arr)
     if with_host_keys:
         return idx, row_ids, keys

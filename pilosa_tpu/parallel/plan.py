@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import time
 import weakref
 from typing import List, Optional
 
@@ -29,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import profile
 from ..obs import span as obs_span
 from ..ops.pool import gather_row
 from ..core.view import VIEW_INVERSE, VIEW_STANDARD
@@ -521,14 +523,26 @@ class HostCountPlan:
         the whole batch decline: the executor then falls back to the
         per-slice map_fn, which handles None slice-by-slice."""
         slices = list(slices)
-        with obs_span("host_fold", slices=len(slices)) as sp:
+        with obs_span("host_fold", slices=len(slices)) as sp, \
+                profile.phase("host_fold"):
+            prof = profile.current()
             total = 0
             for s in slices:
+                t0 = time.monotonic_ns() if prof is not None else 0
                 n = self.count_slice(s)
                 if n is None:
                     sp.tag(declined=True)
                     return None
                 total += n
+                if prof is not None:
+                    # Every leaf block is a dense 16x1024 uint64 read
+                    # (128 KiB), memo hits aside — the fold's memory
+                    # traffic, which the host roofline divides by.
+                    prof.add_bytes("bytes_touched_hbm",
+                                   len(self.leaves) * 16 * 1024 * 8)
+                    prof.add_slice(
+                        slice=int(s), engine="host_fold", count=int(n),
+                        us=round((time.monotonic_ns() - t0) / 1e3, 1))
             return total
 
 

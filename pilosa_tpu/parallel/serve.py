@@ -38,10 +38,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.fragment import MUTATION_EPOCH
-from ..obs import StatMap, jax_scope, span
+from ..obs import StatMap, jax_scope, profile, span
 from ..ops.pool import (
     CONTAINER_WORDS,
     INVALID_KEY,
+    ROW_SPAN,
     fold_log_entries,
     plan_slice_mutations,
 )
@@ -531,6 +532,9 @@ class MeshManager:
         t0 = time.monotonic()
         sp = span("stage", index=index, frame=frame, view=view,
                   slices=num_slices)
+        # Union-interval semantics: build_sharded_index re-enters the
+        # same phase inside; only this outermost bracket counts.
+        ph = profile.phase("stage_h2d").start()
         old = self._views.get(key)
         if old is not None:
             self._purge_memo(old.sharded.words)
@@ -578,6 +582,7 @@ class MeshManager:
             lambda elapsed, ok=True, sv=sv:
                 self._record_stage_sample(sv, elapsed, ok))
         sp.finish()
+        ph.stop()
         return sv
 
     def _record_stage_sample(self, sv: StagedView, elapsed: float,
@@ -1033,7 +1038,8 @@ class MeshManager:
         attribute first-shape serving stalls to the program family
         that paid them."""
         t0 = time.monotonic()
-        fn = build()
+        with profile.phase("compile"):
+            fn = build()
         us = int((time.monotonic() - t0) * 1e6)
         self.compile_stats.inc(f"{entry}_count")
         self.compile_stats.inc(f"{entry}_us", us)
@@ -1770,7 +1776,22 @@ class MeshManager:
             req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
             self._ensure_batch_thread()
             self._batch_q.put(req)
-            req.done.wait()
+            prof = profile.current()
+            if prof is None:
+                req.done.wait()
+            else:
+                # Batched dispatch runs on the batch thread; from here
+                # the wait IS device execution + readback (the fetcher
+                # sets done after np.asarray). Attributed as
+                # device_exec — the D2H split would need per-request
+                # timestamps on the fetcher, not worth a hot-path field.
+                with prof.phase("device_exec"):
+                    req.done.wait()
+                prof.add_bytes("bytes_touched_hbm",
+                               len(leaves) * len(slices)
+                               * ROW_SPAN * CONTAINER_WORDS * 4)
+                prof.add_slice(engine="device_batched",
+                               leaves=len(leaves), slices=len(slices))
             if req.error is not None:
                 _reraise_shared("batched device count", req.error)
             self.stats.inc("count")
@@ -1807,11 +1828,36 @@ class MeshManager:
                 key, lambda: self._timed_build(
                     "fused", lambda: compile_serve_count_fused(
                         self.mesh, json.loads(sig), len(leaves))))
-            with jax_scope("pilosa:count_fused"):
-                limbs = fn(words_t, idx_all, hit_all, mask)
+            prof = profile.current()
+            if prof is None:
+                # THE fast path: async dispatch, no completion wait —
+                # combine_count's device_get is the only sync point.
+                with jax_scope("pilosa:count_fused"):
+                    limbs = fn(words_t, idx_all, hit_all, mask)
+            else:
+                # Profiled: bracket the dispatch with block_until_ready
+                # so device_exec is the kernel's wall time and
+                # readback_d2h is ONLY the D2H fetch. The bracketing
+                # serializes dispatch/readback — profiling observes a
+                # (slightly) slowed query, never the other way around.
+                with prof.phase("device_exec"), \
+                        jax_scope("pilosa:count_fused"):
+                    limbs = fn(words_t, idx_all, hit_all, mask)
+                    limbs.block_until_ready()
+                # Each leaf gathers ROW_SPAN containers per slice.
+                prof.add_bytes("bytes_touched_hbm",
+                               len(leaves) * len(slices)
+                               * ROW_SPAN * CONTAINER_WORDS * 4)
+                prof.add_bytes("bytes_read_back",
+                               int(getattr(limbs, "nbytes", 0)))
+                prof.add_slice(engine="device_fused",
+                               leaves=len(leaves), slices=len(slices),
+                               devices=self.mesh.devices.size
+                               if self.mesh is not None else 1)
             self.stats.inc("device_dispatches")
             self.stats.inc("lone_fused")
-            return (combine_count(limbs),)
+            with profile.phase("readback_d2h"):
+                return (combine_count(limbs),)
         except Exception:  # noqa: BLE001 — fast path only; chained path
             return None    # re-resolves and surfaces real errors
 

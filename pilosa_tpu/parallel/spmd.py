@@ -392,9 +392,9 @@ class SpmdServer:
         (broadcast_one_to_all blocks until ALL processes enter), so a
         failed execute logs and keeps following."""
         assert self.rank != 0, "rank 0 drives; workers follow"
-        import logging
+        from ..obs import get_logger
 
-        log = logging.getLogger("pilosa_tpu.spmd")
+        log = get_logger("spmd")
         while True:
             # The COLLECTIVE runs outside any catch: a distributed-
             # runtime error (dead coordinator, heartbeat loss — even

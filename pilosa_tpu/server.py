@@ -213,6 +213,9 @@ class Server:
         # metrics-sample-interval).
         self.handler.metrics_sample_interval = (
             self.config.metrics_sample_interval)
+        # Continuous-profiling cadence ([obs] profile-sample-rate;
+        # 0 = only on explicit ?profile=true).
+        self.handler.profile_sample_rate = self.config.profile_sample_rate
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd
