@@ -1294,6 +1294,48 @@ def main():
             f"1-in-16 sampling overhead {samp_overhead:.1%} exceeds " \
             f"the 8% guard"
 
+    with section("sched_overhead"):
+        # Scheduler idle fast path: a lone query through submit()/done()
+        # on an otherwise-empty scheduler (nothing queued, nothing in
+        # flight) must cost under 2% of the unscheduled path — the
+        # admission gate is one lock hold, one monotonic read, and a
+        # cached estimate, with no dispatcher hop and no window.
+        # Alternating best-of-rounds like the guards above.
+        _progress("scheduler idle fast-path overhead")
+        from pilosa_tpu.sched import QueryScheduler as _QS
+
+        _sch = _QS()
+
+        def sched_dt(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                MUTATION_EPOCH.bump_structural()
+                _cold_rows()
+                tk = _sch.submit("default", None)
+                try:
+                    e.execute("i", q1)
+                finally:
+                    _sch.done(tk)
+            return (time.perf_counter() - t0) / n
+
+        base_best = sched_best = float("inf")
+        for _ in range(7):
+            base_best = min(base_best, fresh_dt(n_lone))
+            sched_best = min(sched_best, sched_dt(n_lone))
+        overhead = sched_best / base_best - 1.0
+        details["sched_overhead"] = {
+            "plain_ms": base_best * 1e3,
+            "scheduled_ms": sched_best * 1e3,
+            "overhead_frac": overhead,
+            "fastpath_admits": _sch.stats["fastpath"]}
+        # Every admit must have taken the fast path — a queued admit
+        # here would mean the idle scheduler spun up its dispatcher.
+        assert _sch.stats["fastpath"] == _sch.stats["admitted"]
+        _sch.close()
+        assert overhead < 0.02, \
+            f"scheduler idle fast-path overhead {overhead:.1%} " \
+            f"exceeds the 2% guard"
+
     with section("serving_concurrent16_qps"):
         # concurrent clients: 16 threads, every query a DISTINCT 3-leaf
         # Intersect (each query text appears exactly once across

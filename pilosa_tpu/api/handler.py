@@ -73,6 +73,7 @@ from ..errors import (
 )
 from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
+from ..sched import AdmissionError
 from ..utils.stats import ExpvarStats
 from .. import fault
 from .. import obs
@@ -364,6 +365,8 @@ def _proto_resp(msg, status: int = 200) -> Response:
 def _error_status(err: Exception) -> int:
     if isinstance(err, DeadlineExceededError):
         return 504
+    if isinstance(err, AdmissionError):
+        return 429
     if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
                         FragmentNotFoundError)):
         return 404
@@ -444,6 +447,13 @@ class Handler:
         # section. The counter is monotonic across all queries.
         self.profile_sample_rate = 0
         self._profile_seq = itertools.count(1)
+        # Adaptive query scheduler (sched.QueryScheduler, server
+        # wiring; [sched] config). When set, POST /query goes through
+        # admission control — tenant from X-Pilosa-Tenant, shed answers
+        # HTTP 429 + Retry-After, queue wait is profiled as sched_wait
+        # and counts against the query deadline. None = no scheduling
+        # (embedded/test handlers behave exactly as before).
+        self.scheduler = None
         self._prom = obs.prom.Registry()
         self._register_collectors()
         self._routes: List[Route] = []
@@ -575,6 +585,7 @@ class Handler:
         reg.register_collector(self._collect_device)
         reg.register_collector(self._collect_caches)
         reg.register_collector(self._collect_cluster)
+        reg.register_collector(self._collect_sched)
         reg.register_collector(self._collect_fragments)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
@@ -723,6 +734,45 @@ class Handler:
             fams.append(f)
         return fams
 
+    def _collect_sched(self) -> list:
+        """Scheduler telemetry: queue depth by tenant (plus an 'all'
+        total), shed/admitted/expired counters, queue-wait and
+        cohort-size histograms. Empty when no scheduler is wired."""
+        s = self.scheduler
+        if s is None:
+            return []
+        prom = obs.prom
+        depth = prom.MetricFamily(
+            "pilosa_sched_queue_depth", "gauge",
+            "Admitted queries waiting for dispatch, by tenant "
+            "('all' = total).")
+        for tenant, n in sorted(s.queue_depths().items()):
+            depth.add(n, {"tenant": tenant})
+        st = s.stats.copy()
+        shed = prom.MetricFamily(
+            "pilosa_sched_shed_total", "counter",
+            "Requests shed at admission (HTTP 429), by reason.")
+        shed.add(st.get("shed_deadline", 0), {"reason": "deadline"})
+        shed.add(st.get("shed_queue_full", 0), {"reason": "queue_full"})
+        adm = prom.MetricFamily(
+            "pilosa_sched_admitted_total", "counter",
+            "Admitted queries by path (fastpath = idle, no queuing).")
+        adm.add(st.get("fastpath", 0), {"path": "fastpath"})
+        adm.add(st.get("queued", 0), {"path": "queued"})
+        exp = prom.MetricFamily(
+            "pilosa_sched_expired_total", "counter",
+            "Queries whose deadline expired while queued (HTTP 504).")
+        exp.add(st.get("expired_in_queue", 0))
+        wait = prom.MetricFamily(
+            "pilosa_sched_wait_microseconds", "histogram",
+            "Queue wait from admission to dispatch (log2 buckets, µs).")
+        wait.add_histogram(s.wait_hist)
+        batch = prom.MetricFamily(
+            "pilosa_sched_batch_size", "histogram",
+            "Released cohort sizes (>1 = coalesced arrivals).")
+        batch.add_histogram(s.batch_hist)
+        return [depth, shed, adm, exp, wait, batch]
+
     def _collect_fragments(self) -> list:
         """Sampled fragment gauges, cached for metrics_sample_interval
         seconds: scrapers poll, and even a cheap walk is O(fragments)."""
@@ -797,6 +847,10 @@ class Handler:
                 cluster["breakers"] = breakers.snapshot()
             if cluster:
                 snap = dict(snap, cluster=cluster)
+        # Scheduler state: queue depths, shed/admit counters, wait and
+        # cohort-size percentiles (sched.QueryScheduler.snapshot).
+        if self.scheduler is not None:
+            snap = dict(snap, sched=self.scheduler.snapshot())
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:
@@ -961,11 +1015,22 @@ class Handler:
             "  cmdline       process command line\n\n"
             "other /debug endpoints:\n"
             "  /debug/vars         stats snapshot (counters + query "
-            "latency p50/p95/p99)\n"
+            "latency p50/p95/p99; sched = scheduler queue/shed state)\n"
             "  /debug/queries      recent + slow query trace rings "
             "(?threshold_us=N re-filters)\n"
             "  /debug/traces/<id>  one query trace, all spans with "
-            "timings and tags\n\n")
+            "timings and tags\n\n"
+            "query scheduling (when [sched] enabled):\n"
+            "  POST /index/<i>/query reads X-Pilosa-Tenant for fair "
+            "queuing; overload answers\n"
+            "  429 + Retry-After instead of queuing doomed work; "
+            "queue wait counts against the\n"
+            "  query deadline (?deadline= / X-Pilosa-Deadline-Us) and "
+            "profiles as sched_wait.\n"
+            "  /metrics exports pilosa_sched_queue_depth{tenant}, "
+            "pilosa_sched_shed_total{reason},\n"
+            "  pilosa_sched_wait_microseconds, "
+            "pilosa_sched_batch_size.\n\n")
         dump = self._thread_dump_text()
         return Response(200, {"Content-Type": "text/plain; charset=utf-8"},
                         (index + dump).encode())
@@ -1242,22 +1307,13 @@ class Handler:
         if params.get("explain") == "true" and not remote:
             return self._explain_query(index, query, slices, headers, opt)
 
-        # Trace lifecycle: every query records a trace into the
-        # bounded rings behind /debug/queries. A remote fan-out leg
-        # joins the coordinator's trace id (X-Pilosa-Trace) and ships
-        # its spans back in the X-Pilosa-Trace-Spans response header,
-        # where InternalClient grafts them under the fan-out span.
-        th = headers.get("x-pilosa-trace", "") if remote else ""
-        trace = self.tracer.start(
-            "query", trace_id=th.partition(":")[0] or None,
-            index=index, query=query[:256], remote=bool(remote),
-            node=self.host)
-
         # Measured profile (the EXPLAIN ANALYZE counterpart): explicit
         # ?profile=true, a coordinator's X-Pilosa-Profile request
         # header on a remote leg, or the sampled 1-in-N cadence. The
         # profile activates via contextvar exactly like the tracer;
         # with none of the three, profiling code below never allocates.
+        # Activated BEFORE admission so a profiled query's queue wait
+        # shows up as the sched_wait phase.
         want_profile = params.get("profile") == "true" and not remote
         remote_profile = bool(remote
                               and headers.get("x-pilosa-profile"))
@@ -1268,17 +1324,54 @@ class Handler:
         if want_profile or remote_profile or sampled:
             prof = obs.profile.QueryProfile()
             ptoken = obs.profile.activate(prof)
+        ticket = None
+        trace = None
         try:
-            with trace.root:
-                resp = self._run_query(index, query, slices, column_attrs,
-                                       remote, headers, opt,
-                                       profile_section=want_profile)
+            # Admission gate (sched.QueryScheduler, when wired):
+            # deadline-aware shedding answers 429 + Retry-After before
+            # any work queues; a deadline expiring while queued is an
+            # immediate 504; tenants queue fairly by X-Pilosa-Tenant.
+            # Remote fan-out legs bypass it — the coordinator already
+            # paid admission for the whole query, and gating each leg
+            # again would double-queue one logical request.
+            if self.scheduler is not None and not remote:
+                tenant = headers.get("x-pilosa-tenant", "") or "default"
+                try:
+                    with obs.profile.phase("sched_wait"):
+                        ticket = self.scheduler.submit(
+                            tenant=tenant, deadline=opt.deadline)
+                except AdmissionError as e:
+                    self.stats.count("query.shed", 1)
+                    return self._shed_response(e, headers)
+                except DeadlineExceededError as e:
+                    return self._query_error(e, headers)
+
+            # Trace lifecycle: every query records a trace into the
+            # bounded rings behind /debug/queries. A remote fan-out leg
+            # joins the coordinator's trace id (X-Pilosa-Trace) and
+            # ships its spans back in the X-Pilosa-Trace-Spans response
+            # header, where InternalClient grafts them under the
+            # fan-out span.
+            th = headers.get("x-pilosa-trace", "") if remote else ""
+            trace = self.tracer.start(
+                "query", trace_id=th.partition(":")[0] or None,
+                index=index, query=query[:256], remote=bool(remote),
+                node=self.host)
+            try:
+                with trace.root:
+                    resp = self._run_query(index, query, slices,
+                                           column_attrs, remote, headers,
+                                           opt,
+                                           profile_section=want_profile)
+            finally:
+                self.tracer.finish(trace)
         finally:
+            if ticket is not None:
+                self.scheduler.done(ticket)
             if prof is not None:
                 obs.profile.deactivate(ptoken)
                 prof.finish()
                 obs.profile.STATS.record(prof)
-            self.tracer.finish(trace)
         if th:
             resp.headers["X-Pilosa-Trace-Spans"] = json.dumps(
                 trace.serialize_spans(), separators=(",", ":"))
@@ -1395,6 +1488,20 @@ class Handler:
         if self._accepts_proto(headers):
             return _proto_resp(pb.QueryResponse(err=str(e)), status)
         return _json_resp({"error": str(e)}, status)
+
+    def _shed_response(self, e: AdmissionError, headers) -> Response:
+        """Admission shed: HTTP 429 with a Retry-After header (whole
+        seconds, >= 1 — 'do not retry sooner than this') so well-behaved
+        clients back off instead of hammering an overloaded node into
+        504 deadline blowouts."""
+        retry = max(1, int(round(e.retry_after_s)))
+        if self._accepts_proto(headers):
+            resp = _proto_resp(pb.QueryResponse(err=str(e)), 429)
+        else:
+            resp = _json_resp({"error": str(e), "reason": e.reason,
+                               "retry_after_s": retry}, 429)
+        resp.headers["Retry-After"] = str(retry)
+        return resp
 
     def _column_attr_sets(self, index: str, results) -> List[Tuple[int, dict]]:
         """Attrs for every column appearing in row results

@@ -41,6 +41,20 @@ TOML schema:
     format = "text"             # text | json (trace/span-id injected)
     path = ""                   # empty = stderr; overrides log-path
 
+    [sched]
+    enabled = true              # adaptive query scheduler (sched/):
+                                # admission control + batching window +
+                                # per-tenant fairness on POST /query
+    max-window-us = 2000        # batching-window cap under herds
+    idle-window-us = 150        # per-pending-request window growth
+    queue-depth = 256           # bounded admission queue; overflow
+                                # sheds with HTTP 429 + Retry-After
+    default-service-us = 1500   # service-time floor before any
+                                # latency has been measured
+
+    [sched.tenant-weights]      # X-Pilosa-Tenant -> WFQ weight
+    # gold = 4                  # (unlisted tenants weigh 1)
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -168,6 +182,16 @@ class Config:
         self.log_level: str = "info"
         self.log_format: str = "text"
         self.log_file: str = ""
+        # [sched] — adaptive query scheduler (sched/): deadline-aware
+        # admission (429 + Retry-After shedding), adaptive batching
+        # window feeding the mesh batch loop, per-tenant weighted fair
+        # queues keyed by the X-Pilosa-Tenant header.
+        self.sched_enabled: bool = True
+        self.sched_max_window_us: float = 2000.0
+        self.sched_idle_window_us: float = 150.0
+        self.sched_queue_depth: int = 256
+        self.sched_default_service_us: float = 1500.0
+        self.sched_tenant_weights: dict = {}
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -230,6 +254,19 @@ class Config:
         c.log_level = str(lg.get("level", c.log_level))
         c.log_format = str(lg.get("format", c.log_format))
         c.log_file = str(lg.get("path", c.log_file))
+        sc = data.get("sched", {})
+        c.sched_enabled = bool(sc.get("enabled", c.sched_enabled))
+        c.sched_max_window_us = float(sc.get("max-window-us",
+                                             c.sched_max_window_us))
+        c.sched_idle_window_us = float(sc.get("idle-window-us",
+                                              c.sched_idle_window_us))
+        c.sched_queue_depth = int(sc.get("queue-depth",
+                                         c.sched_queue_depth))
+        c.sched_default_service_us = float(
+            sc.get("default-service-us", c.sched_default_service_us))
+        c.sched_tenant_weights = {
+            str(k): float(v)
+            for k, v in dict(sc.get("tenant-weights", {})).items()}
         return c
 
     def expanded_data_dir(self) -> str:
@@ -280,6 +317,16 @@ class Config:
             f'level = "{self.log_level}"\n'
             f'format = "{self.log_format}"\n'
             f'path = "{self.log_file}"\n'
+            f"\n[sched]\n"
+            f"enabled = {'true' if self.sched_enabled else 'false'}\n"
+            f"max-window-us = {int(self.sched_max_window_us)}\n"
+            f"idle-window-us = {int(self.sched_idle_window_us)}\n"
+            f"queue-depth = {self.sched_queue_depth}\n"
+            f"default-service-us = "
+            f"{int(self.sched_default_service_us)}\n"
+            f"\n[sched.tenant-weights]\n"
+            + "".join(f'"{k}" = {v}\n'
+                      for k, v in sorted(self.sched_tenant_weights.items()))
         )
 
 

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import SLICE_WIDTH
+from ..obs.log import get_logger
 from ..roaring import Bitmap
 from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_cache
 from .row import Row
@@ -437,9 +438,7 @@ class Fragment:
         if elapsed > 0.1:
             # Slow-snapshot visibility (the reference's track() logging,
             # fragment.go:1012-1020) — a write stall a client felt.
-            import logging
-
-            logging.getLogger("pilosa_tpu.fragment").info(
+            get_logger("fragment").info(
                 "slow snapshot: %s (%s/%s/%d) took %.0f ms",
                 self.path, self.frame, self.view, self.slice,
                 elapsed * 1e3)

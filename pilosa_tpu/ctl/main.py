@@ -486,6 +486,27 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
             lines.append(f"roofline {backend}: {frac:.3f} of peak "
                          f"({_fmt_bytes(bps)}/s)")
 
+    # Scheduler panel (pilosa_sched_* — only present when [sched] is
+    # enabled): live queue depth, shed rate over the scrape interval,
+    # and the coalesced-cohort size distribution.
+    depth = cur.get(("pilosa_sched_queue_depth", (("tenant", "all"),)))
+    if depth is not None:
+        shed_cur = sum(v for (name, _), v in cur.items()
+                       if name == "pilosa_sched_shed_total")
+        shed_prev = sum(v for (name, _), v in prev.items()
+                        if name == "pilosa_sched_shed_total") if prev else 0.0
+        shed_rate = ((shed_cur - shed_prev) / dt
+                     if prev and dt > 0 else 0.0)
+        line = (f"sched: queue {int(depth)}   shed {int(shed_cur)} "
+                f"({shed_rate:.1f}/s)")
+        pct = _hist_percentiles(cur, "pilosa_sched_batch_size", {})
+        if pct is not None and pct[3] > 0:
+            p50, p95, _, n_b = pct
+            line += (f"   batch p50 {p50:.0f} p95 {p95:.0f} "
+                     f"({n_b} cohorts)")
+        lines.append("")
+        lines.append(line)
+
     brk = [(dict(labels).get("host", ""), v)
            for (name, labels), v in sorted(cur.items())
            if name == "pilosa_breaker_state"]
@@ -507,7 +528,8 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
 
 def cmd_top(args) -> int:
     """Scrape /metrics on an interval and render a one-screen summary
-    (QPS, per-phase percentiles, roofline, breakers, HBM residency) —
+    (QPS, per-phase percentiles, roofline, scheduler queue/shed/batch,
+    breakers, HBM residency) —
     the operator's first-response tool."""
     import urllib.request
 

@@ -670,6 +670,32 @@ class Executor:
         /metrics backend-labeled histogram."""
         return dict(self._route_hists)
 
+    def estimate_service_us(self):
+        """Admission-control service-time estimate (sched/): p95 of
+        the busiest measured route's Count latency, in µs. None until
+        enough queries have been measured — the scheduler blends this
+        with its own observed latencies and a configured floor, so an
+        honest 'don't know yet' beats a guess here."""
+        best = None
+        best_n = 0
+        for h in list(self._route_hists.values()):
+            n = h.total
+            if n > best_n:
+                best, best_n = h, n
+        if best is None or best_n < 4:
+            return None
+        return best.percentile(0.95)
+
+    def burst_hint(self, n: int):
+        """Scheduler cohort-release hint: n coalesced queries are about
+        to arrive together, so the mesh batch loop should hold its
+        drain window open for the whole group (serve.expect_burst).
+        No-op before the manager exists — a hint must never force
+        device construction."""
+        mgr = self._mesh_mgr
+        if mgr is not None and n > 1:
+            mgr.expect_burst(n)
+
     @staticmethod
     def _kill_switches() -> list:
         """The routing kill-switch env vars currently set, for trace

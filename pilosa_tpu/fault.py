@@ -34,6 +34,10 @@ Injection points currently wired:
     handler.query     server side of POST /index/{i}/query (host,
                       index, remote) — a delay here is a slow node
     executor.fanout   coordinator-side remote fan-out (node)
+    sched.admit       query-scheduler admission (tenant) — a delay
+                      here is a stalled scheduler; an error (e.g. an
+                      armed sched.AdmissionError instance) forces
+                      deterministic sheds
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.

@@ -322,6 +322,14 @@ class MeshManager:
             "PILOSA_TPU_LONE_FUSED", "on").lower() not in ("off", "0")
         self._lone_mu = threading.Lock()
         self._counts_inflight = 0
+        # Scheduler cohort hint (sched.QueryScheduler.on_release via
+        # executor.burst_hint): >1 means a released cohort is landing
+        # together, so (a) the first member must NOT take the lone
+        # fused path — it would strand the rest in a narrower batch —
+        # and (b) the batch loop holds its drain window open even when
+        # the previous drain was lone. Decremented as requests drain.
+        self._burst_mu = threading.Lock()
+        self._burst_hint = 0
         self._apply_fn = None
         # EWMA (seconds) of measured incremental-apply cost — the other
         # side of refresh()'s cost gate (vs StagedView.last_stage_s) —
@@ -422,6 +430,10 @@ class MeshManager:
             # from /metrics without a profiler run.
             "compile_count": 0, "compile_us": 0,
             "h2d_chunk_slices": 0,
+            # Drains whose window was held open by a scheduler cohort
+            # hint (expect_burst) — how often the sched/ layer actually
+            # steered coalescing.
+            "sched_hinted": 0,
         })
         # Per-entry-point compile counters ({entry}_count/{entry}_us:
         # count, count_batch, coarse, row_counts, row_counts_src,
@@ -1491,6 +1503,16 @@ class MeshManager:
             except Exception:  # noqa: BLE001 — finisher handles errors
                 pass
 
+    def expect_burst(self, n: int):
+        """Scheduler cohort hint (sched/ via executor.burst_hint): n
+        requests were just released together. Without the hint, the
+        first arrival of a fresh herd either takes the lone fused path
+        or drains alone (last_group == 1 skips the window), and the
+        cohort fragments into two device programs; with it, the whole
+        cohort rides one drain into one shared-read batch."""
+        with self._burst_mu:
+            self._burst_hint += int(n)
+
     @staticmethod
     def _drain_window_s() -> float:
         """Herd drain window (PILOSA_TPU_BATCH_WINDOW_MS env, default
@@ -1520,8 +1542,10 @@ class MeshManager:
         while True:
             first = self._batch_q.get()
             reqs = [first]
+            with self._burst_mu:
+                hinted = self._burst_hint > 1
             deadline = (time.monotonic() + self._drain_window_s()
-                        if last_group > 1 else 0.0)
+                        if (last_group > 1 or hinted) else 0.0)
             while len(reqs) < self._MAX_BATCH:
                 try:
                     reqs.append(self._batch_q.get_nowait())
@@ -1534,6 +1558,12 @@ class MeshManager:
                     except queue.Empty:
                         break
             last_group = len(reqs)
+            with self._burst_mu:
+                if self._burst_hint:
+                    self._burst_hint = max(0,
+                                           self._burst_hint - len(reqs))
+            if hinted:
+                self.stats.inc("sched_hinted")
             groups: Dict[tuple, List[_CountRequest]] = {}
             for r in reqs:
                 groups.setdefault(r.group_key(), []).append(r)
@@ -1757,6 +1787,14 @@ class MeshManager:
         with self._lone_mu:
             self._counts_inflight += 1
             lone = self._counts_inflight == 1
+        if lone:
+            # A scheduler-released cohort arrives GIL-staggered: the
+            # first member would see itself alone and take the fused
+            # path, stranding the rest in a narrower batch. The burst
+            # hint says siblings are right behind — batch instead.
+            with self._burst_mu:
+                if self._burst_hint > 1:
+                    lone = False
         try:
             if lone and self.lone_fused:
                 out = self._lone_count(index, shape, leaves, slices,

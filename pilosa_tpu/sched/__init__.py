@@ -1,0 +1,14 @@
+"""Request-level query scheduling (ROADMAP item 4).
+
+The scheduler sits between the HTTP handler and the executor and
+decides three things the serving layers below cannot: when to hold
+concurrent arrivals so they coalesce into one device program (adaptive
+batching window), whether a request can meet its deadline at all
+(admission control — shed with 429 + Retry-After instead of queuing
+dead work), and who goes next when tenants compete (weighted fair
+queues keyed by the X-Pilosa-Tenant header).
+"""
+
+from .scheduler import AdmissionError, QueryScheduler
+
+__all__ = ["AdmissionError", "QueryScheduler"]

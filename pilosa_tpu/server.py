@@ -216,6 +216,25 @@ class Server:
         # Continuous-profiling cadence ([obs] profile-sample-rate;
         # 0 = only on explicit ?profile=true).
         self.handler.profile_sample_rate = self.config.profile_sample_rate
+        # Adaptive query scheduler ([sched]): deadline-aware admission
+        # (429 + Retry-After), adaptive batching window whose cohort
+        # releases hint the mesh batch loop (executor.burst_hint), and
+        # per-tenant weighted fair queues. Service-time estimates come
+        # from the scheduler's own observations, falling back to the
+        # executor's measured route latencies.
+        self.scheduler = None
+        if self.config.sched_enabled:
+            from .sched import QueryScheduler
+
+            self.scheduler = QueryScheduler(
+                max_window_us=self.config.sched_max_window_us,
+                idle_window_us=self.config.sched_idle_window_us,
+                queue_depth=self.config.sched_queue_depth,
+                default_service_us=self.config.sched_default_service_us,
+                tenant_weights=self.config.sched_tenant_weights,
+                estimator=self.executor.estimate_service_us,
+                on_release=self.executor.burst_hint)
+            self.handler.scheduler = self.scheduler
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd
@@ -288,6 +307,10 @@ class Server:
             except Exception as e:  # noqa: BLE001 — workers may be gone
                 self.logger.warning(f"spmd stop: {e}")
         self.closing.close()
+        # Drain the scheduler first: queued waiters are released
+        # pass-through so no HTTP thread blocks across shutdown.
+        if self.scheduler is not None:
+            self.scheduler.close()
         # Join the warm thread BEFORE holder.close(): a warm mid-load
         # after close would reopen a WAL fd on a fragment whose flock
         # was just released (leaked fd + unprotected writer).
