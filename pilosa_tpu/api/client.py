@@ -441,13 +441,18 @@ class InternalClient:
     # -- anti-entropy plane --------------------------------------------------
 
     def fragment_blocks(self, index: str, frame: str, view: str,
-                        slice_: int) -> List[Tuple[int, bytes]]:
+                        slice_: int,
+                        deadline: Optional[float] = None,
+                        ) -> List[Tuple[int, bytes]]:
         """GET /fragment/blocks -> [(block id, checksum)]; a replica
         that has not created the fragment yet reads as empty (client.go
         FragmentBlocks ErrFragmentNotFound tolerance,
-        fragment.go:1345)."""
+        fragment.go:1345). `deadline` is an absolute time.monotonic()
+        instant bounding socket waits and retries (the anti-entropy
+        loop must never hang on one sick peer)."""
         status, data = self._do("GET", "/fragment/blocks", params={
-            "index": index, "frame": frame, "view": view, "slice": slice_})
+            "index": index, "frame": frame, "view": view, "slice": slice_},
+            deadline=deadline)
         if status == 404:
             return []
         self._check(status, data, "fragment/blocks")
@@ -455,14 +460,17 @@ class InternalClient:
                 for b in json.loads(data.decode())["blocks"]]
 
     def block_data(self, index: str, frame: str, view: str, slice_: int,
-                   block: int) -> Tuple[List[int], List[int]]:
+                   block: int,
+                   deadline: Optional[float] = None,
+                   ) -> Tuple[List[int], List[int]]:
         """GET /fragment/block/data -> (row_ids, column_ids)
-        (client.go:849-888)."""
+        (client.go:849-888), deadline-bounded like fragment_blocks."""
         req = pb.BlockDataRequest(index=index, frame=frame, view=view,
                                   slice=slice_, block=block)
         status, data = self._do("GET", "/fragment/block/data",
                                 body=req.SerializeToString(),
-                                content_type=PROTOBUF_CT, accept=PROTOBUF_CT)
+                                content_type=PROTOBUF_CT, accept=PROTOBUF_CT,
+                                deadline=deadline)
         if status == 404:
             return [], []  # fragment not created on this replica yet
         self._check(status, data, "fragment/block/data")
@@ -508,6 +516,20 @@ class InternalClient:
             "index": index, "frame": frame, "view": view, "slice": slice_},
             body=tar_bytes, content_type="application/octet-stream")
         self._check(status, data, "fragment/data")
+
+    # -- membership control plane --------------------------------------------
+
+    def cluster_resize(self, action: str, **fields) -> dict:
+        """POST /cluster/resize?remote=true — ship a membership control
+        message (join/leave/cutover/complete) to a peer. remote=true
+        marks it already-coordinated so the peer applies it locally
+        without re-forwarding (no broadcast loops)."""
+        body = json.dumps(dict(fields, action=action)).encode()
+        status, data = self._do("POST", "/cluster/resize",
+                                params={"remote": "true"}, body=body,
+                                content_type="application/json")
+        self._check(status, data, "cluster/resize")
+        return json.loads(data.decode() or "{}")
 
     def backup_frame(self, index: str, frame: str, view: str,
                      max_slice: int) -> List[Tuple[int, bytes]]:

@@ -424,6 +424,10 @@ class Handler:
         # whose mutating bulk routes are rejected.
         self.spmd = None
         self.spmd_worker = False
+        # Live migration engine (parallel.Rebalancer, server wiring):
+        # POST /cluster/resize triggers it; None = membership changes
+        # apply without a coordinated data move (embedded/tests).
+        self.resizer = None
         # Guards tracemalloc start/stop from /debug/pprof/heap: the
         # handler is threaded, and crossed ?start/?stop pairs without
         # the lock could stop a trace another request thinks it owns.
@@ -487,6 +491,7 @@ class Handler:
         r("GET", r"/fragment/nodes", self._get_fragment_nodes)
         r("POST", r"/import", self._post_import)
         r("GET", r"/hosts", self._get_hosts)
+        r("POST", r"/cluster/resize", self._post_cluster_resize)
         r("GET", r"/schema", self._get_schema)
         r("GET", r"/slices/max", self._get_slice_max)
         r("GET", r"/status", self._get_status)
@@ -585,6 +590,7 @@ class Handler:
         reg.register_collector(self._collect_device)
         reg.register_collector(self._collect_caches)
         reg.register_collector(self._collect_cluster)
+        reg.register_collector(self._collect_membership)
         reg.register_collector(self._collect_sched)
         reg.register_collector(self._collect_fragments)
         # Measured-profile histograms (process-wide: every profiled
@@ -734,6 +740,48 @@ class Handler:
             fams.append(f)
         return fams
 
+    def _collect_membership(self) -> list:
+        """Elastic-cluster telemetry: per-node membership state (as a
+        number so dashboards can alert on it: 0=DOWN, 1=JOINING,
+        2=LEAVING, 3=UP), migration gauges from the rebalancer, and
+        the handoff-ledger depth. Empty without a cluster."""
+        if self.cluster is None:
+            return []
+        prom = obs.prom
+        order = {"DOWN": 0, "JOINING": 1, "LEAVING": 2, "UP": 3}
+        f = prom.MetricFamily(
+            "pilosa_member_state", "gauge",
+            "Membership state per node: 0=DOWN, 1=JOINING, 2=LEAVING, "
+            "3=UP/ACTIVE.")
+        for host, state in sorted(self.cluster.node_states().items()):
+            f.add(order.get(state, -1), {"host": host, "state": state})
+        fams = [f]
+        rz = self.resizer
+        if rz is not None:
+            snap = rz.snapshot()
+            mig = prom.MetricFamily(
+                "pilosa_migrations_in_flight", "gauge",
+                "Fragment transfers currently streaming.")
+            mig.add(snap["in_flight"])
+            byt = prom.MetricFamily(
+                "pilosa_migration_bytes_total", "counter",
+                "Total fragment bytes shipped by the rebalancer.")
+            byt.add(snap["bytes_total"])
+            outcome = prom.MetricFamily(
+                "pilosa_migrations_total", "counter",
+                "Completed fragment transfers by outcome.")
+            outcome.add(snap["completed"], {"outcome": "verified"})
+            outcome.add(snap["failed"], {"outcome": "failed"})
+            outcome.add(snap["checksum_mismatches"],
+                        {"outcome": "checksum_retry"})
+            hand = prom.MetricFamily(
+                "pilosa_handoff_slices", "gauge",
+                "Slices cut over to the target ring in the pending "
+                "resize (0 when not resizing).")
+            hand.add(snap["handoff_slices"])
+            fams.extend([mig, byt, outcome, hand])
+        return fams
+
     def _collect_sched(self) -> list:
         """Scheduler telemetry: queue depth by tenant (plus an 'all'
         total), shed/admitted/expired counters, queue-wait and
@@ -840,13 +888,22 @@ class Handler:
         # executor's injected ClusterClient (absent under test fakes).
         cc = getattr(self.executor, "client", None)
         cstats = getattr(cc, "stats", None)
+        cluster = {}
         if cstats is not None and hasattr(cstats, "copy"):
             cluster = dict(cstats.copy())
             breakers = getattr(cc, "breakers", None)
             if breakers is not None:
                 cluster["breakers"] = breakers.snapshot()
-            if cluster:
-                snap = dict(snap, cluster=cluster)
+        # Elastic membership: per-node states, the handoff ledger
+        # depth, and the rebalancer's live migration snapshot.
+        if self.cluster is not None:
+            cluster["members"] = self.cluster.node_states()
+            cluster["resizing"] = self.cluster.resizing()
+            cluster["handoff_slices"] = self.cluster.handoff_count()
+        if self.resizer is not None:
+            cluster["rebalance"] = self.resizer.snapshot()
+        if cluster:
+            snap = dict(snap, cluster=cluster)
         # Scheduler state: queue depths, shed/admit counters, wait and
         # cohort-size percentiles (sched.QueryScheduler.snapshot).
         if self.scheduler is not None:
@@ -1142,6 +1199,72 @@ class Handler:
     def _get_hosts(self, pv, params, headers, body) -> Response:
         nodes = self.cluster.nodes if self.cluster else []
         return _json_resp([n.to_dict() for n in nodes])
+
+    def _post_cluster_resize(self, pv, params, headers, body) -> Response:
+        """Admin + control endpoint for elastic membership.
+
+        Actions (JSON body {"action": ..., ...}):
+          join     {host}          node enters the ring as JOINING
+          leave    {host}          ACTIVE node becomes LEAVING
+          cutover  {index, slice}  slice now serves from the target ring
+          complete {}              promote JOINING, drop LEAVING
+          status   {}              read-only snapshot
+
+        `?remote=true` marks a coordinator's control fan-out: apply
+        locally, never re-forward (loop guard), never start a second
+        migration. The admin call (no remote flag) lands on ONE node —
+        that node forwards the membership change to every peer and
+        becomes the migration coordinator.
+        """
+        if self.cluster is None:
+            return _json_resp({"error": "no cluster"}, 501)
+        msg = json.loads(body.decode() or "{}")
+        action = str(msg.get("action", params.get("action", "")))
+        remote = params.get("remote") == "true"
+        c = self.cluster
+        try:
+            if action == "join":
+                c.begin_join(str(msg["host"]))
+            elif action == "leave":
+                c.begin_leave(str(msg["host"]))
+            elif action == "cutover":
+                c.mark_handed_off(str(msg["index"]), int(msg["slice"]))
+            elif action == "complete":
+                c.complete_resize()
+            elif action != "status":
+                return _json_resp(
+                    {"error": f"unknown action: {action!r} (want join, "
+                     "leave, cutover, complete, or status)"}, 400)
+        except KeyError as e:
+            return _json_resp({"error": f"missing field: {e}"}, 400)
+        except ValueError as e:
+            return _json_resp({"error": str(e)}, 400)
+        if not remote and action in ("join", "leave"):
+            # Coordinator path: replicate the membership change, then
+            # kick the migration engine. Forward failures are logged,
+            # not fatal — an unreachable peer re-learns membership from
+            # the status poll, and data convergence rides anti-entropy.
+            if self.client_factory is not None:
+                for node in list(c.nodes):
+                    if node.host == self.host:
+                        continue
+                    try:
+                        self.client_factory(node.host).cluster_resize(
+                            action, **{k: v for k, v in msg.items()
+                                       if k != "action"})
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        if self.logger is not None:
+                            self.logger.warning(
+                                f"resize forward to {node.host}: {e}")
+            if self.resizer is not None:
+                self.resizer.trigger()
+        out = {"action": action or "status",
+               "node_states": c.node_states(),
+               "resizing": c.resizing(),
+               "handoff_slices": c.handoff_count()}
+        if self.resizer is not None:
+            out["rebalance"] = self.resizer.snapshot()
+        return _json_resp(out)
 
     def _get_status(self, pv, params, headers, body) -> Response:
         """Cluster status: this node's status plus last-known peer states."""

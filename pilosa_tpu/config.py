@@ -25,9 +25,24 @@ TOML schema:
     breaker-threshold = 5       # consecutive failures that open a
                                 # node's circuit breaker; 0 disables
     breaker-cooldown = "5s"     # open -> half-open probe delay
+    prefer-local-reads = false  # serve a healthy locally-held replica
+                                # instead of the ring-order primary
+                                # (keeps QPS flat across a resize when
+                                # replica sets overlap)
 
     [anti-entropy]
     interval = "10m"
+    jitter = "-1s"              # uniform start-delay per pass; -1 = auto
+                                # (10% of interval) so nodes sharing a
+                                # config don't sync in lockstep
+    block-deadline = "30s"      # per-RPC budget for peer block fetches
+                                # during a sync pass; 0 = unbounded
+
+    [rebalance]
+    concurrency = 2             # parallel fragment transfers per pass
+    retries = 3                 # per-transfer retry budget (transport
+                                # and checksum-mismatch retransfers)
+    retry-backoff = "200ms"     # base of the doubling backoff
 
     [obs]
     slow-query-threshold = "250ms"
@@ -154,8 +169,24 @@ class Config:
         self.retry_backoff: float = 0.05
         self.breaker_threshold: int = 5
         self.breaker_cooldown: float = 5.0
+        # Locality tie-break for slice placement: serve a healthy
+        # locally-held replica instead of the ring-order primary. Off
+        # by default (reference-faithful load spreading); turn on for
+        # read-heavy single-coordinator deployments so a resize with
+        # overlapping replica sets keeps QPS flat.
+        self.prefer_local_reads: bool = False
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
         self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
+        # [anti-entropy] — jitter spreads pass starts across nodes
+        # (-1 = auto: 10% of interval); block-deadline bounds each
+        # peer block fetch so a wedged replica can't stall the pass.
+        self.anti_entropy_jitter: float = -1.0
+        self.sync_block_deadline: float = 30.0
+        # [rebalance] — live slice migration (parallel/rebalance.py):
+        # transfer concurrency, per-transfer retries, backoff base.
+        self.rebalance_concurrency: int = 2
+        self.rebalance_retry_max: int = 3
+        self.rebalance_retry_backoff: float = 0.2
         # Parity-only (reference config.go:50, cmd/server.go:96): the
         # reference declares [plugins] path but ships no plugin loader,
         # so the field is vestigial there and deliberately inert here —
@@ -233,11 +264,27 @@ class Config:
                                          c.breaker_threshold))
         if "breaker-cooldown" in cl:
             c.breaker_cooldown = parse_duration(cl["breaker-cooldown"])
+        c.prefer_local_reads = bool(cl.get("prefer-local-reads",
+                                           c.prefer_local_reads))
         if "polling-interval" in cl:
             c.polling_interval = parse_duration(cl["polling-interval"])
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             c.anti_entropy_interval = parse_duration(ae["interval"])
+        if "jitter" in ae:
+            j = ae["jitter"]
+            c.anti_entropy_jitter = (
+                -1.0 if str(j).strip().startswith("-")
+                else parse_duration(j))
+        if "block-deadline" in ae:
+            c.sync_block_deadline = parse_duration(ae["block-deadline"])
+        rb = data.get("rebalance", {})
+        c.rebalance_concurrency = int(rb.get("concurrency",
+                                             c.rebalance_concurrency))
+        c.rebalance_retry_max = int(rb.get("retries",
+                                           c.rebalance_retry_max))
+        if "retry-backoff" in rb:
+            c.rebalance_retry_backoff = parse_duration(rb["retry-backoff"])
         c.plugins_path = str(data.get("plugins", {}).get("path",
                                                          c.plugins_path))
         ob = data.get("obs", {})
@@ -272,6 +319,12 @@ class Config:
     def expanded_data_dir(self) -> str:
         return os.path.expanduser(self.data_dir)
 
+    def effective_anti_entropy_jitter(self) -> float:
+        """Resolved jitter seconds: -1 = auto (10% of interval)."""
+        if self.anti_entropy_jitter >= 0:
+            return self.anti_entropy_jitter
+        return 0.1 * self.anti_entropy_interval
+
     def use_device_flag(self):
         """Executor use_device arg: None = auto, True/False = forced.
         Unrecognized values raise — a typo ("onn") silently falling
@@ -303,9 +356,18 @@ class Config:
             f'retry-backoff = "{int(self.retry_backoff * 1000)}ms"\n'
             f"breaker-threshold = {self.breaker_threshold}\n"
             f'breaker-cooldown = "{int(self.breaker_cooldown * 1000)}ms"\n'
+            f"prefer-local-reads = "
+            f"{'true' if self.prefer_local_reads else 'false'}\n"
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'
+            f'jitter = "{int(self.anti_entropy_jitter)}s"\n'
+            f'block-deadline = "{int(self.sync_block_deadline)}s"\n'
+            f"\n[rebalance]\n"
+            f"concurrency = {self.rebalance_concurrency}\n"
+            f"retries = {self.rebalance_retry_max}\n"
+            f'retry-backoff = '
+            f'"{int(self.rebalance_retry_backoff * 1000)}ms"\n'
             f"\n[obs]\n"
             f'slow-query-threshold = '
             f'"{int(self.slow_query_threshold * 1000)}ms"\n'

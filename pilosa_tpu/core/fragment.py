@@ -239,8 +239,21 @@ class Fragment:
         try:
             if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
                 with open(self.path, "rb") as f:
-                    self.storage = Bitmap.from_bytes(f.read())
+                    data = f.read()
+                self.storage = Bitmap.from_bytes(data,
+                                                 truncate_torn_tail=True)
                 self.op_n = self.storage.op_n
+                torn = self.storage.torn_tail_bytes
+                if torn:
+                    # Crash mid-append left a damaged final op. The
+                    # acknowledged prefix is intact — drop the tail on
+                    # disk BEFORE attaching the append fd, or the next
+                    # replay would see the garbage mid-log and refuse
+                    # to load (kill -9 recovery, ISSUE 7 satellite).
+                    get_logger("pilosa.fragment").warning(
+                        "torn WAL tail: truncating %d trailing bytes "
+                        "of %s (crash recovery)", torn, self.path)
+                    os.truncate(self.path, len(data) - torn)
             else:
                 with open(self.path, "wb") as f:
                     self.storage.write_to(f)

@@ -15,9 +15,22 @@ SURVEY.md §4).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
+from .. import fault
 from .view import VIEW_INVERSE, VIEW_STANDARD
+
+
+def _count(stats, name: str, n: int = 1):
+    """Duck-typed counter bump: ExpvarStats has .count, StatMap has
+    .inc, tests may pass neither."""
+    if stats is None or n == 0:
+        return
+    if hasattr(stats, "count"):
+        stats.count(name, n)
+    elif hasattr(stats, "inc"):
+        stats.inc(name, n)
 
 
 class Closing:
@@ -49,7 +62,8 @@ class FragmentSyncer:
     def __init__(self, fragment, host: str, nodes,
                  client_factory: Callable, closing: Optional[Closing] = None,
                  logger=None, row_label: str = "rowID",
-                 column_label: str = "columnID"):
+                 column_label: str = "columnID", stats=None,
+                 op_deadline: float = 0.0):
         self.fragment = fragment
         self.host = host
         self.nodes = nodes  # replica owner Nodes incl. self
@@ -61,13 +75,32 @@ class FragmentSyncer:
         # deliberately fixed here).
         self.row_label = row_label
         self.column_label = column_label
+        # Anti-entropy counters (blocks scanned/dirty/merged, peers
+        # skipped) — server passes its ExpvarStats so /metrics exports
+        # them; None is fine for embedded use.
+        self.stats = stats
+        # Per-RPC budget in seconds for peer block fetches; 0 = none.
+        # Only forwarded when set, so client fakes without a deadline
+        # kwarg keep working.
+        self.op_deadline = float(op_deadline)
+
+    def _log(self, msg: str):
+        if self.logger is not None:
+            self.logger.info(msg)
 
     def _peers(self) -> List[str]:
         return [n.host for n in self.nodes if n.host != self.host]
 
+    def _deadline_kw(self) -> dict:
+        if self.op_deadline > 0:
+            return {"deadline": time.monotonic() + self.op_deadline}
+        return {}
+
     def sync_fragment(self):
         """Compare block checksums across replicas; merge every block
-        that differs anywhere (fragment.go:1320-1399)."""
+        that differs anywhere (fragment.go:1320-1399). An unreachable
+        replica is SKIPPED, not fatal — one dead peer must not abort
+        the whole anti-entropy pass for the live ones."""
         f = self.fragment
         local = dict(f.blocks())
         remote_sets = []
@@ -75,8 +108,16 @@ class FragmentSyncer:
             if self.closing.closed:
                 return
             client = self.client_factory(host)
-            remote_sets.append((host, dict(client.fragment_blocks(
-                f.index, f.frame, f.view, f.slice))))
+            try:
+                blocks = dict(client.fragment_blocks(
+                    f.index, f.frame, f.view, f.slice,
+                    **self._deadline_kw()))
+            except Exception as e:  # noqa: BLE001 — skip unreachable peers
+                _count(self.stats, "syncer_peers_skipped")
+                self._log(f"sync {f.index}/{f.frame}/{f.view}/{f.slice}: "
+                          f"peer {host} unreachable, skipping: {e}")
+                continue
+            remote_sets.append((host, blocks))
 
         # Block ids where any replica disagrees with local (either side
         # missing, or checksums differ).
@@ -89,6 +130,10 @@ class FragmentSyncer:
                 if blocks.get(bid) != cs:
                     dirty.add(bid)
 
+        scanned = {bid for _, blocks in remote_sets for bid in blocks}
+        scanned.update(local)
+        _count(self.stats, "syncer_blocks_scanned", len(scanned))
+        _count(self.stats, "syncer_blocks_dirty", len(dirty))
         for bid in sorted(dirty):
             if self.closing.closed:
                 return
@@ -96,17 +141,31 @@ class FragmentSyncer:
 
     def sync_block(self, block_id: int):
         """Majority-merge one block and push diffs to remotes
-        (fragment.go:1401-1481)."""
+        (fragment.go:1401-1481). Peer fetches ride the injected
+        client's retry/breaker path, bounded by `op_deadline`; an
+        unreachable peer contributes nothing to consensus instead of
+        aborting the merge."""
         f = self.fragment
-        peers = self._peers()
+        fault.point("syncer.block", index=f.index, frame=f.frame,
+                    view=f.view, slice=f.slice, block=block_id)
+        peers = []
         data = []
-        for host in peers:
+        for host in self._peers():
             client = self.client_factory(host)
-            rows, cols = client.block_data(
-                f.index, f.frame, f.view, f.slice, block_id)
+            try:
+                rows, cols = client.block_data(
+                    f.index, f.frame, f.view, f.slice, block_id,
+                    **self._deadline_kw())
+            except Exception as e:  # noqa: BLE001 — skip unreachable peers
+                _count(self.stats, "syncer_peers_skipped")
+                self._log(f"sync block {block_id}: peer {host} "
+                          f"unreachable, skipping: {e}")
+                continue
+            peers.append(host)
             data.append((rows, cols))
 
         diffs = f.merge_block(block_id, data)
+        _count(self.stats, "syncer_blocks_merged")
 
         # Push consensus diffs to each remote as SetBit/ClearBit PQL —
         # only for the standard view, whose orientation SetBit speaks
@@ -125,8 +184,14 @@ class FragmentSyncer:
             if not calls:
                 continue
             client = self.client_factory(host)
-            client.execute_query(None, f.index, "".join(calls), [],
-                                 remote=True)
+            try:
+                client.execute_query(None, f.index, "".join(calls), [],
+                                     remote=True)
+            except Exception as e:  # noqa: BLE001 — peer died mid-sync;
+                # its replica converges on a later pass.
+                _count(self.stats, "syncer_peers_skipped")
+                self._log(f"sync block {block_id}: diff push to {host} "
+                          f"failed: {e}")
 
     def _bit_pql(self, name: str, row_id: int, column_id: int) -> str:
         f = self.fragment
@@ -139,13 +204,15 @@ class HolderSyncer:
 
     def __init__(self, holder, host: str, cluster,
                  client_factory: Callable, closing: Optional[Closing] = None,
-                 logger=None):
+                 logger=None, stats=None, op_deadline: float = 0.0):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.client_factory = client_factory
         self.closing = closing or Closing()
         self.logger = logger
+        self.stats = stats
+        self.op_deadline = float(op_deadline)
 
     def _log(self, msg: str):
         if self.logger is not None:
@@ -223,7 +290,9 @@ class HolderSyncer:
         syncer = FragmentSyncer(frag, self.host, nodes,
                                 self.client_factory, self.closing,
                                 self.logger, row_label=f.row_label,
-                                column_label=idx.column_label)
+                                column_label=idx.column_label,
+                                stats=self.stats,
+                                op_deadline=self.op_deadline)
         try:
             syncer.sync_fragment()
         except Exception as e:  # noqa: BLE001 — sync is best-effort

@@ -507,6 +507,29 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
         lines.append("")
         lines.append(line)
 
+    # Membership panel (pilosa_member_state{host,state} + migration
+    # gauges): per-state node counts and, mid-resize, the live
+    # transfer picture — join/leave progress at a glance.
+    members = [(dict(labels).get("host", ""),
+                dict(labels).get("state", "?"))
+               for (name, labels), v in sorted(cur.items())
+               if name == "pilosa_member_state"]
+    if members:
+        by_state: dict = {}
+        for _, st in members:
+            by_state[st] = by_state.get(st, 0) + 1
+        line = "members: " + "  ".join(
+            f"{st}={n_m}" for st, n_m in sorted(by_state.items()))
+        inflight = cur.get(("pilosa_migrations_in_flight", ()))
+        if inflight:
+            mbytes = cur.get(("pilosa_migration_bytes_total", ()), 0.0)
+            line += (f"   migrating {int(inflight)} "
+                     f"({_fmt_bytes(mbytes)} moved)")
+        handoff = cur.get(("pilosa_handoff_slices", ()), 0.0)
+        if handoff:
+            line += f"   handoff {int(handoff)} slice(s)"
+        lines.append(line)
+
     brk = [(dict(labels).get("host", ""), v)
            for (name, labels), v in sorted(cur.items())
            if name == "pilosa_breaker_state"]
@@ -529,7 +552,7 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
 def cmd_top(args) -> int:
     """Scrape /metrics on an interval and render a one-screen summary
     (QPS, per-phase percentiles, roofline, scheduler queue/shed/batch,
-    breakers, HBM residency) —
+    membership + migrations, breakers, HBM residency) —
     the operator's first-response tool."""
     import urllib.request
 

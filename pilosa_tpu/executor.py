@@ -39,7 +39,11 @@ from .errors import (
     QueryError,
     SliceUnavailableError,
 )
-from .parallel.cluster import NODE_STATE_UP, preferred_owner
+from .parallel.cluster import (
+    NODE_STATE_UP,
+    SERVING_STATES,
+    preferred_owner,
+)
 from .pql import Call, Query
 from . import SLICE_WIDTH
 from . import fault
@@ -138,11 +142,18 @@ class Executor:
 
     def __init__(self, holder, host: str = "", cluster=None, client=None,
                  use_device: Optional[bool] = None, max_workers: int = 8,
-                 device_min_work: Optional[int] = None):
+                 device_min_work: Optional[int] = None,
+                 prefer_local_reads: bool = False):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.client = client
+        # Locality tie-break for slice placement: when on, a healthy
+        # locally-held replica serves locally instead of paying the
+        # HTTP hop to the ring-order primary. Off by default — the
+        # reference routes each slice to ring order, spreading load
+        # across replicas, which is right when clients hit every node.
+        self.prefer_local_reads = prefer_local_reads
         # None = auto (device path when available); False = host roaring only.
         self.use_device = use_device
         # Cost-routing threshold (see _route_to_host); None = resolve
@@ -858,7 +869,9 @@ class Executor:
             if not owners:
                 unowned.append(slice_)
                 continue
-            pick = preferred_owner(owners, state)
+            pick = preferred_owner(
+                owners, state,
+                prefer=self.host if self.prefer_local_reads else None)
             ent = per_host.setdefault(pick.host,
                                       {"slices": 0, "sample": []})
             ent["slices"] += 1
@@ -1448,19 +1461,27 @@ class Executor:
         for slice_ in slices:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
                       if o in nodes]
-            if not owners:
-                if opt is not None and opt.partial:
-                    # Graceful degradation: the response reports the
-                    # slice as missing instead of failing the query.
+            if opt is not None and opt.partial:
+                # Membership-aware degradation: a JOINING node hasn't
+                # received its slices yet and a DOWN node can't answer,
+                # so in partial mode route only to serving replicas
+                # (ACTIVE/LEAVING) and report the slice missing when
+                # none remain — never hang on a non-serving owner.
+                serving = [o for o in owners if o.state in SERVING_STATES]
+                if not serving:
                     opt.missing_slices.append(slice_)
                     continue
+                owners = serving
+            elif not owners:
                 raise SliceUnavailableError()
             # Prefer replicas the status-poll daemon currently sees UP
             # AND whose circuit breaker is closed; a slice whose owners
             # are all marked DOWN/open still tries one (liveness is
             # advisory — the reactive re-split below is the authority,
             # executor.go:1140-1151).
-            pick = preferred_owner(owners, self._breaker_callable())
+            pick = preferred_owner(
+                owners, self._breaker_callable(),
+                prefer=self.host if self.prefer_local_reads else None)
             m.setdefault(pick, []).append(slice_)
         return m
 

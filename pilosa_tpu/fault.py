@@ -38,6 +38,12 @@ Injection points currently wired:
                       here is a stalled scheduler; an error (e.g. an
                       armed sched.AdmissionError instance) forces
                       deterministic sheds
+    syncer.block      anti-entropy per-block merge (index, frame,
+                      view, slice, block) — a delay here is a slow
+                      sync pass; an error aborts one block's merge
+    rebalance.transfer  one fragment migration attempt (index, frame,
+                      view, slice, target) — errors exercise the
+                      transfer retry/backoff path
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.

@@ -26,10 +26,15 @@ from .cluster import (
     JmpHasher,
     ModHasher,
     Node,
+    NODE_STATE_ACTIVE,
     NODE_STATE_DOWN,
+    NODE_STATE_JOINING,
+    NODE_STATE_LEAVING,
     NODE_STATE_UP,
+    SERVING_STATES,
     new_test_cluster,
 )
+from .rebalance import Rebalancer, Transfer
 # The mesh module pulls in jax; load it lazily so host-only paths
 # (config, CLI utilities, pure-HTTP nodes) import fast.
 _MESH_NAMES = (
@@ -107,6 +112,12 @@ __all__ = [
     "JmpHasher",
     "ModHasher",
     "Node",
+    "NODE_STATE_ACTIVE",
     "NODE_STATE_DOWN",
+    "NODE_STATE_JOINING",
+    "NODE_STATE_LEAVING",
     "NODE_STATE_UP",
+    "SERVING_STATES",
+    "Rebalancer",
+    "Transfer",
 ]

@@ -13,13 +13,37 @@ the device-sharded path.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Set, Tuple
 
 DEFAULT_PARTITION_N = 16
 DEFAULT_REPLICA_N = 1
 
+# Membership lifecycle: JOINING -> ACTIVE -> LEAVING -> DOWN. ACTIVE
+# serializes as "UP" — the reference's wire literal, which every status
+# consumer already speaks. JOINING nodes are in the TARGET ring (they
+# will own data once migration cuts over) but not the serving ring;
+# LEAVING nodes are the mirror image: they keep serving until their
+# fragments are handed off, then drop out.
 NODE_STATE_UP = "UP"
+NODE_STATE_ACTIVE = NODE_STATE_UP
 NODE_STATE_DOWN = "DOWN"
+NODE_STATE_JOINING = "JOINING"
+NODE_STATE_LEAVING = "LEAVING"
+
+# States that may serve queries (the rebalancer keeps LEAVING nodes on
+# the hook until cutover).
+SERVING_STATES = (NODE_STATE_UP, NODE_STATE_LEAVING)
+
+# Legal lifecycle edges. Liveness collapses (anything -> DOWN) ride the
+# mark_unreachable fast path; everything else must be a listed edge so
+# a buggy admin sequence fails loudly instead of corrupting placement.
+_TRANSITIONS = {
+    NODE_STATE_JOINING: {NODE_STATE_UP, NODE_STATE_DOWN},
+    NODE_STATE_UP: {NODE_STATE_LEAVING, NODE_STATE_DOWN},
+    NODE_STATE_LEAVING: {NODE_STATE_UP, NODE_STATE_DOWN},
+    NODE_STATE_DOWN: {NODE_STATE_JOINING, NODE_STATE_UP},
+}
 
 _FNV64_OFFSET = 0xCBF29CE484222325
 _FNV64_PRIME = 0x100000001B3
@@ -37,13 +61,40 @@ def fnv64a(data: bytes) -> int:
 class Node:
     """One cluster member (reference cluster.go:39-57)."""
 
-    def __init__(self, host: str, internal_host: str = ""):
+    def __init__(self, host: str, internal_host: str = "",
+                 state: str = NODE_STATE_UP):
         self.host = host
         self.internal_host = internal_host
-        self.state = NODE_STATE_UP
+        self.state = state
 
     def set_state(self, state: str):
+        """Raw setter — liveness feeds (status poll, tests) that only
+        speak UP/DOWN. Lifecycle changes go through transition()."""
         self.state = state
+
+    def transition(self, state: str):
+        """Validated lifecycle edge; raises ValueError on an illegal
+        transition (e.g. JOINING -> LEAVING)."""
+        if state == self.state:
+            return
+        if state not in _TRANSITIONS.get(self.state, ()):
+            raise ValueError(
+                f"illegal node transition {self.state} -> {state} "
+                f"for {self.host}")
+        self.state = state
+
+    def mark_live(self):
+        """Liveness signal: a reachable node that was DOWN comes back
+        UP. JOINING/LEAVING are lifecycle states the rebalancer owns —
+        a liveness ping must not promote a node mid-migration."""
+        if self.state == NODE_STATE_DOWN:
+            self.state = NODE_STATE_UP
+
+    def mark_unreachable(self):
+        """Lost liveness collapses any state to DOWN (a JOINING node
+        that dies mid-migration is dropped from the join; the operator
+        re-issues once it's back)."""
+        self.state = NODE_STATE_DOWN
 
     def to_dict(self) -> dict:
         return {"host": self.host, "internalHost": self.internal_host}
@@ -97,6 +148,12 @@ class Cluster:
         # Live membership, fed by the gossip/nodeset layer; None means
         # "no liveness source, treat everyone as up".
         self.node_set_hosts: Optional[List[str]] = None
+        # Cutover ledger: (index, slice) pairs whose migrated copy the
+        # new owner has acknowledged (checksum-verified) — those route
+        # on the TARGET ring; everything else routes on the serving
+        # ring until then, so queries keep answering mid-migration.
+        self._handoff: Set[Tuple[str, int]] = set()
+        self._handoff_mu = threading.Lock()
 
     # -- membership ----------------------------------------------------------
 
@@ -110,15 +167,80 @@ class Cluster:
         return None
 
     def node_states(self) -> Dict[str, str]:
-        """host -> UP/DOWN (reference cluster.go:156-169)."""
+        """host -> lifecycle state, degraded to DOWN when the liveness
+        feed no longer sees the host (reference cluster.go:156-169)."""
         live = set(self.node_set_hosts if self.node_set_hosts is not None
                    else self.hosts())
         return {
-            n.host: NODE_STATE_UP
-            if n.host in live and n.state == NODE_STATE_UP
-            else NODE_STATE_DOWN
+            n.host: n.state if n.host in live else NODE_STATE_DOWN
             for n in self.nodes
         }
+
+    # -- resize lifecycle ----------------------------------------------------
+
+    def resizing(self) -> bool:
+        """True while any node is mid-lifecycle (JOINING/LEAVING) —
+        i.e. while the serving ring and the target ring differ."""
+        return any(n.state in (NODE_STATE_JOINING, NODE_STATE_LEAVING)
+                   for n in self.nodes)
+
+    def begin_join(self, host: str) -> Node:
+        """Admit `host` as JOINING: it enters the target ring and will
+        own data after migration, but serves nothing yet."""
+        n = self.node_by_host(host)
+        if n is None:
+            n = Node(host, state=NODE_STATE_JOINING)
+            self.nodes.append(n)
+        elif n.state == NODE_STATE_DOWN:
+            n.transition(NODE_STATE_JOINING)
+        return n
+
+    def begin_leave(self, host: str) -> Node:
+        """Mark `host` LEAVING: it keeps serving its slices until each
+        is handed off to the new owners, then drops out."""
+        n = self.node_by_host(host)
+        if n is None:
+            raise ValueError(f"unknown node: {host}")
+        n.transition(NODE_STATE_LEAVING)
+        return n
+
+    def complete_resize(self):
+        """Cutover epilogue: JOINING nodes become ACTIVE, LEAVING
+        nodes drop out of the ring entirely, and the per-slice handoff
+        ledger resets (both rings are equal again)."""
+        kept = []
+        for n in self.nodes:
+            if n.state == NODE_STATE_JOINING:
+                n.transition(NODE_STATE_UP)
+            if n.state == NODE_STATE_LEAVING:
+                continue
+            kept.append(n)
+        self.nodes = kept
+        with self._handoff_mu:
+            self._handoff.clear()
+
+    def mark_handed_off(self, index: str, slice_: int):
+        with self._handoff_mu:
+            self._handoff.add((index, int(slice_)))
+
+    def handed_off(self, index: str, slice_: int) -> bool:
+        with self._handoff_mu:
+            return (index, int(slice_)) in self._handoff
+
+    def handoff_count(self) -> int:
+        with self._handoff_mu:
+            return len(self._handoff)
+
+    def serving_ring(self) -> List[Node]:
+        """Nodes queries may route to today: everyone but JOINING
+        (LEAVING still serves until its slices hand off)."""
+        ring = [n for n in self.nodes if n.state != NODE_STATE_JOINING]
+        return ring or self.nodes
+
+    def target_ring(self) -> List[Node]:
+        """Post-rebalance ownership: JOINING in, LEAVING out."""
+        ring = [n for n in self.nodes if n.state != NODE_STATE_LEAVING]
+        return ring or self.nodes
 
     # -- placement -----------------------------------------------------------
 
@@ -128,18 +250,41 @@ class Cluster:
         data = index.encode() + int(slice_).to_bytes(8, "big")
         return fnv64a(data) % self.partition_n
 
-    def partition_nodes(self, partition_id: int) -> List[Node]:
-        """Replica owners: jump-hash primary + consecutive ring nodes
-        (reference cluster.go:220-240)."""
-        if not self.nodes:
+    def _owners_over(self, ring: List[Node],
+                     partition_id: int) -> List[Node]:
+        if not ring:
             return []
-        replica_n = min(max(self.replica_n, 1), len(self.nodes))
-        primary = self.hasher.hash(partition_id, len(self.nodes))
-        return [self.nodes[(primary + i) % len(self.nodes)]
-                for i in range(replica_n)]
+        replica_n = min(max(self.replica_n, 1), len(ring))
+        primary = self.hasher.hash(partition_id, len(ring))
+        return [ring[(primary + i) % len(ring)] for i in range(replica_n)]
+
+    def partition_nodes(self, partition_id: int,
+                        ring: Optional[List[Node]] = None) -> List[Node]:
+        """Replica owners: jump-hash primary + consecutive ring nodes
+        (reference cluster.go:220-240). `ring` overrides the node list
+        (the rebalancer diffs serving vs target ownership)."""
+        return self._owners_over(
+            self.nodes if ring is None else ring, partition_id)
+
+    def _placement_ring(self, index: str, slice_: int) -> List[Node]:
+        """The ring THIS fragment routes on: during a resize, handed-off
+        slices use the target ring (new owners have a verified copy),
+        everything else stays on the serving ring — so queries keep
+        answering throughout a join/leave."""
+        if not self.resizing():
+            return self.nodes
+        if self.handed_off(index, slice_):
+            return self.target_ring()
+        return self.serving_ring()
 
     def fragment_nodes(self, index: str, slice_: int) -> List[Node]:
-        return self.partition_nodes(self.partition(index, slice_))
+        return self._owners_over(self._placement_ring(index, slice_),
+                                 self.partition(index, slice_))
+
+    def fragment_nodes_over(self, ring: List[Node], index: str,
+                            slice_: int) -> List[Node]:
+        """Ownership over an explicit ring (rebalancer plan math)."""
+        return self._owners_over(ring, self.partition(index, slice_))
 
     def owns_fragment(self, host: str, index: str, slice_: int) -> bool:
         return any(n.host == host for n in self.fragment_nodes(index, slice_))
@@ -149,9 +294,10 @@ class Cluster:
         — primary only, not replicas)."""
         out = []
         for s in range(max_slice + 1):
+            ring = self._placement_ring(index, s)
             p = self.partition(index, s)
-            primary = self.hasher.hash(p, len(self.nodes))
-            if self.nodes[primary].host == host:
+            primary = self.hasher.hash(p, len(ring))
+            if ring[primary].host == host:
                 out.append(s)
         return out
 
@@ -160,19 +306,36 @@ class Cluster:
                           for n in self.nodes]}
 
 
-def preferred_owner(owners: List[Node], breaker_state=None) -> Node:
-    """Routing preference among a slice's replica owners: UP nodes
-    whose circuit breaker is closed, then any UP node, then anyone —
-    both gossip liveness and breaker state are advisory, so a slice
+def preferred_owner(owners: List[Node], breaker_state=None,
+                    prefer: Optional[str] = None) -> Node:
+    """Routing preference among a slice's replica owners: ACTIVE nodes
+    whose circuit breaker is closed, then any ACTIVE node, then LEAVING
+    nodes (still serving until cutover), then anyone — liveness,
+    lifecycle state, and breaker state are all advisory, so a slice
     whose owners all look bad still tries one (the executor's reactive
     re-split is the authority). `breaker_state(host) -> str` comes from
-    the cluster client; None means no breaker info."""
+    the cluster client; None means no breaker info. Within the winning
+    tier, `prefer` (the coordinating node's own host) breaks the tie —
+    a locally-held replica serves locally instead of paying an HTTP
+    hop, which is what keeps query QPS flat across a resize when the
+    replica sets overlap."""
+
+    def pick(cands: List[Node]) -> Node:
+        if prefer is not None:
+            for o in cands:
+                if o.host == prefer:
+                    return o
+        return cands[0]
+
     up = [o for o in owners if o.state == NODE_STATE_UP]
     if breaker_state is not None:
         healthy = [o for o in up if breaker_state(o.host) == "closed"]
         if healthy:
-            return healthy[0]
-    return (up or owners)[0]
+            return pick(healthy)
+    if up:
+        return pick(up)
+    leaving = [o for o in owners if o.state == NODE_STATE_LEAVING]
+    return pick(leaving or owners)
 
 
 def new_test_cluster(n: int) -> Cluster:

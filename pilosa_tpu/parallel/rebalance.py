@@ -1,0 +1,365 @@
+"""Live slice migration: the background Rebalancer.
+
+When ownership shifts (a node JOINING or LEAVING the ring), the node
+that received the `POST /cluster/resize` admin call coordinates a
+migration: every fragment whose target-ring owners differ from its
+serving-ring owners is streamed to the new owners over the existing
+roaring wire format (`Fragment.write_to_tar` -> `POST /fragment/data`),
+with bounded concurrency, per-transfer retries/backoff (the injected
+`client_factory` returns PR-3 `InternalClient`s, so transport retries
+and circuit breakers come for free), and block-checksum verification on
+arrival.
+
+Cutover is per (index, slice): the old owners keep serving a slice
+until EVERY fragment of it has a staged, checksum-verified copy on its
+new owner; then the coordinator marks the slice handed off locally and
+broadcasts the cutover to every peer, flipping placement to the target
+ring. When the whole plan drains, the coordinator completes the resize
+(JOINING -> ACTIVE, LEAVING -> out of the ring) and broadcasts that
+too — queries keep answering throughout.
+
+Writes that land on the old owner between the tar snapshot and the
+cutover ack are not lost: the wired anti-entropy loop (core/syncer)
+converges replica block checksums on the next pass — the documented
+degraded mode (README "Cluster operations").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import fault
+from .cluster import (
+    NODE_STATE_JOINING,
+    NODE_STATE_LEAVING,
+    Cluster,
+)
+from ..core.view import VIEW_INVERSE, VIEW_STANDARD, is_inverse_view
+
+
+class Transfer:
+    """One fragment push: source host -> target host."""
+
+    __slots__ = ("index", "frame", "view", "slice", "source", "target",
+                 "attempts", "bytes")
+
+    def __init__(self, index: str, frame: str, view: str, slice_: int,
+                 source: str, target: str):
+        self.index = index
+        self.frame = frame
+        self.view = view
+        self.slice = slice_
+        self.source = source
+        self.target = target
+        self.attempts = 0
+        self.bytes = 0
+
+    def key(self) -> Tuple[str, int]:
+        return (self.index, self.slice)
+
+    def __repr__(self):
+        return (f"Transfer({self.index}/{self.frame}/{self.view}/"
+                f"{self.slice} {self.source}->{self.target})")
+
+
+class Rebalancer:
+    """Coordinator-side migration engine.
+
+    Runs as a service loop (`run`) woken by `trigger()`; each pass
+    computes the migration plan from the cluster's serving-vs-target
+    ring diff and executes it with `concurrency` worker threads.
+    `rebalance_once()` is the synchronous seam tests drive directly.
+    """
+
+    def __init__(self, holder, cluster: Cluster, host: str,
+                 client_factory: Callable, closing=None, logger=None,
+                 stats=None, concurrency: int = 2, retry_max: int = 3,
+                 retry_backoff: float = 0.2, broadcast=None,
+                 on_complete=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.host = host
+        self.client_factory = client_factory
+        self.closing = closing
+        self.logger = logger
+        self.stats = stats
+        self.concurrency = max(1, int(concurrency))
+        self.retry_max = int(retry_max)
+        self.retry_backoff = float(retry_backoff)
+        # broadcast(action, **fields): ship a control message (cutover,
+        # complete) to every peer — the server wires this to
+        # InternalClient.cluster_resize; None = single-brain (tests).
+        self.broadcast = broadcast
+        # on_complete(): called after a successful resize epilogue.
+        self.on_complete = on_complete
+        self._wake = threading.Event()
+        self._mu = threading.Lock()
+        self._in_flight = 0
+        self._bytes_total = 0
+        self._completed = 0
+        self._failed = 0
+        self._mismatches = 0
+        self._last_error = ""
+
+    # -- service loop --------------------------------------------------------
+
+    def trigger(self):
+        self._wake.set()
+
+    def run(self, poll_interval: float = 0.25):
+        """Service loop: wait for a trigger (or closing), run a pass.
+        Errors never kill the loop — the next trigger retries."""
+        while self.closing is None or not self.closing.closed:
+            if not self._wake.wait(poll_interval):
+                continue
+            self._wake.clear()
+            try:
+                self.rebalance_once()
+            except Exception as e:  # noqa: BLE001 — daemons never die
+                with self._mu:
+                    self._last_error = str(e)
+                self._log(f"rebalance pass failed: {e}")
+
+    def _closed(self) -> bool:
+        return self.closing is not None and self.closing.closed
+
+    def _log(self, msg: str):
+        if self.logger is not None:
+            self.logger.info(msg)
+
+    def _count(self, name: str, n: int = 1):
+        st = self.stats
+        if st is None:
+            return
+        if hasattr(st, "count"):
+            st.count(name, n)
+        elif hasattr(st, "inc"):
+            st.inc(name, n)
+
+    # -- plan ----------------------------------------------------------------
+
+    def _schema(self) -> List[Tuple[str, List[str]]]:
+        out = []
+        for iname in sorted(self.holder.indexes):
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            out.append((iname, sorted(idx.frames)))
+        return out
+
+    def plan(self) -> List[Transfer]:
+        """Diff serving-ring vs target-ring ownership for every known
+        fragment; emit one Transfer per (fragment, new owner). The
+        coordinator's holder knows the global schema and max slices
+        (status-poll merges), so the plan covers remote-owned slices
+        too — the source is any serving owner, pulled through HTTP when
+        it isn't this node."""
+        c = self.cluster
+        if not c.resizing():
+            return []
+        serving = c.serving_ring()
+        target = c.target_ring()
+        transfers: List[Transfer] = []
+        for iname, frames in self._schema():
+            idx = self.holder.index(iname)
+            for is_inv in (False, True):
+                max_slice = (idx.max_inverse_slice() if is_inv
+                             else idx.max_slice())
+                for s in range(max_slice + 1):
+                    if c.handed_off(iname, s):
+                        continue
+                    cur = {n.host for n in
+                           c.fragment_nodes_over(serving, iname, s)}
+                    tgt = {n.host for n in
+                           c.fragment_nodes_over(target, iname, s)}
+                    new_hosts = tgt - cur
+                    if not new_hosts:
+                        continue
+                    source = (self.host if self.host in cur
+                              else sorted(cur)[0])
+                    for fname in frames:
+                        f = idx.frame(fname)
+                        if f is None:
+                            continue
+                        views = sorted(v for v in f.views
+                                       if is_inverse_view(v) == is_inv)
+                        if not views:
+                            # Remote-only data: this node holds no view
+                            # of the frame (status-poll only merged the
+                            # max slice), so probe the default view —
+                            # absent fragments transfer as no-ops.
+                            if not is_inv:
+                                views = [VIEW_STANDARD]
+                            elif f.inverse_enabled:
+                                views = [VIEW_INVERSE]
+                        for view in views:
+                            for tgt_host in sorted(new_hosts):
+                                transfers.append(Transfer(
+                                    iname, fname, view, s, source,
+                                    tgt_host))
+        return transfers
+
+    # -- execution -----------------------------------------------------------
+
+    def rebalance_once(self) -> dict:
+        """One full migration pass: plan, stream every transfer with
+        bounded concurrency, cut each slice over as its fragments are
+        all verified, and complete the resize when the plan drains.
+        Returns a summary dict (also the /cluster/resize response)."""
+        transfers = self.plan()
+        failed: List[Transfer] = []
+        if transfers:
+            # Group by (index, slice): a slice cuts over only when all
+            # its fragments are verified on their new owners.
+            by_slice: Dict[Tuple[str, int], List[Transfer]] = {}
+            for t in transfers:
+                by_slice.setdefault(t.key(), []).append(t)
+            self._log(f"rebalance: {len(transfers)} transfers over "
+                      f"{len(by_slice)} slices")
+            with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+                for key, group in sorted(by_slice.items()):
+                    if self._closed():
+                        return self.snapshot()
+                    ok = True
+                    for t, success in zip(
+                            group, pool.map(self._transfer, group)):
+                        if not success:
+                            ok = False
+                            failed.append(t)
+                    if ok:
+                        self._cutover(*key)
+        if not failed and not self._closed():
+            self._complete()
+        elif failed:
+            with self._mu:
+                self._failed += len(failed)
+            self._log(f"rebalance: {len(failed)} transfers failed; "
+                      "resize stays pending (re-trigger retries)")
+        return self.snapshot()
+
+    def _transfer(self, t: Transfer) -> bool:
+        """Stream one fragment to its new owner and verify the copy by
+        block checksums; retries (with backoff) cover both transport
+        hiccups beyond the client's own retry budget and checksum
+        mismatches from writes racing the snapshot."""
+        with self._mu:
+            self._in_flight += 1
+        try:
+            while t.attempts <= self.retry_max:
+                if self._closed():
+                    return False
+                t.attempts += 1
+                try:
+                    fault.point("rebalance.transfer", index=t.index,
+                                frame=t.frame, view=t.view, slice=t.slice,
+                                target=t.target)
+                    if self._transfer_attempt(t):
+                        with self._mu:
+                            self._completed += 1
+                            self._bytes_total += t.bytes
+                        self._count("rebalance.bytes", t.bytes)
+                        self._count("rebalance.transfer")
+                        return True
+                    # verified copy diverged: count and retransfer
+                    with self._mu:
+                        self._mismatches += 1
+                    self._count("rebalance.checksum_mismatch")
+                    self._log(f"{t}: checksum mismatch, retransferring")
+                except Exception as e:  # noqa: BLE001 — retried below
+                    with self._mu:
+                        self._last_error = f"{t}: {e}"
+                    self._log(f"{t}: attempt {t.attempts} failed: {e}")
+                if t.attempts <= self.retry_max:
+                    time.sleep(self.retry_backoff * (1 << (t.attempts - 1)))
+            self._count("rebalance.failed")
+            return False
+        finally:
+            with self._mu:
+                self._in_flight -= 1
+
+    def _transfer_attempt(self, t: Transfer) -> bool:
+        """One shot: fetch tar (local or from the source owner), push
+        to the target, compare block checksums. True = verified."""
+        if t.source == self.host:
+            frag = self.holder.fragment(t.index, t.frame, t.view, t.slice)
+            if frag is None:
+                return True  # nothing to move for this view/slice
+            import io
+            buf = io.BytesIO()
+            frag.write_to_tar(buf)
+            tar = buf.getvalue()
+            src_blocks = dict(frag.blocks())
+        else:
+            src = self.client_factory(t.source)
+            tar = src.fragment_data(t.index, t.frame, t.view, t.slice)
+            if tar is None:
+                return True
+            src_blocks = dict(src.fragment_blocks(
+                t.index, t.frame, t.view, t.slice))
+        t.bytes = len(tar)
+        dst = self.client_factory(t.target)
+        self._ensure_schema(dst, t.index, t.frame)
+        dst.restore_fragment(t.index, t.frame, t.view, t.slice, tar)
+        got = dict(dst.fragment_blocks(t.index, t.frame, t.view, t.slice))
+        return got == src_blocks
+
+    def _ensure_schema(self, client, index: str, frame: str):
+        """The target may have never heard of this index/frame (a
+        fresh JOINING node); restore needs both to exist."""
+        idx = self.holder.index(index)
+        f = idx.frame(frame) if idx is not None else None
+        try:
+            client.create_index(
+                index, columnLabel=getattr(idx, "column_label", "columnID"))
+            if f is not None:
+                client.create_frame(
+                    index, frame, rowLabel=f.row_label,
+                    inverseEnabled=f.inverse_enabled,
+                    cacheType=f.cache_type, cacheSize=f.cache_size)
+        except Exception:  # noqa: BLE001 — restore will surface it
+            pass
+
+    def _cutover(self, index: str, slice_: int):
+        """Every fragment of (index, slice) is verified on its new
+        owner: flip placement locally and on every peer."""
+        self.cluster.mark_handed_off(index, slice_)
+        self._count("rebalance.cutover")
+        if self.broadcast is not None:
+            self.broadcast("cutover", index=index, slice=int(slice_))
+        self._log(f"cutover: {index}/{slice_} now serves from the "
+                  "target ring")
+
+    def _complete(self):
+        """Plan drained: promote JOINING -> ACTIVE, drop LEAVING from
+        the ring, clear the handoff ledger — everywhere."""
+        if not self.cluster.resizing():
+            return
+        joined = [n.host for n in self.cluster.nodes
+                  if n.state == NODE_STATE_JOINING]
+        left = [n.host for n in self.cluster.nodes
+                if n.state == NODE_STATE_LEAVING]
+        self.cluster.complete_resize()
+        self._count("rebalance.complete")
+        if self.broadcast is not None:
+            self.broadcast("complete")
+        self._log(f"resize complete: joined={joined} left={left}")
+        if self.on_complete is not None:
+            self.on_complete()
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "in_flight": self._in_flight,
+                "completed": self._completed,
+                "failed": self._failed,
+                "checksum_mismatches": self._mismatches,
+                "bytes_total": self._bytes_total,
+                "resizing": self.cluster.resizing(),
+                "handoff_slices": self.cluster.handoff_count(),
+                "last_error": self._last_error,
+            }

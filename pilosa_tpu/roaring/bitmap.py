@@ -270,13 +270,18 @@ class Bitmap:
     op writer for WAL durability (reference: roaring.go:48-52,617-628).
     """
 
-    __slots__ = ("keys", "containers", "op_writer", "op_n")
+    __slots__ = ("keys", "containers", "op_writer", "op_n",
+                 "torn_tail_bytes")
 
     def __init__(self, values: Optional[Iterable[int]] = None):
         self.keys: list[int] = []
         self.containers: list[Container] = []
         self.op_writer = None  # file-like; ops appended when set
         self.op_n = 0
+        # Bytes of damaged trailing WAL dropped by a crash-tolerant
+        # load (from_bytes(truncate_torn_tail=True)); the owner must
+        # truncate the backing file by this much before appending.
+        self.torn_tail_bytes = 0
         if values is not None:
             arr = np.asarray(list(values) if not isinstance(values, np.ndarray) else values, dtype=_U64)
             if arr.size:
@@ -670,7 +675,8 @@ class Bitmap:
         return buf.getvalue()
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Bitmap":
+    def from_bytes(cls, data: bytes,
+                   truncate_torn_tail: bool = False) -> "Bitmap":
         from .serialize import read_bitmap
 
-        return read_bitmap(data)
+        return read_bitmap(data, truncate_torn_tail=truncate_torn_tail)

@@ -58,6 +58,37 @@ def read_ops(data: bytes):
         off += OP_SIZE
 
 
+def scan_ops(data: bytes):
+    """Crash-tolerant WAL parse: returns (ops, valid_bytes, torn_bytes).
+
+    A crash mid-`write_op` can leave exactly one damaged op at the END
+    of the log — either a partial record (< 13 bytes) or a final full
+    record whose checksum doesn't cover what actually hit the disk.
+    That torn TAIL is recoverable: every op before it was acked off a
+    completed write, so the loader truncates the tail and keeps the
+    prefix. A bad checksum with MORE ops after it is a different animal
+    — bit rot or a buggy writer mid-log — and still raises, because
+    silently dropping acknowledged interior ops would corrupt state.
+    """
+    ops = []
+    off = 0
+    n = len(data)
+    while off < n:
+        if off + OP_SIZE > n:
+            return ops, off, n - off  # partial trailing record: torn
+        body = data[off : off + 9]
+        (chk,) = struct.unpack_from("<I", data, off + 9)
+        if chk != fnv32a(body):
+            if off + OP_SIZE == n:
+                return ops, off, OP_SIZE  # torn final record
+            raise ValueError(
+                f"checksum mismatch mid-log at offset {off}: "
+                f"exp={fnv32a(body):08x}, got={chk:08x}")
+        ops.append(struct.unpack("<BQ", body))
+        off += OP_SIZE
+    return ops, off, 0
+
+
 def _container_bytes(c: Container) -> bytes:
     if c.is_array():
         return c.array.astype("<u4").tobytes()
@@ -86,8 +117,16 @@ def write_bitmap(b: Bitmap, w) -> int:
     return n_written
 
 
-def read_bitmap(data: bytes) -> Bitmap:
-    """Parse snapshot + replay trailing op log (reference roaring.go:536-614)."""
+def read_bitmap(data: bytes, truncate_torn_tail: bool = False) -> Bitmap:
+    """Parse snapshot + replay trailing op log (reference roaring.go:536-614).
+
+    With `truncate_torn_tail=True`, a damaged FINAL op (partial record
+    or bad checksum on the last complete record — the signature of a
+    crash mid-append) is dropped instead of raising; the returned
+    bitmap carries `torn_tail_bytes` so the caller can truncate the
+    backing file before reopening it for append. Mid-log corruption
+    still raises either way.
+    """
     if len(data) < HEADER_SIZE:
         raise ValueError("data too small")
     cookie, key_n = struct.unpack_from("<II", data, 0)
@@ -127,7 +166,13 @@ def read_bitmap(data: bytes) -> Bitmap:
             b.containers.append(Container(bitmap=words.astype(np.uint64)))
         end = offset + size
 
-    for typ, value in read_ops(data[end:]):
+    if truncate_torn_tail:
+        ops, _, torn = scan_ops(data[end:])
+        b.torn_tail_bytes = torn
+    else:
+        ops = read_ops(data[end:])
+        b.torn_tail_bytes = 0
+    for typ, value in ops:
         if typ == 0:
             b._add_one(value)
         elif typ == 1:

@@ -31,11 +31,11 @@ from .core.view import VIEW_INVERSE, VIEW_STANDARD
 from .executor import Executor
 from .parallel.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
 from .parallel.cluster import (
-    NODE_STATE_DOWN,
     NODE_STATE_UP,
     Cluster,
     Node,
 )
+from .parallel.rebalance import Rebalancer
 from .obs import StatMap, Tracer
 from .utils.stats import ExpvarStats
 from .wire import pb
@@ -176,9 +176,10 @@ class Server:
             # every rank); HTTP queries landing here serve from the
             # host roaring path over the replicated holder.
             use_device = False
-        self.executor = Executor(self.holder, host=self.host,
-                                 cluster=self.cluster, client=self.client,
-                                 use_device=use_device)
+        self.executor = Executor(
+            self.holder, host=self.host, cluster=self.cluster,
+            client=self.client, use_device=use_device,
+            prefer_local_reads=self.config.prefer_local_reads)
         if self.spmd is not None:
             def _apply_query(index, query):
                 # query arrives pre-parsed: _execute_pql already parsed
@@ -241,6 +242,19 @@ class Server:
             else:
                 self.handler.spmd_worker = True
 
+        # Live slice migration ([rebalance]): the node that takes the
+        # /cluster/resize call coordinates; control messages (join/
+        # leave/cutover/complete) fan out to peers over the same
+        # endpoint with ?remote=true.
+        self.rebalancer = Rebalancer(
+            self.holder, self.cluster, self.host, self.client.for_host,
+            closing=self.closing, logger=self.logger, stats=self.stats,
+            concurrency=self.config.rebalance_concurrency,
+            retry_max=self.config.rebalance_retry_max,
+            retry_backoff=self.config.rebalance_retry_backoff,
+            broadcast=self._broadcast_resize)
+        self.handler.resizer = self.rebalancer
+
         self._api: Optional[APIServer] = None
         self._threads: list = []
         # Last NodeStatus seen per peer host (gossip-lite state).
@@ -270,17 +284,25 @@ class Server:
         self._api.start()
         self.node_set.open()
 
-        for name, fn, interval in [
+        for name, fn, interval, jitter in [
             ("anti-entropy", self._anti_entropy_tick,
-             self.config.anti_entropy_interval),
+             self.config.anti_entropy_interval,
+             self.config.effective_anti_entropy_jitter()),
             ("status-poll", self._status_poll_tick,
-             self.config.polling_interval),
-            ("cache-flush", self._cache_flush_tick, CACHE_FLUSH_INTERVAL),
+             self.config.polling_interval, 0.0),
+            ("cache-flush", self._cache_flush_tick, CACHE_FLUSH_INTERVAL,
+             0.0),
         ]:
             t = threading.Thread(target=self._loop, name=name,
-                                 args=(fn, interval), daemon=True)
+                                 args=(fn, interval, jitter), daemon=True)
             t.start()
             self._threads.append(t)
+
+        # Migration service loop: parked until a resize trigger()s it.
+        t = threading.Thread(target=self.rebalancer.run, name="rebalance",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
 
         if self.spmd is not None and self._spmd_rank != 0:
             # SPMD worker: follow rank 0's descriptor stream (queries,
@@ -324,11 +346,29 @@ class Server:
 
     def _set_live_hosts(self, hosts):
         """Gossip membership feed -> cluster liveness
-        (reference Cluster.NodeStates, cluster.go:156-169)."""
-        self.cluster.node_set_hosts = list(hosts)
+        (reference Cluster.NodeStates, cluster.go:156-169). A live host
+        the ring has never seen enters as JOINING — placement ignores
+        it until the rebalancer streams its slices over and cuts over."""
+        hosts = list(hosts)
+        self.cluster.node_set_hosts = hosts
+        joined = False
+        for h in hosts:
+            if h != self.host and self.cluster.node_by_host(h) is None:
+                try:
+                    self.cluster.begin_join(h)
+                    joined = True
+                    self.logger.info(f"gossip: new member {h} JOINING")
+                except ValueError:
+                    pass
+        if joined:
+            self.rebalancer.trigger()
 
-    def _loop(self, fn, interval: float):
+    def _loop(self, fn, interval: float, jitter: float = 0.0):
         while not self.closing.wait(interval):
+            if jitter > 0:
+                import random
+                if self.closing.wait(random.uniform(0, jitter)):
+                    return
             try:
                 fn()
             except Exception as e:  # noqa: BLE001 — daemons never die
@@ -341,27 +381,46 @@ class Server:
             return
         syncer = HolderSyncer(self.holder, self.host, self.cluster,
                               self.client.for_host, self.closing,
-                              self.logger)
+                              self.logger, stats=self.stats,
+                              op_deadline=self.config.sync_block_deadline)
         syncer.sync_holder()
         self.stats.count("anti_entropy")
 
     def _status_poll_tick(self):
         """Pull NodeStatus from every peer; merge schema/max-slices;
-        track liveness."""
+        track liveness. mark_live/mark_unreachable (not raw set_state)
+        so a poll success can't stomp a JOINING/LEAVING node back to
+        ACTIVE mid-migration."""
         for node in self.cluster.nodes:
             if node.host == self.host:
                 continue
             try:
                 status = self.client.for_host(node.host).node_status()
             except Exception:  # noqa: BLE001 — unreachable peer
-                node.set_state(NODE_STATE_DOWN)
+                node.mark_unreachable()
                 continue
-            node.set_state(NODE_STATE_UP)
+            node.mark_live()
             self._peer_status[node.host] = status
             self.handle_remote_status(status)
 
     def _cache_flush_tick(self):
         self.holder.flush_caches()
+
+    def _broadcast_resize(self, action: str, **fields):
+        """Ship a resize control message (join/leave/cutover/complete)
+        to every peer via POST /cluster/resize?remote=true. Best-effort:
+        a peer that misses a cutover still converges on `complete`, and
+        a peer that misses everything re-learns membership from the
+        status poll + anti-entropy."""
+        for node in list(self.cluster.nodes):
+            if node.host == self.host:
+                continue
+            try:
+                self.client.for_host(node.host).cluster_resize(
+                    action, **fields)
+            except Exception as e:  # noqa: BLE001 — best-effort fan-out
+                self.logger.warning(
+                    f"resize broadcast {action} to {node.host}: {e}")
 
     # -- BroadcastHandler (server.go:255-300) --------------------------------
 

@@ -1,0 +1,41 @@
+"""Child process for the kill -9 crash-recovery test
+(test_crash_recovery.py): boot a single-node server on the given data
+dir + port, then serve until killed. The parent streams SetBit writes
+at it, SIGKILLs it mid-stream, and restarts it on the same data dir to
+assert WAL replay restores every acknowledged bit.
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    data_dir, port = sys.argv[1], int(sys.argv[2])
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    c = Config()
+    c.data_dir = data_dir
+    c.host = f"127.0.0.1:{port}"
+    c.cluster_hosts = [c.host]
+    c.anti_entropy_interval = 3600
+    c.polling_interval = 3600
+    c.sched_enabled = False
+    s = Server(c)
+    s.open()
+    print(f"READY {port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

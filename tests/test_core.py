@@ -409,9 +409,9 @@ def test_holder_cold_open_is_lazy(tmp_path, monkeypatch):
     calls = {"n": 0}
     orig = fragment_mod.Bitmap.from_bytes
 
-    def counting(data):
+    def counting(data, **kw):
         calls["n"] += 1
-        return orig(data)
+        return orig(data, **kw)
 
     monkeypatch.setattr(fragment_mod.Bitmap, "from_bytes",
                         staticmethod(counting))

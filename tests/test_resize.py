@@ -1,0 +1,628 @@
+"""Elastic cluster: membership lifecycle, migration-aware placement,
+the live-migration Rebalancer, anti-entropy under churn, and a
+join-under-herd chaos run over real HTTP (ISSUE 7)."""
+
+import io
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.config import Config
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.syncer import FragmentSyncer
+from pilosa_tpu.parallel.cluster import (
+    NODE_STATE_DOWN,
+    NODE_STATE_JOINING,
+    NODE_STATE_LEAVING,
+    NODE_STATE_UP,
+    Cluster,
+    Node,
+    preferred_owner,
+)
+from pilosa_tpu.parallel.rebalance import Rebalancer
+from pilosa_tpu.server import Server
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+# -- membership lifecycle -----------------------------------------------------
+
+
+class TestLifecycle:
+    def test_transition_table(self):
+        n = Node("h")
+        assert n.state == NODE_STATE_UP
+        n.transition(NODE_STATE_LEAVING)
+        n.transition(NODE_STATE_UP)  # leave aborted
+        n.transition(NODE_STATE_DOWN)
+        n.transition(NODE_STATE_JOINING)
+        n.transition(NODE_STATE_UP)
+        # illegal edges fail loudly
+        with pytest.raises(ValueError):
+            Node("h", state=NODE_STATE_JOINING).transition(NODE_STATE_LEAVING)
+        with pytest.raises(ValueError):
+            Node("h", state=NODE_STATE_UP).transition(NODE_STATE_JOINING)
+        # self-transition is a no-op, never an error
+        Node("h").transition(NODE_STATE_UP)
+
+    def test_liveness_never_stomps_lifecycle(self):
+        """A status-poll success must not promote a JOINING/LEAVING
+        node back to ACTIVE mid-migration."""
+        j = Node("h", state=NODE_STATE_JOINING)
+        j.mark_live()
+        assert j.state == NODE_STATE_JOINING
+        lv = Node("h", state=NODE_STATE_LEAVING)
+        lv.mark_live()
+        assert lv.state == NODE_STATE_LEAVING
+        d = Node("h", state=NODE_STATE_DOWN)
+        d.mark_live()
+        assert d.state == NODE_STATE_UP
+        # lost liveness collapses anything to DOWN
+        j.mark_unreachable()
+        assert j.state == NODE_STATE_DOWN
+
+    def test_join_leave_complete(self):
+        c = Cluster(nodes=[Node("h0"), Node("h1")], replica_n=1)
+        assert not c.resizing()
+        c.begin_join("h2")
+        assert c.resizing()
+        assert c.node_by_host("h2").state == NODE_STATE_JOINING
+        # idempotent: a forwarded join for an already-known node no-ops
+        c.begin_join("h2")
+        c.begin_leave("h0")
+        assert c.node_by_host("h0").state == NODE_STATE_LEAVING
+        c.mark_handed_off("i", 3)
+        assert c.handed_off("i", 3) and c.handoff_count() == 1
+        c.complete_resize()
+        assert not c.resizing()
+        assert c.hosts() == ["h1", "h2"]  # LEAVING dropped, JOINING kept
+        assert c.node_by_host("h2").state == NODE_STATE_UP
+        assert c.handoff_count() == 0
+
+    def test_begin_leave_unknown_raises(self):
+        c = Cluster(nodes=[Node("h0")], replica_n=1)
+        with pytest.raises(ValueError):
+            c.begin_leave("nope")
+
+
+# -- placement ----------------------------------------------------------------
+
+
+class TestPlacement:
+    def test_joining_node_never_serves_before_handoff(self):
+        """While ACTIVE replicas exist, placement must not select a
+        JOINING (or DOWN) node for any slice until it is handed off."""
+        c = Cluster(nodes=[Node("h0"), Node("h1")], replica_n=2)
+        c.begin_join("h2")
+        for s in range(32):
+            owners = {n.host for n in c.fragment_nodes("i", s)}
+            assert "h2" not in owners, f"slice {s} routed to JOINING node"
+        # after the handoff ack the slice flips to the target ring
+        c.mark_handed_off("i", 0)
+        target = {n.host for n in c.fragment_nodes_over(
+            c.target_ring(), "i", 0)}
+        assert {n.host for n in c.fragment_nodes("i", 0)} == target
+
+    def test_leaving_node_keeps_serving_until_handoff(self):
+        c = Cluster(nodes=[Node("h0"), Node("h1")], replica_n=1)
+        before = {s: {n.host for n in c.fragment_nodes("i", s)}
+                  for s in range(16)}
+        c.begin_leave("h1")
+        # pre-handoff, ownership is unchanged: the LEAVING node is
+        # still on the hook for its slices
+        for s in range(16):
+            assert {n.host for n in c.fragment_nodes("i", s)} == before[s]
+
+    def test_preferred_owner_state_ladder(self):
+        up = Node("a", state=NODE_STATE_UP)
+        leaving = Node("b", state=NODE_STATE_LEAVING)
+        down = Node("c", state=NODE_STATE_DOWN)
+        joining = Node("d", state=NODE_STATE_JOINING)
+        assert preferred_owner([down, leaving, up]) is up
+        assert preferred_owner([down, leaving]) is leaving
+        assert preferred_owner([joining, down]) is joining  # last resort
+        # breaker-aware: an open-breaker UP node loses to a closed one
+        up2 = Node("e", state=NODE_STATE_UP)
+        states = {"a": "open", "e": "closed"}
+        assert preferred_owner([up, up2], states.get) is up2
+        # within a tier, the coordinator's own host wins (serve the
+        # locally-held replica instead of paying an HTTP hop) — but
+        # local preference never overrides the state/breaker ladder
+        assert preferred_owner([up, up2], prefer="e") is up2
+        assert preferred_owner([up, up2], states.get, prefer="a") is up2
+        assert preferred_owner([down, leaving], prefer="c") is leaving
+
+
+# -- rebalancer ---------------------------------------------------------------
+
+
+class LocalClient:
+    """InternalClient-shaped facade over another node's in-process
+    Holder (the mockable-client seam the syncer tests use)."""
+
+    def __init__(self, holder):
+        self.holder = holder
+
+    def fragment_data(self, index, frame, view, slice_):
+        frag = self.holder.fragment(index, frame, view, slice_)
+        if frag is None:
+            return None
+        buf = io.BytesIO()
+        frag.write_to_tar(buf)
+        return buf.getvalue()
+
+    def fragment_blocks(self, index, frame, view, slice_, deadline=None):
+        frag = self.holder.fragment(index, frame, view, slice_)
+        return list(frag.blocks()) if frag is not None else []
+
+    def restore_fragment(self, index, frame, view, slice_, tar):
+        f = self.holder.frame(index, frame)
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(slice_)
+        frag.read_from_tar(io.BytesIO(tar))
+
+    def create_index(self, index, **kw):
+        self.holder.create_index_if_not_exists(index)
+
+    def create_frame(self, index, frame, **kw):
+        self.holder.index(index).create_frame_if_not_exists(frame)
+
+
+def _seed_holder(path, slices, rows=(1,)):
+    h = Holder(str(path))
+    h.open()
+    idx = h.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists("f")
+    for s in slices:
+        for r in rows:
+            f.set_bit(r, s * SLICE_WIDTH + s)
+    return h
+
+
+def _blocks(holder, s):
+    frag = holder.fragment("i", "f", "standard", s)
+    return dict(frag.blocks()) if frag is not None else {}
+
+
+class TestRebalancer:
+    def test_join_streams_verifies_and_cuts_over(self, tmp_path):
+        h0 = _seed_holder(tmp_path / "n0", range(6), rows=(1, 2))
+        h1 = Holder(str(tmp_path / "n1"))
+        h1.open()
+        # replica_n=2 over a 2-node target ring: every slice gains the
+        # joiner as an owner, so every fragment must move.
+        c = Cluster(nodes=[Node("h0")], replica_n=2)
+        c.begin_join("h1")
+        events = []
+        rb = Rebalancer(h0, c, "h0", {"h1": LocalClient(h1)}.__getitem__,
+                        broadcast=lambda a, **f: events.append((a, f)),
+                        retry_backoff=0.0)
+        rb.rebalance_once()
+        assert not c.resizing()
+        assert c.node_by_host("h1").state == NODE_STATE_UP
+        assert ("complete", {}) in events
+        cutovers = {(f["index"], f["slice"]) for a, f in events
+                    if a == "cutover"}
+        assert cutovers == {("i", s) for s in range(6)}
+        for s in range(6):
+            assert _blocks(h1, s) == _blocks(h0, s), f"slice {s} diverged"
+        snap = rb.snapshot()
+        assert snap["completed"] == 6 and snap["failed"] == 0
+        assert snap["bytes_total"] > 0
+        h0.close()
+        h1.close()
+
+    def test_leave_pulls_from_remote_source(self, tmp_path):
+        """Data owned by the LEAVING node is pulled through its client
+        and lands on the surviving owner before it drops out."""
+        c = Cluster(nodes=[Node("h0"), Node("h1")], replica_n=1)
+        owned_by_h1 = [s for s in range(8)
+                       if c.fragment_nodes("i", s)[0].host == "h1"]
+        assert owned_by_h1, "hash placed nothing on h1; widen the range"
+        h1 = _seed_holder(tmp_path / "n1", owned_by_h1)
+        # the coordinator (h0) knows the schema + max slice but holds
+        # none of h1's fragments
+        h0 = Holder(str(tmp_path / "n0"))
+        h0.open()
+        idx = h0.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f")
+        idx.set_remote_max_slice(7)
+        c.begin_leave("h1")
+        clients = {"h0": LocalClient(h0), "h1": LocalClient(h1)}
+        rb = Rebalancer(h0, c, "h0", clients.__getitem__, retry_backoff=0.0)
+        rb.rebalance_once()
+        assert not c.resizing()
+        assert c.hosts() == ["h0"]
+        for s in owned_by_h1:
+            assert _blocks(h0, s) == _blocks(h1, s)
+            assert _blocks(h0, s), f"slice {s} arrived empty"
+        h0.close()
+        h1.close()
+
+    def test_checksum_mismatch_retransfers(self, tmp_path):
+        h0 = _seed_holder(tmp_path / "n0", range(2))
+        h1 = Holder(str(tmp_path / "n1"))
+        h1.open()
+
+        class FlakyClient(LocalClient):
+            dropped = 0
+
+            def restore_fragment(self, index, frame, view, slice_, tar):
+                if FlakyClient.dropped < 1:
+                    # swallow the first restore: the verify pass sees
+                    # empty blocks on the target and must retransfer
+                    FlakyClient.dropped += 1
+                    return
+                super().restore_fragment(index, frame, view, slice_, tar)
+
+        c = Cluster(nodes=[Node("h0")], replica_n=2)
+        c.begin_join("h1")
+        rb = Rebalancer(h0, c, "h0", {"h1": FlakyClient(h1)}.__getitem__,
+                        retry_backoff=0.0)
+        rb.rebalance_once()
+        assert not c.resizing()
+        assert rb.snapshot()["checksum_mismatches"] >= 1
+        for s in range(2):
+            assert _blocks(h1, s) == _blocks(h0, s)
+        h0.close()
+        h1.close()
+
+    def test_failed_transfer_keeps_resize_pending(self, tmp_path):
+        h0 = _seed_holder(tmp_path / "n0", range(2))
+        h1 = Holder(str(tmp_path / "n1"))
+        h1.open()
+        broken = {"on": True}
+
+        class DeadClient(LocalClient):
+            def restore_fragment(self, *a, **kw):
+                if broken["on"]:
+                    raise ConnectionError("target unreachable")
+                super().restore_fragment(*a, **kw)
+
+        c = Cluster(nodes=[Node("h0")], replica_n=2)
+        c.begin_join("h1")
+        rb = Rebalancer(h0, c, "h0", {"h1": DeadClient(h1)}.__getitem__,
+                        retry_max=1, retry_backoff=0.0)
+        rb.rebalance_once()
+        # nothing promoted: the joiner stays JOINING and a re-trigger
+        # retries the plan
+        assert c.resizing()
+        assert c.node_by_host("h1").state == NODE_STATE_JOINING
+        assert rb.snapshot()["failed"] > 0
+        broken["on"] = False
+        rb.rebalance_once()
+        assert not c.resizing()
+        assert c.node_by_host("h1").state == NODE_STATE_UP
+        h0.close()
+        h1.close()
+
+
+# -- anti-entropy under churn -------------------------------------------------
+
+
+class RecordingPeer:
+    """Fake peer client: serves blocks/data from a real Fragment, or
+    raises if marked dead; records diff pushes."""
+
+    def __init__(self, frag=None, dead=False):
+        self.frag = frag
+        self.dead = dead
+        self.pushed = []
+        self.seen_kwargs = []
+
+    def fragment_blocks(self, index, frame, view, slice_, **kw):
+        self.seen_kwargs.append(kw)
+        if self.dead:
+            raise ConnectionError("peer down")
+        return list(self.frag.blocks())
+
+    def block_data(self, index, frame, view, slice_, block, **kw):
+        if self.dead:
+            raise ConnectionError("peer down")
+        rows, cols = self.frag.block_data(block)
+        return rows, cols
+
+    def execute_query(self, node, index, query, slices, remote=True):
+        if self.dead:
+            raise ConnectionError("peer down")
+        self.pushed.append(query)
+        return [True]
+
+
+class TestSyncerChurn:
+    def _frag(self, tmp_path, name, bits):
+        h = Holder(str(tmp_path / name))
+        h.open()
+        f = h.create_index_if_not_exists("i").create_frame_if_not_exists("f")
+        for row, col in bits:
+            f.set_bit(row, col)
+        return h, h.fragment("i", "f", "standard", 0)
+
+    def test_dead_peer_skipped_not_fatal(self, tmp_path):
+        """One unreachable replica must not abort the pass: the live
+        peer's divergent bits still merge in, and the skip is counted."""
+        h0, local = self._frag(tmp_path, "n0", [(1, 0)])
+        h2, remote = self._frag(tmp_path, "n2", [(1, 0), (1, 7)])
+        peers = {"h1": RecordingPeer(dead=True),
+                 "h2": RecordingPeer(remote)}
+
+        class Stats:
+            counts = {}
+
+            def count(self, name, n=1):
+                Stats.counts[name] = Stats.counts.get(name, 0) + n
+
+        nodes = [Node("h0"), Node("h1"), Node("h2")]
+        syncer = FragmentSyncer(local, "h0", nodes, peers.__getitem__,
+                                stats=Stats())
+        syncer.sync_fragment()
+        # union-of-2 consensus: the live peer's extra bit arrived
+        assert dict(local.blocks()) == dict(remote.blocks())
+        assert Stats.counts.get("syncer_peers_skipped", 0) >= 1
+        assert Stats.counts.get("syncer_blocks_merged", 0) >= 1
+        h0.close()
+        h2.close()
+
+    def test_peer_dying_mid_block_sync_converges_later(self, tmp_path):
+        """A peer that answers fragment_blocks but dies before
+        block_data contributes nothing to consensus — and its diff
+        push failing is swallowed, not raised."""
+        h0, local = self._frag(tmp_path, "n0", [(1, 0), (2, 3)])
+        h2, remote = self._frag(tmp_path, "n2", [(1, 0)])
+        flaky = RecordingPeer(remote)
+        orig = flaky.block_data
+
+        def die(*a, **kw):
+            raise ConnectionError("died mid-sync")
+
+        flaky.block_data = die
+        nodes = [Node("h0"), Node("h2")]
+        syncer = FragmentSyncer(local, "h0", nodes,
+                                {"h2": flaky}.__getitem__)
+        syncer.sync_fragment()  # must not raise
+        # local state untouched by the failed merge
+        assert dict(local.blocks()) != dict(remote.blocks())
+        flaky.block_data = orig
+        syncer.sync_fragment()
+        assert flaky.pushed, "diff push to the recovered peer missing"
+        h0.close()
+        h2.close()
+
+    def test_op_deadline_rides_block_fetches(self, tmp_path):
+        h0, local = self._frag(tmp_path, "n0", [(1, 0)])
+        h2, remote = self._frag(tmp_path, "n2", [(1, 5)])
+        peer = RecordingPeer(remote)
+        nodes = [Node("h0"), Node("h2")]
+        syncer = FragmentSyncer(local, "h0", nodes,
+                                {"h2": peer}.__getitem__, op_deadline=30.0)
+        syncer.sync_fragment()
+        assert peer.seen_kwargs and all(
+            kw.get("deadline", 0) > time.monotonic()
+            for kw in peer.seen_kwargs)
+        # and with no deadline configured the kwarg is omitted, so
+        # deadline-unaware fakes keep working
+        peer2 = RecordingPeer(remote)
+        FragmentSyncer(local, "h0", nodes,
+                       {"h2": peer2}.__getitem__).sync_fragment()
+        assert all("deadline" not in kw for kw in peer2.seen_kwargs)
+        h0.close()
+        h2.close()
+
+
+# -- /cluster/resize endpoint -------------------------------------------------
+
+
+@pytest.fixture
+def server1(tmp_path):
+    port = free_ports(1)[0]
+    c = Config()
+    c.data_dir = str(tmp_path / "node0")
+    c.host = f"127.0.0.1:{port}"
+    c.cluster_hosts = [c.host]
+    c.anti_entropy_interval = 3600
+    c.polling_interval = 3600
+    c.sched_enabled = False
+    s = Server(c)
+    s.open()
+    yield s
+    s.close()
+
+
+def _resize(server, body, remote=False):
+    params = {"remote": "true"} if remote else {}
+    resp = server.handler.handle("POST", "/cluster/resize", params=params,
+                                 body=json.dumps(body).encode())
+    return resp.status, json.loads(resp.body.decode())
+
+
+class TestResizeEndpoint:
+    def test_status_and_validation(self, server1):
+        status, out = _resize(server1, {"action": "status"})
+        assert status == 200
+        assert out["node_states"] == {server1.host: "UP"}
+        assert out["resizing"] is False
+        status, out = _resize(server1, {"action": "shrink"})
+        assert status == 400 and "unknown action" in out["error"]
+        status, out = _resize(server1, {"action": "join"})
+        assert status == 400 and "missing field" in out["error"]
+        status, out = _resize(server1, {"action": "leave",
+                                        "host": "unknown:1"})
+        assert status == 400
+
+    def test_cutover_and_remote_guard(self, server1):
+        # remote control messages apply locally without re-forwarding
+        status, out = _resize(server1, {"action": "cutover", "index": "i",
+                                        "slice": 4}, remote=True)
+        assert status == 200 and out["handoff_slices"] == 1
+        assert server1.cluster.handed_off("i", 4)
+        status, out = _resize(server1, {"action": "complete"}, remote=True)
+        assert status == 200 and out["handoff_slices"] == 0
+
+    def test_join_triggers_rebalancer_to_completion(self, server1):
+        """An admin join on an empty holder must drain immediately:
+        the joiner is promoted to ACTIVE by the service loop (forwards
+        to the unreachable phantom host are best-effort no-ops)."""
+        phantom = f"127.0.0.1:{free_ports(1)[0]}"
+        status, out = _resize(server1, {"action": "join", "host": phantom})
+        assert status == 200
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (not server1.cluster.resizing()
+                    and server1.cluster.node_by_host(phantom) is not None
+                    and server1.cluster.node_by_host(phantom).state
+                    == NODE_STATE_UP):
+                break
+            time.sleep(0.05)
+        assert not server1.cluster.resizing()
+        assert server1.cluster.node_by_host(phantom).state == NODE_STATE_UP
+
+    def test_expvar_and_metrics_report_membership(self, server1):
+        resp = server1.handler.handle("GET", "/debug/vars")
+        snap = json.loads(resp.body.decode())
+        assert snap["cluster"]["members"] == {server1.host: "UP"}
+        assert "rebalance" in snap["cluster"]
+        resp = server1.handler.handle("GET", "/metrics")
+        text = resp.body.decode()
+        assert "pilosa_member_state{" in text
+        assert "pilosa_migrations_in_flight" in text
+        assert "pilosa_migration_bytes_total" in text
+
+
+# -- chaos: join + node loss under a query herd -------------------------------
+
+
+def _post(host, path, body=b"", timeout=10):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode() or "{}")
+
+
+class TestChaosJoin:
+    def test_join_and_kill_under_herd(self, tmp_path):
+        """3-node cluster (replica 2). A 16-thread query herd runs
+        while a 4th node joins (live migration + cutover) and then an
+        original node drops. Every query must answer — success or an
+        explicit partial=true — never hang or 500. Afterwards
+        anti-entropy passes converge every replica pair
+        (fragment_blocks equality)."""
+        ports = free_ports(4)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+
+        def make(i, cluster_hosts):
+            c = Config()
+            c.data_dir = str(tmp_path / f"node{i}")
+            c.host = hosts[i]
+            c.cluster_hosts = cluster_hosts
+            c.replica_n = 2
+            c.anti_entropy_interval = 3600
+            c.polling_interval = 3600
+            c.sched_enabled = False
+            s = Server(c)
+            s.open()
+            return s
+
+        servers = [make(i, hosts[:3]) for i in range(3)]
+        joiner = None
+        n_slices = 6
+        try:
+            _post(hosts[0], "/index/i")
+            _post(hosts[0], "/index/i/frame/f")
+            q = "".join(
+                f"SetBit(rowID=1, frame=f, columnID={s * SLICE_WIDTH + s})"
+                for s in range(n_slices))
+            status, out = _post(hosts[0], "/index/i/query", q.encode())
+            assert status == 200 and out["results"] == [True] * n_slices
+
+            failures = []
+            stop = threading.Event()
+
+            def herd(i):
+                target = hosts[i % 2]  # node0/node1 stay up throughout
+                while not stop.is_set():
+                    try:
+                        st, out = _post(
+                            target, "/index/i/query?partial=true",
+                            b"Count(Bitmap(rowID=1, frame=f))")
+                        if st != 200:
+                            failures.append((target, st, out))
+                        elif (out["results"][0] != n_slices
+                              and not out.get("partial")):
+                            failures.append((target, "silent-loss", out))
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        failures.append((target, "exn", repr(e)))
+
+            threads = [threading.Thread(target=herd, args=(i,), daemon=True)
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+
+            # node 3 joins under load: it boots knowing the full
+            # 4-host ring (its own placement view matches the target
+            # ring), the admin call lands on node 0 which coordinates
+            joiner = make(3, hosts)
+            status, _ = _post(hosts[0], "/cluster/resize",
+                              json.dumps({"action": "join",
+                                          "host": hosts[3]}).encode())
+            assert status == 200
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not servers[0].cluster.resizing():
+                    break
+                time.sleep(0.1)
+            assert not servers[0].cluster.resizing(), \
+                servers[0].rebalancer.snapshot()
+            # membership converged everywhere (broadcast 'complete')
+            for s in servers[:2] + [joiner]:
+                assert set(s.cluster.hosts()) == set(hosts), s.host
+            # writes after cutover replicate on the NEW ring
+            q2 = "".join(
+                f"SetBit(rowID=3, frame=f, columnID={s * SLICE_WIDTH + 9})"
+                for s in range(n_slices))
+            status, _ = _post(hosts[0], "/index/i/query", q2.encode())
+            assert status == 200
+
+            # an original node drops out from under the herd
+            servers[2].close()
+            time.sleep(0.6)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), "herd hung"
+            assert not failures, failures[:5]
+
+            # anti-entropy converges the survivors: every live replica
+            # pair agrees on fragment_blocks
+            live = [servers[0], servers[1], joiner]
+            for s in live:
+                s._anti_entropy_tick()
+            by_host = {s.host: s for s in live}
+            compared = 0
+            for sl in range(n_slices):
+                owners = [n.host for n in
+                          servers[0].cluster.fragment_nodes("i", sl)
+                          if n.host in by_host]
+                frags = [by_host[h].holder.fragment("i", "f", "standard", sl)
+                         for h in owners]
+                blocks = [dict(f.blocks()) for f in frags if f is not None]
+                for b in blocks[1:]:
+                    assert b == blocks[0], f"slice {sl} diverged"
+                    compared += 1
+            assert compared > 0, "no replica pairs compared"
+        finally:
+            for s in servers[:2] + ([joiner] if joiner else []):
+                s.close()
