@@ -1909,6 +1909,166 @@ def main():
             if node1 is not None:
                 node1.close()
 
+    with section("sustained_ingest"):
+        # Durable-ingest headline (ISSUE 8): a sustained set_bit stream
+        # under the group-commit WAL while max_op_n forces background
+        # snapshots mid-stream and a 16-thread read herd runs
+        # throughout. Three numbers + one guard: bulk-import throughput
+        # under the herd, writer-visible set_bit p99 vs the snapshot
+        # wall time (a regression to blocking snapshots makes
+        # p99 >= wall and trips the assert), and reopen time after a
+        # kill -9 mid-ingest.
+        _progress("sustained ingest: writer p99 vs snapshot wall time")
+        import signal as _sg
+        import subprocess as _sp
+        import tempfile as _tf3
+        import threading as _th3
+
+        from pilosa_tpu.core.fragment import Fragment as _Frag
+        from pilosa_tpu.core.wal import WalConfig as _WalCfg
+
+        ing_dir = _tf3.mkdtemp(prefix="bench_ingest_")
+        frag = _Frag(os.path.join(ing_dir, "frag"), "bi", "f",
+                     "standard", 0,
+                     wal=_WalCfg(fsync_policy="group",
+                                 group_window_us=250.0,
+                                 max_op_n=100_000_000))
+        frag.open()
+        try:
+            # Seed via bulk import — timed under the read herd. The
+            # seed is deliberately large (24M bits over 256 rows) so
+            # every later snapshot has real work: the stall guard is
+            # meaningless against a near-instant snapshot.
+            rng_ = np.random.default_rng(11)
+            n_seed = 24_000_000
+            seed_rows = rng_.integers(0, 256, size=n_seed,
+                                      dtype=np.uint64)
+            seed_cols = rng_.integers(0, 1 << 20, size=n_seed,
+                                      dtype=np.uint64)
+
+            herd_stop = _th3.Event()
+            herd_reads = [0] * 16
+            herd_errs: list = []
+
+            def _reader(i_):
+                # Paced point reads, not a hot spin: a spinning herd
+                # doing full-fragment counts holds the fragment lock
+                # for a 4096-container walk per read and (on a small
+                # host) starves the GIL — that measures the thread
+                # scheduler, not the storage engine.
+                try:
+                    while not herd_stop.is_set():
+                        frag.row(herd_reads[i_] % 64).count()
+                        herd_reads[i_] += 1
+                        time.sleep(0.001)
+                except Exception as err_:  # noqa: BLE001 — fail below
+                    herd_errs.append(err_)
+
+            herd = [_th3.Thread(target=_reader, args=(i_,), daemon=True)
+                    for i_ in range(16)]
+            for t_ in herd:
+                t_.start()
+
+            t0_ = time.perf_counter()
+            frag.import_bits(seed_rows, seed_cols)
+            import_dt = time.perf_counter() - t0_
+
+            # Sustained per-bit stream: 4 writers, every latency
+            # recorded AFTER the commit barrier returned (the ack a
+            # client would see), with max_op_n small enough that
+            # several background snapshots trigger mid-stream.
+            frag.max_op_n = 512
+            lat_mu = _th3.Lock()
+            lats: list = []
+            snaps0 = frag._snap_gen
+
+            def _writer(r_):
+                mine = []
+                for i_ in range(400):
+                    tb_ = time.perf_counter()
+                    frag.set_bit(1000 + r_, r_ * 20_000 + i_)
+                    mine.append(time.perf_counter() - tb_)
+                with lat_mu:
+                    lats.extend(mine)
+
+            ws = [_th3.Thread(target=_writer, args=(r_,))
+                  for r_ in range(4)]
+            t0_ = time.perf_counter()
+            for t_ in ws:
+                t_.start()
+            for t_ in ws:
+                t_.join()
+            stream_dt = time.perf_counter() - t0_
+            herd_stop.set()
+            for t_ in herd:
+                t_.join(timeout=10)
+            assert not herd_errs, herd_errs
+            assert frag.wait_snapshot(timeout=60)
+            snaps_during = frag._snap_gen - snaps0
+            snap_wall_s = frag._last_snapshot_s
+            lats.sort()
+            p99 = lats[int(len(lats) * 0.99)]
+
+            # Kill -9 mid-ingest, then time the reopen (side-WAL
+            # replay + torn-tail truncation + cache rebuild).
+            child = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tests", "ingest_child.py")
+            kdir = _tf3.mkdtemp(prefix="bench_ingest_kill_")
+            proc = _sp.Popen(
+                [sys.executable, child, kdir, "group", "none", "0"],
+                stdout=_sp.PIPE, text=True)
+            acked = 0
+            for line_ in proc.stdout:
+                if line_.startswith("A "):
+                    acked += 1
+                    if acked >= 300:
+                        break
+            proc.send_signal(_sg.SIGKILL)
+            proc.wait(timeout=30)
+            proc.stdout.close()
+            t0_ = time.perf_counter()
+            frag2 = _Frag(os.path.join(kdir, "frag"), "i", "f",
+                          "standard", 0)
+            frag2.open()
+            frag2.ensure_loaded()
+            recov_dt = time.perf_counter() - t0_
+            recovered = frag2.count()
+            frag2.close()
+
+            details["sustained_ingest"] = {
+                "fsync_policy": "group",
+                "import_bits": n_seed,
+                "import_bits_per_s": n_seed / import_dt,
+                "herd_reads_during_ingest": sum(herd_reads),
+                "stream_ops": len(lats),
+                "stream_ops_per_s": len(lats) / stream_dt,
+                "set_bit_p50_us": lats[len(lats) // 2] * 1e6,
+                "set_bit_p99_us": p99 * 1e6,
+                "set_bit_max_us": lats[-1] * 1e6,
+                "snapshots_during_stream": snaps_during,
+                "snapshot_wall_us": snap_wall_s * 1e6,
+                "p99_over_snapshot_wall": p99 / snap_wall_s,
+                "wal_fsyncs": frag._wal.fsyncs,
+                "recovery_after_kill9_ms": recov_dt * 1e3,
+                "recovered_bits": recovered,
+                "acked_before_kill": acked}
+            assert snaps_during >= 1, \
+                "max_op_n never triggered a background snapshot"
+            # THE guard: a writer ack must never absorb a whole
+            # snapshot. Blocking snapshots put the rewrite inside the
+            # write path, so p99 >= wall; the non-blocking engine
+            # keeps p99 at group-commit cost.
+            assert p99 < snap_wall_s, (
+                f"writer p99 {p99 * 1e3:.2f}ms >= snapshot wall "
+                f"{snap_wall_s * 1e3:.2f}ms: snapshots are blocking "
+                f"the write path again")
+            assert recovered >= acked, (acked, recovered)
+            assert recov_dt < 5.0, \
+                f"post-kill-9 reopen took {recov_dt:.1f}s"
+        finally:
+            frag.close()
+
     # Cache-layer counters for the whole run (query memo, leaf blocks,
     # per-slice memos, leaf matrices, mesh-side memo/batch stats) — the
     # judge-visible proof of which r4/r5 mechanisms actually fired.

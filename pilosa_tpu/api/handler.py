@@ -70,6 +70,7 @@ from ..errors import (
     IndexNotFoundError,
     PilosaError,
     QueryError,
+    WriteBackpressureError,
 )
 from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
@@ -367,6 +368,8 @@ def _error_status(err: Exception) -> int:
         return 504
     if isinstance(err, AdmissionError):
         return 429
+    if isinstance(err, WriteBackpressureError):
+        return 503
     if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
                         FragmentNotFoundError)):
         return 404
@@ -593,6 +596,7 @@ class Handler:
         reg.register_collector(self._collect_membership)
         reg.register_collector(self._collect_sched)
         reg.register_collector(self._collect_fragments)
+        reg.register_collector(self._collect_storage)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
@@ -870,6 +874,39 @@ class Handler:
                 nf.add(pending, dict(labels, state="pending"))
         return [rc, card, nf]
 
+    def _collect_storage(self) -> list:
+        """WAL durability telemetry (process-wide, core/wal.py): fsync
+        and backpressure counters, group-commit batch sizes, background
+        snapshot wall times."""
+        prom = obs.prom
+        from ..core.wal import GROUP_SIZE, SNAPSHOT_US, WAL_STATS
+
+        fsync = prom.MetricFamily(
+            "pilosa_wal_fsync_total", "counter",
+            "WAL group-commit fsyncs across all fragments.")
+        fsync.add(WAL_STATS.get("fsync", 0))
+        bp = prom.MetricFamily(
+            "pilosa_wal_backpressure_total", "counter",
+            "Writers gated (state=gated) or shed with 503 (state=shed) "
+            "by the [storage] max-wal-ops bound.")
+        bp.add(WAL_STATS.get("backpressure", 0), {"state": "gated"})
+        bp.add(WAL_STATS.get("backpressure_shed", 0), {"state": "shed"})
+        snaps = prom.MetricFamily(
+            "pilosa_storage_snapshots_total", "counter",
+            "Background fragment snapshots by outcome.")
+        snaps.add(WAL_STATS.get("snapshots", 0), {"outcome": "ok"})
+        snaps.add(WAL_STATS.get("snapshots_failed", 0),
+                  {"outcome": "error"})
+        group = prom.MetricFamily(
+            "pilosa_wal_group_size", "histogram",
+            "Ops coalesced per WAL commit (group-commit batch size).")
+        group.add_histogram(GROUP_SIZE)
+        swall = prom.MetricFamily(
+            "pilosa_storage_snapshot_us", "histogram",
+            "Background snapshot wall time (microseconds).")
+        swall.add_histogram(SNAPSHOT_US)
+        return [fsync, bp, snaps, group, swall]
+
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
         snap["uptime_seconds"] = round(
@@ -908,6 +945,11 @@ class Handler:
         # cohort-size percentiles (sched.QueryScheduler.snapshot).
         if self.scheduler is not None:
             snap = dict(snap, sched=self.scheduler.snapshot())
+        # Per-fragment durability/snapshot state (guarded: test fakes
+        # stand in for the holder without storage_state).
+        ss = getattr(self.holder, "storage_state", None)
+        if ss is not None:
+            snap = dict(snap, storage=ss())
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:
@@ -1607,6 +1649,19 @@ class Handler:
         return _json_resp(out)
 
     def _query_error(self, e, headers) -> Response:
+        if isinstance(e, WriteBackpressureError):
+            # Write shed (WAL bound exceeded, snapshot behind): 503 +
+            # Retry-After, the write-path sibling of _shed_response —
+            # transient, so the cluster client's retry classification
+            # backs off and retries instead of failing the import.
+            retry = max(1, int(round(e.retry_after_s)))
+            if self._accepts_proto(headers):
+                resp = _proto_resp(pb.QueryResponse(err=str(e)), 503)
+            else:
+                resp = _json_resp({"error": str(e),
+                                   "retry_after_s": retry}, 503)
+            resp.headers["Retry-After"] = str(retry)
+            return resp
         status = 504 if isinstance(e, DeadlineExceededError) else 400
         if self._accepts_proto(headers):
             return _proto_resp(pb.QueryResponse(err=str(e)), status)

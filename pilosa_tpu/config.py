@@ -70,6 +70,24 @@ TOML schema:
     [sched.tenant-weights]      # X-Pilosa-Tenant -> WFQ weight
     # gold = 4                  # (unlisted tenants weigh 1)
 
+    [storage]
+    fsync-policy = "group"      # never | group | always: what an acked
+                                # set_bit survives. never = process kill
+                                # only (no fsync, the historical
+                                # behavior); group = power loss, one
+                                # fsync per commit window shared by all
+                                # concurrent writers; always = power
+                                # loss, fsync per barrier
+    group-commit-window-us = 250  # how long the commit leader lets a
+                                # group accumulate before its fsync
+    max-wal-ops = 65536         # pending-op bound per fragment before
+                                # writers backpressure (0 = unbounded)
+    backpressure-deadline = "1s"  # how long a gated writer waits for a
+                                # snapshot to land before shedding with
+                                # HTTP 503 + Retry-After
+    max-op-n = 0                # snapshot threshold per fragment;
+                                # 0 = default (2000)
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -223,6 +241,14 @@ class Config:
         self.sched_queue_depth: int = 256
         self.sched_default_service_us: float = 1500.0
         self.sched_tenant_weights: dict = {}
+        # [storage] — durable sustained-write ingest (core/wal.py):
+        # group-commit fsync policy, WAL bound + backpressure deadline,
+        # snapshot threshold override (0 = fragment default).
+        self.storage_fsync_policy: str = "group"
+        self.storage_group_window_us: float = 250.0
+        self.storage_max_wal_ops: int = 65536
+        self.storage_backpressure_deadline: float = 1.0
+        self.storage_max_op_n: int = 0
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -314,6 +340,17 @@ class Config:
         c.sched_tenant_weights = {
             str(k): float(v)
             for k, v in dict(sc.get("tenant-weights", {})).items()}
+        st = data.get("storage", {})
+        c.storage_fsync_policy = str(st.get("fsync-policy",
+                                            c.storage_fsync_policy))
+        c.storage_group_window_us = float(
+            st.get("group-commit-window-us", c.storage_group_window_us))
+        c.storage_max_wal_ops = int(st.get("max-wal-ops",
+                                           c.storage_max_wal_ops))
+        if "backpressure-deadline" in st:
+            c.storage_backpressure_deadline = parse_duration(
+                st["backpressure-deadline"])
+        c.storage_max_op_n = int(st.get("max-op-n", c.storage_max_op_n))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -324,6 +361,19 @@ class Config:
         if self.anti_entropy_jitter >= 0:
             return self.anti_entropy_jitter
         return 0.1 * self.anti_entropy_interval
+
+    def wal_config(self):
+        """Build the [storage] WalConfig threaded Holder -> Fragment.
+        Raises ValueError on a bad fsync-policy (a typo must not
+        silently weaken durability)."""
+        from .core.wal import WalConfig
+
+        return WalConfig(
+            fsync_policy=self.storage_fsync_policy,
+            group_window_us=self.storage_group_window_us,
+            max_wal_ops=self.storage_max_wal_ops,
+            backpressure_deadline=self.storage_backpressure_deadline,
+            max_op_n=self.storage_max_op_n or None)
 
     def use_device_flag(self):
         """Executor use_device arg: None = auto, True/False = forced.
@@ -389,6 +439,14 @@ class Config:
             f"\n[sched.tenant-weights]\n"
             + "".join(f'"{k}" = {v}\n'
                       for k, v in sorted(self.sched_tenant_weights.items()))
+            + f"\n[storage]\n"
+            f'fsync-policy = "{self.storage_fsync_policy}"\n'
+            f"group-commit-window-us = "
+            f"{int(self.storage_group_window_us)}\n"
+            f"max-wal-ops = {self.storage_max_wal_ops}\n"
+            f'backpressure-deadline = '
+            f'"{int(self.storage_backpressure_deadline * 1000)}ms"\n'
+            f"max-op-n = {self.storage_max_op_n}\n"
         )
 
 

@@ -30,11 +30,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import SLICE_WIDTH
+from .. import SLICE_WIDTH, fault
+from ..errors import WriteBackpressureError
+from ..obs import profile as _profile
 from ..obs.log import get_logger
 from ..roaring import Bitmap
+from ..roaring.serialize import scan_ops
 from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_cache
 from .row import Row
+from .wal import SNAPSHOT_US, WAL_STATS, WalCommitter, WalConfig
+from .wal import FSYNC_NEVER as _FSYNC_NEVER
 
 # Snapshot after this many WAL ops (reference fragment.go:62-65).
 MAX_OP_N = 2000
@@ -144,7 +149,8 @@ class Fragment:
     def __init__(self, path: str, index: str, frame: str, view: str, slice_: int,
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
-                 row_attr_store=None, stats=None):
+                 row_attr_store=None, stats=None,
+                 wal: Optional[WalConfig] = None):
         self.path = path
         self.index = index
         self.frame = frame
@@ -162,13 +168,35 @@ class Fragment:
         self._mu = threading.RLock()
         self.storage = Bitmap()
         self.op_n = 0
-        self.max_op_n = MAX_OP_N
+        # Durability policy ([storage] config). A bare Fragment (tests,
+        # embedded use) keeps the historical write-through/no-fsync
+        # behavior; server deployments get the config default (group).
+        self.wal_cfg = wal if wal is not None else WalConfig(
+            fsync_policy=_FSYNC_NEVER)
+        self.max_op_n = (self.wal_cfg.max_op_n
+                         if self.wal_cfg.max_op_n else MAX_OP_N)
+        self._wal = WalCommitter(self.wal_cfg, stats=stats, path=path)
         self.cache = new_cache(cache_type, cache_size)
         self.checksums: Dict[int, bytes] = {}
         self._op_file = None
         self._lock_file = None
         self._pending_load = True
         self._loading = False
+        # Non-blocking snapshot state. `_snapshotting` flags a frozen
+        # view being written in the background while ops are redirected
+        # to the side `.wal` file; `_snap_gen` counts completed
+        # attempts (success or failure) so forced-snapshot callers can
+        # wait for "a snapshot that started after my mutation".
+        self._snapshotting = False
+        self._snap_thread: Optional[threading.Thread] = None
+        self._snap_done = threading.Event()
+        self._snap_done.set()
+        self._snap_gen = 0
+        self._snap_err: Optional[BaseException] = None
+        self._side_file = None
+        self._snap_base_op_n = 0
+        self._resnap = False
+        self._last_snapshot_s = 0.0
         # Materialized-row LRU, bounded: a TopN over a wide row space
         # (or a long-lived server touching many rows) must not pin one
         # Row per row id forever — each cached Row holds its segment
@@ -257,32 +285,85 @@ class Fragment:
             else:
                 with open(self.path, "wb") as f:
                     self.storage.write_to(f)
-            # Unbuffered: each 13-byte op reaches the OS immediately —
-            # the durability point (reference appends straight to the
-            # fd, roaring.go:617-628; a buffered handle would lose ops
-            # on crash).
+            # Unbuffered append fd; ops route through the per-fragment
+            # WAL committer, which write-throughs (fsync-policy never)
+            # or group-commits (group/always) per [storage] config.
             self._op_file = open(self.path, "ab", buffering=0)
-            self.storage.op_writer = self._op_file
+            self._wal.retarget(self._op_file)
+            self.storage.op_writer = self._wal
+            self._replay_side_wal()
             self._load_cache()
             self._pending_load = False
         finally:
             self._loading = False
 
-    @_locked
+    def _replay_side_wal(self):
+        """Crash recovery for a background snapshot that died mid-way:
+        a leftover side `.wal` file holds every op accepted after the
+        snapshot's freeze point. Replay it onto the loaded image and
+        splice its bytes into the main file (append + fsync BEFORE
+        unlinking — dropping the side file first would lose acked ops
+        to a crash in between). Ops are absolute positions, so replay
+        is idempotent whether the main file is the pre-crash original
+        (rename never happened) or the renamed snapshot — and even if
+        a previous splice appended but didn't unlink."""
+        tmp = self.path + ".snapshotting"
+        if os.path.exists(tmp):
+            # Snapshot temp never renamed: dead weight.
+            os.unlink(tmp)
+        side_path = self.path + ".wal"
+        if not os.path.exists(side_path):
+            return
+        with open(side_path, "rb") as f:
+            data = f.read()
+        ops, valid, torn = scan_ops(data)
+        if torn:
+            get_logger("pilosa.fragment").warning(
+                "torn side-WAL tail: dropping %d trailing bytes of %s "
+                "(crash recovery)", torn, side_path)
+        for typ, value in ops:
+            if typ == 0:
+                self.storage._add_one(value)
+            else:
+                self.storage._remove_one(value)
+        if valid:
+            self._op_file.write(data[:valid])
+            os.fsync(self._op_file.fileno())
+        os.unlink(side_path)
+        self.op_n += len(ops)
+        self.storage.op_n = self.op_n
+        if ops:
+            get_logger("pilosa.fragment").info(
+                "replayed %d side-WAL ops into %s (crash recovery)",
+                len(ops), self.path)
+
     def close(self):
-        self.flush_cache()
-        if self._op_file is not None:
-            self._op_file.close()
-            self._op_file = None
-        self.storage.op_writer = None
-        if self._lock_file is not None:
-            fcntl.flock(self._lock_file, fcntl.LOCK_UN)
-            self._lock_file.close()
-            self._lock_file = None
-        # A reopened fragment must re-parse and re-attach the WAL —
-        # a stale loaded flag would leave op_writer detached and
-        # silently drop acked writes on the floor.
-        self._pending_load = True
+        # Drain any in-flight background snapshot (and chained
+        # re-snapshot) BEFORE tearing down fds. Joined outside _mu:
+        # the worker's finish step needs the fragment lock.
+        while True:
+            with self._mu:
+                t = self._snap_thread if self._snapshotting else None
+            if t is None:
+                break
+            t.join()
+        with self._mu:
+            self.flush_cache()
+            # Flush + release barrier waiters; pending buffered ops
+            # reach disk (fsynced under a syncing policy).
+            self._wal.detach()
+            if self._op_file is not None:
+                self._op_file.close()
+                self._op_file = None
+            self.storage.op_writer = None
+            if self._lock_file is not None:
+                fcntl.flock(self._lock_file, fcntl.LOCK_UN)
+                self._lock_file.close()
+                self._lock_file = None
+            # A reopened fragment must re-parse and re-attach the WAL —
+            # a stale loaded flag would leave op_writer detached and
+            # silently drop acked writes on the floor.
+            self._pending_load = True
 
     # -- reads -------------------------------------------------------------
 
@@ -332,35 +413,105 @@ class Fragment:
     def _pos(self, row_id: int, column_id: int) -> int:
         return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
 
-    @_loaded
-    def set_bit(self, row_id: int, column_id: int) -> bool:
-        """Set a bit; WAL-append, maybe snapshot, update caches.
-        Returns True if the bit was newly set (fragment.go:371-413)."""
-        pos = self._pos(row_id, column_id)
-        churn = self.storage._find_key(pos >> 16) < 0
-        changed = self.storage.add(pos)
-        self._log_append(0, pos, churn)
-        self._mark_dirty(row_id)
-        if changed:
-            self.cache.add(row_id, self.row(row_id).count())
-            if self.stats:
-                self.stats.count("setN", 1)
-        self._increment_op_n()
+    def set_bit(self, row_id: int, column_id: int,
+                deadline: Optional[float] = None) -> bool:
+        """Set a bit; WAL-append, update caches, wait the durability
+        barrier. Returns True if the bit was newly set
+        (fragment.go:371-413). `deadline` (absolute monotonic, from
+        the query's ExecOptions) caps any backpressure wait."""
+        self._wal_gate(deadline)
+        with self._mu:
+            self.ensure_loaded()
+            pos = self._pos(row_id, column_id)
+            churn = self.storage._find_key(pos >> 16) < 0
+            changed = self.storage.add(pos)
+            seq = self._wal.seq()
+            self._log_append(0, pos, churn)
+            self._mark_dirty(row_id)
+            if changed:
+                # Row-cache update happens BEFORE the snapshot trigger
+                # (and the trigger itself is now only an async flip), so
+                # a max_op_n=1 fragment never recounts a row mid-
+                # snapshot-churn.
+                self.cache.add(row_id, self.row(row_id).count())
+                if self.stats:
+                    self.stats.count("setN", 1)
+            self._increment_op_n()
+        with _profile.phase("wal_commit"):
+            self._wal.wait_durable(seq)
         return changed
 
-    @_loaded
-    def clear_bit(self, row_id: int, column_id: int) -> bool:
-        pos = self._pos(row_id, column_id)
-        changed = self.storage.remove(pos)
-        churn = changed and self.storage._find_key(pos >> 16) < 0
-        self._log_append(1, pos, churn)
-        self._mark_dirty(row_id)
-        if changed:
-            self.cache.add(row_id, self.row(row_id).count())
-            if self.stats:
-                self.stats.count("clearN", 1)
-        self._increment_op_n()
+    def clear_bit(self, row_id: int, column_id: int,
+                  deadline: Optional[float] = None) -> bool:
+        self._wal_gate(deadline)
+        with self._mu:
+            self.ensure_loaded()
+            pos = self._pos(row_id, column_id)
+            changed = self.storage.remove(pos)
+            seq = self._wal.seq()
+            churn = changed and self.storage._find_key(pos >> 16) < 0
+            self._log_append(1, pos, churn)
+            self._mark_dirty(row_id)
+            if changed:
+                self.cache.add(row_id, self.row(row_id).count())
+                if self.stats:
+                    self.stats.count("clearN", 1)
+            self._increment_op_n()
+        with _profile.phase("wal_commit"):
+            self._wal.wait_durable(seq)
         return changed
+
+    def _pending_wal_ops(self) -> int:
+        """Ops not yet covered by a completed or in-flight-frozen
+        snapshot — the quantity [storage] max-wal-ops bounds. During a
+        background snapshot that's the side-WAL op count; otherwise
+        the whole un-snapshotted log."""
+        if self._snapshotting:
+            return self.op_n - self._snap_base_op_n
+        return self.op_n
+
+    def _wal_gate(self, deadline: Optional[float] = None):
+        """Write backpressure: when the snapshot falls behind sustained
+        ingest and the pending WAL outgrows max-wal-ops, block the
+        writer (outside _mu — readers keep serving) until a snapshot
+        lands or the deadline expires, then shed with
+        WriteBackpressureError (HTTP 503 + Retry-After)."""
+        limit = self.wal_cfg.max_wal_ops
+        if limit <= 0 or self._pending_load:
+            return
+        if self._mu._is_owned():
+            # Reentrant write (consensus merge holding _mu): blocking
+            # here could never make progress — the snapshot's finish
+            # step needs the lock this thread already holds.
+            return
+        # Unlocked int reads: the bound is advisory within one op.
+        if self._pending_wal_ops() <= limit:
+            return
+        WAL_STATS.inc("backpressure")
+        if self.stats:
+            self.stats.count("wal_backpressureN", 1)
+        give_up = time.monotonic() + self.wal_cfg.backpressure_deadline
+        if deadline is not None:
+            give_up = min(give_up, deadline)
+        while True:
+            with self._mu:
+                if self._pending_wal_ops() <= limit:
+                    return
+                if not self._snapshotting:
+                    self._start_snapshot()
+                done = self._snap_done
+            remaining = give_up - time.monotonic()
+            if remaining <= 0:
+                WAL_STATS.inc("backpressure_shed")
+                if self.stats:
+                    self.stats.count("wal_shedN", 1)
+                retry = max(1.0, self._last_snapshot_s or 1.0)
+                raise WriteBackpressureError(
+                    f"write backpressure: {self._pending_wal_ops()} "
+                    f"pending WAL ops > max-wal-ops={limit} on "
+                    f"{self.frame}/{self.view}/{self.slice}",
+                    retry_after_s=retry)
+            done.wait(min(remaining, 0.05))
 
     # -- mutation log (device-image maintenance) -----------------------------
 
@@ -404,57 +555,242 @@ class Fragment:
 
     def _increment_op_n(self):
         self.op_n += 1
-        if self.op_n > self.max_op_n:
-            self.snapshot()
+        if self.op_n > self.max_op_n and not self._snapshotting:
+            # Async flip only — the writer never waits for the rewrite.
+            self._start_snapshot()
 
-    @_loaded
     def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]):
         """Bulk import: WAL-detached adds + forced snapshot
-        (fragment.go:922-989)."""
+        (fragment.go:922-989). Goes through the non-blocking snapshot
+        engine but WAITS for it to land — a bulk import's ops have no
+        WAL records, so its commit barrier IS the snapshot. Concurrent
+        readers and per-bit writers on other rows keep serving
+        throughout (the rewrite happens off a frozen view)."""
         rows = np.asarray(row_ids, dtype=np.uint64)
         cols = np.asarray(column_ids, dtype=np.uint64)
         if rows.shape != cols.shape:
             raise ValueError("row/column mismatch")
         pos = rows * np.uint64(SLICE_WIDTH) + (cols % np.uint64(SLICE_WIDTH))
+        while True:
+            # Apply only when a covering snapshot can start at once: a
+            # freeze taken BEFORE the bulk apply would persist a state
+            # the import's barrier doesn't cover.
+            with self._mu:
+                self.ensure_loaded()
+                if not self._snapshotting:
+                    self._import_apply_locked(rows, pos)
+                    target = self._snap_gen + 1
+                    self._start_snapshot()
+                    break
+                done = self._snap_done
+            done.wait()
+        self._await_snapshot(target)
+
+    def _import_apply_locked(self, rows: np.ndarray, pos: np.ndarray):
+        """In-memory bulk apply. On ANY failure after partial mutation
+        the fragment reloads from disk — bulk ops write no WAL records,
+        so the on-disk state is still the consistent pre-import image
+        and re-parsing it restores memory to match (the alternative,
+        snapshotting a partially-applied import, would silently persist
+        half a bulk load)."""
         self.storage.op_writer = None
         try:
             self.storage.add_many(pos)
+            fault.point("storage.import_apply", path=self.path)
+            self._mark_dirty(None)
+            for r in np.unique(rows):
+                self.cache.bulk_add(int(r), self.row(int(r)).count())
+            self.cache.invalidate()
+        except BaseException:
+            self._reload_from_disk()
+            raise
         finally:
-            self.storage.op_writer = self._op_file
-        self._mark_dirty(None)
-        for r in np.unique(rows):
-            self.cache.bulk_add(int(r), self.row(int(r)).count())
-        self.cache.invalidate()
-        self.snapshot()
+            self.storage.op_writer = self._wal
 
-    @_loaded
+    def _reload_from_disk(self):
+        """Discard the in-memory image and re-parse the on-disk state
+        (failed bulk-import recovery). The append fd and flock stay as
+        they are; buffered WAL ops are flushed first so the file covers
+        every accepted per-bit op."""
+        self._wal.flush()
+        with open(self.path, "rb") as f:
+            data = f.read()
+        self.storage = Bitmap.from_bytes(data)
+        self.op_n = self.storage.op_n
+        self.storage.op_writer = self._wal
+        self._mark_dirty(None)
+        self.cache = new_cache(self.cache_type, self.cache_size)
+        self.rebuild_cache()
+
+    # -- non-blocking snapshots ----------------------------------------------
+
     def snapshot(self):
-        """Atomically rewrite the file: write temp, fsync, rename, reopen
-        WAL (fragment.go:992-1057)."""
+        """Force a snapshot covering the current state and wait for it
+        to land (temp + fsync + rename, spliced side WAL). Raises the
+        background writer's error, if any — with the fragment left
+        fully serviceable either way (the op writer is never detached;
+        a failed attempt drains the side WAL back into the still-valid
+        main file)."""
+        with self._mu:
+            self.ensure_loaded()
+            target = self._request_snapshot_locked()
+        self._await_snapshot(target)
+
+    def wait_snapshot(self, timeout: Optional[float] = None) -> bool:
+        """Block until no snapshot is in flight (tests/operators).
+        Returns False on timeout."""
+        give_up = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._mu:
+                if not self._snapshotting:
+                    return True
+                done = self._snap_done
+            left = None if give_up is None else give_up - time.monotonic()
+            if left is not None and left <= 0:
+                return False
+            done.wait(left)
+
+    def storage_state(self) -> dict:
+        """Durability/snapshot state for /debug/vars (unlocked reads:
+        a racing writer skews a counter by one, never tears)."""
+        return {
+            "op_n": self.op_n,
+            "max_op_n": self.max_op_n,
+            "pending_wal_ops": self._pending_wal_ops(),
+            "snapshotting": self._snapshotting,
+            "fsync_policy": self.wal_cfg.fsync_policy,
+            "wal_fsyncs": self._wal.fsyncs,
+            "last_snapshot_ms": round(self._last_snapshot_s * 1e3, 3),
+        }
+
+    def _request_snapshot_locked(self) -> int:
+        """Ensure a snapshot covering the CURRENT storage state will
+        run; returns the generation to wait for. If one is already in
+        flight its freeze predates us, so chain another behind it."""
+        if self._snapshotting:
+            self._resnap = True
+            return self._snap_gen + 2
+        self._start_snapshot()
+        return self._snap_gen + 1
+
+    def _start_snapshot(self):
+        """The redirect flip (holds _mu, cost O(containers) + one
+        fsync): freeze the storage view, aim the committer at a fresh
+        side `.wal` file, and hand the frozen image to a background
+        writer. This is the only stall a writer ever pays for a
+        snapshot."""
+        frozen = self.storage.freeze_view()
+        self._side_file = open(self.path + ".wal", "wb", buffering=0)
+        # Drains + fsyncs pending ops into the main file first, so the
+        # main/side split is exactly at the freeze point.
+        self._wal.retarget(self._side_file)
+        self._snap_base_op_n = self.op_n
+        self._snapshotting = True
+        self._snap_done = threading.Event()
+        self._snap_thread = threading.Thread(
+            target=self._snapshot_worker, args=(frozen,),
+            name=f"snapshot:{self.frame}/{self.view}/{self.slice}",
+            daemon=True)
+        self._snap_thread.start()
+
+    def _snapshot_worker(self, frozen: Bitmap):
         start = time.monotonic()
-        if self._op_file is not None:
-            self._op_file.close()
-            self._op_file = None
+        err: Optional[BaseException] = None
         tmp = self.path + ".snapshotting"
-        with open(tmp, "wb") as f:
-            self.storage.write_to(f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        self.storage.op_n = 0
-        self.op_n = 0
-        self._op_file = open(self.path, "ab", buffering=0)
-        self.storage.op_writer = self._op_file
-        elapsed = time.monotonic() - start
+        try:
+            with open(tmp, "wb") as f:
+                frozen.write_to(f)
+                f.flush()
+                fault.point("storage.fsync", path=self.path,
+                            kind="snapshot")
+                os.fsync(f.fileno())
+            fault.point("storage.rename", path=self.path)
+            os.replace(tmp, self.path)
+        except BaseException as e:  # noqa: BLE001 — must reach _finish
+            err = e
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._finish_snapshot(err, start)
+
+    def _finish_snapshot(self, err: Optional[BaseException], start: float):
+        """Splice (briefly under _mu): drain the side WAL into the new
+        main file — or, on a failed attempt, back into the still-valid
+        old one — reattach the committer, and wake waiters. The side
+        file is unlinked only AFTER its bytes are durable in main."""
+        with self._mu:
+            try:
+                side_path = self.path + ".wal"
+                if err is None:
+                    target = open(self.path, "ab", buffering=0)
+                else:
+                    target = self._op_file
+                # Flushes buffered ops into the side file (fsynced
+                # under a syncing policy), then aims appends at main.
+                self._wal.retarget(target)
+                self._side_file.close()
+                self._side_file = None
+                with open(side_path, "rb") as sf:
+                    side_bytes = sf.read()
+                if side_bytes:
+                    target.write(side_bytes)
+                    if self.wal_cfg.fsync_policy != _FSYNC_NEVER:
+                        os.fsync(target.fileno())
+                os.unlink(side_path)
+                if err is None:
+                    self._op_file.close()
+                    self._op_file = target
+                    self.op_n -= self._snap_base_op_n
+                    self.storage.op_n = self.op_n
+                # On failure op_n keeps counting from the last real
+                # snapshot; the next trigger retries the whole flip.
+            finally:
+                elapsed = time.monotonic() - start
+                self._last_snapshot_s = elapsed
+                self._snap_err = err
+                self._snap_gen += 1
+                self._snapshotting = False
+                self._snap_thread = None
+                resnap, self._resnap = self._resnap, False
+                # Capture THIS attempt's event before a chained
+                # re-snapshot replaces _snap_done with a fresh one —
+                # waiters parked on the old event must still wake.
+                done_evt = self._snap_done
+                if resnap:
+                    self._start_snapshot()
+                done_evt.set()
+        SNAPSHOT_US.observe(int(elapsed * 1e6))
+        WAL_STATS.inc("snapshots_failed" if err else "snapshots")
         if self.stats:
             self.stats.timing("snapshot_us", int(elapsed * 1e6))
-        if elapsed > 0.1:
+        if err is not None:
+            get_logger("fragment").warning(
+                "snapshot failed: %s (%s/%s/%d): %s — side WAL drained "
+                "back into main, will retry",
+                self.path, self.frame, self.view, self.slice, err)
+        elif elapsed > 0.1:
             # Slow-snapshot visibility (the reference's track() logging,
-            # fragment.go:1012-1020) — a write stall a client felt.
+            # fragment.go:1012-1020) — now background wall time, not a
+            # write stall a client felt.
             get_logger("fragment").info(
-                "slow snapshot: %s (%s/%s/%d) took %.0f ms",
+                "slow snapshot: %s (%s/%s/%d) took %.0f ms (background)",
                 self.path, self.frame, self.view, self.slice,
                 elapsed * 1e3)
+
+    def _await_snapshot(self, target_gen: int):
+        """Wait (WITHOUT holding _mu — the worker's finish step needs
+        it) until `target_gen` snapshots have completed; raise the
+        covering attempt's error."""
+        while True:
+            with self._mu:
+                if self._snap_gen >= target_gen:
+                    err = self._snap_err
+                    break
+                done = self._snap_done
+            done.wait()
+        if err is not None:
+            raise err
 
     # -- TopN ---------------------------------------------------------------
 
@@ -652,13 +988,17 @@ class Fragment:
             if clears.size:
                 self.storage.remove_many(clears)
         finally:
-            self.storage.op_writer = self._op_file
+            self.storage.op_writer = self._wal
         self._mark_dirty(None)
         for r in np.unique(np.concatenate([sets, clears])
                            // np.uint64(SLICE_WIDTH)):
             self.cache.bulk_add(int(r), self.row(int(r)).count())
         self.cache.invalidate()
-        self.snapshot()
+        # Runs under the caller's _mu (merge_block): WAITING for the
+        # snapshot here would deadlock with its finish step, which
+        # needs this lock. Request coverage and return — anti-entropy
+        # re-converges if a crash beats the background write.
+        self._request_snapshot_locked()
 
     # -- cache persistence ---------------------------------------------------
 
@@ -727,22 +1067,39 @@ class Fragment:
             info.mtime = int(time.time())
             tar.addfile(info, io.BytesIO(cache))
 
-    @_loaded
     def read_from_tar(self, fileobj):
         """Restore from a tar archive produced by write_to_tar
-        (fragment.go:1155-1266)."""
+        (fragment.go:1155-1266). The data member replaces storage
+        wholesale, then rides the non-blocking snapshot engine —
+        applied only between snapshots (a freeze taken before the
+        swap would persist the pre-restore image) and waited on
+        OUTSIDE _mu."""
         with tarfile.open(fileobj=fileobj, mode="r|") as tar:
             for member in tar:
                 buf = tar.extractfile(member).read()
                 if member.name == "data":
-                    self.storage.op_writer = None
-                    self.storage = Bitmap.from_bytes(buf)
-                    self._mark_dirty(None)
-                    self.snapshot()
+                    while True:
+                        with self._mu:
+                            self.ensure_loaded()
+                            if not self._snapshotting:
+                                self.storage.op_writer = None
+                                self.storage = Bitmap.from_bytes(buf)
+                                self.op_n = self.storage.op_n
+                                self.storage.op_writer = self._wal
+                                self._mark_dirty(None)
+                                target = self._snap_gen + 1
+                                self._start_snapshot()
+                                break
+                            done = self._snap_done
+                        done.wait()
+                    self._await_snapshot(target)
                 elif member.name == "cache":
-                    for id_, _n in json.loads(buf or b"[]"):
-                        self.cache.bulk_add(int(id_), self.row(int(id_)).count())
-                    self.cache.recalculate()
+                    with self._mu:
+                        self.ensure_loaded()
+                        for id_, _n in json.loads(buf or b"[]"):
+                            self.cache.bulk_add(
+                                int(id_), self.row(int(id_)).count())
+                        self.cache.recalculate()
 
     # -- device compute image ------------------------------------------------
 

@@ -34,7 +34,7 @@ class Frame:
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
                  time_quantum: str = "",
-                 stats=None, broadcaster=None):
+                 stats=None, broadcaster=None, wal=None):
         validate_name(name)
         self.path = path
         self.index = index
@@ -46,6 +46,7 @@ class Frame:
         self.time_quantum = TimeQuantum(time_quantum)
         self.stats = stats
         self.broadcaster = broadcaster
+        self.wal = wal
         self.views: Dict[str, View] = {}
         self._create_mu = threading.RLock()
         self.row_attr_store = AttrStore(os.path.join(path, "attrs.db"))
@@ -121,6 +122,7 @@ class Frame:
             row_attr_store=self.row_attr_store,
             stats=self.stats.with_tags(f"view:{name}") if self.stats else None,
             broadcaster=self.broadcaster,
+            wal=self.wal,
         )
 
     def view(self, name: str) -> Optional[View]:
@@ -145,29 +147,36 @@ class Frame:
 
     # -- writes ------------------------------------------------------------
 
-    def set_bit(self, row_id: int, column_id: int, t: Optional[datetime] = None) -> bool:
+    def set_bit(self, row_id: int, column_id: int, t: Optional[datetime] = None,
+                deadline: Optional[float] = None) -> bool:
         """Set on standard view, time views for t, and the reversed
-        inverse view (frame.go:446-485)."""
-        changed = self.create_view_if_not_exists(VIEW_STANDARD).set_bit(row_id, column_id)
+        inverse view (frame.go:446-485). `deadline` (absolute
+        monotonic) caps any write-backpressure wait per fragment."""
+        changed = self.create_view_if_not_exists(VIEW_STANDARD).set_bit(
+            row_id, column_id, deadline=deadline)
         if t is not None:
             for vname in views_by_time(VIEW_STANDARD, t, self.time_quantum):
-                if self.create_view_if_not_exists(vname).set_bit(row_id, column_id):
+                if self.create_view_if_not_exists(vname).set_bit(
+                        row_id, column_id, deadline=deadline):
                     changed = True
         if self.inverse_enabled:
-            if self.create_view_if_not_exists(VIEW_INVERSE).set_bit(column_id, row_id):
+            if self.create_view_if_not_exists(VIEW_INVERSE).set_bit(
+                    column_id, row_id, deadline=deadline):
                 changed = True
             if t is not None:
                 for vname in views_by_time(VIEW_INVERSE, t, self.time_quantum):
-                    if self.create_view_if_not_exists(vname).set_bit(column_id, row_id):
+                    if self.create_view_if_not_exists(vname).set_bit(
+                            column_id, row_id, deadline=deadline):
                         changed = True
         return changed
 
-    def clear_bit(self, row_id: int, column_id: int) -> bool:
+    def clear_bit(self, row_id: int, column_id: int,
+                  deadline: Optional[float] = None) -> bool:
         v = self.views.get(VIEW_STANDARD)
-        changed = v.clear_bit(row_id, column_id) if v else False
+        changed = v.clear_bit(row_id, column_id, deadline=deadline) if v else False
         if self.inverse_enabled:
             iv = self.views.get(VIEW_INVERSE)
-            if iv and iv.clear_bit(column_id, row_id):
+            if iv and iv.clear_bit(column_id, row_id, deadline=deadline):
                 changed = True
         return changed
 

@@ -19,10 +19,14 @@ from .index import Index
 
 
 class Holder:
-    def __init__(self, path: str, stats=None, broadcaster=None):
+    def __init__(self, path: str, stats=None, broadcaster=None, wal=None):
         self.path = path
         self.stats = stats or NopStats()
         self.broadcaster = broadcaster
+        # [storage] durability config (core/wal.WalConfig), threaded
+        # down to every Fragment; None = the fragment default
+        # (write-through, no fsync).
+        self.wal = wal
         self.indexes: Dict[str, Index] = {}
         # Guards check-then-act index creation/deletion under the
         # threaded HTTP server (reference Holder.mu).
@@ -54,6 +58,7 @@ class Holder:
             name=name,
             stats=self.stats.with_tags(f"index:{name}"),
             broadcaster=self.broadcaster,
+            wal=self.wal,
             **options,
         )
 
@@ -115,6 +120,22 @@ class Holder:
 
     def max_inverse_slices(self) -> Dict[str, int]:
         return {name: idx.max_inverse_slice() for name, idx in self.indexes.items()}
+
+    def storage_state(self) -> List[dict]:
+        """Per-fragment durability/snapshot state for /debug/vars.
+        Lazily-opened fragments are skipped (reporting must never force
+        a multi-GB parse)."""
+        out: List[dict] = []
+        for iname, idx in sorted(self.indexes.items()):
+            for fname, frame in sorted(idx.frames.items()):
+                for vname, view in sorted(frame.views.items()):
+                    for slice_, frag in sorted(view.fragments.items()):
+                        if frag._pending_load:
+                            continue
+                        state = frag.storage_state()
+                        state["fragment"] = f"{iname}/{fname}/{vname}/{slice_}"
+                        out.append(state)
+        return out
 
     def flush_caches(self):
         """Persist fragment count caches (holder.go:326-358)."""

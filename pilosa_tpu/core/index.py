@@ -26,7 +26,8 @@ DEFAULT_COLUMN_LABEL = "columnID"
 class Index:
     def __init__(self, path: str, name: str,
                  column_label: str = DEFAULT_COLUMN_LABEL,
-                 time_quantum: str = "", stats=None, broadcaster=None):
+                 time_quantum: str = "", stats=None, broadcaster=None,
+                 wal=None):
         validate_name(name)
         self.path = path
         self.name = name
@@ -34,6 +35,7 @@ class Index:
         self.time_quantum = TimeQuantum(time_quantum)
         self.stats = stats
         self.broadcaster = broadcaster
+        self.wal = wal
         self.frames: Dict[str, Frame] = {}
         self._create_mu = threading.RLock()
         self.column_attr_store = AttrStore(os.path.join(path, "attrs.db"))
@@ -119,6 +121,7 @@ class Index:
             name=name,
             stats=self.stats.with_tags(f"frame:{name}") if self.stats else None,
             broadcaster=self.broadcaster,
+            wal=self.wal,
             **options,
         )
 

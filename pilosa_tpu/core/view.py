@@ -31,7 +31,8 @@ class View:
     def __init__(self, path: str, index: str, frame: str, name: str,
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
-                 row_attr_store=None, stats=None, broadcaster=None):
+                 row_attr_store=None, stats=None, broadcaster=None,
+                 wal=None):
         self.path = path
         self.index = index
         self.frame = frame
@@ -41,6 +42,7 @@ class View:
         self.row_attr_store = row_attr_store
         self.stats = stats
         self.broadcaster = broadcaster
+        self.wal = wal
         self.fragments: Dict[int, Fragment] = {}
         self._create_mu = threading.RLock()
 
@@ -74,6 +76,7 @@ class View:
             cache_size=self.cache_size,
             row_attr_store=self.row_attr_store,
             stats=self.stats.with_tags(f"slice:{slice_}") if self.stats else None,
+            wal=self.wal,
         )
         frag.open(lazy=lazy)
         # Copy-on-write: readers (max_slice, query fan-out) iterate
@@ -106,12 +109,14 @@ class View:
                 is_inverse=is_inverse_view(self.name)))
         return frag
 
-    def set_bit(self, row_id: int, column_id: int) -> bool:
+    def set_bit(self, row_id: int, column_id: int,
+                deadline: Optional[float] = None) -> bool:
         frag = self.create_fragment_if_not_exists(column_id // SLICE_WIDTH)
-        return frag.set_bit(row_id, column_id)
+        return frag.set_bit(row_id, column_id, deadline=deadline)
 
-    def clear_bit(self, row_id: int, column_id: int) -> bool:
+    def clear_bit(self, row_id: int, column_id: int,
+                  deadline: Optional[float] = None) -> bool:
         frag = self.fragments.get(column_id // SLICE_WIDTH)
         if frag is None:
             return False
-        return frag.clear_bit(row_id, column_id)
+        return frag.clear_bit(row_id, column_id, deadline=deadline)

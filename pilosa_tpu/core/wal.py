@@ -1,0 +1,251 @@
+"""Group-commit WAL durability engine (ISSUE 8).
+
+The fragment's op log is an append-only run of 13-byte records at the
+tail of its roaring file (serialize.write_op). Historically every
+`set_bit` wrote its record straight to an unbuffered fd and returned —
+kill-9-safe (the OS keeps page-cache writes of a dead process) but not
+power-loss-safe, because nothing ever called fsync. This module adds an
+explicit durability policy per fragment:
+
+    never   today's behavior: unbuffered write-through, no fsync. An
+            acked bit survives process death, not power loss.
+    group   writers' records coalesce in an in-process buffer; the
+            first barrier-waiter becomes the COMMIT LEADER, sleeps the
+            group-commit window, then performs ONE buffered write and
+            ONE fsync for everything accumulated, and wakes the group.
+            set_bit/clear_bit return only after their record's commit.
+    always  like group with a zero window: every barrier fsyncs
+            immediately (still coalescing whatever raced in).
+
+The committer is also the fragment's op_writer target (Bitmap.add /
+remove call `write()` with one record per op), which lets it route
+appends to the main file or — during a background snapshot — to the
+side `.wal` file without the Bitmap knowing (fragment._start_snapshot).
+
+Idle cost is zero: no committer thread exists; the leader is always a
+writer that had to wait anyway.
+
+Power-loss simulation: under `group`/`always`, records sit in the
+in-process buffer until their commit fsync — so a SIGKILL landing at
+the `storage.fsync` fault seam genuinely loses every unsynced op, which
+is exactly the power-loss window the torture harness probes. For
+`never`, set PILOSA_TPU_WAL_SIM_POWER_LOSS=1 to buffer write-through
+records too (flushed only at snapshot flips and close), turning kill -9
+into a power-loss analog for the no-fsync policy as well.
+
+Fault seams (fault.py): `storage.fsync` fires before every WAL-commit
+fsync (kind="commit") and before the snapshot temp-file fsync
+(kind="snapshot"); `storage.rename` fires before the snapshot's
+atomic os.replace; `storage.import_apply` fires after a bulk import's
+in-memory apply, before it is made durable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from .. import fault
+from ..obs import Histogram, StatMap
+
+FSYNC_NEVER = "never"
+FSYNC_GROUP = "group"
+FSYNC_ALWAYS = "always"
+FSYNC_POLICIES = (FSYNC_NEVER, FSYNC_GROUP, FSYNC_ALWAYS)
+
+DEFAULT_GROUP_WINDOW_US = 250.0
+DEFAULT_MAX_WAL_OPS = 65536
+DEFAULT_BACKPRESSURE_DEADLINE = 1.0
+
+# Process-wide WAL telemetry (all fragments), exported at /metrics as
+# pilosa_wal_* by the handler's storage collector. Per-fragment detail
+# lives in /debug/vars under `storage` (Holder.storage_state).
+WAL_STATS = StatMap()
+# Ops per commit batch — the group-commit win is this histogram's mean
+# drifting above 1 under concurrent writers.
+GROUP_SIZE = Histogram()
+# Background snapshot wall time (us) across all fragments.
+SNAPSHOT_US = Histogram()
+
+
+class WalConfig:
+    """[storage] knobs, threaded Holder -> ... -> Fragment.
+
+    `max_op_n` of None keeps the fragment's default snapshot threshold
+    (fragment.MAX_OP_N). `max_wal_ops` <= 0 disables backpressure.
+    """
+
+    __slots__ = ("fsync_policy", "group_window_us", "max_wal_ops",
+                 "backpressure_deadline", "max_op_n",
+                 "simulate_power_loss")
+
+    def __init__(self, fsync_policy: str = FSYNC_GROUP,
+                 group_window_us: float = DEFAULT_GROUP_WINDOW_US,
+                 max_wal_ops: int = DEFAULT_MAX_WAL_OPS,
+                 backpressure_deadline: float = DEFAULT_BACKPRESSURE_DEADLINE,
+                 max_op_n: Optional[int] = None,
+                 simulate_power_loss: bool = False):
+        if fsync_policy not in FSYNC_POLICIES:
+            # A typo must not silently weaken durability to "never".
+            raise ValueError(
+                f"fsync-policy must be one of {FSYNC_POLICIES}, "
+                f"got {fsync_policy!r}")
+        self.fsync_policy = fsync_policy
+        self.group_window_us = float(group_window_us)
+        self.max_wal_ops = int(max_wal_ops)
+        self.backpressure_deadline = float(backpressure_deadline)
+        self.max_op_n = max_op_n
+        self.simulate_power_loss = bool(
+            simulate_power_loss
+            or os.environ.get("PILOSA_TPU_WAL_SIM_POWER_LOSS"))
+
+
+class WalCommitter:
+    """Per-fragment commit barrier + op-append router.
+
+    All state lives under one condition variable. Lock order is
+    Fragment._mu -> WalCommitter._cv (write()/retarget() are called
+    with _mu held); nothing under _cv ever takes _mu, so the pair
+    cannot deadlock. Barrier waits (`wait_durable`) happen OUTSIDE
+    the fragment lock so a leader sleeping its window never blocks
+    readers or other writers' mutations.
+    """
+
+    def __init__(self, cfg: WalConfig, stats=None, path: str = ""):
+        self.cfg = cfg
+        self.stats = stats
+        self.path = path
+        self._cv = threading.Condition()
+        self._target = None          # unbuffered append file object
+        self._buf = bytearray()      # appended, not yet written+synced
+        self._appended = 0           # ops accepted (seq of the newest)
+        self._synced = 0             # ops durable per policy
+        self._leader = False         # a commit leader is in flight
+        self.fsyncs = 0              # commits performed (fsync count)
+
+    # -- policy helpers ------------------------------------------------------
+
+    def _buffers(self) -> bool:
+        if self.cfg.fsync_policy == FSYNC_NEVER:
+            return self.cfg.simulate_power_loss
+        return True
+
+    def _syncs(self) -> bool:
+        return self.cfg.fsync_policy != FSYNC_NEVER
+
+    # -- op_writer protocol (called under Fragment._mu) ----------------------
+
+    def write(self, data: bytes) -> int:
+        """Accept one op record (Bitmap.add/remove write exactly one
+        13-byte record per call)."""
+        with self._cv:
+            if self._target is None:
+                raise ValueError("WAL committer detached")
+            if self._buffers():
+                self._buf += data
+            else:
+                self._target.write(data)
+            self._appended += 1
+            return len(data)
+
+    def seq(self) -> int:
+        """Sequence number of the newest accepted op — the barrier
+        token `wait_durable` takes."""
+        with self._cv:
+            return self._appended
+
+    # -- lifecycle (called under Fragment._mu) -------------------------------
+
+    def retarget(self, new_target) -> None:
+        """Aim subsequent appends at `new_target` (snapshot flip /
+        splice / open). Pending buffered ops are drained into the OLD
+        target first — with an fsync under a syncing policy, so every
+        already-accepted seq is durable in the file era it belongs to
+        and `_synced` never lies across the swap."""
+        with self._cv:
+            self._drain_locked()
+            self._target = new_target
+
+    def detach(self) -> None:
+        """Close-time teardown: drain, mark everything synced (nothing
+        further can commit), wake any barrier waiters."""
+        with self._cv:
+            self._drain_locked()
+            self._target = None
+            self._synced = self._appended
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Force pending buffered ops onto disk (fsync per policy) —
+        used before re-parsing the file (import-failure recovery), so
+        the on-disk log covers every accepted op."""
+        with self._cv:
+            self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        if self._target is None:
+            self._buf.clear()
+            return
+        if self._buf:
+            self._target.write(bytes(self._buf))
+            self._buf.clear()
+        if self._syncs() and self._synced < self._appended:
+            os.fsync(self._target.fileno())
+            self._synced = self._appended
+
+    # -- the commit barrier (called WITHOUT Fragment._mu) --------------------
+
+    def wait_durable(self, seq: int) -> None:
+        """Return once op `seq` is durable per policy. Under `group`
+        the first waiter leads: sleep the window, then one write + one
+        fsync covers the whole batch."""
+        if seq <= 0 or not self._syncs():
+            return
+        window = (self.cfg.group_window_us / 1e6
+                  if self.cfg.fsync_policy == FSYNC_GROUP else 0.0)
+        while True:
+            with self._cv:
+                if self._synced >= seq:
+                    return
+                if not self._leader:
+                    self._leader = True
+                    break
+                self._cv.wait(0.05)
+        # Leader, outside the lock: let the group accumulate.
+        if window > 0:
+            time.sleep(window)
+        try:
+            self._commit()
+        finally:
+            with self._cv:
+                self._leader = False
+                self._cv.notify_all()
+
+    def _commit(self) -> None:
+        """One buffered write + one fsync for everything accepted so
+        far. IO happens under _cv: appenders block for the fsync's
+        duration (they hold Fragment._mu and would barrier-wait right
+        after anyway), and retarget() can never swap the fd out from
+        under the write."""
+        with self._cv:
+            if self._target is None or self._synced >= self._appended:
+                return
+            # The seam fires BEFORE the buffered write: a SIGKILL
+            # armed here loses every unsynced op — the power-loss
+            # window the torture harness depends on.
+            fault.point("storage.fsync", path=self.path, kind="commit",
+                        pending=self._appended - self._synced)
+            if self._buf:
+                self._target.write(bytes(self._buf))
+                self._buf.clear()
+            os.fsync(self._target.fileno())
+            batch = self._appended - self._synced
+            self._synced = self._appended
+            self.fsyncs += 1
+            WAL_STATS.inc("fsync")
+            WAL_STATS.inc("group_ops", batch)
+            GROUP_SIZE.observe(batch)
+            if self.stats is not None:
+                self.stats.count("wal_fsyncN", 1)

@@ -60,6 +60,21 @@ class DeadlineExceededError(PilosaError):
         super().__init__(msg)
 
 
+class WriteBackpressureError(PilosaError):
+    """A write was shed because the fragment's un-snapshotted op count
+    exceeded [storage] max-wal-ops and the background snapshot didn't
+    catch up within the backpressure deadline. Maps to HTTP 503 with a
+    Retry-After header. `transient = True`: the condition clears as
+    soon as a snapshot lands, so a backed-off retry is exactly right."""
+
+    transient = True
+
+    def __init__(self, msg: str = "write backpressure: WAL bound exceeded",
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class BroadcastError(PilosaError):
     """A write broadcast failed on one or more peers. Carries every
     per-node outcome (`failures`: list of (host, exception)) instead of

@@ -1270,7 +1270,8 @@ class Executor:
 
         return self._execute_mutate_view(
             index, c, opt, col_id,
-            lambda: f.set_bit(row_id, col_id, timestamp))
+            lambda: f.set_bit(row_id, col_id, timestamp,
+                              deadline=opt.deadline))
 
     def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
         self._check_writable("ClearBit()", opt)
@@ -1280,7 +1281,7 @@ class Executor:
                                     clear=True)
         return self._execute_mutate_view(
             index, c, opt, col_id,
-            lambda: f.clear_bit(row_id, col_id))
+            lambda: f.clear_bit(row_id, col_id, deadline=opt.deadline))
 
     def _execute_mutate_view(self, index: str, c: Call, opt: ExecOptions,
                              col_id: int, local_fn: Callable[[], bool]) -> bool:

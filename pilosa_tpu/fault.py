@@ -44,6 +44,15 @@ Injection points currently wired:
     rebalance.transfer  one fragment migration attempt (index, frame,
                       view, slice, target) — errors exercise the
                       transfer retry/backoff path
+    storage.fsync     before every WAL commit fsync (kind="commit",
+                      path, pending) and before the snapshot temp-file
+                      fsync (kind="snapshot", path) — an armed error
+                      whose constructor SIGKILLs the process simulates
+                      power loss at the exact durability boundary
+    storage.rename    before the snapshot's atomic os.replace (path)
+    storage.import_apply  after a bulk import's in-memory apply,
+                      before it is made durable (path) — errors
+                      exercise the reload-from-disk recovery
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.

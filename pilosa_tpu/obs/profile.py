@@ -36,7 +36,8 @@ from .metrics import Histogram
 # The canonical phase set, in pipeline order. to_dict() emits phases in
 # this order (then any ad-hoc extras) so profiles diff cleanly.
 PHASES = ("sched_wait", "parse", "plan", "stage_h2d", "compile",
-          "device_exec", "readback_d2h", "host_fold", "fanout_remote")
+          "device_exec", "readback_d2h", "host_fold", "wal_commit",
+          "fanout_remote")
 
 BYTE_COUNTERS = ("bytes_staged", "bytes_touched_hbm", "bytes_read_back")
 

@@ -629,6 +629,20 @@ class Bitmap:
             b.containers.append(c)
         return b
 
+    def freeze_view(self) -> "Bitmap":
+        """O(containers) immutable snapshot view: shares every
+        container payload, marking them `shared` so both sides
+        copy-on-write before mutating (same mechanism as
+        offset_range). The background snapshot writer serializes the
+        frozen view while live writers keep mutating the original —
+        the clone cost is one list copy, never a payload copy."""
+        out = Bitmap()
+        out.keys = list(self.keys)
+        for c in self.containers:
+            c.shared = True
+        out.containers = list(self.containers)
+        return out
+
     # -- maintenance -------------------------------------------------------
 
     def clone(self) -> "Bitmap":

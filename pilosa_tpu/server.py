@@ -99,7 +99,8 @@ class Server:
             ring=self.config.trace_ring,
             slow_us=self.config.slow_query_threshold * 1e6)
         self.holder = Holder(self.config.expanded_data_dir(),
-                             stats=self.stats)
+                             stats=self.stats,
+                             wal=self.config.wal_config())
         self.cluster = Cluster(
             nodes=[Node(h) for h in self.config.cluster_hosts],
             replica_n=self.config.replica_n,

@@ -1,0 +1,80 @@
+"""Child process for the power-loss torture test
+(test_ingest_durability.py): run sustained concurrent set_bit ingest on
+one Fragment under a given fsync policy, printing "A <row> <col>" for
+every bit ONLY AFTER its commit barrier returned (i.e. after the ack a
+client would have seen), while an armed fault kills the process with
+SIGKILL at an injected durability seam. The parent reopens the data dir
+and asserts the per-policy invariant: under group/always every acked
+bit survived; under never the file still loads cleanly.
+
+Usage: ingest_child.py <dir> <policy> <kill_point> <kill_after>
+
+    kill_point  commit-fsync | snapshot-fsync | rename | none
+    kill_after  matches of the seam to let through before the kill
+                (none: run until the parent kills us)
+"""
+
+import os
+import signal
+import sys
+import threading
+
+
+class _Kill(Exception):
+    """Armed at a fault seam: constructing the error IS the crash —
+    SIGKILL at the exact point the seam guards, before the fsync or
+    rename it precedes."""
+
+    def __init__(self, *args):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def main():
+    data_dir, policy, kill_point, kill_after = (
+        sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from pilosa_tpu import fault
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.core.wal import WalConfig
+
+    if kill_point == "commit-fsync":
+        fault.arm("storage.fsync", error=_Kill, kind="commit",
+                  after=kill_after)
+    elif kill_point == "snapshot-fsync":
+        fault.arm("storage.fsync", error=_Kill, kind="snapshot",
+                  after=kill_after)
+    elif kill_point == "rename":
+        fault.arm("storage.rename", error=_Kill, after=kill_after)
+
+    frag = Fragment(os.path.join(data_dir, "frag"), "i", "f", "standard",
+                    0, wal=WalConfig(fsync_policy=policy,
+                                     group_window_us=500.0,
+                                     max_op_n=32))
+    frag.open()
+    print("READY", flush=True)
+
+    out_mu = threading.Lock()
+
+    def writer(row: int, n: int):
+        for i in range(n):
+            col = row * 10000 + i
+            frag.set_bit(row, col)
+            # The barrier returned: a client would have its ack now.
+            with out_mu:
+                print(f"A {row} {col}", flush=True)
+
+    threads = [threading.Thread(target=writer, args=(r, 400))
+               for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    frag.close()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,8 @@ def test_fragment_snapshot_trigger(tmp_path):
     f.open()
     for i in range(12):
         f.set_bit(0, i)
+    # Snapshots run in the background now; wait for the flip to land.
+    assert f.wait_snapshot(timeout=10)
     assert f.op_n <= 10  # snapshot reset
     f.close()
     f2 = Fragment(path, "i", "f", "standard", 0)

@@ -1,0 +1,554 @@
+"""Durable sustained-write ingest (ISSUE 8): group-commit WAL policies,
+non-blocking shadow-WAL snapshots, write backpressure, and the
+power-loss torture harness (subprocess SIGKILL at injected
+`storage.fsync` / `storage.rename` seams, invariants per fsync policy).
+"""
+
+import io
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu import fault
+from pilosa_tpu.config import Config
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.wal import (
+    FSYNC_ALWAYS,
+    FSYNC_GROUP,
+    FSYNC_NEVER,
+    WAL_STATS,
+    WalCommitter,
+    WalConfig,
+)
+from pilosa_tpu.errors import WriteBackpressureError
+from pilosa_tpu.roaring.serialize import write_op
+
+CHILD = os.path.join(os.path.dirname(__file__), "ingest_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+def _frag(tmp_path, name="0", **wal_kw):
+    f = Fragment(str(tmp_path / name), "i", "f", "standard", 0,
+                 wal=WalConfig(**wal_kw) if wal_kw else None)
+    f.open()
+    return f
+
+
+def _reopen_bits(path):
+    """Open the fragment file fresh and return {(row, col)}."""
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    try:
+        return set(f.for_each_bit())
+    finally:
+        f.close()
+
+
+# -- group-commit WAL ---------------------------------------------------------
+
+
+class TestGroupCommit:
+    def test_group_coalesces_concurrent_writers(self, tmp_path):
+        f = _frag(tmp_path, fsync_policy=FSYNC_GROUP,
+                  group_window_us=2000.0)
+        try:
+            n_threads, per = 8, 25
+            errs = []
+
+            def w(row):
+                try:
+                    for i in range(per):
+                        assert f.set_bit(row, i)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=w, args=(r,))
+                  for r in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            # The whole point: far fewer fsyncs than acked ops.
+            assert f._wal.fsyncs < n_threads * per
+            assert f._wal.fsyncs >= 1
+        finally:
+            f.close()
+        bits = _reopen_bits(str(tmp_path / "0"))
+        assert len(bits) == n_threads * per
+
+    def test_always_fsyncs_every_barrier(self, tmp_path):
+        f = _frag(tmp_path, fsync_policy=FSYNC_ALWAYS)
+        try:
+            for i in range(10):
+                f.set_bit(0, i)
+            # Sequential writer, zero window: one commit per barrier.
+            assert f._wal.fsyncs == 10
+        finally:
+            f.close()
+
+    def test_never_policy_no_fsync(self, tmp_path):
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER)
+        try:
+            for i in range(10):
+                f.set_bit(0, i)
+            assert f._wal.fsyncs == 0
+        finally:
+            f.close()
+        assert _reopen_bits(str(tmp_path / "0")) == {
+            (0, i) for i in range(10)}
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="fsync-policy"):
+            WalConfig(fsync_policy="allways")
+
+    def test_power_loss_simulation_buffers_writes(self, tmp_path):
+        """never + simulate_power_loss: write-through records are held
+        in process memory (kill -9 would lose them — the power-loss
+        analog); close() flushes them to disk."""
+        path = str(tmp_path / "0")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER,
+                  simulate_power_loss=True)
+        try:
+            size0 = os.path.getsize(path)
+            for i in range(5):
+                f.set_bit(0, i)
+            assert os.path.getsize(path) == size0  # still buffered
+        finally:
+            f.close()
+        assert _reopen_bits(path) == {(0, i) for i in range(5)}
+
+    def test_detach_releases_barrier_waiters(self, tmp_path):
+        c = WalCommitter(WalConfig(fsync_policy=FSYNC_GROUP))
+        with open(str(tmp_path / "wal"), "ab") as target:
+            c.retarget(target)
+            c.write(b"x" * 13)
+            c.detach()
+            c.wait_durable(1)  # must not hang
+
+
+# -- non-blocking snapshots ---------------------------------------------------
+
+
+class TestNonBlockingSnapshot:
+    def test_writers_not_stalled_by_slow_snapshot(self, tmp_path):
+        fault.arm("storage.fsync", delay=0.3, kind="snapshot")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=20)
+        try:
+            for i in range(21):  # trips the async flip
+                f.set_bit(0, i)
+            assert f._snapshotting
+            # Writers during the 300ms background write: each must pay
+            # only the redirect flip, not the snapshot wall time.
+            for i in range(21, 31):
+                t0 = time.monotonic()
+                f.set_bit(0, i)
+                assert time.monotonic() - t0 < 0.1
+            assert f.wait_snapshot(timeout=10)
+            assert f.row(0).count() == 31
+            assert not os.path.exists(f.path + ".wal")
+        finally:
+            f.close()
+        assert _reopen_bits(str(tmp_path / "0")) == {
+            (0, i) for i in range(31)}
+
+    def test_side_wal_replayed_on_reopen(self, tmp_path):
+        """A crash between snapshot rename and splice leaves a side
+        .wal on disk; reopen must replay it and splice it into main."""
+        path = str(tmp_path / "0")
+        f = _frag(tmp_path)
+        for i in range(4):
+            f.set_bit(1, i)
+        f.close()
+        buf = io.BytesIO()
+        for i in range(4, 8):
+            write_op(buf, 0, 1 * 2**20 + i)  # SLICE_WIDTH = 2**20
+        with open(path + ".wal", "wb") as sf:
+            sf.write(buf.getvalue())
+        # Stale snapshot temp from the same crash: swept on reopen.
+        with open(path + ".snapshotting", "wb") as tf:
+            tf.write(b"half a snapshot")
+        assert _reopen_bits(path) == {(1, i) for i in range(8)}
+        assert not os.path.exists(path + ".wal")
+        assert not os.path.exists(path + ".snapshotting")
+        # And the splice landed in the MAIN file: once more, no side.
+        assert _reopen_bits(path) == {(1, i) for i in range(8)}
+
+    def test_side_wal_torn_tail_dropped(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = _frag(tmp_path)
+        f.set_bit(1, 0)
+        f.close()
+        buf = io.BytesIO()
+        write_op(buf, 0, 1 * 2**20 + 1)
+        with open(path + ".wal", "wb") as sf:
+            sf.write(buf.getvalue() + b"\x07torn")  # partial last op
+        assert _reopen_bits(path) == {(1, 0), (1, 1)}
+
+    def test_snapshot_failure_keeps_fragment_serviceable(self, tmp_path):
+        """Satellite 1: the old snapshot() closed+nulled the op file
+        before writing the temp — a failed rename left acked writes
+        silently WAL-less. Now a failed attempt re-raises AND the
+        fragment keeps appending durably."""
+        path = str(tmp_path / "0")
+        f = _frag(tmp_path)
+        f.set_bit(0, 1)
+        rule = fault.arm("storage.rename", error=RuntimeError)
+        try:
+            with pytest.raises(RuntimeError):
+                f.snapshot()
+            # The op writer survived: this write still reaches the WAL.
+            f.set_bit(0, 2)
+            fault.disarm(rule)
+            f.snapshot()  # retry succeeds
+            assert f.op_n == 0
+        finally:
+            f.close()
+        assert _reopen_bits(path) == {(0, 1), (0, 2)}
+
+    def test_forced_snapshot_waits_for_covering_attempt(self, tmp_path):
+        """snapshot() called while one is in flight must chain a second
+        attempt — the in-flight freeze predates the caller's state."""
+        fault.arm("storage.fsync", delay=0.2, kind="snapshot", times=1)
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=5)
+        try:
+            for i in range(6):
+                f.set_bit(0, i)
+            assert f._snapshotting
+            f.set_bit(0, 99)  # rides the side WAL
+            f.snapshot()  # must cover (0, 99)
+            assert f.op_n == 0
+        finally:
+            f.close()
+        assert (0, 99) in _reopen_bits(str(tmp_path / "0"))
+
+    def test_max_op_n_one(self, tmp_path):
+        """Satellite 3: snapshot trigger on every op — cache updates
+        (row recounts) must never interleave with snapshot churn."""
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=1)
+        try:
+            for i in range(8):
+                f.set_bit(0, i)
+            assert f.row(0).count() == 8
+            assert f.cache.get(0) == 8
+            assert f.wait_snapshot(timeout=10)
+        finally:
+            f.close()
+        assert _reopen_bits(str(tmp_path / "0")) == {
+            (0, i) for i in range(8)}
+
+    def test_concurrent_readers_during_snapshot_and_splice(self, tmp_path):
+        """Satellite 4: readers racing the background snapshot + splice
+        see no torn state, and the mutation-log generation never skips
+        for log_since consumers."""
+        fault.arm("storage.fsync", delay=0.05, kind="snapshot")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=25)
+        errs = []
+        stop = threading.Event()
+
+        def reader():
+            last = 0
+            try:
+                while not stop.is_set():
+                    n = f.row(0).count()
+                    assert n >= last, "row count went backwards"
+                    last = n
+                    f.count()
+                    gen = f.generation
+                    entries = f.log_since(gen)
+                    assert entries == [] or entries is None or entries
+                    sum(1 for _ in f.for_each_bit())
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        readers = [threading.Thread(target=reader) for _ in range(4)]
+        for t in readers:
+            t.start()
+        try:
+            gen0 = f.generation
+            for i in range(200):
+                f.set_bit(0, i)
+            # One generation bump per op, none lost to the snapshots
+            # that ran underneath.
+            assert f.generation == gen0 + 200
+        finally:
+            stop.set()
+            for t in readers:
+                t.join()
+        assert not errs
+        assert f.row(0).count() == 200
+        assert f.wait_snapshot(timeout=10)
+        f.close()
+        assert len(_reopen_bits(str(tmp_path / "0"))) == 200
+
+
+# -- import_bits through the engine -------------------------------------------
+
+
+class TestImportDurability:
+    def test_import_forces_covering_snapshot(self, tmp_path):
+        f = _frag(tmp_path)
+        try:
+            f.import_bits([1, 1, 2], [0, 1, 5])
+            assert f.op_n == 0  # snapshot landed before return
+            assert f.row(1).count() == 2
+        finally:
+            f.close()
+        assert _reopen_bits(str(tmp_path / "0")) == {
+            (1, 0), (1, 1), (2, 5)}
+
+    def test_import_partial_failure_restores_disk_state(self, tmp_path):
+        """Satellite 2: a fault mid-import must not leave memory
+        diverged from disk with no WAL record of the delta."""
+        path = str(tmp_path / "0")
+        f = _frag(tmp_path)
+        f.set_bit(3, 7)
+        rule = fault.arm("storage.import_apply", error=RuntimeError)
+        try:
+            with pytest.raises(RuntimeError):
+                f.import_bits([1, 1, 2], [0, 1, 5])
+            # Memory reloaded to the consistent pre-import image.
+            assert set(f.for_each_bit()) == {(3, 7)}
+            assert f.cache.get(1) in (0, None)
+            fault.disarm(rule)
+            # The fragment is fully serviceable: per-bit and bulk.
+            f.set_bit(3, 8)
+            f.import_bits([1], [0])
+        finally:
+            f.close()
+        assert _reopen_bits(path) == {(3, 7), (3, 8), (1, 0)}
+
+
+# -- write backpressure -------------------------------------------------------
+
+
+class TestBackpressure:
+    def test_shed_when_snapshot_stalls(self, tmp_path):
+        fault.arm("storage.fsync", delay=1.0, kind="snapshot")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=10,
+                  max_wal_ops=20, backpressure_deadline=0.15)
+        shed0 = WAL_STATS.get("backpressure_shed", 0)
+        try:
+            with pytest.raises(WriteBackpressureError) as ei:
+                for i in range(500):
+                    f.set_bit(0, i)
+            assert ei.value.retry_after_s >= 1.0
+            assert ei.value.transient
+            assert WAL_STATS.get("backpressure_shed", 0) > shed0
+            # Bounded growth: the side WAL holds at most ~limit ops,
+            # not the 500 the loop tried to push.
+            assert f._pending_wal_ops() <= 20 + 2
+            # Once the snapshot lands the gate opens again.
+            assert f.wait_snapshot(timeout=10)
+            fault.reset()
+            f.set_bit(1, 0)
+        finally:
+            f.close()
+
+    def test_deadline_caps_backpressure_wait(self, tmp_path):
+        """A query deadline tighter than the backpressure deadline wins
+        (PR 3 deadline machinery integration)."""
+        fault.arm("storage.fsync", delay=1.0, kind="snapshot")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=5,
+                  max_wal_ops=8, backpressure_deadline=30.0)
+        try:
+            with pytest.raises(WriteBackpressureError):
+                for i in range(100):
+                    t0 = time.monotonic()
+                    f.set_bit(0, i, deadline=time.monotonic() + 0.1)
+                    assert time.monotonic() - t0 < 5.0
+        finally:
+            f.close()
+
+    def test_unbounded_when_disabled(self, tmp_path):
+        fault.arm("storage.fsync", delay=0.2, kind="snapshot")
+        f = _frag(tmp_path, fsync_policy=FSYNC_NEVER, max_op_n=10,
+                  max_wal_ops=0)
+        try:
+            for i in range(100):
+                f.set_bit(0, i)  # never sheds
+        finally:
+            f.close()
+
+
+# -- API surface --------------------------------------------------------------
+
+
+class TestApiSurface:
+    def test_query_sets_503_with_retry_after(self, tmp_path):
+        from pilosa_tpu.api import Handler
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.parallel import new_test_cluster
+
+        fault.arm("storage.fsync", delay=5.0, kind="snapshot")
+        holder = Holder(str(tmp_path / "data"),
+                        wal=WalConfig(fsync_policy=FSYNC_NEVER,
+                                      max_op_n=5, max_wal_ops=8,
+                                      backpressure_deadline=0.05))
+        holder.open()
+        cluster = new_test_cluster(1)
+        ex = Executor(holder, host=cluster.nodes[0].host,
+                      cluster=cluster, use_device=False)
+        h = Handler(holder, ex, cluster=cluster,
+                    host=cluster.nodes[0].host)
+        try:
+            assert h.handle("POST", "/index/i").status == 200
+            assert h.handle("POST", "/index/i/frame/f").status == 200
+            saw_503 = None
+            for i in range(60):
+                r = h.handle(
+                    "POST", "/index/i/query",
+                    body=f"SetBit(rowID=0, frame=f, columnID={i})"
+                    .encode())
+                if r.status == 503:
+                    saw_503 = r
+                    break
+                assert r.status == 200
+            assert saw_503 is not None, "backpressure never shed"
+            assert int(saw_503.headers["Retry-After"]) >= 1
+            assert "backpressure" in saw_503.json()["error"]
+            # /debug/vars exposes per-fragment storage state.
+            fault.reset()
+            frag = holder.fragment("i", "f", "standard", 0)
+            assert frag.wait_snapshot(timeout=10)
+            dv = h.handle("GET", "/debug/vars").json()
+            assert any(s["fsync_policy"] == FSYNC_NEVER
+                       for s in dv["storage"])
+            # /metrics exports the WAL families.
+            m = h.handle("GET", "/metrics").body.decode()
+            assert "pilosa_wal_fsync_total" in m
+            assert "pilosa_wal_backpressure_total" in m
+            assert "pilosa_wal_group_size" in m
+        finally:
+            holder.close()
+
+    def test_config_storage_section(self):
+        c = Config.from_toml(
+            '[storage]\nfsync-policy = "always"\n'
+            'group-commit-window-us = 100\nmax-wal-ops = 1024\n'
+            'backpressure-deadline = "250ms"\nmax-op-n = 500\n',
+            is_text=True)
+        assert c.storage_fsync_policy == "always"
+        w = c.wal_config()
+        assert w.fsync_policy == FSYNC_ALWAYS
+        assert w.group_window_us == 100.0
+        assert w.max_wal_ops == 1024
+        assert w.backpressure_deadline == 0.25
+        assert w.max_op_n == 500
+        # Defaults: group policy, round-trips through to_toml.
+        d = Config()
+        assert d.storage_fsync_policy == FSYNC_GROUP
+        rt = Config.from_toml(d.to_toml(), is_text=True)
+        assert rt.storage_fsync_policy == FSYNC_GROUP
+        assert rt.storage_max_wal_ops == d.storage_max_wal_ops
+        # A typo must raise, not weaken durability.
+        c.storage_fsync_policy = "nevr"
+        with pytest.raises(ValueError):
+            c.wal_config()
+
+    def test_wal_commit_profile_phase_registered(self):
+        from pilosa_tpu.obs.profile import PHASES
+
+        assert "wal_commit" in PHASES
+
+
+# -- power-loss torture (subprocess, slow) ------------------------------------
+
+
+def _run_child(tmp_path, policy, kill_point, kill_after, env=None,
+               parent_kill_after_acks=None):
+    """Spawn the torture child; return (acked, exit_code)."""
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, str(tmp_path), policy, kill_point,
+         str(kill_after)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})})
+    acked = set()
+    done = False
+    try:
+        for raw in proc.stdout:
+            line = raw.decode(errors="replace")
+            if not line.endswith("\n"):
+                break  # torn final line: the kill landed mid-print
+            if line.startswith("A "):
+                _, row, col = line.split()
+                acked.add((int(row), int(col)))
+                if (parent_kill_after_acks is not None
+                        and len(acked) >= parent_kill_after_acks):
+                    proc.kill()
+            elif line.startswith("DONE"):
+                done = True
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    return acked, done
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["group", "always"])
+def test_torture_kill_at_commit_fsync(tmp_path, policy):
+    """SIGKILL before a WAL commit fsync: every bit acked past its
+    barrier must survive reopen (unsynced buffered ops are legitimately
+    lost — they were never acked)."""
+    acked, done = _run_child(tmp_path, policy, "commit-fsync", 10)
+    assert not done, "kill never landed"
+    assert acked, "no acked writes before the kill"
+    survived = _reopen_bits(str(tmp_path / "frag"))
+    assert acked <= survived, (
+        f"lost {len(acked - survived)} acked bits: "
+        f"{sorted(acked - survived)[:5]}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kill_point,kill_after", [
+    ("snapshot-fsync", 2), ("rename", 2)])
+def test_torture_kill_during_snapshot(tmp_path, kill_point, kill_after):
+    """SIGKILL inside the background snapshot (before its temp fsync /
+    before the atomic rename): the main file + side WAL must cover
+    every acked bit on reopen."""
+    acked, done = _run_child(tmp_path, "group", kill_point, kill_after)
+    assert not done, "kill never landed"
+    assert acked
+    survived = _reopen_bits(str(tmp_path / "frag"))
+    assert acked <= survived, (
+        f"lost {len(acked - survived)} acked bits after {kill_point}")
+
+
+@pytest.mark.slow
+def test_torture_never_policy_reopens_clean(tmp_path):
+    """fsync-policy never with simulated power loss: acked bits MAY be
+    lost (that's the documented contract) but the file must reopen
+    un-torn via tail truncation."""
+    acked, done = _run_child(
+        tmp_path, "never", "none", 0,
+        env={"PILOSA_TPU_WAL_SIM_POWER_LOSS": "1"},
+        parent_kill_after_acks=300)
+    assert acked
+    survived = _reopen_bits(str(tmp_path / "frag"))  # must not raise
+    assert survived <= acked  # nothing invented, possibly bits lost
+
+
+@pytest.mark.slow
+def test_torture_recovery_time_bounded(tmp_path):
+    """Post-kill-9 reopen (WAL replay + possible side-WAL splice) stays
+    well under a second for a few thousand ops."""
+    acked, done = _run_child(tmp_path, "group", "commit-fsync", 25)
+    assert not done
+    t0 = time.monotonic()
+    survived = _reopen_bits(str(tmp_path / "frag"))
+    recovery_s = time.monotonic() - t0
+    assert acked <= survived
+    assert recovery_s < 5.0
