@@ -2069,6 +2069,88 @@ def main():
         finally:
             frag.close()
 
+    with section("eviction_thrash"):
+        # HBM residency governor under a sub-working-set budget
+        # (ISSUE 9): four frames, budget sized to hold two staged
+        # views, queries round-robining across all four — every other
+        # query forces an LRU evict + restage. Numbers: QPS with the
+        # working set fully resident (unlimited budget) vs thrashing,
+        # plus evictions per query. Acceptance is graceful degradation:
+        # zero errors, residency capped at the budget, and the
+        # thrash path still answering (it pays a restage, not a 500).
+        _progress("eviction thrash: round-robin over a starved budget")
+        import tempfile as _tf4
+
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.core import Holder
+
+        ev_dir = _tf4.mkdtemp(prefix="bench_evict_")
+        ev_holder = Holder(ev_dir)
+        ev_holder.open()
+        ev_idx = ev_holder.create_index_if_not_exists("ev")
+        ev_frames = ["f1", "f2", "f3", "f4"]
+        rng_ev = np.random.default_rng(41)
+        for fr_ in ev_frames:
+            fo_ = ev_idx.create_frame_if_not_exists(fr_)
+            for col_ in rng_ev.integers(0, SLICE_WIDTH, 64):
+                fo_.set_bit(1, int(col_))
+        # The views here are deliberately tiny (one slice); the
+        # min-work cost gate would route every query to the host and
+        # measure nothing. Pin it off for this section only.
+        min_work_prev = os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK")
+        os.environ["PILOSA_TPU_DEVICE_MIN_WORK"] = "0"
+        try:
+            # Probe one staged view's padded bytes on THIS mesh, then
+            # starve: two views' worth for a four-view working set.
+            probe_ex = Executor(ev_holder, use_device=True,
+                                mesh_config={"hbm_budget_bytes": -1})
+            all_executors.append(probe_ex)
+            probe_ex.execute("ev", parse_string(
+                "Count(Bitmap(rowID=1, frame=f1))"))
+            view_b = probe_ex.mesh_manager().stats["staged_bytes"]
+            assert view_b > 0, "probe query never staged a view"
+            n_ev = 40 if on_tpu else 12
+
+            def _spin(ex_, tag_):
+                t0_ = time.perf_counter()
+                for i_ in range(n_ev):
+                    fr_ = ev_frames[i_ % len(ev_frames)]
+                    # fresh rowID: the whole-query memo can't answer,
+                    # so every call walks staging + the device path
+                    out_ = ex_.execute("ev", parse_string(
+                        f"Count(Bitmap(rowID={2 + i_}, frame={fr_}))"))
+                    assert out_ == [0], (tag_, fr_, out_)
+                return (time.perf_counter() - t0_) / n_ev
+
+            resident_dt = _spin(probe_ex, "resident")
+            starved_ex = Executor(ev_holder, use_device=True,
+                                  mesh_config={
+                                      "hbm_budget_bytes": 2 * view_b})
+            all_executors.append(starved_ex)
+            starved_dt = _spin(starved_ex, "starved")
+            smgr = starved_ex.mesh_manager()
+            assert smgr.stats["staged_bytes"] <= 2 * view_b, \
+                (smgr.stats["staged_bytes"], 2 * view_b)
+            details["eviction_thrash"] = {
+                "view_bytes": int(view_b),
+                "budget_bytes": int(2 * view_b),
+                "resident_qps": 1.0 / resident_dt,
+                "thrash_qps": 1.0 / starved_dt,
+                "thrash_slowdown_x": starved_dt / resident_dt,
+                "evictions": int(smgr.stats["evicted_budget"]),
+                "evictions_per_query": smgr.stats["evicted_budget"]
+                / n_ev,
+                "oom_evictions": int(smgr.stats["evicted_oom"]),
+                "host_fallbacks": int(
+                    smgr.stats.get("fallback_hbm_infeasible", 0)
+                    + smgr.stats.get("fallback_oom", 0))}
+        finally:
+            if min_work_prev is None:
+                os.environ.pop("PILOSA_TPU_DEVICE_MIN_WORK", None)
+            else:
+                os.environ["PILOSA_TPU_DEVICE_MIN_WORK"] = min_work_prev
+            ev_holder.close()
+
     # Cache-layer counters for the whole run (query memo, leaf blocks,
     # per-slice memos, leaf matrices, mesh-side memo/batch stats) — the
     # judge-visible proof of which r4/r5 mechanisms actually fired.

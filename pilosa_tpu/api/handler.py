@@ -182,7 +182,7 @@ GET  /status    GET /hosts         cluster state     GET  /slices/max
 POST /import                       protobuf bulk     GET  /export            CSV
 GET  /fragment/data                fragment snapshot GET  /debug/vars        stats
 GET  /metrics                      Prometheus text   GET  /version
-POST /index/{i}/query?explain=true predicted plan (routing, no dispatch)
+POST /index/{i}/query?explain=true predicted plan (routing, quarantine, no dispatch)
 POST /index/{i}/query?profile=true measured profile (phase times, bytes, roofline)
 GET  /debug/queries                recent + slow     GET  /debug/traces/{id} spans
 GET  /debug/pprof/profile          sampling profiler
@@ -634,6 +634,31 @@ class Handler:
                               ("routed_host", "routed_host")):
                 disp.add(stats.get(key, 0), {"mode": mode})
             fams.append(disp)
+            ev = prom.MetricFamily(
+                "pilosa_hbm_evictions_total", "counter",
+                "Staged views evicted, by trigger: budget = LRU "
+                "pressure against [mesh] hbm-budget-bytes, oom = "
+                "emergency eviction after device RESOURCE_EXHAUSTED.")
+            ev.add(stats.get("evicted_budget", 0), {"reason": "budget"})
+            ev.add(stats.get("evicted_oom", 0), {"reason": "oom"})
+            fams.append(ev)
+            fb = prom.MetricFamily(
+                "pilosa_device_fallback_total", "counter",
+                "Queries degraded to the host fold, by reason "
+                "(unstaged = view missing/unstageable, oom = device "
+                "memory exhausted after eviction, hbm_infeasible = one "
+                "view overflows the budget, quarantined = plan "
+                "signature serving a failure quarantine).")
+            fb.add(stats.get("fallback", 0), {"reason": "unstaged"})
+            for reason in ("oom", "hbm_infeasible", "quarantined"):
+                fb.add(stats.get(f"fallback_{reason}", 0),
+                       {"reason": reason})
+            fams.append(fb)
+            fams.append(prom.MetricFamily(
+                "pilosa_plan_quarantined_total", "counter",
+                "Plan signatures quarantined off the device path "
+                "after repeated failures.")
+                .add(stats.get("plan_quarantined", 0)))
         mgr = getattr(ex, "_mesh_mgr", None)
         cs = getattr(mgr, "compile_stats", None)
         if cs is not None:
@@ -674,6 +699,16 @@ class Handler:
                     "pilosa_hbm_staged_views", "gauge",
                     "Fragment views currently staged on-device.")
                     .add(dm["views"]))
+            try:
+                budget = mgr._hbm_budget_bytes()
+            except Exception:  # noqa: BLE001 — telemetry never fails scrape
+                budget = 0
+            fams.append(prom.MetricFamily(
+                "pilosa_hbm_budget_bytes", "gauge",
+                "Resolved staged-pool HBM byte budget ([mesh] "
+                "hbm-budget-bytes / env / device memory_stats minus "
+                "headroom); 0 = unlimited.")
+                .add(max(0, budget)))
         return fams
 
     def _collect_caches(self) -> list:
@@ -916,7 +951,22 @@ class Handler:
         # fallback + cumulative timings) — SURVEY.md §5 observability.
         mesh = getattr(self.executor, "device_stats", None)
         if mesh:
-            snap = dict(snap, mesh=dict(mesh))
+            mesh_snap = dict(mesh)
+            # HBM governor state: resolved budget, residency report,
+            # and the quarantine roster — the runbook's first stop when
+            # pilosa_device_fallback_total moves.
+            mgr = getattr(self.executor, "_mesh_mgr", None)
+            if mgr is not None:
+                try:
+                    mesh_snap["hbm"] = {
+                        "budget_bytes": max(0, mgr._hbm_budget_bytes()),
+                        **mgr.device_memory(),
+                    }
+                    mesh_snap["quarantined_plans"] = \
+                        mgr.quarantined_plans()
+                except Exception:  # noqa: BLE001 — debug never 500s
+                    pass
+            snap = dict(snap, mesh=mesh_snap)
         hc = getattr(self.executor, "host_cache_stats", None)
         if hc:
             snap = dict(snap, host_cache=dict(hc))

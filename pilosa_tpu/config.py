@@ -70,6 +70,21 @@ TOML schema:
     [sched.tenant-weights]      # X-Pilosa-Tenant -> WFQ weight
     # gold = 4                  # (unlisted tenants weigh 1)
 
+    [mesh]
+    hbm-budget-bytes = 0        # HBM residency budget per backend for
+                                # staged views; 0 = auto (per-device
+                                # bytes_limit from memory_stats() minus
+                                # the headroom fraction, 8 GiB when the
+                                # backend reports no limit); negative =
+                                # unlimited (no eviction)
+    hbm-headroom-fraction = 0.15  # slack left for XLA scratch/compile
+                                # buffers when the budget is auto-derived
+    quarantine-after = 2        # device failures for one plan signature
+                                # before it is quarantined (host-fold
+                                # serves it meanwhile)
+    quarantine-ttl = "60s"      # how long a quarantined plan signature
+                                # stays off the device path
+
     [storage]
     fsync-policy = "group"      # never | group | always: what an acked
                                 # set_bit survives. never = process kill
@@ -241,6 +256,16 @@ class Config:
         self.sched_queue_depth: int = 256
         self.sched_default_service_us: float = 1500.0
         self.sched_tenant_weights: dict = {}
+        # [mesh] — HBM residency governor (parallel/serve.py): byte
+        # budget for staged device views (0 = auto from the backend's
+        # memory_stats() minus the headroom fraction, negative =
+        # unlimited), plus the poisoned-plan quarantine knobs (failure
+        # count before a plan signature leaves the device path, and for
+        # how long).
+        self.mesh_hbm_budget_bytes: int = 0
+        self.mesh_hbm_headroom: float = 0.15
+        self.mesh_quarantine_after: int = 2
+        self.mesh_quarantine_ttl: float = 60.0
         # [storage] — durable sustained-write ingest (core/wal.py):
         # group-commit fsync policy, WAL bound + backpressure deadline,
         # snapshot threshold override (0 = fragment default).
@@ -340,6 +365,15 @@ class Config:
         c.sched_tenant_weights = {
             str(k): float(v)
             for k, v in dict(sc.get("tenant-weights", {})).items()}
+        me = data.get("mesh", {})
+        c.mesh_hbm_budget_bytes = int(me.get("hbm-budget-bytes",
+                                             c.mesh_hbm_budget_bytes))
+        c.mesh_hbm_headroom = float(me.get("hbm-headroom-fraction",
+                                           c.mesh_hbm_headroom))
+        c.mesh_quarantine_after = int(me.get("quarantine-after",
+                                             c.mesh_quarantine_after))
+        if "quarantine-ttl" in me:
+            c.mesh_quarantine_ttl = parse_duration(me["quarantine-ttl"])
         st = data.get("storage", {})
         c.storage_fsync_policy = str(st.get("fsync-policy",
                                             c.storage_fsync_policy))
@@ -374,6 +408,16 @@ class Config:
             max_wal_ops=self.storage_max_wal_ops,
             backpressure_deadline=self.storage_backpressure_deadline,
             max_op_n=self.storage_max_op_n or None)
+
+    def mesh_config(self) -> dict:
+        """The [mesh] knobs as the dict Executor threads into
+        MeshManager (kept a plain dict so tests can hand-build one)."""
+        return {
+            "hbm_budget_bytes": self.mesh_hbm_budget_bytes,
+            "hbm_headroom": self.mesh_hbm_headroom,
+            "quarantine_after": self.mesh_quarantine_after,
+            "quarantine_ttl": self.mesh_quarantine_ttl,
+        }
 
     def use_device_flag(self):
         """Executor use_device arg: None = auto, True/False = forced.
@@ -439,6 +483,12 @@ class Config:
             f"\n[sched.tenant-weights]\n"
             + "".join(f'"{k}" = {v}\n'
                       for k, v in sorted(self.sched_tenant_weights.items()))
+            + f"\n[mesh]\n"
+            f"hbm-budget-bytes = {self.mesh_hbm_budget_bytes}\n"
+            f"hbm-headroom-fraction = {self.mesh_hbm_headroom}\n"
+            f"quarantine-after = {self.mesh_quarantine_after}\n"
+            f'quarantine-ttl = '
+            f'"{int(self.mesh_quarantine_ttl * 1000)}ms"\n'
             + f"\n[storage]\n"
             f'fsync-policy = "{self.storage_fsync_policy}"\n'
             f"group-commit-window-us = "

@@ -543,9 +543,20 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
            if name == "pilosa_hbm_resident_bytes"]
     if hbm:
         total = sum(v for _, v in hbm)
-        lines.append(f"hbm resident: {_fmt_bytes(total)} across "
-                     f"{len(hbm)} device(s)  " + "  ".join(
-                         f"{d}={_fmt_bytes(v)}" for d, v in hbm[:8]))
+        line = (f"hbm resident: {_fmt_bytes(total)} across "
+                f"{len(hbm)} device(s)  " + "  ".join(
+                    f"{d}={_fmt_bytes(v)}" for d, v in hbm[:8]))
+        budget = cur.get(("pilosa_hbm_budget_bytes", ()), 0.0)
+        if budget:
+            line += f"   budget {_fmt_bytes(budget)}"
+        ev = sum(v for (name, _labels), v in cur.items()
+                 if name == "pilosa_hbm_evictions_total")
+        if ev:
+            line += f"   evictions {int(ev)}"
+        quar = cur.get(("pilosa_plan_quarantined_total", ()), 0.0)
+        if quar:
+            line += f"   quarantined plans {int(quar)}"
+        lines.append(line)
     return "\n".join(lines) + "\n"
 
 

@@ -75,6 +75,25 @@ class WriteBackpressureError(PilosaError):
         self.retry_after_s = float(retry_after_s)
 
 
+class DeviceResourceError(PilosaError):
+    """The device path could not serve a query within its HBM budget:
+    a single staged view exceeds [mesh] hbm-budget-bytes
+    (`reason="hbm_infeasible"`), the device ran out of memory even
+    after evicting every cold view (`reason="oom"`), or the plan
+    signature is quarantined after repeated failures
+    (`reason="quarantined"`). The serve layer catches this and falls
+    back to the host-fold path, so it normally never reaches HTTP;
+    if it does (host path also broken), it maps to 503.
+    `transient = True`: budget pressure clears as views are evicted
+    and quarantines expire."""
+
+    transient = True
+
+    def __init__(self, msg: str, reason: str = "oom"):
+        super().__init__(msg)
+        self.reason = reason
+
+
 class BroadcastError(PilosaError):
     """A write broadcast failed on one or more peers. Carries every
     per-node outcome (`failures`: list of (host, exception)) instead of

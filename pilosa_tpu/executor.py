@@ -143,8 +143,13 @@ class Executor:
     def __init__(self, holder, host: str = "", cluster=None, client=None,
                  use_device: Optional[bool] = None, max_workers: int = 8,
                  device_min_work: Optional[int] = None,
-                 prefer_local_reads: bool = False):
+                 prefer_local_reads: bool = False,
+                 mesh_config: Optional[dict] = None):
         self.holder = holder
+        # [mesh] knobs (config.Config.mesh_config()) handed to the
+        # MeshManager on construction: HBM budget, headroom, plan
+        # quarantine policy. Empty dict = env/auto resolution.
+        self.mesh_config = dict(mesh_config or {})
         self.host = host
         self.cluster = cluster
         self.client = client
@@ -520,13 +525,20 @@ class Executor:
             # runs when a memo entry will be stored, because the leaves
             # name exactly the fragments the revalidation token must
             # cover (a tokenless entry dies on every epoch bump).
-            from .parallel.plan import _lower_tree
+            from .parallel.plan import _lower_tree, _tree_signature
 
             leaves: list = []
             shape = _lower_tree(self.holder, index, child, leaves)
+            route_reason = None
             if shape is not None and leaves:
                 if backend_on:
-                    if self._route_to_host(len(slices), len(leaves)):
+                    import json as _json
+
+                    sig = _json.dumps(_tree_signature(shape))
+                    route_reason = self._route_to_host(
+                        len(slices), len(leaves), index=index,
+                        leaves=leaves, sig=sig)
+                    if route_reason:
                         host_lowered = (shape, leaves)
                     else:
                         lowered = (shape, leaves)
@@ -540,6 +552,8 @@ class Executor:
         psp.tag(route=route, backend_on=backend_on,
                 leaves=len(leaves) if backend_on or qkey is not None
                 else 0)
+        if host_lowered is not None and route_reason:
+            psp.tag(route_reason=route_reason)
         switches = self._kill_switches()
         if switches:
             psp.tag(kill_switches=switches)
@@ -642,7 +656,8 @@ class Executor:
             try:
                 from .parallel.serve import MeshManager
 
-                self._mesh_mgr = MeshManager(self.holder)
+                self._mesh_mgr = MeshManager(self.holder,
+                                             config=self.mesh_config)
             except Exception:  # noqa: BLE001 — device layer unavailable
                 self._mesh_mgr_failed = True
                 return None
@@ -786,15 +801,20 @@ class Executor:
             memo_hit = self._host_cache.query_peek(
                 (index, ck, tuple(slices)), MUTATION_EPOCH.n)
 
+        route_reason = None
         if memo_hit:
             route = "memo"
         elif lowerable and backend_on:
-            route = ("host-fold"
-                     if self._would_route_to_host(len(slices), len(leaves))
-                     else "mesh")
+            sig = _json.dumps(_tree_signature(shape))
+            route_reason = self._would_route_to_host(
+                len(slices), len(leaves), index=index, leaves=leaves,
+                sig=sig)
+            route = "host-fold" if route_reason else "mesh"
         else:
             route = "roaring"
         info["route"] = route
+        if route_reason:
+            info["route_reason"] = route_reason
         info["cost_model"] = {
             "backend_on": backend_on,
             "lowerable": lowerable,
@@ -807,11 +827,14 @@ class Executor:
         info["memo_hit"] = memo_hit
 
         mgr = self._mesh_mgr  # peek only: never force construction
-        plan_hit = False
+        plan_hit = quarantined = False
         if lowerable and mgr is not None:
             sig = _json.dumps(_tree_signature(shape))
             plan_hit = mgr._fused_plans.contains_sig(sig)
-        info["plan_cache"] = {"checked": mgr is not None, "hit": plan_hit}
+            quarantined = mgr.plan_quarantined(sig)
+        info["plan_cache"] = {"checked": mgr is not None,
+                              "hit": plan_hit,
+                              "quarantined": quarantined}
         if lowerable:
             info["staging"] = self._explain_staging(index, leaves, slices)
         info["placement"] = self._explain_placement(index, slices)
@@ -937,15 +960,27 @@ class Executor:
     # (r2 measured nary_* at 26-270× SLOWER than host without routing).
     _DEFAULT_MIN_WORK = 192
 
-    def _route_to_host(self, num_slices: int, num_leaves: int) -> bool:
-        """True when a lowerable Count tree should serve from the host
-        C++ kernels anyway: estimated device benefit below threshold.
+    def _route_to_host(self, num_slices: int, num_leaves: int,
+                       index: Optional[str] = None, leaves=None,
+                       sig: Optional[str] = None) -> Optional[str]:
+        """Truthy (the routing reason) when a lowerable Count tree
+        should serve from the host C++ kernels anyway — falsy (None)
+        when the device path should run. Cost reasons ("min_work",
+        "cpu_native"): estimated device benefit below threshold.
         Threshold resolution: explicit device_min_work arg >
         PILOSA_TPU_DEVICE_MIN_WORK env > _DEFAULT_MIN_WORK. The cost
         model applies in EVERY device mode — use_device picks which
         backends are available, not which engine a given query should
-        pay for; 0 disables routing (every lowerable tree → mesh).
+        pay for; 0 disables cost routing (every lowerable tree → mesh).
         Routed queries count in /debug/vars mesh stats (routed_host).
+
+        RESILIENCE reasons apply even with cost routing disabled, when
+        `index`/`leaves`/`sig` context is supplied: "quarantined" (the
+        plan signature is serving a quarantine TTL after repeated
+        device failures) and "hbm_infeasible" (a leaf's view alone
+        overflows [mesh] hbm-budget-bytes — staging is known-doomed,
+        skip straight to the host fold). These also bump the matching
+        pilosa_device_fallback_total reason counter.
 
         The router is BACKEND-AWARE above the threshold: on a `cpu`
         JAX backend, large folds route to the host C++ kernels too —
@@ -955,13 +990,18 @@ class Executor:
         no accelerator behind the mesh the dispatch floor buys nothing.
         PILOSA_TPU_CPU_ROUTE_NATIVE=off pins large folds to the mesh
         (measurement / regression escape hatch); thr <= 0 still
-        disables ALL routing."""
-        if not self._would_route_to_host(num_slices, num_leaves):
-            return False
+        disables all COST routing."""
+        reason = self._would_route_to_host(num_slices, num_leaves,
+                                           index=index, leaves=leaves,
+                                           sig=sig)
+        if not reason:
+            return None
         mgr = self.mesh_manager()
         if mgr is not None:
             mgr.stats.inc("routed_host")
-        return True
+            if reason in ("quarantined", "hbm_infeasible"):
+                mgr.stats.inc(f"fallback_{reason}")
+        return reason
 
     def _min_work(self) -> int:
         """The resolved cost-routing threshold (see _route_to_host)."""
@@ -982,16 +1022,32 @@ class Executor:
             self._min_work_resolved = thr
         return thr
 
-    def _would_route_to_host(self, num_slices: int, num_leaves: int) -> bool:
-        """The pure routing decision — no stats, no manager
-        construction — shared by _route_to_host and explain()."""
+    def _would_route_to_host(self, num_slices: int, num_leaves: int,
+                             index: Optional[str] = None, leaves=None,
+                             sig: Optional[str] = None) -> Optional[str]:
+        """The pure routing decision (reason string or None) — no
+        stats, no manager construction — shared by _route_to_host and
+        explain(). Resilience gates consult the EXISTING mesh manager
+        only: with no manager yet there is nothing staged, no
+        quarantine history, and no resolved budget to gate on."""
+        mgr = self._mesh_mgr
+        if mgr is not None:
+            if sig and mgr.plan_quarantined(sig):
+                return "quarantined"
+            if index is not None and leaves:
+                try:
+                    if mgr.stage_infeasible(index, leaves, num_slices):
+                        return "hbm_infeasible"
+                except Exception:  # noqa: BLE001 — peek must not kill
+                    pass           # the query; _stage_once re-checks
         thr = self._min_work()
         if thr <= 0:
-            return False
-        if (num_slices * max(1, num_leaves) >= thr
-                and not self._cpu_native_routes()):
-            return False
-        return True
+            return None
+        if num_slices * max(1, num_leaves) < thr:
+            return "min_work"
+        if self._cpu_native_routes():
+            return "cpu_native"
+        return None
 
     def _cpu_native_routes(self) -> bool:
         """True when large folds should route to the host despite

@@ -53,6 +53,14 @@ Injection points currently wired:
     storage.import_apply  after a bulk import's in-memory apply,
                       before it is made durable (path) — errors
                       exercise the reload-from-disk recovery
+    mesh.stage        before a fragment view is built + H2D-staged
+                      (index, frame, view, slices) — an armed
+                      ResourceExhausted simulates device OOM during
+                      staging and exercises evict-and-retry
+    device.exec       before each device program launch (sig, kind) —
+                      an armed ResourceExhausted here exercises the
+                      full recovery ladder: evict + retry, host-fold
+                      fallback, and plan-signature quarantine
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.
@@ -69,6 +77,18 @@ from typing import Any, Dict, List, Optional, Type
 
 from .obs import StatMap
 
+class SimulatedResourceExhausted(RuntimeError):
+    """Stands in for jaxlib's XlaRuntimeError(RESOURCE_EXHAUSTED) at
+    the mesh.stage / device.exec seams — the serve layer's OOM
+    classifier matches it by message, exactly as it matches the real
+    thing, so CPU-only chaos tests drive the same recovery ladder a
+    TPU OOM would."""
+
+    def __init__(self, msg: str = ""):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: {msg or 'fault-injected device OOM'}")
+
+
 # Exception names accepted by the env spec (error=Name).
 _ERROR_NAMES: Dict[str, Type[BaseException]] = {
     "ConnectionError": ConnectionError,
@@ -76,6 +96,7 @@ _ERROR_NAMES: Dict[str, Type[BaseException]] = {
     "ConnectionRefusedError": ConnectionRefusedError,
     "TimeoutError": TimeoutError,
     "OSError": OSError,
+    "ResourceExhausted": SimulatedResourceExhausted,
 }
 
 STATS = StatMap()

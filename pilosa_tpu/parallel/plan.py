@@ -24,7 +24,7 @@ import json
 import os
 import time
 import weakref
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -102,8 +102,16 @@ class CompiledPlanCache:
         self._mu = threading.Lock()
         self._fns: "OrderedDict[tuple, object]" = OrderedDict()
         self.cap = cap
+        # Poisoned-plan set: tree signature -> monotonic expiry.
+        # A signature lands here after repeated compile/runtime
+        # failures (serve._note_plan_failure); while quarantined the
+        # serving layer skips the device path for that shape entirely,
+        # so one pathological query can't take the fast path down for
+        # everyone. TTL'd: the fault may be transient (driver hiccup,
+        # fixed by a restage), so the shape gets retried eventually.
+        self._poisoned: Dict[str, float] = {}
         self.stats = {"hit": 0, "miss": 0, "evicted": 0,
-                      "compile_us": 0}
+                      "compile_us": 0, "quarantined": 0}
 
     @staticmethod
     def key(sig: str, words_t) -> tuple:
@@ -140,6 +148,55 @@ class CompiledPlanCache:
         only: no staging, no mutation, no LRU reorder."""
         with self._mu:
             return any(k[0] == sig for k in self._fns)
+
+    def quarantine(self, sig: str, ttl_s: float,
+                   now: Optional[float] = None) -> None:
+        """Poison a tree signature for ttl_s seconds and drop its
+        cached programs (they may be the broken artifact)."""
+        if now is None:
+            now = time.monotonic()
+        with self._mu:
+            self._poisoned[sig] = now + float(ttl_s)
+            self.stats["quarantined"] += 1
+            for k in [k for k in self._fns if k[0] == sig]:
+                del self._fns[k]
+
+    def is_quarantined(self, sig: str,
+                       now: Optional[float] = None) -> bool:
+        """Whether this tree shape is currently poisoned. Expired
+        entries are reaped on the way through, so an abandoned shape
+        doesn't pin its entry forever."""
+        if now is None:
+            now = time.monotonic()
+        with self._mu:
+            expiry = self._poisoned.get(sig)
+            if expiry is None:
+                return False
+            if now >= expiry:
+                del self._poisoned[sig]
+                return False
+            return True
+
+    def quarantined_sigs(self, now: Optional[float] = None) -> List[str]:
+        """Live (unexpired) poisoned signatures — the ?explain=true /
+        debug surface."""
+        if now is None:
+            now = time.monotonic()
+        with self._mu:
+            expired = [s for s, t in self._poisoned.items() if now >= t]
+            for s in expired:
+                del self._poisoned[s]
+            return sorted(self._poisoned)
+
+    def clear_quarantine(self, sig: Optional[str] = None) -> int:
+        """Operator escape hatch: lift one signature's quarantine (or
+        all of them). Returns how many entries were cleared."""
+        with self._mu:
+            if sig is None:
+                n = len(self._poisoned)
+                self._poisoned.clear()
+                return n
+            return 1 if self._poisoned.pop(sig, None) is not None else 0
 
     def __len__(self) -> int:
         return len(self._fns)

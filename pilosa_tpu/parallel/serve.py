@@ -65,6 +65,20 @@ from .mesh import (
     resolve_row_indices,
 )
 from .plan import CompiledPlanCache, _tree_signature
+from .. import fault
+from ..errors import DeviceResourceError
+
+
+def _is_resource_exhausted(e: BaseException) -> bool:
+    """Device OOM classifier. jaxlib surfaces allocation failure as
+    XlaRuntimeError with RESOURCE_EXHAUSTED (or "out of memory") in the
+    message — there is no stable exception subclass to catch across
+    jaxlib versions, so the message IS the contract — and the fault
+    seams raise SimulatedResourceExhausted carrying the same marker."""
+    if isinstance(e, fault.SimulatedResourceExhausted):
+        return True
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
 
 
 def _num_env(name: str, default, cast=int):
@@ -84,7 +98,7 @@ class StagedView:
     __slots__ = ("sharded", "row_ids", "keys_host", "slice_gens",
                  "num_slices", "idx_cache", "host_idx_cache", "last_used",
                  "last_stage_s", "inc_spend_s", "inc_ewma_s", "inc_count",
-                 "validated_epoch")
+                 "validated_epoch", "pins")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices):
         self.sharded = sharded            # ShardedIndex (device, padded S)
@@ -129,6 +143,13 @@ class StagedView:
         # Incremental applies since this view was staged — drives the
         # deterministic (count-based) restage policy in SPMD mode.
         self.inc_count = 0
+        # In-flight query refcount: taken at plan time (_stage_leaves*
+        # under _mu) and released after the fold/fetch. A pinned view
+        # is never evicted — neither by the budget scan nor by the OOM
+        # emergency evictor — so a query's staged arrays stay resident
+        # for its whole unlocked execution window (the use-epoch stamp
+        # below only protects the resolution currently holding _mu).
+        self.pins = 0
         # MUTATION_EPOCH.read() pair captured BEFORE the last staleness
         # walk that found (or made) this view current. refresh()'s O(1)
         # fast path: while the process-wide pair hasn't moved, no
@@ -271,9 +292,15 @@ class MeshManager:
     fall back to the host path.
     """
 
-    def __init__(self, holder, mesh=None):
+    def __init__(self, holder, mesh=None, config=None):
         self.holder = holder
         self._mesh = mesh
+        # [mesh] knobs threaded from config.Config.mesh_config() (plain
+        # dict so tests can hand-build one): hbm_budget_bytes (0 = auto,
+        # negative = unlimited), hbm_headroom, quarantine_after,
+        # quarantine_ttl. Env vars override per-knob (resolution order
+        # in _resolve_budget / the quarantine fields below).
+        self._config = dict(config or {})
         self._mu = threading.RLock()
         # Staged device images, LRU-ordered (move-to-end on access):
         # total HBM held by staged pools is bounded by _hbm_budget_bytes
@@ -282,6 +309,33 @@ class MeshManager:
         # (holder.go:326-358). An evicted view restages on next use.
         self._views: "OrderedDict[Tuple[str, str, str], StagedView]" = \
             OrderedDict()
+        # Bumped under _mu on every structural change to the residency
+        # picture (stage insert, any evict, invalidate, incremental
+        # image swap): device_memory()'s lock-free snapshot rereads
+        # until the counter holds still, so a scrape racing a stage
+        # can't report per-device totals from a different generation
+        # than its padded total.
+        self._views_gen = 0
+        # Resolved HBM budget cache (one memory_stats() probe) and the
+        # poisoned-plan strike counter feeding CompiledPlanCache's
+        # quarantine set. _quar_mu is its own tiny lock: strikes are
+        # noted from the batch thread, fetch workers, and serving
+        # threads, and must not wait behind a multi-second stage.
+        self._budget_resolved: Optional[int] = None
+        self._plan_failures: Dict[str, int] = {}
+        self._quar_mu = threading.Lock()
+        qa = self._config.get("quarantine_after") or 0
+        self._quarantine_after = (int(qa) if qa
+                                  else _num_env("PILOSA_TPU_QUARANTINE_AFTER",
+                                                2))
+        qt = self._config.get("quarantine_ttl") or 0.0
+        self._quarantine_ttl = (float(qt) if qt
+                                else _num_env("PILOSA_TPU_QUARANTINE_TTL_S",
+                                              60.0, float))
+        # Per-(view, num_slices) infeasibility verdicts for the routing
+        # peek (stage_infeasible), validated against MUTATION_EPOCH —
+        # the O(slices) container-count walk must not run per query.
+        self._infeasible_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._count_fns: Dict[Tuple[str, int], object] = {}
         self._batch_fns: Dict[tuple, object] = {}
         self._coarse_fns: Dict[tuple, object] = {}
@@ -407,6 +461,15 @@ class MeshManager:
         # increments under that contention.
         self.stats = StatMap({
             "stage": 0, "incremental": 0, "evicted": 0,
+            # Residency governor: reason-split eviction counters
+            # (evicted stays the total for dashboard continuity), OOM
+            # evict-and-retry attempts, the resolved byte budget, and
+            # the degraded-mode fallbacks by reason (these feed
+            # pilosa_device_fallback_total{reason} at /metrics).
+            "evicted_budget": 0, "evicted_oom": 0, "oom_retries": 0,
+            "hbm_budget_bytes": 0, "plan_quarantined": 0,
+            "fallback_infeasible": 0, "fallback_oom": 0,
+            "fallback_quarantined": 0,
             "staged_bytes": 0, "count": 0, "topn": 0,
             "batched": 0, "deduped": 0, "inflight_shared": 0, "coarse": 0,
             "coarse_uniform": 0,
@@ -447,60 +510,160 @@ class MeshManager:
             self._mesh = default_mesh()
         return self._mesh
 
-    @staticmethod
-    def _hbm_budget_bytes() -> int:
-        """Staged-pool HBM budget (PILOSA_TPU_HBM_BUDGET_MB env,
-        default 8192 MB — half a v5e chip's 16 GB, leaving room for
-        query intermediates). 0 disables eviction."""
-        return _num_env("PILOSA_TPU_HBM_BUDGET_MB", 8192) << 20
+    def _hbm_budget_bytes(self) -> int:
+        """Resolved staged-pool HBM byte budget; <= 0 means unlimited
+        (no eviction, no infeasibility gate). Resolution order:
+          1. [mesh] hbm-budget-bytes (positive = that many bytes,
+             negative = explicitly unlimited, 0 = fall through);
+          2. PILOSA_TPU_HBM_BUDGET_BYTES env;
+          3. PILOSA_TPU_HBM_BUDGET_MB env (the legacy knob);
+          4. auto: the backend's per-device bytes_limit from
+             jax.local_devices()[0].memory_stats(), minus the
+             [mesh] hbm-headroom-fraction left for XLA scratch and
+             compiled-program buffers;
+          5. 8 GiB — half a v5e chip — when the backend reports no
+             limit (CPU test meshes report none).
+        Config and env are re-read on every call (both are cheap, and
+        operators retune the env knob on a live process); only the
+        auto-probed device limit is cached (memory_stats is an RPC on
+        some relays) — tests reset it by clearing _budget_resolved."""
+        import os
+
+        b = None
+        cfg = int(self._config.get("hbm_budget_bytes", 0) or 0)
+        if cfg:
+            b = cfg  # negative = unlimited, handled by <= 0 checks
+        else:
+            for env, shift in (("PILOSA_TPU_HBM_BUDGET_BYTES", 0),
+                               ("PILOSA_TPU_HBM_BUDGET_MB", 20)):
+                raw = os.environ.get(env, "")
+                if raw:
+                    try:
+                        b = int(raw) << shift
+                        break
+                    except ValueError:
+                        pass
+        if b is None:
+            b = self._budget_resolved
+            if b is None:
+                b = self._probe_budget()
+                self._budget_resolved = b
+        if self.stats["hbm_budget_bytes"] != max(0, b):
+            self.stats["hbm_budget_bytes"] = max(0, b)
+        return b
+
+    def _probe_budget(self) -> int:
+        headroom = float(self._config.get("hbm_headroom", 0.15))
+        try:
+            import jax
+
+            limit = int((jax.local_devices()[0].memory_stats() or {})
+                        .get("bytes_limit", 0))
+            if limit > 0:
+                return int(limit * (1.0 - headroom))
+        except Exception:  # noqa: BLE001 — backends without memory_stats
+            pass
+        return 8192 << 20
 
     @staticmethod
-    def _view_bytes(sv: StagedView) -> int:
-        return (int(np.prod(sv.sharded.words.shape)) * 4
-                + int(np.prod(sv.sharded.keys.shape)) * 4)
+    def _sharded_bytes(sh) -> int:
+        """Padded device bytes of ONE ShardedIndex snapshot. Takes the
+        snapshot, not the StagedView: device_memory() must read
+        sv.sharded exactly once per view (a concurrent incremental
+        swap between a words read and a keys read would mix two
+        generations of the image)."""
+        return (int(np.prod(sh.words.shape)) * 4
+                + int(np.prod(sh.keys.shape)) * 4)
+
+    def _view_bytes(self, sv: StagedView) -> int:
+        return self._sharded_bytes(sv.sharded)
 
     def _evict_over_budget(self):
         """Evict least-recently-used staged views until under the HBM
         budget. Views stamped with the CURRENT use-epoch (touched by
         the resolution in progress — possibly several frames of one
-        query tree) are never evicted: a query spanning more frames
-        than the budget fits runs over budget once rather than
-        restage-thrashing forever. Call under _mu. Safe against
-        in-flight queries: they hold their own references to the
+        query tree) and views PINNED by an in-flight query
+        (StagedView.pins) are never evicted: a query spanning more
+        frames than the budget fits runs over budget once rather than
+        restage-thrashing forever, and a query mid-fold keeps its
+        images. Call under _mu. Safe against in-flight queries even
+        without the pin: they hold their own references to the
         immutable arrays; eviction only drops the manager's, and the
         memo entries reading those arrays are purged with them."""
         total = sum(self._view_bytes(v) for v in self._views.values())
         budget = self._hbm_budget_bytes()
         if budget > 0:
             for key in [k for k, v in self._views.items()
-                        if v.last_used != self._use_epoch]:
+                        if v.last_used != self._use_epoch
+                        and v.pins == 0]:
                 if total <= budget:
                     break
                 sv = self._views.pop(key)
                 self._purge_memo(sv.sharded.words)
+                self._views_gen += 1
                 total -= self._view_bytes(sv)
                 self.stats.inc("evicted")
+                self.stats.inc("evicted_budget")
         self.stats["staged_bytes"] = total
+
+    def _evict_for_oom(self) -> int:
+        """Emergency eviction after a device RESOURCE_EXHAUSTED: drop
+        every staged view not pinned by an in-flight query — including
+        current-use-epoch ones; the failing query's own views are
+        pinned, and anything else is worth less than recovering the
+        request. Returns how many views were dropped (0 means nothing
+        left to free — the retry will likely fail too)."""
+        with self._mu:
+            dropped = 0
+            for key in [k for k, v in self._views.items()
+                        if v.pins == 0]:
+                sv = self._views.pop(key)
+                self._purge_memo(sv.sharded.words)
+                self._views_gen += 1
+                self.stats.inc("evicted")
+                self.stats.inc("evicted_oom")
+                dropped += 1
+            self.stats["staged_bytes"] = sum(
+                self._view_bytes(v) for v in self._views.values())
+        return dropped
 
     def device_memory(self) -> dict:
         """HBM residency report for /metrics: padded bytes (what the
         pool actually allocates, INVALID_KEY slots included), live
         bytes (valid containers only — padding overhead is the gap),
-        and a per-device breakdown from JAX shard placement. Reads a
-        GIL-atomic snapshot of the view dict WITHOUT taking _mu, so a
-        scrape never stalls behind a multi-second stage; shard shape
-        reads are metadata-only (no device transfer)."""
-        views = list(self._views.values())
+        and a per-device breakdown from JAX shard placement.
+
+        Lock-free but CONSISTENT: each attempt snapshots the views and
+        each view's sharded image ONCE, then checks that _views_gen
+        (bumped under _mu by every stage/evict/invalidate/incremental
+        swap) held still across the walk — a moved counter retries, so
+        a scrape racing a stage can't sum per-device shards from a
+        different residency generation than its padded total. After a
+        few dirty reads it falls back to computing under _mu (bounded
+        staleness beats an unbounded retry loop when staging churns);
+        shard reads are metadata-only (no device transfer) either way."""
+        for _ in range(3):
+            gen = self._views_gen
+            snap = [(sv.sharded, sv.keys_host)
+                    for sv in list(self._views.values())]
+            if self._views_gen == gen:
+                return self._device_memory_from(snap)
+        with self._mu:
+            snap = [(sv.sharded, sv.keys_host)
+                    for sv in self._views.values()]
+        return self._device_memory_from(snap)
+
+    def _device_memory_from(self, snap) -> dict:
         padded = live = 0
         per_device: Dict[str, int] = {}
-        for sv in views:
-            padded += self._view_bytes(sv)
-            if sv.keys_host is not None:
-                live += int((sv.keys_host != INVALID_KEY).sum()) * (
+        for sh, keys_host in snap:
+            padded += self._sharded_bytes(sh)
+            if keys_host is not None:
+                live += int((keys_host != INVALID_KEY).sum()) * (
                     CONTAINER_WORDS * 4 + 4)
             placed = False
             try:
-                for arr in (sv.sharded.words, sv.sharded.keys):
+                for arr in (sh.words, sh.keys):
                     for shard in arr.addressable_shards:
                         n = int(np.prod(shard.data.shape)) * 4
                         dev = str(shard.device)
@@ -510,11 +673,74 @@ class MeshManager:
                 placed = False
             if not placed:
                 devs = [str(d) for d in np.asarray(self.mesh.devices).flat]
-                share = self._view_bytes(sv) // max(1, len(devs))
+                share = self._sharded_bytes(sh) // max(1, len(devs))
                 for dev in devs:
                     per_device[dev] = per_device.get(dev, 0) + share
-        return {"views": len(views), "padded_bytes": padded,
+        return {"views": len(snap), "padded_bytes": padded,
                 "live_bytes": live, "per_device": per_device}
+
+    # Bound on memoized per-view infeasibility verdicts: each is a few
+    # machine words; the bound exists for never-repeating view names.
+    _INFEASIBLE_CACHE_MAX = 256
+
+    def stage_infeasible(self, index: str, leaves,
+                         num_slices: int) -> bool:
+        """Would ANY of these leaves' views overflow the HBM budget on
+        its own? The executor's routing peek: an infeasible view is
+        known-doomed before a single byte moves, so the query goes
+        straight to the host fold instead of paying a snapshot + raise
+        per request. Verdicts memoize per (index, frame, view,
+        num_slices) against the global MUTATION_EPOCH — any write
+        anywhere invalidates (capacity only grows via writes), keeping
+        the steady-state cost of this gate one dict probe per view.
+        Never forces a fragment parse (lazily-opened fragments are
+        skipped — they under-estimate, and the stage-time check in
+        _stage_once remains the authority)."""
+        budget = self._hbm_budget_bytes()
+        if budget <= 0:
+            return False
+        ep = MUTATION_EPOCH.read()
+        for frame, view in dict.fromkeys((f, v)
+                                         for f, v, _r, _q in leaves):
+            ck = (index, frame, view, num_slices)
+            with self._mu:
+                hit = self._infeasible_cache.get(ck)
+                if hit is not None and hit[0] == ep:
+                    self._infeasible_cache.move_to_end(ck)
+                    if hit[1]:
+                        return True
+                    continue
+            bad = self._view_would_exceed(index, frame, view,
+                                          num_slices, budget)
+            with self._mu:
+                self._infeasible_cache[ck] = (ep, bad)
+                self._infeasible_cache.move_to_end(ck)
+                while (len(self._infeasible_cache)
+                       > self._INFEASIBLE_CACHE_MAX):
+                    self._infeasible_cache.popitem(last=False)
+            if bad:
+                return True
+        return False
+
+    def _view_would_exceed(self, index: str, frame: str, view: str,
+                           num_slices: int, budget: int) -> bool:
+        """Mirror of _estimate_staged_bytes computed from the LIVE
+        fragments (no snapshot): padded container capacity of the
+        fullest loaded slice, padded slice count, bytes-per-slot."""
+        if (index, frame, view) in self._views:
+            return False  # resident: it fit when it staged
+        n_dev = max(1, int(self.mesh.shape[SLICE_AXIS]))
+        s_pad = -(-max(1, num_slices) // n_dev) * n_dev
+        cap = 1
+        for s in range(num_slices):
+            frag = self.holder.fragment(index, frame, view, s)
+            if frag is None:
+                continue
+            with frag._mu:
+                if not frag._pending_load:
+                    cap = max(cap, len(frag.storage.keys))
+        cap = -(-cap // ROW_SPAN) * ROW_SPAN
+        return s_pad * cap * (CONTAINER_WORDS * 4 + 4) > budget
 
     # -- staging -------------------------------------------------------------
 
@@ -539,8 +765,76 @@ class MeshManager:
                 gens.append((frag, frag.generation))
         return bitmaps, gens
 
+    def _estimate_staged_bytes(self, bitmaps) -> int:
+        """Pre-H2D estimate of the device bytes build_sharded_index
+        will allocate for these fragment snapshots — EXACT, because it
+        mirrors the padding math in mesh.build_sharded_index: slices
+        padded to a multiple of the mesh's slice-axis extent, row
+        capacity padded to a ROW_SPAN multiple of the fullest slice,
+        and (CONTAINER_WORDS words + 1 key) * 4 bytes per container
+        slot. Lets the governor reject or make room for a stage before
+        a single byte moves."""
+        n_dev = max(1, int(self.mesh.shape[SLICE_AXIS]))
+        s = len(bitmaps)
+        s_pad = -(-max(1, s) // n_dev) * n_dev
+        cap = max(1, max((len(b.keys) for b in bitmaps if b is not None),
+                         default=1))
+        cap = -(-cap // ROW_SPAN) * ROW_SPAN
+        return s_pad * cap * (CONTAINER_WORDS * 4 + 4)
+
+    def _reserve(self, key, est: int, budget: int) -> None:
+        """Make room for an incoming stage of `est` bytes: evict cold
+        unpinned views (LRU, excluding `key` itself — its old image is
+        being replaced anyway) until resident + est fits the budget.
+        If pinned/current-epoch views block the way, proceed over
+        budget rather than thrash: the overshoot is one stage's worth
+        and self-corrects at the next _evict_over_budget. Call under
+        _mu."""
+        total = sum(self._view_bytes(v) for k, v in self._views.items()
+                    if k != key)
+        for k in [k for k, v in self._views.items()
+                  if k != key and v.pins == 0
+                  and v.last_used != self._use_epoch]:
+            if total + est <= budget:
+                break
+            sv = self._views.pop(k)
+            self._purge_memo(sv.sharded.words)
+            self._views_gen += 1
+            total -= self._view_bytes(sv)
+            self.stats.inc("evicted")
+            self.stats.inc("evicted_budget")
+        self.stats["staged_bytes"] = total
+
     def _stage(self, key, num_slices: int) -> StagedView:
+        """Stage with the OOM recovery ladder: a RESOURCE_EXHAUSTED
+        from the H2D path triggers an emergency eviction of every
+        unpinned view and ONE retry; a second failure surfaces as
+        DeviceResourceError(reason="oom") so callers degrade to the
+        host-fold path instead of 500ing. Infeasibility (a single view
+        bigger than the whole budget) is raised by _stage_once before
+        any transfer and passes straight through."""
+        try:
+            return self._stage_once(key, num_slices)
+        except DeviceResourceError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classify then rethrow
+            if not _is_resource_exhausted(e):
+                raise
+            self.stats.inc("oom_retries")
+            self._evict_for_oom()
+            try:
+                return self._stage_once(key, num_slices)
+            except Exception as e2:  # noqa: BLE001
+                if _is_resource_exhausted(e2):
+                    raise DeviceResourceError(
+                        f"stage {key} out of device memory after "
+                        f"eviction: {e2}", reason="oom") from e2
+                raise
+
+    def _stage_once(self, key, num_slices: int) -> StagedView:
         index, frame, view = key
+        fault.point("mesh.stage", index=index, frame=frame, view=view,
+                    slices=num_slices)
         t0 = time.monotonic()
         sp = span("stage", index=index, frame=frame, view=view,
                   slices=num_slices)
@@ -553,6 +847,16 @@ class MeshManager:
         inherit_inc_ewma = old.inc_ewma_s if old is not None else None
         bitmaps, gens = self._snapshot_fragments(index, frame, view,
                                                  num_slices)
+        budget = self._hbm_budget_bytes()
+        if budget > 0:
+            est = self._estimate_staged_bytes(bitmaps)
+            if est > budget:
+                # One view alone overflows the budget: no eviction can
+                # help — route this query to the host-fold path.
+                raise DeviceResourceError(
+                    f"staged view {key} needs {est} bytes, over the "
+                    f"{budget}-byte HBM budget", reason="hbm_infeasible")
+            self._reserve(key, est, budget)
         stage_io: dict = {}
         with jax_scope("pilosa:h2d_stage"):
             sharded, row_ids, keys_host = build_sharded_index(
@@ -578,6 +882,7 @@ class MeshManager:
         # caller decays it first when the restage was gate-chosen).
         sv.inc_ewma_s = inherit_inc_ewma
         self._views[key] = sv
+        self._views_gen += 1
         self._evict_over_budget()
         self.stats.inc("stage")
         dispatch_s = time.monotonic() - t0
@@ -677,11 +982,22 @@ class MeshManager:
                 num_slices: int) -> Optional[StagedView]:
         """Return an up-to-date StagedView, restaging or incrementally
         scatter-updating as needed. None when the view can't be staged
-        (missing index/frame)."""
+        (missing index/frame) — or when the HBM governor refuses it
+        (view bigger than the budget, or device OOM that survived the
+        evict-and-retry ladder): callers already treat an unstaged view
+        as "fold on the host", so degraded mode is the same None."""
         idx = self.holder.index(index)
         if idx is None or idx.frame(frame) is None:
             return None
         key = (index, frame, view)
+        try:
+            return self._refresh_locked(key, num_slices)
+        except DeviceResourceError as e:
+            self.stats.inc(f"fallback_{e.reason}")
+            return None
+
+    def _refresh_locked(self, key, num_slices: int) -> Optional[StagedView]:
+        index, frame, view = key
         with self._mu:
             # Epoch pair read UNDER _mu, before any staleness
             # inspection: a write that lands mid-walk bumps the pair
@@ -832,6 +1148,7 @@ class MeshManager:
             sp = span("incremental", index=index, frame=frame, view=view)
             with jax_scope("pilosa:apply_writes"):
                 sv.sharded = self._apply_fn(sv.sharded, *batches)
+            self._views_gen += 1
             sp.finish()
             sv.slice_gens = new_gens
             sv.validated_epoch = ep
@@ -873,6 +1190,7 @@ class MeshManager:
         with self._mu:
             if index is None:
                 self._views.clear()
+                self._views_gen += 1
                 self.stats["staged_bytes"] = 0
                 self._topn_memo.clear()
                 # The epoch must advance here too: an in-flight query's
@@ -884,6 +1202,7 @@ class MeshManager:
                 for key in [k for k in self._views if k[0] == index]:
                     self._purge_memo(self._views[key].sharded.words)
                     del self._views[key]
+                    self._views_gen += 1
                 self.stats["staged_bytes"] = sum(
                     self._view_bytes(v) for v in self._views.values())
 
@@ -968,18 +1287,50 @@ class MeshManager:
             mask[idx] = 1
         return mask
 
+    def _release_pins(self, pins) -> None:
+        """Drop the eviction pins a query took at plan time. Each entry
+        is a StagedView whose pins count was incremented under _mu;
+        decrement under the same lock and clear the list so a double
+        release is a no-op.
+
+        Release is also the governor's reconvergence point: a batch
+        whose members together staged more than the budget runs over it
+        (every view shares one use-epoch, so _evict_over_budget spares
+        them all — deliberately, to finish the batch without
+        restage-thrashing mid-flight). Without a hook here the
+        overshoot would be PERMANENT once the working set is fully
+        resident, since eviction otherwise only runs at stage time and
+        resident views never stage again. Evicting on release pulls
+        residency back under the budget as soon as the batch is done,
+        at the cost of honest LRU thrash when the steady working set
+        exceeds the budget."""
+        if not pins:
+            return
+        with self._mu:
+            for sv in pins:
+                if sv.pins > 0:
+                    sv.pins -= 1
+            pins.clear()
+            if (self._hbm_budget_bytes() > 0
+                    and self.stats["staged_bytes"]
+                    > self._hbm_budget_bytes()):
+                self._evict_over_budget()
+
     def _count_args(self, index: str, shape, leaves, slices: Sequence[int],
-                    num_slices: int):
+                    num_slices: int, pins=None):
         """Resolve a count request to device arrays:
         (sig, words_t, idx_t, hit_t, dev_mask) or None. All staging
         state (refresh, words snapshot, idx/mask caches) is read and
         mutated under _mu: a concurrent refresh() swaps sv.sharded in
         place, and a query that read one leaf's words before the swap
         and another after would mix two generations of the same view.
-        Only compiled calls run unlocked."""
+        Only compiled calls run unlocked. `pins` (a list) collects an
+        eviction pin per staged view used, held until the caller's
+        _release_pins — the unlocked execution window must not have its
+        images evicted-and-restaged under memory pressure mid-fold."""
         with self._mu:
             self._use_epoch += 1
-            out = self._stage_leaves(index, leaves, num_slices)
+            out = self._stage_leaves(index, leaves, num_slices, pins=pins)
             if out is None:
                 return None
             words_t, idx_t, hit_t, coarse_t, first = out
@@ -992,7 +1343,8 @@ class MeshManager:
         sig = json.dumps(_tree_signature(shape))
         return (sig, words_t, idx_t, hit_t, coarse_t, dev_mask)
 
-    def _stage_leaves(self, index: str, leaves, num_slices: int):
+    def _stage_leaves(self, index: str, leaves, num_slices: int,
+                      pins=None):
         """Stage every leaf's (frame, view) and resolve its row into
         cached device gather arrays. Call under _mu (staging snapshot
         consistency — see _count_args). Returns
@@ -1001,7 +1353,9 @@ class MeshManager:
         the resolver turns into hit=0 everywhere. coarse_t[i] is the
         leaf's (starts, valid) device pair when coarse-eligible, else
         None. Shared by the Count path and the TopN src path so
-        absent-row/staging semantics can't diverge."""
+        absent-row/staging semantics can't diverge. When `pins` is a
+        list, each unique view gets one eviction pin (released by the
+        caller via _release_pins)."""
         staged: Dict[Tuple[str, str], tuple] = {}
         words_t, idx_t, hit_t, coarse_t = [], [], [], []
         for frame, view, row_id, _req in leaves:
@@ -1011,6 +1365,9 @@ class MeshManager:
                 if sv is None:
                     self.stats.inc("fallback")
                     return None
+                if pins is not None:
+                    sv.pins += 1
+                    pins.append(sv)
                 staged[vkey] = (sv, sv.sharded.words)
             sv, words = staged[vkey]
             i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
@@ -1444,6 +1801,92 @@ class MeshManager:
         fn = self._count_fn(sig, len(idx_t))
         return lambda: fn(words_t, idx_t, hit_t, dev_mask)
 
+    # -- plan quarantine + guarded device execution ---------------------------
+
+    def _note_plan_failure(self, sig: str) -> None:
+        """Count a device-execution strike against a plan signature;
+        at [mesh] quarantine-after strikes the signature is quarantined
+        in the compiled-plan cache for quarantine-ttl, and identical
+        queries skip the device path (host fold) until it expires. A
+        success is NOT required to clear strikes early — the TTL is the
+        release valve — but strikes reset when the quarantine lands so
+        the next TTL window starts clean."""
+        if not sig:
+            return
+        with self._quar_mu:
+            n = self._plan_failures.get(sig, 0) + 1
+            if n < self._quarantine_after:
+                self._plan_failures[sig] = n
+                return
+            self._plan_failures.pop(sig, None)
+        self._fused_plans.quarantine(sig, self._quarantine_ttl)
+        self.stats.inc("plan_quarantined")
+
+    def plan_quarantined(self, sig: str) -> bool:
+        return self._fused_plans.is_quarantined(sig)
+
+    def quarantined_plans(self) -> List[str]:
+        return self._fused_plans.quarantined_sigs()
+
+    def clear_quarantine(self, sig: Optional[str] = None) -> int:
+        """Operator reset (ctl / debug): lift a quarantine (or all) and
+        forget accumulated strikes. Returns how many were lifted."""
+        with self._quar_mu:
+            if sig is None:
+                self._plan_failures.clear()
+            else:
+                self._plan_failures.pop(sig, None)
+        return self._fused_plans.clear_quarantine(sig)
+
+    def _guarded_exec(self, sig: str, launch, kind: str = "count",
+                      note: bool = True):
+        """Run one device program launch through the recovery ladder:
+
+          quarantined sig  -> DeviceResourceError("quarantined") now,
+                              no launch (callers host-fold);
+          RESOURCE_EXHAUSTED -> emergency-evict unpinned views, retry
+                              ONCE; a second OOM degrades to
+                              DeviceResourceError("oom");
+          other errors     -> propagate unchanged (caller semantics
+                              keep working), after noting a strike.
+
+        `note=False` suppresses strike counting AND the fallback_*
+        stat bumps for launches whose failure another path will retry
+        and re-count (e.g. _lone_count falling through to the chained
+        path) — otherwise one transient fault would double-strike
+        straight into quarantine and double-count the fallback."""
+
+        def attempt():
+            fault.point("device.exec", sig=sig, kind=kind)
+            return launch()
+
+        if self.plan_quarantined(sig):
+            if note:
+                self.stats.inc("fallback_quarantined")
+            raise DeviceResourceError(
+                f"plan quarantined: {sig[:80]}", reason="quarantined")
+        try:
+            return attempt()
+        except Exception as e:  # noqa: BLE001 — classify then rethrow
+            if not _is_resource_exhausted(e):
+                if note:
+                    self._note_plan_failure(sig)
+                raise
+            self.stats.inc("oom_retries")
+            self._evict_for_oom()
+            try:
+                return attempt()
+            except Exception as e2:  # noqa: BLE001
+                if note:
+                    self._note_plan_failure(sig)
+                if _is_resource_exhausted(e2):
+                    if note:
+                        self.stats.inc("fallback_oom")
+                    raise DeviceResourceError(
+                        f"device OOM after eviction: {e2}",
+                        reason="oom") from e2
+                raise
+
     # -- dynamic batching -----------------------------------------------------
 
     # Queries coalesced into one device program, max. Compile cost grows
@@ -1617,19 +2060,29 @@ class MeshManager:
                 ct = group[0].coarse_t
                 ustarts = self._uniform_starts([ct])
                 if ustarts is not None:
-                    fn = self._coarse_fn(sig, len(idx_t), 1,
-                                         uniform=True)
-                    limbs = fn(words_t, self._device_starts(ustarts),
-                               dev_mask)
+                    du = self._device_starts(ustarts)
+
+                    def launch():
+                        fn = self._coarse_fn(sig, len(idx_t), 1,
+                                             uniform=True)
+                        return fn(words_t, du, dev_mask)
+
+                    limbs = self._guarded_exec(sig, launch)
                     self.stats.inc("coarse_uniform")
                 else:
-                    fn = self._coarse_fn(sig, len(idx_t), 1)
-                    limbs = fn(words_t, tuple(c[0] for c in ct),
-                               tuple(c[1] for c in ct), dev_mask)
+                    def launch():
+                        fn = self._coarse_fn(sig, len(idx_t), 1)
+                        return fn(words_t, tuple(c[0] for c in ct),
+                                  tuple(c[1] for c in ct), dev_mask)
+
+                    limbs = self._guarded_exec(sig, launch)
                 self.stats.inc("coarse")
             else:
-                fn = self._count_fn(sig, len(idx_t))
-                limbs = fn(words_t, idx_t, hit_t, dev_mask)
+                def launch():
+                    fn = self._count_fn(sig, len(idx_t))
+                    return fn(words_t, idx_t, hit_t, dev_mask)
+
+                limbs = self._guarded_exec(sig, launch)
         else:
             sig, words_t, _, _, dev_mask = group[0].args
             num_leaves = len(group[0].args[2])
@@ -1664,17 +2117,21 @@ class MeshManager:
                                 key, sig, leaf_map, len(uniques))
                 if shared is not None:
                     if getattr(shared, "uniform", False):
-                        limbs = shared(
-                            tuple(u[0] for u in uniques),
-                            self._device_starts(_np.asarray(
-                                [u[3] for u in uniques],
-                                dtype=_np.int32)),
-                            dev_mask)
+                        du = self._device_starts(_np.asarray(
+                            [u[3] for u in uniques], dtype=_np.int32))
+
+                        def launch():
+                            return shared(
+                                tuple(u[0] for u in uniques), du,
+                                dev_mask)
                     else:
-                        limbs = shared(
-                            tuple(u[0] for u in uniques),
-                            tuple(u[1] for u in uniques),
-                            tuple(u[2] for u in uniques), dev_mask)
+                        def launch():
+                            return shared(
+                                tuple(u[0] for u in uniques),
+                                tuple(u[1] for u in uniques),
+                                tuple(u[2] for u in uniques), dev_mask)
+
+                    limbs = self._guarded_exec(sig, launch)
                     # shared output columns follow the CANONICAL group
                     # order; distribute results in that order (exact
                     # width, no padding)
@@ -1684,34 +2141,47 @@ class MeshManager:
                     ustarts = self._uniform_starts(
                         [r.coarse_t for r in padded])
                     if ustarts is not None:
-                        fn = self._coarse_fn(sig, num_leaves, b_pad,
-                                             uniform=True)
-                        limbs = fn(words_t, self._device_starts(ustarts),
-                                   dev_mask)
+                        du = self._device_starts(ustarts)
+
+                        def launch():
+                            fn = self._coarse_fn(sig, num_leaves, b_pad,
+                                                 uniform=True)
+                            return fn(words_t, du, dev_mask)
+
+                        limbs = self._guarded_exec(sig, launch)
                         self.stats.inc("coarse_uniform", b)
                     else:
-                        fn = self._coarse_fn(sig, num_leaves, b_pad)
                         start_flat = tuple(
                             r.coarse_t[i][0] for r in padded
                             for i in range(num_leaves))
                         valid_flat = tuple(
                             r.coarse_t[i][1] for r in padded
                             for i in range(num_leaves))
-                        limbs = fn(words_t, start_flat, valid_flat,
-                                   dev_mask)
+
+                        def launch():
+                            fn = self._coarse_fn(sig, num_leaves, b_pad)
+                            return fn(words_t, start_flat, valid_flat,
+                                      dev_mask)
+
+                        limbs = self._guarded_exec(sig, launch)
                 self.stats.inc("coarse", b)
             else:
-                fn = self._get_or_compile(
-                    self._batch_fns, (sig, num_leaves, b_pad),
-                    lambda: compile_serve_count_batch(
-                        self.mesh, json.loads(sig), num_leaves, b_pad),
-                    entry="count_batch")
                 idx_flat = tuple(r.args[2][i] for r in padded
                                  for i in range(num_leaves))
                 hit_flat = tuple(r.args[3][i] for r in padded
                                  for i in range(num_leaves))
-                with jax_scope("pilosa:count_batch"):
-                    limbs = fn(words_t, idx_flat, hit_flat, dev_mask)
+
+                def launch():
+                    fn = self._get_or_compile(
+                        self._batch_fns, (sig, num_leaves, b_pad),
+                        lambda: compile_serve_count_batch(
+                            self.mesh, json.loads(sig), num_leaves,
+                            b_pad),
+                        entry="count_batch")
+                    with jax_scope("pilosa:count_batch"):
+                        return fn(words_t, idx_flat, hit_flat, dev_mask)
+
+                limbs = self._guarded_exec(sig, launch)
             self.stats.inc("batched", b)
 
         # Every branch above launched exactly ONE compiled program.
@@ -1743,6 +2213,17 @@ class MeshManager:
                     for j, r in enumerate(group):
                         r.result = (int(arr[1, j]) << 16) + int(arr[0, j])
             except Exception as e:  # noqa: BLE001 — fail the group
+                # Async execution errors surface HERE (first fetch),
+                # not at dispatch — strike the plan signature so a
+                # persistently failing program still quarantines, and
+                # degrade device OOM to the transient error count()
+                # turns into a host-fold (the dispatched program can't
+                # be retried post-hoc; the re-issued query can).
+                self._note_plan_failure(sig)
+                if _is_resource_exhausted(e):
+                    self.stats.inc("fallback_oom")
+                    e = DeviceResourceError(
+                        f"device OOM at result fetch: {e}", reason="oom")
                 for r in group:
                     r.error = e
             for r in group:
@@ -1782,6 +2263,16 @@ class MeshManager:
         t0 = time.monotonic()
         sp = span("dispatch", engine="mesh", leaves=len(leaves),
                   slices=len(slices))
+        # Quarantine gate BEFORE any staging or inflight accounting:
+        # a signature that keeps killing the device path skips it
+        # entirely (the executor folds on the host) until the TTL
+        # expires. Cheap — json.dumps of the already-lowered shape.
+        sig = json.dumps(_tree_signature(shape))
+        if self.plan_quarantined(sig):
+            self.stats.inc("fallback_quarantined")
+            sp.tag(mode="quarantined")
+            sp.finish()
+            return None
         if not self.lone_fused:
             sp.tag(kill_switch="lone_fused=off")
         with self._lone_mu:
@@ -1795,6 +2286,7 @@ class MeshManager:
             with self._burst_mu:
                 if self._burst_hint > 1:
                     lone = False
+        pins: list = []
         try:
             if lone and self.lone_fused:
                 out = self._lone_count(index, shape, leaves, slices,
@@ -1806,7 +2298,7 @@ class MeshManager:
                     sp.tag(mode="fused", dispatches=1)
                     return out[0]
             prepared = self._count_args(index, shape, leaves, slices,
-                                        num_slices)
+                                        num_slices, pins=pins)
             if prepared is None:
                 sp.tag(mode="fallback")
                 return None
@@ -1831,12 +2323,19 @@ class MeshManager:
                 prof.add_slice(engine="device_batched",
                                leaves=len(leaves), slices=len(slices))
             if req.error is not None:
+                if isinstance(req.error, DeviceResourceError):
+                    # The recovery ladder already retried and counted
+                    # the fallback; answer None so the executor folds
+                    # this query on the host instead of 500ing.
+                    sp.tag(mode="fallback", reason=req.error.reason)
+                    return None
                 _reraise_shared("batched device count", req.error)
             self.stats.inc("count")
             self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
             sp.tag(mode="batched")
             return req.result
         finally:
+            self._release_pins(pins)
             sp.finish()
             with self._lone_mu:
                 self._counts_inflight -= 1
@@ -1849,11 +2348,17 @@ class MeshManager:
         as jit arguments — no standalone device_put ever runs. Returns
         a 1-tuple (count,) so a legitimate zero survives the truthiness
         at the call site, or None to fall through to the chained path
-        (which re-resolves and reports its own fallback)."""
+        (which re-resolves and reports its own fallback). Device
+        launches go through _guarded_exec with note=False: a failure
+        here falls through to the chained path, which retries and
+        notes its OWN strike — noting both would double-strike one
+        transient fault straight into quarantine."""
+        pins: list = []
         try:
             with self._mu:
                 self._use_epoch += 1
-                out = self._stage_leaves_host(index, leaves, num_slices)
+                out = self._stage_leaves_host(index, leaves, num_slices,
+                                              pins=pins)
                 if out is None:
                     return None
                 words_t, idx_all, hit_all, first = out
@@ -1870,18 +2375,25 @@ class MeshManager:
             if prof is None:
                 # THE fast path: async dispatch, no completion wait —
                 # combine_count's device_get is the only sync point.
-                with jax_scope("pilosa:count_fused"):
-                    limbs = fn(words_t, idx_all, hit_all, mask)
+                def launch():
+                    with jax_scope("pilosa:count_fused"):
+                        return fn(words_t, idx_all, hit_all, mask)
+
+                limbs = self._guarded_exec(sig, launch, note=False)
             else:
                 # Profiled: bracket the dispatch with block_until_ready
                 # so device_exec is the kernel's wall time and
                 # readback_d2h is ONLY the D2H fetch. The bracketing
                 # serializes dispatch/readback — profiling observes a
                 # (slightly) slowed query, never the other way around.
-                with prof.phase("device_exec"), \
-                        jax_scope("pilosa:count_fused"):
-                    limbs = fn(words_t, idx_all, hit_all, mask)
-                    limbs.block_until_ready()
+                def launch():
+                    with jax_scope("pilosa:count_fused"):
+                        out_l = fn(words_t, idx_all, hit_all, mask)
+                        out_l.block_until_ready()
+                        return out_l
+
+                with prof.phase("device_exec"):
+                    limbs = self._guarded_exec(sig, launch, note=False)
                 # Each leaf gathers ROW_SPAN containers per slice.
                 prof.add_bytes("bytes_touched_hbm",
                                len(leaves) * len(slices)
@@ -1898,13 +2410,17 @@ class MeshManager:
                 return (combine_count(limbs),)
         except Exception:  # noqa: BLE001 — fast path only; chained path
             return None    # re-resolves and surfaces real errors
+        finally:
+            self._release_pins(pins)
 
-    def _stage_leaves_host(self, index: str, leaves, num_slices: int):
+    def _stage_leaves_host(self, index: str, leaves, num_slices: int,
+                           pins=None):
         """_stage_leaves for the fused path: identical staging and
         absent-row semantics, but the resolved gather metadata stays on
         the host — (words_t, idx_all (L, S, 16) int32, hit_all
         (L, S, 16) uint32, first_staged_view) or None. Call under _mu
-        (same snapshot-consistency contract as _stage_leaves)."""
+        (same snapshot-consistency contract as _stage_leaves, same
+        optional eviction-pin collection)."""
         staged: Dict[Tuple[str, str], tuple] = {}
         words_t, idx_l, hit_l = [], [], []
         for frame, view, row_id, _req in leaves:
@@ -1914,6 +2430,9 @@ class MeshManager:
                 if sv is None:
                     self.stats.inc("fallback")
                     return None
+                if pins is not None:
+                    sv.pins += 1
+                    pins.append(sv)
                 staged[vkey] = (sv, sv.sharded.words)
             sv, words = staged[vkey]
             i = int(np.searchsorted(sv.row_ids, np.uint64(row_id)))
@@ -2052,19 +2571,23 @@ class MeshManager:
         return self._device_cached(self._starts_cache, key, 256, make)
 
     def _row_counts_args(self, index: str, frame: str, view: str,
-                         slices: Sequence[int], num_slices: int):
+                         slices: Sequence[int], num_slices: int,
+                         pins=None):
         """Snapshot the staged arrays for a per-row-counts collective:
         (row_ids, sharded, dev_mask, padded, epoch), ("empty", row_ids)
         for a rowless view, or None on fallback. The resolution half of
         _row_counts_call, shared with the SPMD descriptor plane
         (spmd.SpmdServer) so staging/mask semantics cannot diverge.
-        Takes _mu."""
+        Takes _mu. `pins` collects an eviction pin (see _count_args)."""
         with self._mu:
             self._use_epoch += 1
             sv = self.refresh(index, frame, view, num_slices)
             if sv is None:
                 self.stats.inc("fallback")
                 return None
+            if pins is not None:
+                sv.pins += 1
+                pins.append(sv)
             sharded = sv.sharded  # snapshot before releasing _mu
             mask = self._mask_for(sv, slices)
             if mask is None:
@@ -2078,14 +2601,16 @@ class MeshManager:
         return sv.row_ids, sharded, dev_mask, padded, epoch
 
     def _row_counts_call(self, index: str, frame: str, view: str,
-                         slices: Sequence[int], num_slices: int):
+                         slices: Sequence[int], num_slices: int,
+                         pins=None):
         """(row_ids, zero-arg callable -> (2, padded) DEVICE limb
         array — async; np.asarray it to materialize) or None; see
         _count_call for the locking contract. Identical concurrent
         calls (same staged image, mask, padding) SHARE one in-flight
         device execution — the common shape of a TopN hotspot is many
         clients asking the same frame."""
-        out = self._row_counts_args(index, frame, view, slices, num_slices)
+        out = self._row_counts_args(index, frame, view, slices,
+                                    num_slices, pins=pins)
         if out is None:
             return None
         if len(out) == 2:  # ("empty", row_ids): rowless view
@@ -2103,7 +2628,15 @@ class MeshManager:
             return row_ids, (lambda: memo)
 
         def call():
-            out = self._single_flight(key, lambda: fn(sharded, dev_mask))
+            # Pseudo-signature per padded width: row_counts has no
+            # lowered tree, but the quarantine/recovery ladder still
+            # wants a stable identity for the program family.
+            def launch():
+                return self._single_flight(
+                    key, lambda: fn(sharded, dev_mask))
+
+            out = self._guarded_exec(f"__row_counts__:{padded}", launch,
+                                     kind="row_counts")
             self._memo_put(key, out, (sharded.words, dev_mask), epoch)
             return out
 
@@ -2149,13 +2682,27 @@ class MeshManager:
         or None. num_rows pads to a power of two so growing row spaces
         recompile on doubling only."""
         t0 = time.monotonic()
-        out = self._row_counts_call(index, frame, view, slices, num_slices)
-        if out is None:
+        pins: list = []
+        try:
+            out = self._row_counts_call(index, frame, view, slices,
+                                        num_slices, pins=pins)
+            if out is None:
+                return None
+            row_ids, call = out
+            if call is None:
+                return row_ids, np.zeros(0, dtype=np.int64)
+            limbs = np.asarray(call())
+        except DeviceResourceError:
+            # Ladder exhausted (counted where it failed); degrade to
+            # the host fold by answering "not staged".
             return None
-        row_ids, call = out
-        if call is None:
-            return row_ids, np.zeros(0, dtype=np.int64)
-        limbs = np.asarray(call())
+        except Exception as e:  # noqa: BLE001 — classify fetch errors
+            if _is_resource_exhausted(e):
+                self.stats.inc("fallback_oom")
+                return None
+            raise
+        finally:
+            self._release_pins(pins)
         counts = combine_limbs(limbs, len(row_ids))
         self.stats.inc("topn")
         self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
@@ -2180,9 +2727,21 @@ class MeshManager:
         (ADVICE r2). A single program reads a single immutable snapshot
         — there is no window to re-check."""
         t0 = time.monotonic()
-        out = self._src_counts_limbs(
-            "tan", self._tanimoto_fns, compile_serve_row_counts_tanimoto,
-            index, frame, view, src, slices, num_slices)
+        pins: list = []
+        try:
+            out = self._src_counts_limbs(
+                "tan", self._tanimoto_fns,
+                compile_serve_row_counts_tanimoto,
+                index, frame, view, src, slices, num_slices, pins=pins)
+        except DeviceResourceError:
+            return None
+        except Exception as e:  # noqa: BLE001 — classify fetch errors
+            if _is_resource_exhausted(e):
+                self.stats.inc("fallback_oom")
+                return None
+            raise
+        finally:
+            self._release_pins(pins)
         if out is None:
             return None
         all_rows, padded, limbs = out
@@ -2198,7 +2757,8 @@ class MeshManager:
                              tanimoto, row_ids, attr_predicate)
 
     def _src_counts_args(self, index: str, frame: str, view: str, src,
-                         slices: Sequence[int], num_slices: int):
+                         slices: Sequence[int], num_slices: int,
+                         pins=None):
         """Resolve a src-tree row-count request to device arrays under
         _mu: (sv, sharded, words_t, idx_t, hit_t, dev_mask, padded,
         sig, epoch), or the explicit ("empty", row_ids) marker for a
@@ -2213,6 +2773,9 @@ class MeshManager:
             if sv is None:
                 self.stats.inc("fallback")
                 return None
+            if pins is not None:
+                sv.pins += 1
+                pins.append(sv)
             sharded = sv.sharded
             mask = self._mask_for(sv, slices)
             if mask is None:
@@ -2220,7 +2783,8 @@ class MeshManager:
                 return None
             if len(sv.row_ids) == 0:
                 return ("empty", sv.row_ids)
-            out = self._stage_leaves(index, src_leaves, num_slices)
+            out = self._stage_leaves(index, src_leaves, num_slices,
+                                     pins=pins)
             if out is None:
                 return None
             words_t, idx_t, hit_t, _coarse_t, _first = out
@@ -2233,7 +2797,8 @@ class MeshManager:
 
     def _src_counts_limbs(self, kind: str, fn_cache: dict, compiler,
                           index: str, frame: str, view: str, src,
-                          slices: Sequence[int], num_slices: int):
+                          slices: Sequence[int], num_slices: int,
+                          pins=None):
         """Shared resolve+execute for the src-tree row-count programs
         (row_counts_src and the fused tanimoto): snapshot under _mu,
         compile outside it, memo/single-flight, one readback. Returns
@@ -2249,7 +2814,7 @@ class MeshManager:
         snapshotted after _stage_leaves so src-side purges are
         observed."""
         prepared = self._src_counts_args(index, frame, view, src,
-                                         slices, num_slices)
+                                         slices, num_slices, pins=pins)
         if prepared is None:
             return None
         if prepared[0] == "empty":  # rowless view
@@ -2266,9 +2831,12 @@ class MeshManager:
                tuple(id(w) for w in words_t), tuple(id(a) for a in idx_t))
         out = self._memo_get(key)
         if out is None:
-            out = self._single_flight(
-                key, lambda: fn(sharded.keys, sharded.words, words_t,
-                                idx_t, hit_t, dev_mask))
+            def launch():
+                return self._single_flight(
+                    key, lambda: fn(sharded.keys, sharded.words,
+                                    words_t, idx_t, hit_t, dev_mask))
+
+            out = self._guarded_exec(sig, launch, kind=kind)
             self._memo_put(key, out,
                            (sharded.words, dev_mask) + tuple(words_t)
                            + tuple(idx_t), epoch)
@@ -2283,9 +2851,22 @@ class MeshManager:
         src.intersection_count loop, fragment.go:564-608). Returns
         (row_ids, counts int64) or None."""
         t0 = time.monotonic()
-        out = self._src_counts_limbs(
-            "rcs", self._rowcount_src_fns, compile_serve_row_counts_src,
-            index, frame, view, (src_shape, src_leaves), slices, num_slices)
+        pins: list = []
+        try:
+            out = self._src_counts_limbs(
+                "rcs", self._rowcount_src_fns,
+                compile_serve_row_counts_src,
+                index, frame, view, (src_shape, src_leaves), slices,
+                num_slices, pins=pins)
+        except DeviceResourceError:
+            return None
+        except Exception as e:  # noqa: BLE001 — classify fetch errors
+            if _is_resource_exhausted(e):
+                self.stats.inc("fallback_oom")
+                return None
+            raise
+        finally:
+            self._release_pins(pins)
         if out is None:
             return None
         row_ids, _padded, limbs = out

@@ -180,7 +180,8 @@ class Server:
         self.executor = Executor(
             self.holder, host=self.host, cluster=self.cluster,
             client=self.client, use_device=use_device,
-            prefer_local_reads=self.config.prefer_local_reads)
+            prefer_local_reads=self.config.prefer_local_reads,
+            mesh_config=self.config.mesh_config())
         if self.spmd is not None:
             def _apply_query(index, query):
                 # query arrives pre-parsed: _execute_pql already parsed

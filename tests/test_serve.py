@@ -603,10 +603,10 @@ class TestCostRouting:
         seed(holder, bits=self.BITS)
         e = Executor(holder, use_device=True, device_min_work=1)
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-        assert e._route_to_host(num_slices=1, num_leaves=1) is False
+        assert not e._route_to_host(num_slices=1, num_leaves=1)
         # verdict is cached: flipping the backend later cannot re-route
         monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
-        assert e._route_to_host(num_slices=1, num_leaves=1) is False
+        assert not e._route_to_host(num_slices=1, num_leaves=1)
 
     def test_zero_threshold_disables_routing(self, holder):
         seed(holder, bits=self.BITS)
