@@ -2151,6 +2151,110 @@ def main():
                 os.environ["PILOSA_TPU_DEVICE_MIN_WORK"] = min_work_prev
             ev_holder.close()
 
+    with section("shadow_verify_overhead"):
+        # Shadow verification cost (ISSUE 10): 1-in-N sampled device
+        # counts are recomputed through the host roaring fold. Price
+        # the serving path with shadow off (must be exactly 0 checks)
+        # vs 1-in-64 — the amortized overhead must stay under 2%. Plus
+        # the scrubber pacing check: a pass over the holder's bytes at
+        # a configured rate limit must not exceed that budget.
+        _progress("shadow verification overhead: off vs 1-in-64")
+        import tempfile as _tf5
+
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.core.scrub import Scrubber
+        from pilosa_tpu.executor import SHADOW_STATS
+
+        sh_dir = _tf5.mkdtemp(prefix="bench_shadow_")
+        sh_holder = Holder(sh_dir)
+        sh_holder.open()
+        sh_idx = sh_holder.create_index_if_not_exists("sh")
+        sh_f = sh_idx.create_frame_if_not_exists("f")
+        rng_sh = np.random.default_rng(43)
+        # 2048 seeded rows: six measurement passes each need a fresh
+        # 256-row window (fresh cache keys, real host-recount work).
+        for row_ in range(2048):
+            for col_ in rng_sh.integers(0, 2 * SLICE_WIDTH, 8):
+                sh_f.set_bit(row_, int(col_))
+        min_work_prev = os.environ.get("PILOSA_TPU_DEVICE_MIN_WORK")
+        os.environ["PILOSA_TPU_DEVICE_MIN_WORK"] = "0"
+        try:
+            sh_ex = Executor(sh_holder, use_device=True,
+                             mesh_config={"hbm_budget_bytes": -1})
+            all_executors.append(sh_ex)
+            n_sh = 512 if on_tpu else 192
+
+            def _shadow_spin(sample_1_in, salt):
+                # Fresh rowIDs every pass (salt shifts the window) so
+                # the whole-query memo never answers and every query
+                # walks the device path — the thing shadow verification
+                # taxes.
+                sh_ex.shadow_sample = sample_1_in
+                t0_ = time.perf_counter()
+                for i_ in range(n_sh):
+                    sh_ex.execute("sh", parse_string(
+                        f"Count(Bitmap(rowID={salt + i_ % 256}, frame=f))"))
+                return (time.perf_counter() - t0_) / n_sh
+
+            checks0 = sum(v for k, v in SHADOW_STATS.copy().items()
+                          if k.startswith("checks:"))
+            # Best-of-3 per mode, every rep over a fresh seeded-row
+            # window: host timing noise between two long separated
+            # loops would otherwise swamp a 2% bound.
+            off_dt = min(_shadow_spin(0, s) for s in (0, 256, 512))
+            checks_off = sum(v for k, v in SHADOW_STATS.copy().items()
+                             if k.startswith("checks:")) - checks0
+            on_dt = min(_shadow_spin(64, s) for s in (1024, 1280, 1536))
+            checks_on = sum(v for k, v in SHADOW_STATS.copy().items()
+                            if k.startswith("checks:")) - checks0
+            overhead = on_dt / off_dt - 1.0
+
+            # Scrubber pacing: scrub the holder's on-disk bytes under a
+            # rate limit sized so an unpaced pass would blow through it.
+            for sl_ in sh_idx.frame("f").views["standard"].fragments:
+                fr_ = sh_holder.fragment("sh", "f", "standard", sl_)
+                fr_.snapshot()
+                fr_.wait_snapshot(timeout=60)
+            total_b = sum(
+                os.path.getsize(sh_holder.fragment(
+                    "sh", "f", "standard", sl_).path)
+                for sl_ in sh_idx.frame("f").views["standard"].fragments)
+            rate_b = max(1, int(total_b / 0.5))  # budget: ~0.5 s pass
+            t0_ = time.perf_counter()
+            Scrubber(sh_holder, rate_limit=rate_b).scrub_pass()
+            scrub_dt = time.perf_counter() - t0_
+            eff_rate = total_b / scrub_dt
+
+            details["shadow_verify_overhead"] = {
+                "queries_per_mode": n_sh,
+                "shadow_off_us": off_dt * 1e6,
+                "shadow_1in64_us": on_dt * 1e6,
+                "overhead_pct": overhead * 100.0,
+                "checks_off": int(checks_off),
+                "checks_1in64": int(checks_on),
+                "scrub_bytes": int(total_b),
+                "scrub_rate_limit_bytes_s": rate_b,
+                "scrub_pass_s": scrub_dt,
+                "scrub_effective_bytes_s": eff_rate}
+            assert checks_off == 0, \
+                f"shadow off still ran {checks_off} host recounts"
+            assert checks_on >= n_sh // 64, (checks_on, n_sh)
+            # THE guard: 1-in-64 sampling must be amortized noise.
+            assert overhead < 0.02, (
+                f"shadow 1-in-64 overhead {overhead * 100:.2f}% >= 2%")
+            # Pacing: the pass must respect the bytes/s budget (token
+            # accounting makes it exact up to one final-file credit).
+            assert eff_rate <= 1.5 * rate_b, (
+                f"scrubber burst {eff_rate:.0f} B/s over a "
+                f"{rate_b} B/s limit")
+        finally:
+            if min_work_prev is None:
+                os.environ.pop("PILOSA_TPU_DEVICE_MIN_WORK", None)
+            else:
+                os.environ["PILOSA_TPU_DEVICE_MIN_WORK"] = min_work_prev
+            sh_holder.close()
+
     # Cache-layer counters for the whole run (query memo, leaf blocks,
     # per-slice memos, leaf matrices, mesh-side memo/batch stats) — the
     # judge-visible proof of which r4/r5 mechanisms actually fired.

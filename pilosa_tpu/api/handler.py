@@ -461,6 +461,11 @@ class Handler:
         # and counts against the query deadline. None = no scheduling
         # (embedded/test handlers behave exactly as before).
         self.scheduler = None
+        # Background integrity scrubber (core/scrub.Scrubber, server
+        # wiring; [integrity] config). Feeds the pilosa_scrub_* metric
+        # families and the /debug/vars integrity section. None =
+        # embedded/test handlers without one.
+        self.scrubber = None
         self._prom = obs.prom.Registry()
         self._register_collectors()
         self._routes: List[Route] = []
@@ -597,6 +602,7 @@ class Handler:
         reg.register_collector(self._collect_sched)
         reg.register_collector(self._collect_fragments)
         reg.register_collector(self._collect_storage)
+        reg.register_collector(self._collect_integrity)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
@@ -940,7 +946,75 @@ class Handler:
             "pilosa_storage_snapshot_us", "histogram",
             "Background snapshot wall time (microseconds).")
         swall.add_histogram(SNAPSHOT_US)
-        return [fsync, bp, snaps, group, swall]
+        torn = prom.MetricFamily(
+            "pilosa_wal_torn_tails_total", "counter",
+            "Torn final WAL records truncated at load (crash "
+            "mid-append recoveries — expected after power loss; "
+            "a climbing rate without crashes means flaky storage).")
+        torn.add(WAL_STATS.get("torn_tails", 0))
+        return [fsync, bp, snaps, group, swall, torn]
+
+    def _collect_integrity(self) -> list:
+        """Data-integrity telemetry: corrupt-load / read-repair
+        counters (core/fragment.INTEGRITY_STATS), scrubber progress
+        (core/scrub.SCRUB_STATS + last-scrub age), and shadow
+        verification checks/mismatches by backend
+        (executor.SHADOW_STATS)."""
+        prom = obs.prom
+        from ..core.fragment import INTEGRITY_STATS
+        from ..core.scrub import SCRUB_STATS
+        from ..executor import SHADOW_STATS
+
+        corrupt = prom.MetricFamily(
+            "pilosa_integrity_corrupt_total", "counter",
+            "Fragment loads that failed integrity verification "
+            "(footer CRC / container FNV / op-log checksum).")
+        corrupt.add(INTEGRITY_STATS.get("corrupt", 0))
+        repaired = prom.MetricFamily(
+            "pilosa_integrity_repaired_total", "counter",
+            "Corrupt fragments restored from a verified replica copy "
+            "(outcome=repaired) vs left pending with no donor "
+            "(outcome=unrepaired).")
+        repaired.add(INTEGRITY_STATS.get("repaired", 0),
+                     {"outcome": "repaired"})
+        repaired.add(INTEGRITY_STATS.get("unrepaired", 0),
+                     {"outcome": "unrepaired"})
+        sfrag = prom.MetricFamily(
+            "pilosa_scrub_fragments_total", "counter",
+            "Fragments verified by the background scrubber.")
+        sfrag.add(SCRUB_STATS.get("fragments", 0))
+        srep = prom.MetricFamily(
+            "pilosa_scrub_repairs_total", "counter",
+            "Scrubber-initiated repairs (snapshot rewrite, replica "
+            "read-repair, or anti-entropy merge).")
+        srep.add(SCRUB_STATS.get("repairs", 0))
+        fams = [corrupt, repaired, sfrag, srep]
+        if self.scrubber is not None:
+            age = prom.MetricFamily(
+                "pilosa_scrub_last_age_seconds", "gauge",
+                "Seconds since the least-recently-scrubbed fragment "
+                "was verified (0 until the first pass).")
+            age.add(self.scrubber.oldest_scrub_age())
+            fams.append(age)
+        shadow_c = prom.MetricFamily(
+            "pilosa_shadow_checks_total", "counter",
+            "Sampled device results recomputed through the host "
+            "roaring fold.")
+        shadow_m = prom.MetricFamily(
+            "pilosa_shadow_mismatch_total", "counter",
+            "Shadow recomputations whose host answer DIFFERED from "
+            "the device answer. Any nonzero value is a sev: the "
+            "offending plan signature is quarantined.")
+        backends = sorted({k.split(":", 1)[1]
+                           for k in SHADOW_STATS.copy()
+                           if ":" in k}) or ["mesh"]
+        for b in backends:
+            shadow_c.add(SHADOW_STATS.get(f"checks:{b}", 0),
+                         {"backend": b})
+            shadow_m.add(SHADOW_STATS.get(f"mismatch:{b}", 0),
+                         {"backend": b})
+        fams += [shadow_c, shadow_m]
+        return fams
 
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
@@ -1000,6 +1074,19 @@ class Handler:
         ss = getattr(self.holder, "storage_state", None)
         if ss is not None:
             snap = dict(snap, storage=ss())
+        # Data-integrity state: corrupt/repair counters, shadow
+        # verification tallies, and the scrubber's pass snapshot.
+        from ..core.fragment import INTEGRITY_STATS
+        from ..executor import SHADOW_STATS
+
+        integrity = dict(INTEGRITY_STATS.copy())
+        shadow = SHADOW_STATS.copy()
+        if shadow:
+            integrity["shadow"] = dict(shadow)
+        if self.scrubber is not None:
+            integrity["scrub"] = self.scrubber.snapshot()
+        if integrity:
+            snap = dict(snap, integrity=integrity)
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:

@@ -103,6 +103,19 @@ TOML schema:
     max-op-n = 0                # snapshot threshold per fragment;
                                 # 0 = default (2000)
 
+    [integrity]
+    enabled = true              # master switch for the background
+                                # scrubber (checksummed snapshots and
+                                # load-time verification are always on)
+    scrub-interval = "10m"      # how often the scrubber walks every
+                                # owned fragment re-verifying on-disk
+                                # footers and replica block checksums
+    scrub-rate-limit-bytes = 16777216  # scrub read budget in bytes/s
+                                # (token-paced; 0 = unpaced)
+    shadow-sample-1-in = 0      # recompute 1-in-N device Count/TopN
+                                # results through the host roaring fold
+                                # and compare; 0 = off
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -274,6 +287,13 @@ class Config:
         self.storage_max_wal_ops: int = 65536
         self.storage_backpressure_deadline: float = 1.0
         self.storage_max_op_n: int = 0
+        # [integrity] — data-integrity subsystem (core/scrub.py,
+        # executor shadow verification): scrubber pacing and the
+        # device-result sampling rate.
+        self.integrity_enabled: bool = True
+        self.integrity_scrub_interval: float = 600.0
+        self.integrity_rate_limit: int = 16 << 20
+        self.integrity_shadow_sample: int = 0
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -385,6 +405,15 @@ class Config:
             c.storage_backpressure_deadline = parse_duration(
                 st["backpressure-deadline"])
         c.storage_max_op_n = int(st.get("max-op-n", c.storage_max_op_n))
+        it = data.get("integrity", {})
+        c.integrity_enabled = bool(it.get("enabled", c.integrity_enabled))
+        if "scrub-interval" in it:
+            c.integrity_scrub_interval = parse_duration(
+                it["scrub-interval"])
+        c.integrity_rate_limit = int(it.get("scrub-rate-limit-bytes",
+                                            c.integrity_rate_limit))
+        c.integrity_shadow_sample = int(it.get("shadow-sample-1-in",
+                                               c.integrity_shadow_sample))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -497,6 +526,11 @@ class Config:
             f'backpressure-deadline = '
             f'"{int(self.storage_backpressure_deadline * 1000)}ms"\n'
             f"max-op-n = {self.storage_max_op_n}\n"
+            f"\n[integrity]\n"
+            f"enabled = {'true' if self.integrity_enabled else 'false'}\n"
+            f'scrub-interval = "{int(self.integrity_scrub_interval)}s"\n'
+            f"scrub-rate-limit-bytes = {self.integrity_rate_limit}\n"
+            f"shadow-sample-1-in = {self.integrity_shadow_sample}\n"
         )
 
 

@@ -31,7 +31,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import SLICE_WIDTH, fault
-from ..errors import WriteBackpressureError
+from ..errors import CorruptFragmentError, WriteBackpressureError
+from ..obs import StatMap
 from ..obs import profile as _profile
 from ..obs.log import get_logger
 from ..roaring import Bitmap
@@ -43,6 +44,59 @@ from .wal import FSYNC_NEVER as _FSYNC_NEVER
 
 # Snapshot after this many WAL ops (reference fragment.go:62-65).
 MAX_OP_N = 2000
+
+# Process-wide integrity counters: corrupt loads detected, read-repairs
+# completed, fragments left unrepaired (no replica). Exported as
+# pilosa_integrity_* Prometheus families.
+INTEGRITY_STATS = StatMap()
+
+
+class IntegrityContext:
+    """Shared data-integrity wiring, threaded Holder→Index→Frame→View→
+    Fragment BY REFERENCE (like WalConfig) so the server can inject the
+    read-repair source after the cluster client exists and every
+    fragment — already-open and future — sees it through the one shared
+    object.
+
+    `repair_source(fragment) -> Optional[bytes]` returns a VERIFIED tar
+    (write_to_tar format) streamed from a live replica — the server's
+    closure fetches via InternalClient.fragment_data and cross-checks
+    block checksums against the replica's fragment_blocks before
+    handing it over — or None when no replica can supply one."""
+
+    __slots__ = ("repair_source",)
+
+    def __init__(self, repair_source=None):
+        self.repair_source = repair_source
+
+
+def bitmap_block_checksums(bm: Bitmap) -> Dict[int, bytes]:
+    """Per-100-row-block SHA-1 digests of a bare bitmap — the same
+    hashes Fragment.blocks() serves, computable on a parsed replica
+    image or an on-disk snapshot without constructing a Fragment
+    (read-repair verification, scrubber disk-vs-memory diff)."""
+    out: Dict[int, bytes] = {}
+    if not bm.keys:
+        return out
+    containers_per_block = HASH_BLOCK_SIZE * SLICE_WIDTH >> 16
+    for blk in sorted({int(k) // containers_per_block for k in bm.keys}):
+        lo = blk * HASH_BLOCK_SIZE * SLICE_WIDTH
+        vals = bm.slice_range(lo, lo + HASH_BLOCK_SIZE * SLICE_WIDTH)
+        if len(vals) == 0:
+            continue
+        out[blk] = hashlib.sha1(vals.astype("<u8").tobytes()).digest()
+    return out
+
+
+def bitmap_from_tar(tar_bytes: bytes) -> Optional[Bitmap]:
+    """Extract + parse the `data` member of a write_to_tar archive
+    (verifying its integrity footer when present)."""
+    with tarfile.open(fileobj=io.BytesIO(tar_bytes), mode="r|") as tar:
+        for member in tar:
+            if member.name == "data":
+                buf = tar.extractfile(member).read()
+                return Bitmap.from_bytes(buf, verify=True)
+    return None
 
 
 class _MutationEpoch:
@@ -150,7 +204,8 @@ class Fragment:
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
                  row_attr_store=None, stats=None,
-                 wal: Optional[WalConfig] = None):
+                 wal: Optional[WalConfig] = None,
+                 integrity: Optional[IntegrityContext] = None):
         self.path = path
         self.index = index
         self.frame = frame
@@ -160,6 +215,10 @@ class Fragment:
         self.cache_size = cache_size
         self.row_attr_store = row_attr_store
         self.stats = stats
+        self.integrity = integrity
+        # Wall-clock of the scrubber's last verification pass over this
+        # fragment (0 = never scrubbed), for staleness metrics.
+        self.last_scrub = 0.0
 
         # Serializes storage/cache/WAL access across the threaded HTTP
         # server and the executor's per-slice pool (reference
@@ -178,6 +237,12 @@ class Fragment:
         self._wal = WalCommitter(self.wal_cfg, stats=stats, path=path)
         self.cache = new_cache(cache_type, cache_size)
         self.checksums: Dict[int, bytes] = {}
+        # Full blocks() result memo, keyed by mutation generation: the
+        # anti-entropy walk, rebalance verification, and the scrubber
+        # all hit GET /fragment/blocks repeatedly — an idle fragment
+        # answers from this pair instead of re-walking every container.
+        self._blocks_gen = -1
+        self._blocks_cache: Optional[List[Tuple[int, bytes]]] = None
         self._op_file = None
         self._lock_file = None
         self._pending_load = True
@@ -260,42 +325,157 @@ class Fragment:
         but-empty — acked writes would miss the WAL and the next
         snapshot would overwrite the real data with the empty image.
         The separate _loading flag breaks the _load_cache →
-        rebuild_cache → row() re-entry, not the retry."""
+        rebuild_cache → row() re-entry, not the retry.
+
+        A storage image that fails integrity verification (footer CRC
+        mismatch, rotted header, mid-log op corruption) does NOT
+        crash-loop: the rotted file is quarantined aside and the
+        fragment read-repairs from a live replica via the injected
+        IntegrityContext.repair_source, all under _mu — concurrent
+        queries block on the lock and then see the repaired image.
+        Only when no replica can supply a verified copy does the touch
+        raise CorruptFragmentError (a SliceUnavailableError, so the
+        executor re-splits / degrades to partial), and the NEXT touch
+        retries the repair."""
         if not self._pending_load or self._loading:
             return
         self._loading = True
         try:
-            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
-                with open(self.path, "rb") as f:
-                    data = f.read()
-                self.storage = Bitmap.from_bytes(data,
-                                                 truncate_torn_tail=True)
-                self.op_n = self.storage.op_n
-                torn = self.storage.torn_tail_bytes
-                if torn:
-                    # Crash mid-append left a damaged final op. The
-                    # acknowledged prefix is intact — drop the tail on
-                    # disk BEFORE attaching the append fd, or the next
-                    # replay would see the garbage mid-log and refuse
-                    # to load (kill -9 recovery, ISSUE 7 satellite).
-                    get_logger("pilosa.fragment").warning(
-                        "torn WAL tail: truncating %d trailing bytes "
-                        "of %s (crash recovery)", torn, self.path)
-                    os.truncate(self.path, len(data) - torn)
-            else:
-                with open(self.path, "wb") as f:
-                    self.storage.write_to(f)
-            # Unbuffered append fd; ops route through the per-fragment
-            # WAL committer, which write-throughs (fsync-policy never)
-            # or group-commits (group/always) per [storage] config.
-            self._op_file = open(self.path, "ab", buffering=0)
-            self._wal.retarget(self._op_file)
-            self.storage.op_writer = self._wal
-            self._replay_side_wal()
+            try:
+                self._load_storage()
+            except ValueError as err:
+                self._recover_corrupt(err)
             self._load_cache()
             self._pending_load = False
         finally:
             self._loading = False
+
+    def _load_storage(self):
+        """Read + verify + parse the storage file, attach the append
+        fd, and replay any side WAL. Raises ValueError (incl.
+        CorruptSnapshotError) on a rotted image, with no append fd left
+        attached."""
+        if self._op_file is not None:
+            # Retry after a failed attempt: drop the stale fd first.
+            self.storage.op_writer = None
+            try:
+                self._op_file.close()
+            except OSError:
+                pass
+            self._op_file = None
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            with open(self.path, "rb") as f:
+                data = f.read()
+            data = fault.corrupt("storage.corrupt", data, path=self.path,
+                                 kind="snapshot")
+            self.storage = Bitmap.from_bytes(data, truncate_torn_tail=True,
+                                             verify=True)
+            self.op_n = self.storage.op_n
+            torn = self.storage.torn_tail_bytes
+            if torn:
+                # Crash mid-append left a damaged final op. The
+                # acknowledged prefix is intact — drop the tail on
+                # disk BEFORE attaching the append fd, or the next
+                # replay would see the garbage mid-log and refuse
+                # to load (kill -9 recovery, ISSUE 7 satellite).
+                WAL_STATS.inc("torn_tails")
+                get_logger("pilosa.fragment").warning(
+                    "torn WAL tail: truncating %d trailing bytes "
+                    "of %s (crash recovery)", torn, self.path)
+                os.truncate(self.path, len(data) - torn)
+        else:
+            with open(self.path, "wb") as f:
+                self.storage.write_to(f, footer=True)
+        # Unbuffered append fd; ops route through the per-fragment
+        # WAL committer, which write-throughs (fsync-policy never)
+        # or group-commits (group/always) per [storage] config.
+        self._op_file = open(self.path, "ab", buffering=0)
+        self._wal.retarget(self._op_file)
+        self.storage.op_writer = self._wal
+        try:
+            self._replay_side_wal()
+        except ValueError:
+            # Rotted side WAL: detach before recovery quarantines it.
+            self.storage.op_writer = None
+            try:
+                self._op_file.close()
+            except OSError:
+                pass
+            self._op_file = None
+            raise
+
+    def _recover_corrupt(self, err: BaseException):
+        """Corrupt-storage recovery: stream a verified replica copy
+        through the rebalance transfer format and swap it in. Caller is
+        ensure_loaded, under _mu with _loading set.
+
+        Ordering is the safety property: the rotted file is moved aside
+        (as `.corrupt` evidence) only AFTER a verified replacement is
+        in hand. An unrepaired fragment keeps the rot in place so every
+        retry re-detects it and raises — it must never degrade to a
+        fresh empty image whose next snapshot would bury the real data."""
+        INTEGRITY_STATS.inc("corrupt")
+        if self.stats:
+            self.stats.count("corruptN", 1)
+        log = get_logger("pilosa.fragment")
+        log.error(
+            "corrupt fragment storage %s (%s/%s/%d): %s — attempting "
+            "read-repair from a replica", self.path, self.frame,
+            self.view, self.slice, err)
+        self.storage = Bitmap()  # drop any partially-parsed image
+        self.op_n = 0
+        bm = None
+        src = self.integrity.repair_source if self.integrity else None
+        if src is not None:
+            try:
+                tar_bytes = src(self)
+                if tar_bytes:
+                    bm = bitmap_from_tar(tar_bytes)
+            except Exception as rerr:  # noqa: BLE001 — degrade, not crash
+                log.error("read-repair of %s failed: %s", self.path, rerr)
+        if bm is None:
+            INTEGRITY_STATS.inc("unrepaired")
+            raise CorruptFragmentError(
+                f"fragment {self.frame}/{self.view}/{self.slice} is "
+                f"corrupt and no replica supplied a verified copy: "
+                f"{err}") from err
+        if os.path.exists(self.path):
+            try:
+                os.replace(self.path, self.path + ".corrupt")
+            except OSError:
+                pass
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            bm.write_to(f, footer=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        # Attach directly off the parsed image — re-reading through
+        # _load_storage would run the freshly-written bytes back
+        # through the bit-rot seam and re-detect an injected fault.
+        self.storage = bm
+        self.op_n = bm.op_n
+        self._op_file = open(self.path, "ab", buffering=0)
+        self._wal.retarget(self._op_file)
+        self.storage.op_writer = self._wal
+        try:
+            # Locally-acked ops stranded in a side WAL survive the
+            # repair: absolute positions replay idempotently onto the
+            # replica image.
+            self._replay_side_wal()
+        except ValueError as serr:
+            side_path = self.path + ".wal"
+            log.error("side WAL of repaired fragment %s is also rotted "
+                      "(%s): quarantined, anti-entropy will reconverge",
+                      self.path, serr)
+            try:
+                os.replace(side_path, side_path + ".corrupt")
+            except OSError:
+                pass
+        self._mark_dirty(None)  # device pools/caches rebuild from scratch
+        INTEGRITY_STATS.inc("repaired")
+        log.warning("read-repair: %s (%s/%s/%d) restored from replica",
+                    self.path, self.frame, self.view, self.slice)
 
     def _replay_side_wal(self):
         """Crash recovery for a background snapshot that died mid-way:
@@ -316,8 +496,11 @@ class Fragment:
             return
         with open(side_path, "rb") as f:
             data = f.read()
+        data = fault.corrupt("storage.corrupt", data, path=side_path,
+                             kind="side-wal")
         ops, valid, torn = scan_ops(data)
         if torn:
+            WAL_STATS.inc("torn_tails")
             get_logger("pilosa.fragment").warning(
                 "torn side-WAL tail: dropping %d trailing bytes of %s "
                 "(crash recovery)", torn, side_path)
@@ -699,7 +882,9 @@ class Fragment:
         tmp = self.path + ".snapshotting"
         try:
             with open(tmp, "wb") as f:
-                frozen.write_to(f)
+                # Integrity footer rides the temp through the atomic
+                # rename: every durable snapshot is born verifiable.
+                frozen.write_to(f, footer=True)
                 f.flush()
                 fault.point("storage.fsync", path=self.path,
                             kind="snapshot")
@@ -888,9 +1073,18 @@ class Fragment:
         visited — a 100-row block spans exactly 1600 containers, so
         candidate block ids come straight from the container keys (a
         sparse huge-rowID fragment must not scan the dense block range).
-        Checksums are cached per block and invalidated by writes."""
+        Checksums are cached per block and invalidated by writes; on
+        top of that the WHOLE result list is memoized per mutation
+        generation, so back-to-back anti-entropy / rebalance / scrub
+        passes over an idle fragment cost one int compare instead of a
+        container-key walk."""
+        if self._blocks_cache is not None \
+                and self._blocks_gen == self.generation:
+            return list(self._blocks_cache)
         out: List[Tuple[int, bytes]] = []
         if not self.storage.keys:
+            self._blocks_cache = []
+            self._blocks_gen = self.generation
             return out
         containers_per_block = HASH_BLOCK_SIZE * SLICE_WIDTH >> 16
         for blk in sorted({int(k) // containers_per_block for k in self.storage.keys}):
@@ -905,6 +1099,8 @@ class Fragment:
             digest = hashlib.sha1(vals.astype("<u8").tobytes()).digest()
             self.checksums[blk] = digest
             out.append((blk, digest))
+        self._blocks_cache = list(out)
+        self._blocks_gen = self.generation
         return out
 
     @_loaded
@@ -1054,7 +1250,10 @@ class Fragment:
     def write_to_tar(self, fileobj):
         """Stream data+cache as a tar archive (fragment.go:1095-1153)."""
         with tarfile.open(fileobj=fileobj, mode="w|") as tar:
-            data = self.storage.to_bytes()
+            # footer=True: transfers (rebalance, read-repair) carry the
+            # integrity footer, so the receiver verifies the wire bytes
+            # with the same machinery that guards the disk.
+            data = self.storage.to_bytes(footer=True)
             info = tarfile.TarInfo("data")
             info.size = len(data)
             info.mtime = int(time.time())

@@ -34,7 +34,8 @@ class Frame:
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
                  time_quantum: str = "",
-                 stats=None, broadcaster=None, wal=None):
+                 stats=None, broadcaster=None, wal=None,
+                 integrity=None):
         validate_name(name)
         self.path = path
         self.index = index
@@ -47,6 +48,7 @@ class Frame:
         self.stats = stats
         self.broadcaster = broadcaster
         self.wal = wal
+        self.integrity = integrity
         self.views: Dict[str, View] = {}
         self._create_mu = threading.RLock()
         self.row_attr_store = AttrStore(os.path.join(path, "attrs.db"))
@@ -123,6 +125,7 @@ class Frame:
             stats=self.stats.with_tags(f"view:{name}") if self.stats else None,
             broadcaster=self.broadcaster,
             wal=self.wal,
+            integrity=self.integrity,
         )
 
     def view(self, name: str) -> Optional[View]:

@@ -19,7 +19,8 @@ from .index import Index
 
 
 class Holder:
-    def __init__(self, path: str, stats=None, broadcaster=None, wal=None):
+    def __init__(self, path: str, stats=None, broadcaster=None, wal=None,
+                 integrity=None):
         self.path = path
         self.stats = stats or NopStats()
         self.broadcaster = broadcaster
@@ -27,6 +28,11 @@ class Holder:
         # down to every Fragment; None = the fragment default
         # (write-through, no fsync).
         self.wal = wal
+        # Shared-by-reference IntegrityContext (core/fragment): the
+        # server fills in repair_source after the cluster client
+        # exists, and every fragment sees it — same late-binding trick
+        # as broadcaster.
+        self.integrity = integrity
         self.indexes: Dict[str, Index] = {}
         # Guards check-then-act index creation/deletion under the
         # threaded HTTP server (reference Holder.mu).
@@ -59,6 +65,7 @@ class Holder:
             stats=self.stats.with_tags(f"index:{name}"),
             broadcaster=self.broadcaster,
             wal=self.wal,
+            integrity=self.integrity,
             **options,
         )
 

@@ -27,7 +27,7 @@ class Index:
     def __init__(self, path: str, name: str,
                  column_label: str = DEFAULT_COLUMN_LABEL,
                  time_quantum: str = "", stats=None, broadcaster=None,
-                 wal=None):
+                 wal=None, integrity=None):
         validate_name(name)
         self.path = path
         self.name = name
@@ -36,6 +36,7 @@ class Index:
         self.stats = stats
         self.broadcaster = broadcaster
         self.wal = wal
+        self.integrity = integrity
         self.frames: Dict[str, Frame] = {}
         self._create_mu = threading.RLock()
         self.column_attr_store = AttrStore(os.path.join(path, "attrs.db"))
@@ -122,6 +123,7 @@ class Index:
             stats=self.stats.with_tags(f"frame:{name}") if self.stats else None,
             broadcaster=self.broadcaster,
             wal=self.wal,
+            integrity=self.integrity,
             **options,
         )
 

@@ -1,0 +1,300 @@
+"""Background integrity scrubber.
+
+A paced, low-priority loop that walks every fragment this node owns
+and proves — byte by byte — that what is on disk still matches what
+the checksums said when it was written:
+
+1. **On-disk verification**: re-read the fragment file, re-verify the
+   integrity footer (whole-region CRC + per-container FNV-1a, see
+   roaring/serialize.py) and the op-log checksums. Rot found on a
+   LOADED fragment is repaired from memory (the in-RAM image is
+   authoritative — a fresh snapshot rewrites the file); rot on a
+   lazily-unloaded fragment routes through `ensure_loaded`'s
+   read-repair path, which streams a verified copy from a replica.
+2. **Disk-vs-memory diff**: when the parse succeeds and the fragment
+   is loaded and quiescent (same op count, no snapshot in flight),
+   the parsed image's per-block SHA-1s are compared against the live
+   `blocks()` checksums — catching rot that a footerless (pre-footer
+   era) file cannot self-detect.
+3. **Cross-replica diff**: the local block checksums are diffed
+   against each replica's `/fragment/blocks`; divergence hands the
+   fragment to the anti-entropy FragmentSyncer for a majority merge.
+
+Pacing: `rate_limit` bytes/second across the whole pass (token
+accounting against the pass start time), so a multi-GB holder scrubs
+in the background without starving query I/O. The loop sleeps on the
+shared `closing` flag, so shutdown interrupts a pass immediately.
+
+Counters live in the module-level SCRUB_STATS StatMap (exported as
+pilosa_scrub_* Prometheus families by the handler); each fragment's
+`last_scrub` timestamp feeds the pilosa_scrub_last_age_seconds gauge.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from .. import fault
+from ..obs import StatMap, get_logger
+from ..roaring import Bitmap
+from .fragment import INTEGRITY_STATS, bitmap_block_checksums
+from .syncer import Closing, FragmentSyncer
+from .view import VIEW_INVERSE, VIEW_STANDARD
+
+# Process-wide scrub counters: fragments verified, repairs (by kind),
+# bytes read, corruption found, passes completed.
+SCRUB_STATS = StatMap()
+
+
+class Scrubber:
+    """Walks owned fragments verifying + repairing integrity.
+
+    `client_factory(host)` yields an InternalClient (or a test fake
+    with fragment_blocks/block_data/execute_query); None disables the
+    cross-replica diff (single-node / embedded use). `cluster` may be
+    None too — then every fragment is treated as owned and unreplicated.
+    """
+
+    def __init__(self, holder, host: str = "", cluster=None,
+                 client_factory: Optional[Callable] = None,
+                 closing: Optional[Closing] = None, logger=None,
+                 stats=None, interval: float = 600.0,
+                 rate_limit: int = 16 << 20, enabled: bool = True,
+                 op_deadline: float = 0.0):
+        self.holder = holder
+        self.host = host
+        self.cluster = cluster
+        self.client_factory = client_factory
+        self.closing = closing or Closing()
+        self.logger = logger or get_logger("pilosa.scrub")
+        self.stats = stats
+        self.interval = float(interval)
+        self.rate_limit = int(rate_limit)
+        self.enabled = bool(enabled)
+        self.op_deadline = float(op_deadline)
+        self.last_pass_start = 0.0
+        self.last_pass_end = 0.0
+        self.last_pass_fragments = 0
+        # Pass-local pacing state.
+        self._pass_t0 = 0.0
+        self._pass_bytes = 0
+
+    # -- pacing -----------------------------------------------------------
+
+    def _pace(self, nbytes: int):
+        """Sleep just enough that cumulative bytes / elapsed stays at or
+        under rate_limit. Token accounting against the pass start beats
+        per-file sleeps: small fragments bank credit that big ones
+        spend, so the pass never bursts above the budget for long."""
+        self._pass_bytes += nbytes
+        SCRUB_STATS.inc("bytes", nbytes)
+        if self.rate_limit <= 0:
+            return
+        min_elapsed = self._pass_bytes / self.rate_limit
+        lag = min_elapsed - (time.monotonic() - self._pass_t0)
+        if lag > 0:
+            self.closing.wait(lag)
+
+    # -- the pass ---------------------------------------------------------
+
+    def scrub_pass(self) -> int:
+        """One full walk of owned fragments. Returns fragments scrubbed."""
+        if not self.enabled:
+            return 0
+        self._pass_t0 = time.monotonic()
+        self._pass_bytes = 0
+        self.last_pass_start = time.time()
+        n = 0
+        for index_name in sorted(self.holder.indexes):
+            if self.closing.closed:
+                break
+            idx = self.holder.index(index_name)
+            if idx is None:
+                continue
+            max_slices = {
+                VIEW_STANDARD: idx.max_slice(),
+                VIEW_INVERSE: idx.max_inverse_slice(),
+            }
+            for frame_name in sorted(idx.frames):
+                f = idx.frame(frame_name)
+                if f is None:
+                    continue
+                for view in list(f.views.values()):
+                    is_inv = view.name == VIEW_INVERSE or \
+                        view.name.startswith(VIEW_INVERSE + "_")
+                    limit = max_slices[VIEW_INVERSE if is_inv
+                                       else VIEW_STANDARD]
+                    for slice_, frag in sorted(view.fragments.items()):
+                        if self.closing.closed:
+                            return n
+                        if slice_ > limit:
+                            continue
+                        if self.cluster is not None and \
+                                not self.cluster.owns_fragment(
+                                    self.host, index_name, slice_):
+                            continue
+                        try:
+                            self.scrub_fragment(
+                                idx, f, view.name, slice_, frag)
+                            n += 1
+                        except Exception as e:  # noqa: BLE001 — a
+                            # scrub must never take the server down.
+                            self.logger.error(
+                                "scrub %s/%s/%s/%d failed: %s",
+                                index_name, frame_name, view.name,
+                                slice_, e)
+        self.last_pass_end = time.time()
+        self.last_pass_fragments = n
+        SCRUB_STATS.inc("passes")
+        return n
+
+    def scrub_fragment(self, idx, frame, view_name: str, slice_: int,
+                       frag):
+        """Verify one fragment: on-disk parse + footer, disk-vs-memory
+        block diff, cross-replica block diff. Repairs in place."""
+        parsed = self._verify_disk(frag)
+        if parsed is not None:
+            self._diff_memory(frag, parsed)
+        self._diff_replicas(idx, frame, slice_, frag)
+        frag.last_scrub = time.time()
+        SCRUB_STATS.inc("fragments")
+
+    def _verify_disk(self, frag) -> Optional[Bitmap]:
+        """Re-read + re-verify the fragment file. Returns the parsed
+        image on success (for the memory diff), None when the file is
+        absent, unparseable, or was repaired this call."""
+        try:
+            with open(frag.path, "rb") as fh:
+                data = fh.read()
+        except FileNotFoundError:
+            return None  # never snapshotted yet — nothing to rot
+        self._pace(len(data))
+        data = fault.corrupt("storage.corrupt", data, path=frag.path,
+                             kind="scrub")
+        try:
+            return Bitmap.from_bytes(data, truncate_torn_tail=True,
+                                     verify=True)
+        except ValueError as err:
+            SCRUB_STATS.inc("corrupt")
+            INTEGRITY_STATS.inc("scrub_detected")
+            self.logger.error("scrub: %s is rotted on disk: %s",
+                              frag.path, err)
+            self._repair_disk(frag)
+            return None
+
+    def _repair_disk(self, frag):
+        """Disk rot repair. Loaded fragment: memory is authoritative —
+        snapshot rewrites the file (with a fresh footer). Unloaded:
+        ensure_loaded re-detects the rot and read-repairs from a
+        replica; no replica leaves it pending and loud, exactly like a
+        query touch would."""
+        try:
+            if frag._pending_load:
+                frag.ensure_loaded()
+            else:
+                frag.snapshot()
+                frag.wait_snapshot(timeout=60.0)
+            SCRUB_STATS.inc("repairs")
+        except Exception as e:  # noqa: BLE001 — unrepairable (e.g. no
+            # replica) is counted, not fatal; next pass retries.
+            SCRUB_STATS.inc("unrepaired")
+            self.logger.error("scrub: repair of %s failed: %s",
+                              frag.path, e)
+
+    def _diff_memory(self, frag, parsed: Bitmap):
+        """Compare the parsed on-disk image against the live blocks()
+        checksums — the net that catches rot in a footerless file.
+        Only meaningful when the fragment is loaded and quiescent:
+        checked under the fragment lock so a concurrent write or
+        snapshot simply skips the diff instead of false-positiving."""
+        with frag._mu:
+            if frag._pending_load or frag._snapshotting:
+                return
+            if frag.op_n != parsed.op_n:
+                return  # writes raced the read; next pass re-checks
+            mem = dict(frag.blocks())
+        disk = bitmap_block_checksums(parsed)
+        if disk == mem:
+            return
+        SCRUB_STATS.inc("corrupt")
+        INTEGRITY_STATS.inc("scrub_detected")
+        self.logger.error(
+            "scrub: %s disk image diverges from memory "
+            "(%d disk / %d mem blocks) — rewriting snapshot",
+            frag.path, len(disk), len(mem))
+        self._repair_disk(frag)
+
+    def _diff_replicas(self, idx, frame, slice_: int, frag):
+        """Diff local block checksums against every replica; divergence
+        hands the fragment to FragmentSyncer's majority merge."""
+        if self.cluster is None or self.client_factory is None:
+            return
+        nodes = self.cluster.fragment_nodes(idx.name, slice_)
+        if len(nodes) < 2:
+            return
+        local = dict(frag.blocks())
+        divergent = False
+        for node in nodes:
+            if node.host == self.host or self.closing.closed:
+                continue
+            client = self.client_factory(node.host)
+            try:
+                remote = dict(client.fragment_blocks(
+                    idx.name, frame.name, frag.view, slice_))
+            except Exception:  # noqa: BLE001 — dead peer: anti-entropy
+                # territory, not the scrubber's
+                continue
+            if remote != local:
+                divergent = True
+                break
+        if not divergent:
+            return
+        SCRUB_STATS.inc("divergent")
+        self.logger.warning(
+            "scrub: %s/%s/%s/%d diverges across replicas — syncing",
+            idx.name, frame.name, frag.view, slice_)
+        syncer = FragmentSyncer(frag, self.host, nodes,
+                                self.client_factory, self.closing,
+                                self.logger, row_label=frame.row_label,
+                                column_label=idx.column_label,
+                                stats=self.stats,
+                                op_deadline=self.op_deadline)
+        syncer.sync_fragment()
+        SCRUB_STATS.inc("repairs")
+
+    # -- observability ----------------------------------------------------
+
+    def oldest_scrub_age(self) -> float:
+        """Seconds since the least-recently-scrubbed fragment was
+        scrubbed; 0.0 when nothing has been scrubbed yet (fresh boot —
+        an alert on a huge bogus age would be noise, the passes gauge
+        covers 'never ran')."""
+        oldest = None
+        for idx in self.holder.indexes.values():
+            for f in idx.frames.values():
+                for view in f.views.values():
+                    for frag in view.fragments.values():
+                        ts = getattr(frag, "last_scrub", 0.0)
+                        if ts <= 0:
+                            continue
+                        if oldest is None or ts < oldest:
+                            oldest = ts
+        if oldest is None:
+            return 0.0
+        return max(0.0, time.time() - oldest)
+
+    def snapshot(self) -> dict:
+        """/debug/vars section."""
+        out = {
+            "enabled": self.enabled,
+            "interval_s": self.interval,
+            "rate_limit_bytes_s": self.rate_limit,
+            "last_pass_start": self.last_pass_start,
+            "last_pass_end": self.last_pass_end,
+            "last_pass_fragments": self.last_pass_fragments,
+            "oldest_scrub_age_s": round(self.oldest_scrub_age(), 3),
+        }
+        out.update(SCRUB_STATS.copy())
+        return out

@@ -32,7 +32,7 @@ class View:
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
                  row_attr_store=None, stats=None, broadcaster=None,
-                 wal=None):
+                 wal=None, integrity=None):
         self.path = path
         self.index = index
         self.frame = frame
@@ -43,6 +43,7 @@ class View:
         self.stats = stats
         self.broadcaster = broadcaster
         self.wal = wal
+        self.integrity = integrity
         self.fragments: Dict[int, Fragment] = {}
         self._create_mu = threading.RLock()
 
@@ -77,6 +78,7 @@ class View:
             row_attr_store=self.row_attr_store,
             stats=self.stats.with_tags(f"slice:{slice_}") if self.stats else None,
             wal=self.wal,
+            integrity=self.integrity,
         )
         frag.open(lazy=lazy)
         # Copy-on-write: readers (max_slice, query fan-out) iterate

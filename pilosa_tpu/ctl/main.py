@@ -557,6 +557,27 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
         if quar:
             line += f"   quarantined plans {int(quar)}"
         lines.append(line)
+
+    # Integrity panel: scrubber progress + corruption/repair tallies +
+    # shadow verification. Mismatches > 0 is the wake-someone line.
+    sfrag = cur.get(("pilosa_scrub_fragments_total", ()), 0.0)
+    corrupt = cur.get(("pilosa_integrity_corrupt_total", ()), 0.0)
+    mism = sum(v for (name, _labels), v in cur.items()
+               if name == "pilosa_shadow_mismatch_total")
+    if sfrag or corrupt or mism:
+        line = f"integrity: scrubbed {int(sfrag)}"
+        age = cur.get(("pilosa_scrub_last_age_seconds", ()))
+        if age is not None:
+            line += f" (oldest {age:.0f}s ago)"
+        reps = cur.get(("pilosa_scrub_repairs_total", ()), 0.0)
+        line += f"   corrupt {int(corrupt)}   repairs {int(reps)}"
+        checks = sum(v for (name, _labels), v in cur.items()
+                     if name == "pilosa_shadow_checks_total")
+        if checks or mism:
+            line += f"   shadow {int(checks)} checks"
+            if mism:
+                line += f" / {int(mism)} MISMATCH"
+        lines.append(line)
     return "\n".join(lines) + "\n"
 
 

@@ -40,8 +40,21 @@ class FragmentNotFoundError(PilosaError):
 class SliceUnavailableError(PilosaError):
     """No node available for a slice (reference errSliceUnavailable)."""
 
-    def __init__(self):
-        super().__init__("slice unavailable")
+    def __init__(self, msg: str = "slice unavailable"):
+        super().__init__(msg)
+
+
+class CorruptFragmentError(SliceUnavailableError):
+    """A fragment's snapshot failed integrity verification and
+    read-repair could not source a verified replacement from any
+    replica. Subclasses SliceUnavailableError on purpose: the
+    executor's re-split machinery then routes the slice to a healthy
+    replica, and `partial=true` degrades to missing_slices when none
+    exists — a corrupt fragment must never 500 a query that another
+    copy can answer, and must never serve garbage."""
+
+    def __init__(self, msg: str = "fragment corrupt"):
+        super().__init__(msg)
 
 
 class QueryError(PilosaError):

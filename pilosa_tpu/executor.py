@@ -60,6 +60,14 @@ TIME_FORMAT = "%Y-%m-%dT%H:%M"
 
 _WRITE_CALLS = ("ClearBit", "SetBit", "SetRowAttrs", "SetColumnAttrs")
 
+# Shadow-verification counters, keyed "checks:<backend>" /
+# "mismatch:<backend>" — exported as pilosa_shadow_checks_total /
+# pilosa_shadow_mismatch_total{backend} Prometheus families. A
+# mismatch means the device returned a DIFFERENT answer than the host
+# roaring fold for the same tree: miscompiled plan, bad staging, or
+# silent device fault — the one failure class checksums can't see.
+SHADOW_STATS = obs.StatMap()
+
 
 class ExecOptions:
     """Per-Execute context (executor.go:1253-1256).
@@ -205,6 +213,14 @@ class Executor:
         # engine — the backend-labeled latency histogram at /metrics.
         self.route_stats = obs.StatMap()
         self._route_hists: dict = {}
+        # [integrity] shadow-sample-1-in: every Nth device Count/TopN
+        # result is recomputed through the host roaring fold and
+        # compared (0 = off). itertools.count() next() is atomic under
+        # the GIL, so the sampler needs no lock.
+        import itertools
+
+        self.shadow_sample = 0
+        self._shadow_counter = itertools.count()
 
     def set_spmd(self, spmd):
         """Wire the SPMD descriptor plane (rank 0 of a multi-host
@@ -917,6 +933,45 @@ class Executor:
             top = max(top, idx.max_slice())
         return top + 1
 
+    def _shadow_sampled(self) -> bool:
+        """True on every Nth call when [integrity] shadow-sample-1-in
+        is set (N > 0)."""
+        n = self.shadow_sample
+        return n > 0 and next(self._shadow_counter) % n == 0
+
+    def _shadow_check_count(self, index: str, shape, leaves, batch_slices,
+                            device_n: int, backend: str) -> int:
+        """Recompute a sampled device Count through the host roaring
+        fold and compare. On mismatch: count it, log the divergence,
+        quarantine the plan signature (identical queries host-fold
+        until the TTL expires — a miscompiled plan must not keep
+        serving wrong answers), and return the HOST value, which is
+        what the caller serves. The host fold is ground truth: it reads
+        the same roaring containers the checksums protect."""
+        from .parallel.plan import HostCountPlan, _tree_signature
+
+        SHADOW_STATS.inc(f"checks:{backend}")
+        host_n = HostCountPlan(self.holder, index, shape, leaves,
+                               cache=self._host_cache
+                               ).count_slices(batch_slices)
+        if host_n is None or int(host_n) == int(device_n):
+            return device_n
+        import json as _json
+
+        sig = _json.dumps(_tree_signature(shape))
+        SHADOW_STATS.inc(f"mismatch:{backend}")
+        cur = obs.current_span()
+        trace = getattr(getattr(cur, "trace", None), "trace_id", "-")
+        obs.get_logger("executor").error(
+            "shadow verification MISMATCH (%s): device=%d host=%d "
+            "index=%s slices=%d trace=%s — quarantining plan sig",
+            backend, int(device_n), int(host_n), index,
+            len(batch_slices), trace)
+        mgr = self._mesh_mgr
+        if mgr is not None:
+            mgr.quarantine_plan(sig)
+        return int(host_n)
+
     def _mesh_count_batch(self, index: str, lowered):
         """A batch_fn serving a whole slice set as one mesh collective,
         or None when the tree/backend doesn't qualify. `lowered` is the
@@ -933,20 +988,28 @@ class Executor:
             # descriptor stream so every rank enters it together.
             def batch_fn(batch_slices):
                 try:
-                    return self._spmd.count(
+                    n = self._spmd.count(
                         index, shape, leaves, batch_slices,
                         self._batch_num_slices(index, batch_slices))
                 except Exception:  # noqa: BLE001 — device failure → host
                     return None
+                if n is not None and self._shadow_sampled():
+                    n = self._shadow_check_count(
+                        index, shape, leaves, batch_slices, n, "spmd")
+                return n
 
             return batch_fn
 
         def batch_fn(batch_slices):
             try:
-                return mgr.count(index, shape, leaves, batch_slices,
-                                 self._batch_num_slices(index, batch_slices))
+                n = mgr.count(index, shape, leaves, batch_slices,
+                              self._batch_num_slices(index, batch_slices))
             except Exception:  # noqa: BLE001 — any device failure → host path
                 return None
+            if n is not None and self._shadow_sampled():
+                n = self._shadow_check_count(
+                    index, shape, leaves, batch_slices, n, "mesh")
+            return n
 
         return batch_fn
 
@@ -1207,10 +1270,23 @@ class Executor:
         row_ids, _ = c.uint_slice_arg("ids")
         min_threshold, _ = c.uint_arg("threshold")
 
+        # Shadow verification applies only to the exact-ids form: its
+        # host recount (f.top over storage) is ground truth, where the
+        # ranked form's host pass is cache-approximate and would
+        # false-positive against exact device counts.
+        shadow_ok = bool(row_ids) and src is None and \
+            attr_predicate is None and not tanimoto
+
+        def shadow(batch_slices, pairs, backend):
+            if pairs is None or not shadow_ok or not self._shadow_sampled():
+                return pairs
+            return self._shadow_check_top_n(index, c, batch_slices,
+                                            pairs, backend)
+
         if self._spmd is not None:
             def batch_fn(batch_slices):
                 try:
-                    return self._spmd.top_n(
+                    pairs = self._spmd.top_n(
                         index, frame, VIEW_STANDARD, batch_slices,
                         self._batch_num_slices(index, batch_slices),
                         0 if row_ids else n, row_ids,
@@ -1219,12 +1295,13 @@ class Executor:
                         tanimoto_threshold=tanimoto)
                 except Exception:  # noqa: BLE001 — device failure → host
                     return None
+                return shadow(batch_slices, pairs, "spmd")
 
             return batch_fn
 
         def batch_fn(batch_slices):
             try:
-                return mgr.top_n(
+                pairs = mgr.top_n(
                     index, frame, VIEW_STANDARD, batch_slices,
                     self._batch_num_slices(index, batch_slices),
                     0 if row_ids else n, row_ids,
@@ -1233,8 +1310,33 @@ class Executor:
                     tanimoto_threshold=tanimoto)
             except Exception:  # noqa: BLE001 — any device failure → host path
                 return None
+            return shadow(batch_slices, pairs, "mesh")
 
         return batch_fn
+
+    def _shadow_check_top_n(self, index: str, c: Call, batch_slices,
+                            pairs, backend: str):
+        """Recompute a sampled exact-ids TopN through the host storage
+        recount and compare. On mismatch the batch_fn returns None, so
+        the map/reduce host path serves the query — TopN device
+        programs are keyed per fragment pool rather than per query
+        tree, so there is no plan signature to quarantine; the mismatch
+        counter and log line are the alarm."""
+        SHADOW_STATS.inc(f"checks:{backend}")
+        host: List[tuple] = []
+        for s in batch_slices:
+            host = add_to_pairs(host, self.execute_top_n_slice(index, c, s))
+        if dict(host) == dict(pairs):
+            return pairs
+        SHADOW_STATS.inc(f"mismatch:{backend}")
+        cur = obs.current_span()
+        trace = getattr(getattr(cur, "trace", None), "trace_id", "-")
+        obs.get_logger("executor").error(
+            "shadow verification MISMATCH (%s TopN): device=%s host=%s "
+            "index=%s slices=%d trace=%s — serving host recount",
+            backend, dict(pairs), dict(host), index, len(batch_slices),
+            trace)
+        return None
 
     def execute_top_n_slice(self, index: str, c: Call, slice_: int) -> List[tuple]:
         """One slice of TopN (executor.go:333-396)."""

@@ -27,6 +27,20 @@ from ONE seeded RNG, so a fixed PILOSA_TPU_FAULT_SEED makes the whole
 chaos schedule deterministic), plus any `key=value` context match
 (e.g. `host=`) compared against the kwargs the injection point passes.
 
+Two knobs arm DATA faults rather than raise/sleep faults — their rules
+never fire at plain `point()` seams:
+
+    bits=N / offset=K / xor=M   bit rot: `fault.corrupt(name, data)`
+                      seams return `data` with N bits flipped at
+                      seeded-random positions (or the single byte at
+                      offset K XORed with M, default 0x01; K counts
+                      from the end when negative). Deterministic under
+                      PILOSA_TPU_FAULT_SEED.
+    delta=N           result perturbation: `fault.perturb(name, value)`
+                      seams return value+N — a device fold that
+                      silently miscomputes, for shadow-verification
+                      tests.
+
 Injection points currently wired:
 
     client.do         every InternalClient HTTP attempt (host, method,
@@ -60,7 +74,14 @@ Injection points currently wired:
     device.exec       before each device program launch (sig, kind) —
                       an armed ResourceExhausted here exercises the
                       full recovery ladder: evict + retry, host-fold
-                      fallback, and plan-signature quarantine
+                      fallback, and plan-signature quarantine; a
+                      `delta=` rule perturbs the returned count at the
+                      result seam (kind="count-result"), driving the
+                      shadow-verification catch path
+    storage.corrupt   fragment file reads (path, kind="snapshot" for
+                      the main file, kind="side-wal" for the snapshot
+                      side log) — a `bits=`/`offset=` rule flips bits
+                      in the bytes read, simulating at-rest bit rot
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.
@@ -107,11 +128,14 @@ class Rule:
     lock; reads of the immutable spec fields are free."""
 
     __slots__ = ("point", "error", "delay", "times", "after", "prob",
-                 "match", "fired", "seen")
+                 "match", "fired", "seen", "bits", "offset", "xor",
+                 "delta")
 
     def __init__(self, point: str, error=None, delay: float = 0.0,
                  times: Optional[int] = None, after: int = 0,
-                 prob: float = 1.0, match: Optional[Dict[str, Any]] = None):
+                 prob: float = 1.0, match: Optional[Dict[str, Any]] = None,
+                 bits: int = 0, offset: Optional[int] = None,
+                 xor: int = 0x01, delta: Optional[int] = None):
         self.point = point
         self.error = error
         self.delay = float(delay)
@@ -119,8 +143,17 @@ class Rule:
         self.after = int(after)
         self.prob = float(prob)
         self.match = dict(match or {})
+        self.bits = int(bits)          # corrupt(): random bit flips
+        self.offset = offset           # corrupt(): fixed byte offset
+        self.xor = int(xor)            # corrupt(): mask for offset mode
+        self.delta = delta             # perturb(): value shift
         self.fired = 0  # times this rule actually fired
         self.seen = 0   # times this rule matched (incl. after/prob skips)
+
+    def _is_data_rule(self) -> bool:
+        """Corrupt/perturb rules act only at their own seams — a plain
+        point() must not raise, sleep, or burn their times= budget."""
+        return self.bits > 0 or self.offset is not None or self.delta is not None
 
     def _matches(self, ctx: Dict[str, Any]) -> bool:
         return all(str(ctx.get(k)) == str(v) for k, v in self.match.items())
@@ -148,11 +181,14 @@ class Injector:
 
     def arm(self, point: str, *, error=None, delay: float = 0.0,
             times: Optional[int] = None, after: int = 0, prob: float = 1.0,
-            match: Optional[Dict[str, Any]] = None, **ctx_match) -> Rule:
+            match: Optional[Dict[str, Any]] = None, bits: int = 0,
+            offset: Optional[int] = None, xor: int = 0x01,
+            delta: Optional[int] = None, **ctx_match) -> Rule:
         m = dict(match or {})
         m.update(ctx_match)
         rule = Rule(point, error=error, delay=delay, times=times,
-                    after=after, prob=prob, match=m)
+                    after=after, prob=prob, match=m, bits=bits,
+                    offset=offset, xor=xor, delta=delta)
         with self._mu:
             self._rules.append(rule)
         _set_active(True)
@@ -188,7 +224,8 @@ class Injector:
         delay = 0.0
         with self._mu:
             for rule in self._rules:
-                if rule.point != point or not rule._matches(ctx):
+                if rule.point != point or rule._is_data_rule() \
+                        or not rule._matches(ctx):
                     continue
                 rule.seen += 1
                 if rule.seen <= rule.after:
@@ -208,6 +245,59 @@ class Injector:
             time.sleep(delay)
         if to_raise is not None:
             raise to_raise
+
+    def mutate(self, point: str, data: bytes, ctx: Dict[str, Any]) -> bytes:
+        """Apply every armed bit-rot rule for `point` to `data`.
+        Flip positions come from the ONE seeded RNG, so a fixed
+        PILOSA_TPU_FAULT_SEED makes the rot schedule deterministic."""
+        buf = None
+        with self._mu:
+            for rule in self._rules:
+                if rule.point != point or not rule._matches(ctx):
+                    continue
+                if rule.bits <= 0 and rule.offset is None:
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self._rand.random() >= rule.prob:
+                    continue
+                if not data:
+                    continue
+                rule.fired += 1
+                self._log.append((point, dict(ctx)))
+                STATS.inc(f"fault.{point}")
+                if buf is None:
+                    buf = bytearray(data)
+                if rule.offset is not None:
+                    buf[rule.offset % len(buf)] ^= (rule.xor & 0xFF) or 0x01
+                for _ in range(rule.bits):
+                    pos = self._rand.randrange(len(buf) * 8)
+                    buf[pos >> 3] ^= 1 << (pos & 7)
+        return data if buf is None else bytes(buf)
+
+    def shift(self, point: str, value, ctx: Dict[str, Any]):
+        """Apply every armed delta= rule for `point` to a numeric
+        result — a device fold that silently returns the wrong answer."""
+        with self._mu:
+            for rule in self._rules:
+                if rule.point != point or rule.delta is None \
+                        or not rule._matches(ctx):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self._rand.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                self._log.append((point, dict(ctx)))
+                STATS.inc(f"fault.{point}")
+                value = value + rule.delta
+        return value
 
 
 # Module-global active flag: point() must be near-free when nothing is
@@ -253,6 +343,32 @@ def point(name: str, **ctx) -> None:
         else:
             return
     _INJECTOR.fire(name, ctx)
+
+
+def corrupt(name: str, data: bytes, **ctx) -> bytes:
+    """Bit-rot seam: returns `data` with armed bits=/offset= rules
+    applied (identity when nothing is armed)."""
+    if not _ACTIVE:
+        if not _ENV_LOADED:
+            _load_env_once()
+            if not _ACTIVE:
+                return data
+        else:
+            return data
+    return _INJECTOR.mutate(name, data, ctx)
+
+
+def perturb(name: str, value, **ctx):
+    """Result-perturbation seam: returns `value` shifted by armed
+    delta= rules (identity when nothing is armed)."""
+    if not _ACTIVE:
+        if not _ENV_LOADED:
+            _load_env_once()
+            if not _ACTIVE:
+                return value
+        else:
+            return value
+    return _INJECTOR.shift(name, value, ctx)
 
 
 def active() -> bool:
@@ -310,6 +426,8 @@ def load_spec(spec: str) -> List[Rule]:
                 kw["after"] = int(v)
             elif k == "prob":
                 kw["prob"] = float(v)
+            elif k in ("bits", "offset", "xor", "delta"):
+                kw[k] = int(v, 0)
             else:
                 kw["match"][k] = v
         rules.append(_INJECTOR.arm(pt.strip(), **kw))

@@ -1825,6 +1825,18 @@ class MeshManager:
     def plan_quarantined(self, sig: str) -> bool:
         return self._fused_plans.is_quarantined(sig)
 
+    def quarantine_plan(self, sig: str) -> None:
+        """Quarantine a signature IMMEDIATELY, bypassing the strike
+        ladder. For failures where a retry cannot help and serving the
+        device answer again would be wrong — shadow verification caught
+        the plan returning a different count than the host fold."""
+        if not sig:
+            return
+        with self._quar_mu:
+            self._plan_failures.pop(sig, None)
+        self._fused_plans.quarantine(sig, self._quarantine_ttl)
+        self.stats.inc("plan_quarantined")
+
     def quarantined_plans(self) -> List[str]:
         return self._fused_plans.quarantined_sigs()
 
@@ -2296,7 +2308,8 @@ class MeshManager:
                     self.stats.inc("query_us",
                                    int((time.monotonic() - t0) * 1e6))
                     sp.tag(mode="fused", dispatches=1)
-                    return out[0]
+                    return fault.perturb("device.exec", out[0], sig=sig,
+                                         kind="count-result")
             prepared = self._count_args(index, shape, leaves, slices,
                                         num_slices, pins=pins)
             if prepared is None:
@@ -2333,7 +2346,11 @@ class MeshManager:
             self.stats.inc("count")
             self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
             sp.tag(mode="batched")
-            return req.result
+            # Bit-rot seam for shadow verification: a delta rule on
+            # device.exec (kind=count-result) perturbs the returned
+            # count, modeling a silent device miscomputation.
+            return fault.perturb("device.exec", req.result, sig=sig,
+                                 kind="count-result")
         finally:
             self._release_pins(pins)
             sp.finish()

@@ -271,7 +271,7 @@ class Bitmap:
     """
 
     __slots__ = ("keys", "containers", "op_writer", "op_n",
-                 "torn_tail_bytes")
+                 "torn_tail_bytes", "verified_footer")
 
     def __init__(self, values: Optional[Iterable[int]] = None):
         self.keys: list[int] = []
@@ -282,6 +282,10 @@ class Bitmap:
         # load (from_bytes(truncate_torn_tail=True)); the owner must
         # truncate the backing file by this much before appending.
         self.torn_tail_bytes = 0
+        # True when from_bytes(verify=True) checked an integrity
+        # footer against the snapshot region; False for footerless
+        # (pre-footer era) data or unverified loads.
+        self.verified_footer = False
         if values is not None:
             arr = np.asarray(list(values) if not isinstance(values, np.ndarray) else values, dtype=_U64)
             if arr.size:
@@ -615,6 +619,8 @@ class Bitmap:
         b.containers = []
         b.op_writer = None
         b.op_n = 0
+        b.torn_tail_bytes = 0
+        b.verified_footer = False
         for key in np.flatnonzero(counts):
             blk = blocks[key] if own else blocks[key].copy()
             c = Container.__new__(Container)
@@ -678,19 +684,21 @@ class Bitmap:
 
     # -- serialization (see serialize.py) ----------------------------------
 
-    def write_to(self, w) -> int:
+    def write_to(self, w, footer: bool = False) -> int:
         from .serialize import write_bitmap
 
-        return write_bitmap(self, w)
+        return write_bitmap(self, w, footer=footer)
 
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, footer: bool = False) -> bytes:
         buf = io.BytesIO()
-        self.write_to(buf)
+        self.write_to(buf, footer=footer)
         return buf.getvalue()
 
     @classmethod
     def from_bytes(cls, data: bytes,
-                   truncate_torn_tail: bool = False) -> "Bitmap":
+                   truncate_torn_tail: bool = False,
+                   verify: bool = False) -> "Bitmap":
         from .serialize import read_bitmap
 
-        return read_bitmap(data, truncate_torn_tail=truncate_torn_tail)
+        return read_bitmap(data, truncate_torn_tail=truncate_torn_tail,
+                           verify=verify)

@@ -6,15 +6,34 @@ Layout (reference /root/reference/roaring/roaring.go:475-614):
     containerCount x { u64 key | u32 n-1 }            # 12-byte headers
     containerCount x { u32 absolute offset }
     container blocks: array -> n x u32 LE; bitmap -> 1024 x u64 LE
+    [integrity footer]                                # optional, see below
     op log: repeated { u8 type | u64 value | u32 fnv32a(first 9 bytes) }
 
 All little-endian. Containers with n <= 4096 are stored in array form,
 larger in bitmap form (the reader infers form from n).
+
+Integrity footer (`write_bitmap(footer=True)`): written between the
+snapshot region and the op log, so a crashed writer can never tear it
+(it rides the snapshot temp through the atomic rename) while ops keep
+appending after it:
+
+    u8 0xF7 | u32 payload_len
+    payload: u32 crc32(snapshot region) | u32 containerCount
+             containerCount x u32 fnv32a(container block bytes)
+    u32 fnv32a(type byte .. payload)
+
+The leading type byte can never collide with an op record (op types
+are 0/1), so a reader positioned at the end of the container blocks
+distinguishes footer from op log by one byte. The whole-region CRC
+detects any flipped bit in the snapshot image; the per-container
+FNV-1a list localizes WHICH container rotted (scrub diagnostics); the
+trailing self-checksum detects rot inside the footer itself.
 """
 
 from __future__ import annotations
 
 import struct
+import zlib
 
 import numpy as np
 
@@ -23,6 +42,24 @@ from .bitmap import ARRAY_MAX_SIZE, BITMAP_N, Bitmap, Container
 COOKIE = 12346
 HEADER_SIZE = 8
 OP_SIZE = 13
+
+# Integrity footer record type: outside the op-type space (0=set,
+# 1=clear) so the first byte after the container blocks is unambiguous.
+FOOTER_TYPE = 0xF7
+# type byte + payload length; the self-checksum trails the payload.
+_FOOTER_PREFIX = 5
+# Smallest possible footer: empty bitmap (crc + count, no fnvs) + fnv.
+_FOOTER_MIN = _FOOTER_PREFIX + 8 + 4
+
+
+class CorruptSnapshotError(ValueError):
+    """The snapshot region (or its integrity footer) failed
+    verification: bit rot, not a crash-torn tail. Carries the keys of
+    the containers whose FNV-1a mismatched, when localizable."""
+
+    def __init__(self, msg: str, bad_keys=()):
+        super().__init__(msg)
+        self.bad_keys = list(bad_keys)
 
 
 def fnv32a(data: bytes) -> int:
@@ -95,8 +132,12 @@ def _container_bytes(c: Container) -> bytes:
     return c.bitmap.astype("<u8").tobytes()
 
 
-def write_bitmap(b: Bitmap, w) -> int:
-    """Serialize the snapshot region (no ops). Returns bytes written."""
+def write_bitmap(b: Bitmap, w, footer: bool = False) -> int:
+    """Serialize the snapshot region (no ops). Returns bytes written.
+
+    With `footer=True`, an integrity footer (module docstring) follows
+    the container blocks; `read_bitmap` skips it transparently and
+    verifies it on demand (`verify=True`)."""
     entries = [
         (key, c) for key, c in zip(b.keys, b.containers) if c.n > 0
     ]
@@ -114,10 +155,58 @@ def write_bitmap(b: Bitmap, w) -> int:
     for chunk in (header, keyhdrs, bytes(offsets), *blocks):
         w.write(chunk)
         n_written += len(chunk)
+    if footer:
+        crc = zlib.crc32(header)
+        crc = zlib.crc32(keyhdrs, crc)
+        crc = zlib.crc32(bytes(offsets), crc)
+        for blk in blocks:
+            crc = zlib.crc32(blk, crc)
+        n_written += write_footer(w, crc, [fnv32a(blk) for blk in blocks])
     return n_written
 
 
-def read_bitmap(data: bytes, truncate_torn_tail: bool = False) -> Bitmap:
+def write_footer(w, region_crc: int, container_fnvs) -> int:
+    """Append an integrity footer record. Returns bytes written."""
+    payload = struct.pack("<II", region_crc & 0xFFFFFFFF,
+                          len(container_fnvs))
+    payload += b"".join(struct.pack("<I", f) for f in container_fnvs)
+    rec = struct.pack("<BI", FOOTER_TYPE, len(payload)) + payload
+    rec += struct.pack("<I", fnv32a(rec))
+    w.write(rec)
+    return len(rec)
+
+
+def _parse_footer(data: bytes, off: int):
+    """Parse the footer record starting at `off` (data[off] is known to
+    be FOOTER_TYPE). Returns (region_crc, [container fnvs], record_len).
+    Raises CorruptSnapshotError when the record is truncated or fails
+    its own checksum — footers are written atomically with the snapshot
+    temp, so a damaged one is rot, never a torn append."""
+    n = len(data)
+    if off + _FOOTER_MIN > n:
+        raise CorruptSnapshotError("integrity footer truncated")
+    (plen,) = struct.unpack_from("<I", data, off + 1)
+    rec_len = _FOOTER_PREFIX + plen + 4
+    if plen < 8 or off + rec_len > n:
+        raise CorruptSnapshotError(
+            f"integrity footer out of bounds: payload={plen}")
+    body = data[off:off + _FOOTER_PREFIX + plen]
+    (chk,) = struct.unpack_from("<I", data, off + _FOOTER_PREFIX + plen)
+    if chk != fnv32a(body):
+        raise CorruptSnapshotError("integrity footer checksum mismatch")
+    crc, count = struct.unpack_from("<II", data, off + _FOOTER_PREFIX)
+    if plen != 8 + count * 4:
+        raise CorruptSnapshotError(
+            f"integrity footer length mismatch: {count} containers, "
+            f"payload={plen}")
+    fnvs = [struct.unpack_from("<I", data,
+                               off + _FOOTER_PREFIX + 8 + i * 4)[0]
+            for i in range(count)]
+    return crc, fnvs, rec_len
+
+
+def read_bitmap(data: bytes, truncate_torn_tail: bool = False,
+                verify: bool = False) -> Bitmap:
     """Parse snapshot + replay trailing op log (reference roaring.go:536-614).
 
     With `truncate_torn_tail=True`, a damaged FINAL op (partial record
@@ -126,6 +215,14 @@ def read_bitmap(data: bytes, truncate_torn_tail: bool = False) -> Bitmap:
     bitmap carries `torn_tail_bytes` so the caller can truncate the
     backing file before reopening it for append. Mid-log corruption
     still raises either way.
+
+    With `verify=True`, an integrity footer — when present — is checked
+    against the snapshot region: whole-region CRC first (catches any
+    flipped bit, zlib C speed), then per-container FNV-1a to name the
+    rotted containers in the CorruptSnapshotError. A file with no
+    footer (pre-footer era, raw to_bytes transfers) passes unverified;
+    the result carries `verified_footer` either way so callers that
+    REQUIRE a footer can tell the difference.
     """
     if len(data) < HEADER_SIZE:
         raise ValueError("data too small")
@@ -150,6 +247,7 @@ def read_bitmap(data: bytes, truncate_torn_tail: bool = False) -> Bitmap:
         ns.append(n_minus_1 + 1)
 
     end = ops_offset + key_n * 4
+    spans = []  # (offset, size) per container, for footer verification
     for i in range(key_n):
         (offset,) = struct.unpack_from("<I", data, ops_offset + i * 4)
         n = ns[i]
@@ -164,7 +262,26 @@ def read_bitmap(data: bytes, truncate_torn_tail: bool = False) -> Bitmap:
         else:
             words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=offset)
             b.containers.append(Container(bitmap=words.astype(np.uint64)))
+        spans.append((offset, size))
         end = offset + size
+
+    b.verified_footer = False
+    if end < len(data) and data[end] == FOOTER_TYPE:
+        crc, fnvs, rec_len = _parse_footer(data, end)
+        if verify:
+            if len(fnvs) != key_n:
+                raise CorruptSnapshotError(
+                    f"integrity footer container count mismatch: "
+                    f"footer={len(fnvs)}, file={key_n}")
+            if zlib.crc32(data[:end]) != crc:
+                bad = [b.keys[i] for i, (off, size) in enumerate(spans)
+                       if fnv32a(data[off:off + size]) != fnvs[i]]
+                raise CorruptSnapshotError(
+                    f"snapshot region CRC mismatch "
+                    f"({len(bad)} rotted containers localized)",
+                    bad_keys=bad)
+            b.verified_footer = True
+        end += rec_len
 
     if truncate_torn_tail:
         ops, _, torn = scan_ops(data[end:])

@@ -25,7 +25,13 @@ from typing import Dict, Optional
 from .api import APIServer, Handler, InternalClient
 from .api.client import BreakerRegistry
 from .config import Config
+from .core.fragment import (
+    IntegrityContext,
+    bitmap_block_checksums,
+    bitmap_from_tar,
+)
 from .core.holder import Holder
+from .core.scrub import Scrubber
 from .core.syncer import Closing, HolderSyncer
 from .core.view import VIEW_INVERSE, VIEW_STANDARD
 from .executor import Executor
@@ -98,9 +104,15 @@ class Server:
         self.tracer = Tracer(
             ring=self.config.trace_ring,
             slow_us=self.config.slow_query_threshold * 1e6)
+        # Shared IntegrityContext: created empty here (fragments keep a
+        # reference), repair_source wired below once the cluster client
+        # exists — a corrupt fragment then read-repairs from a replica
+        # at load time.
+        self.integrity = IntegrityContext()
         self.holder = Holder(self.config.expanded_data_dir(),
                              stats=self.stats,
-                             wal=self.config.wal_config())
+                             wal=self.config.wal_config(),
+                             integrity=self.integrity)
         self.cluster = Cluster(
             nodes=[Node(h) for h in self.config.cluster_hosts],
             replica_n=self.config.replica_n,
@@ -248,6 +260,20 @@ class Server:
         # /cluster/resize call coordinates; control messages (join/
         # leave/cutover/complete) fan out to peers over the same
         # endpoint with ?remote=true.
+        # Data-integrity wiring ([integrity]): read-repair source,
+        # device-result shadow sampling, background scrubber.
+        self.integrity.repair_source = self._repair_source
+        self.executor.shadow_sample = self.config.integrity_shadow_sample
+        self.scrubber = Scrubber(
+            self.holder, host=self.host, cluster=self.cluster,
+            client_factory=self.client.for_host, closing=self.closing,
+            logger=self.logger, stats=self.stats,
+            interval=self.config.integrity_scrub_interval,
+            rate_limit=self.config.integrity_rate_limit,
+            enabled=self.config.integrity_enabled,
+            op_deadline=self.config.sync_block_deadline)
+        self.handler.scrubber = self.scrubber
+
         self.rebalancer = Rebalancer(
             self.holder, self.cluster, self.host, self.client.for_host,
             closing=self.closing, logger=self.logger, stats=self.stats,
@@ -281,6 +307,7 @@ class Server:
                 node.host = self.host
             self.executor.host = self.host
             self.handler.host = self.host
+            self.scrubber.host = self.host
             if hasattr(self.node_set, "local_host"):
                 self.node_set.local_host = self.host
         self._api.start()
@@ -294,6 +321,9 @@ class Server:
              self.config.polling_interval, 0.0),
             ("cache-flush", self._cache_flush_tick, CACHE_FLUSH_INTERVAL,
              0.0),
+            ("scrub", self._scrub_tick,
+             self.config.integrity_scrub_interval,
+             0.1 * self.config.integrity_scrub_interval),
         ]:
             t = threading.Thread(target=self._loop, name=name,
                                  args=(fn, interval, jitter), daemon=True)
@@ -407,6 +437,44 @@ class Server:
 
     def _cache_flush_tick(self):
         self.holder.flush_caches()
+
+    def _scrub_tick(self):
+        if self.config.integrity_enabled:
+            self.scrubber.scrub_pass()
+
+    def _repair_source(self, frag) -> Optional[bytes]:
+        """Read-repair source (IntegrityContext.repair_source): stream
+        the fragment tar from the first live replica whose payload
+        VERIFIES — the tar's own integrity footer must parse, and its
+        per-block checksums must match what the replica separately
+        reports via /fragment/blocks (a rotted replica must never
+        become the repair donor)."""
+        for node in self.cluster.fragment_nodes(frag.index, frag.slice):
+            if node.host == self.host or node.state != NODE_STATE_UP:
+                continue
+            client = self.client.for_host(node.host)
+            try:
+                tar = client.fragment_data(frag.index, frag.frame,
+                                           frag.view, frag.slice)
+                if not tar:
+                    continue
+                bm = bitmap_from_tar(tar)
+                if bm is None:
+                    continue
+                want = dict(client.fragment_blocks(
+                    frag.index, frag.frame, frag.view, frag.slice))
+                if bitmap_block_checksums(bm) != want:
+                    self.logger.warning(
+                        "read-repair: replica %s serves inconsistent "
+                        "checksums for %s/%s/%s/%d — skipping",
+                        node.host, frag.index, frag.frame, frag.view,
+                        frag.slice)
+                    continue
+                return tar
+            except Exception as e:  # noqa: BLE001 — next replica
+                self.logger.warning(
+                    "read-repair fetch from %s failed: %s", node.host, e)
+        return None
 
     def _broadcast_resize(self, action: str, **fields):
         """Ship a resize control message (join/leave/cutover/complete)
