@@ -1211,6 +1211,53 @@ def main():
         assert scrape_best < 0.010, \
             f"/metrics scrape {scrape_best * 1e3:.1f} ms exceeds 10 ms"
 
+    with section("slo_overhead"):
+        # SLO-accounting guard: the handler wrapper's per-query cost —
+        # one SLORecorder.record() (tenant-label lookup + one lock hold
+        # + three ring-bucket increments + latency bucketing), exactly
+        # what _post_query adds to every coordinator query — must stay
+        # under 1% of the lone-query fast path. Alternating best-of-7
+        # rounds so machine drift hits both sides.
+        _progress("slo outcome-accounting overhead")
+        from pilosa_tpu.obs import slo as _slo
+
+        _rec = _slo.SLORecorder(tenants=["gold", "silver"],
+                                mismatch_source=lambda: 0.0)
+
+        def slo_dt(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                MUTATION_EPOCH.bump_structural()
+                _cold_rows()
+                q_t0 = time.monotonic()
+                e.execute("i", q1)
+                dt_us = (time.monotonic() - q_t0) * 1e6
+                _rec.record("ok", tenant="gold", latency_us=dt_us)
+            return (time.perf_counter() - t0) / n
+
+        base_best = slo_best = float("inf")
+        for _ in range(7):
+            base_best = min(base_best, fresh_dt(n_lone))
+            slo_best = min(slo_best, slo_dt(n_lone))
+        overhead = slo_best / base_best - 1.0
+
+        # The read path stays cheap too: a full status() (three window
+        # aggregations + burn-rate math) under 5 ms — /debug/slo and
+        # the /metrics collector both render from it per scrape.
+        t0 = time.perf_counter()
+        st = _rec.status()
+        status_ms = (time.perf_counter() - t0) * 1e3
+        assert st["verdict"] in ("OK", "VIOLATED")
+        details["slo_overhead"] = {
+            "plain_ms": base_best * 1e3,
+            "slo_ms": slo_best * 1e3,
+            "overhead_frac": overhead,
+            "status_ms": status_ms}
+        assert overhead < 0.01, \
+            f"slo accounting overhead {overhead:.1%} exceeds the 1% guard"
+        assert status_ms < 5.0, \
+            f"slo status() {status_ms:.2f} ms exceeds 5 ms"
+
     with section("profile_overhead"):
         # Measured-profiling guard, two halves. (1) Profiling OFF: the
         # per-query cost of the handler's sampling decision plus the

@@ -466,6 +466,14 @@ class Handler:
         # families and the /debug/vars integrity section. None =
         # embedded/test handlers without one.
         self.scrubber = None
+        # SLO observatory (obs.slo.SLORecorder; [slo] config). Every
+        # coordinator query outcome — success, partial, shed 429,
+        # deadline 504, backpressure 503, other errors — is recorded
+        # here exactly once by _post_query, feeding the rolling SLI
+        # windows, pilosa_slo_* families, and GET /debug/slo. The
+        # server replaces this default with a config-driven recorder;
+        # set to None to disable accounting entirely.
+        self.slo = obs.slo.SLORecorder()
         self._prom = obs.prom.Registry()
         self._register_collectors()
         self._routes: List[Route] = []
@@ -506,6 +514,7 @@ class Handler:
         r("GET", r"/version", self._get_version)
         r("GET", r"/metrics", self._get_metrics)
         r("GET", r"/debug/vars", self._get_expvar)
+        r("GET", r"/debug/slo", self._get_debug_slo)
         r("GET", r"/debug/queries", self._get_debug_queries)
         r("GET", r"/debug/traces/(?P<tid>[^/]+)", self._get_debug_trace)
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
@@ -603,9 +612,23 @@ class Handler:
         reg.register_collector(self._collect_fragments)
         reg.register_collector(self._collect_storage)
         reg.register_collector(self._collect_integrity)
+        reg.register_collector(self._collect_slo)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
+
+    def _collect_slo(self) -> list:
+        if self.slo is None:
+            return []
+        return self.slo.families()
+
+    def _get_debug_slo(self, pv, params, headers, body):
+        """SLO observatory snapshot: per-window SLIs, burn rates, and
+        error budgets — the same numbers the pilosa_slo_* families
+        export, as one JSON document."""
+        if self.slo is None:
+            return _json_resp({"error": "slo accounting disabled"}, 404)
+        return _json_resp(self.slo.status())
 
     def _collect_runtime(self) -> list:
         prom = obs.prom
@@ -1585,6 +1608,46 @@ class Handler:
     # -- query ---------------------------------------------------------------
 
     def _post_query(self, pv, params, headers, body) -> Response:
+        """Outcome-accounting wrapper around the real query path
+        (_post_query_inner). Every coordinator-side query outcome —
+        success, partial, shed 429, deadline 504, backpressure 503,
+        client error, server error — is recorded here EXACTLY ONCE
+        into the SLO recorder's pilosa_query_outcome_total family, so
+        the availability SLI has a single source of truth instead of
+        stitching scheduler stats together with route histograms.
+        Remote fan-out legs and ?explain=true are skipped: one logical
+        query counts once, at its coordinator, and explain dispatches
+        no work worth judging."""
+        if self.slo is None:
+            return self._post_query_inner(pv, params, headers, body, {})
+        info: dict = {}
+        t0 = time.monotonic()
+        try:
+            resp = self._post_query_inner(pv, params, headers, body,
+                                          info)
+        except PilosaError as e:
+            # handle() will turn this into a response via
+            # _error_status; record the same mapping now.
+            if not (info.get("remote") or info.get("explain")):
+                self.slo.record(
+                    obs.slo.outcome_for_status(_error_status(e)),
+                    tenant=info.get("tenant", "default"))
+            raise
+        if info.get("remote") or info.get("explain"):
+            return resp
+        opt = info.get("opt")
+        partial = bool(opt is not None and opt.partial
+                       and opt.missing_slices)
+        latency_us = None
+        if resp.status < 400:
+            latency_us = (time.monotonic() - t0) * 1e6
+        self.slo.record(obs.slo.outcome_for_status(resp.status, partial),
+                        tenant=info.get("tenant", "default"),
+                        latency_us=latency_us)
+        return resp
+
+    def _post_query_inner(self, pv, params, headers, body,
+                          info: dict) -> Response:
         index = pv["index"]
         # Read request: protobuf QueryRequest or raw PQL + URL params
         # (reference readQueryRequest, handler.go:811-871).
@@ -1599,14 +1662,19 @@ class Handler:
                       if s != ""]
             column_attrs = params.get("columnAttrs") == "true"
             remote = False
+        tenant = headers.get("x-pilosa-tenant", "") or "default"
+        info["remote"] = bool(remote)
+        info["tenant"] = tenant
         fault.point("handler.query", host=self.host, index=index,
                     remote=bool(remote))
         opt = self._exec_options(params, headers, remote)
+        info["opt"] = opt
 
         # ?explain=true: return the PLANNED execution — routing with
         # cost-model inputs, breaker-aware placement, cache peeks,
         # staging estimate — without dispatching any device work.
         if params.get("explain") == "true" and not remote:
+            info["explain"] = True
             return self._explain_query(index, query, slices, headers, opt)
 
         # Measured profile (the EXPLAIN ANALYZE counterpart): explicit
@@ -1625,6 +1693,13 @@ class Handler:
         prof = ptoken = None
         if want_profile or remote_profile or sampled:
             prof = obs.profile.QueryProfile()
+            if self.slo is not None and not remote:
+                # Tenant dimension only on the sampled/profiled path,
+                # bounded by the SLO recorder's tenant-label map —
+                # pilosa_query_phase_us cardinality stays
+                # |tenant-weights| + "other", not one series per
+                # arbitrary header value.
+                prof.tenant = self.slo.tenant_label(tenant)
             ptoken = obs.profile.activate(prof)
         ticket = None
         trace = None
@@ -1637,7 +1712,6 @@ class Handler:
             # paid admission for the whole query, and gating each leg
             # again would double-queue one logical request.
             if self.scheduler is not None and not remote:
-                tenant = headers.get("x-pilosa-tenant", "") or "default"
                 try:
                     with obs.profile.phase("sched_wait"):
                         ticket = self.scheduler.submit(
@@ -1843,6 +1917,27 @@ class Handler:
     # -- import / export -----------------------------------------------------
 
     def _post_import(self, pv, params, headers, body) -> Response:
+        """Outcome-accounting wrapper mirroring _post_query's: the
+        import write path is where WAL backpressure (503) surfaces, so
+        its outcomes land in the same pilosa_query_outcome_total
+        family under route="import"."""
+        if self.slo is None:
+            return self._post_import_inner(pv, params, headers, body)
+        tenant = headers.get("x-pilosa-tenant", "") or "default"
+        try:
+            resp = self._post_import_inner(pv, params, headers, body)
+        except PilosaError as e:
+            self.slo.record(
+                obs.slo.outcome_for_status(_error_status(e)),
+                tenant=tenant, route="import")
+            raise
+        # No latency_us: the latency SLI means "query p99 under the
+        # declared threshold"; batch imports must not dilute it.
+        self.slo.record(obs.slo.outcome_for_status(resp.status),
+                        tenant=tenant, route="import")
+        return resp
+
+    def _post_import_inner(self, pv, params, headers, body) -> Response:
         req = pb.ImportRequest()
         req.ParseFromString(body)
         # Validate ownership of the slice (handler.go:931).

@@ -116,6 +116,19 @@ TOML schema:
                                 # results through the host roaring fold
                                 # and compare; 0 = off
 
+    [slo]
+    enabled = true              # SLO observatory (obs/slo.py):
+                                # per-tenant outcome accounting, error
+                                # budgets, burn rates, GET /debug/slo
+    availability = 99.9         # percent of queries answering non-5xx
+                                # and non-shed
+    p99-us = 50000              # latency threshold in microseconds —
+                                # a served query is "fast" iff under it
+    latency-target = 99.0       # percent of served queries that must
+                                # land under p99-us
+    shed-rate-max = 0.05        # max tolerated admission-shed (429)
+                                # fraction
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -294,6 +307,15 @@ class Config:
         self.integrity_scrub_interval: float = 600.0
         self.integrity_rate_limit: int = 16 << 20
         self.integrity_shadow_sample: int = 0
+        # [slo] — declared service objectives (obs/slo.py). The
+        # availability/latency targets are percentages; shed-rate-max
+        # is a fraction; correctness (zero shadow-mismatch growth) has
+        # no knob — its budget is always zero.
+        self.slo_enabled: bool = True
+        self.slo_availability: float = 99.9
+        self.slo_p99_us: float = 50_000.0
+        self.slo_latency_target: float = 99.0
+        self.slo_shed_rate_max: float = 0.05
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -414,6 +436,15 @@ class Config:
                                             c.integrity_rate_limit))
         c.integrity_shadow_sample = int(it.get("shadow-sample-1-in",
                                                c.integrity_shadow_sample))
+        sl = data.get("slo", {})
+        c.slo_enabled = bool(sl.get("enabled", c.slo_enabled))
+        c.slo_availability = float(sl.get("availability",
+                                          c.slo_availability))
+        c.slo_p99_us = float(sl.get("p99-us", c.slo_p99_us))
+        c.slo_latency_target = float(sl.get("latency-target",
+                                            c.slo_latency_target))
+        c.slo_shed_rate_max = float(sl.get("shed-rate-max",
+                                           c.slo_shed_rate_max))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -446,6 +477,16 @@ class Config:
             "hbm_headroom": self.mesh_hbm_headroom,
             "quarantine_after": self.mesh_quarantine_after,
             "quarantine_ttl": self.mesh_quarantine_ttl,
+        }
+
+    def slo_objectives(self) -> dict:
+        """The [slo] targets keyed the way obs.slo.SLORecorder expects
+        its objectives dict."""
+        return {
+            "availability": self.slo_availability,
+            "p99_us": self.slo_p99_us,
+            "latency_target": self.slo_latency_target,
+            "shed_rate_max": self.slo_shed_rate_max,
         }
 
     def use_device_flag(self):
@@ -531,6 +572,12 @@ class Config:
             f'scrub-interval = "{int(self.integrity_scrub_interval)}s"\n'
             f"scrub-rate-limit-bytes = {self.integrity_rate_limit}\n"
             f"shadow-sample-1-in = {self.integrity_shadow_sample}\n"
+            f"\n[slo]\n"
+            f"enabled = {'true' if self.slo_enabled else 'false'}\n"
+            f"availability = {self.slo_availability}\n"
+            f"p99-us = {int(self.slo_p99_us)}\n"
+            f"latency-target = {self.slo_latency_target}\n"
+            f"shed-rate-max = {self.slo_shed_rate_max}\n"
         )
 
 

@@ -400,7 +400,7 @@ def _hist_percentiles(metrics: dict, name: str, fixed: dict):
     whose labels include `fixed`. Percentile = the smallest le whose
     cumulative count covers the quantile (exact for the log2 exporter,
     an upper bound in general)."""
-    buckets = []
+    by_le: dict = {}
     for (mname, labels), v in metrics.items():
         if mname != name + "_bucket":
             continue
@@ -408,10 +408,14 @@ def _hist_percentiles(metrics: dict, name: str, fixed: dict):
         if any(d.get(k) != str(val) for k, val in fixed.items()):
             continue
         le = d.get("le", "")
-        buckets.append((float("inf") if le == "+Inf" else float(le), v))
-    if not buckets:
+        le = float("inf") if le == "+Inf" else float(le)
+        # Sum across any series the fixed labels don't pin down (e.g.
+        # per-tenant phase histograms viewed by (phase, backend)) —
+        # cumulative counts stay cumulative under per-le addition.
+        by_le[le] = by_le.get(le, 0.0) + v
+    if not by_le:
         return None
-    buckets.sort()
+    buckets = sorted(by_le.items())
     total = buckets[-1][1]
     if total <= 0:
         return (0.0, 0.0, 0.0, 0)
@@ -578,7 +582,49 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
             if mism:
                 line += f" / {int(mism)} MISMATCH"
         lines.append(line)
+
+    # SLO panel (pilosa_slo_* — [slo] objectives): per-objective error
+    # budget remaining over the accounting window plus the fastest
+    # burn rate across windows. Budget 0 / VIOLATED is the page line.
+    slo_objs = sorted({dict(labels).get("objective", "")
+                       for (name, labels) in cur
+                       if name == "pilosa_slo_budget_remaining"})
+    if slo_objs:
+        parts = []
+        for obj in slo_objs:
+            rem = cur.get(("pilosa_slo_budget_remaining",
+                           (("objective", obj),)), 0.0)
+            burns = [(dict(labels).get("window", ""), v)
+                     for (name, labels), v in cur.items()
+                     if name == "pilosa_slo_burn_rate"
+                     and dict(labels).get("objective") == obj]
+            part = f"{obj} {rem * 100:.0f}%"
+            if burns:
+                w, rate = max(burns, key=lambda x: (x[1], x[0]))
+                part += f" (burn {rate:.2f}@{w})"
+            if rem <= 0:
+                part += " VIOLATED"
+            parts.append(part)
+        lines.append("")
+        lines.append("slo budget: " + "   ".join(parts))
     return "\n".join(lines) + "\n"
+
+
+def cmd_loadgen(args) -> int:
+    """`pilosa-tpu loadgen` — delegate to tools/loadgen.py (its parser
+    owns every flag; exit code is the SLO verdict)."""
+    try:
+        from tools import loadgen
+    except ImportError:
+        # Source checkout without the repo root on sys.path (e.g.
+        # console-script install): tools/ sits two levels up from
+        # pilosa_tpu/ctl/.
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from tools import loadgen
+    return loadgen.main(args.rest)
 
 
 def cmd_top(args) -> int:
@@ -709,6 +755,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="number of scrapes, 0 = until interrupted")
     p.set_defaults(fn=cmd_top)
 
+    # Placeholder row for --help only: main() routes "loadgen" before
+    # argparse runs, because tools/loadgen.py's parser owns its flags
+    # (REMAINDER can't pass leading optionals through on py>=3.12).
+    p = sub.add_parser("loadgen", add_help=False,
+                       help="seeded load generation with SLO verdicts")
+    p.set_defaults(fn=cmd_loadgen, rest=[])
+
     p = sub.add_parser("config", help="print the default config")
     p.set_defaults(fn=cmd_config)
 
@@ -716,8 +769,13 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = make_parser().parse_args(argv)
+    if argv is None:
+        argv = sys.argv[1:]
     try:
+        if argv and argv[0] == "loadgen":
+            return cmd_loadgen(
+                argparse.Namespace(rest=list(argv[1:])))
+        args = make_parser().parse_args(argv)
         return args.fn(args)
     except KeyboardInterrupt:
         return 130

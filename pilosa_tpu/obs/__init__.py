@@ -13,6 +13,7 @@ from .metrics import Histogram, StatMap
 from . import log
 from . import profile
 from . import prom
+from . import slo
 from .log import get_logger
 from .trace import (
     NOOP_SPAN,
@@ -38,6 +39,7 @@ __all__ = [
     "log",
     "profile",
     "prom",
+    "slo",
     "span",
     "wrap_ctx",
 ]

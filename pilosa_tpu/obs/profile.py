@@ -109,10 +109,16 @@ class QueryProfile:
     """
 
     __slots__ = ("_mu", "_phase_ns", "_active", "_bytes", "_slices",
-                 "remotes", "start_ns", "end_ns", "backend", "tags")
+                 "remotes", "start_ns", "end_ns", "backend", "tags",
+                 "tenant")
 
     def __init__(self, backend: Optional[str] = None):
         self._mu = threading.Lock()
+        # Bounded tenant label for the exported phase histograms; ""
+        # keeps the series tenant-less (remote legs, embedded tests).
+        # The handler assigns it through SLORecorder.tenant_label so
+        # cardinality is capped at |tenant-weights| + "other".
+        self.tenant = ""
         self._phase_ns: Dict[str, int] = {}
         # phase -> [depth, outermost_start_ns]
         self._active: Dict[str, List[int]] = {}
@@ -348,11 +354,12 @@ class ProfileStats:
     def record(self, prof: QueryProfile) -> None:
         d = prof.to_dict()
         backend = d["backend"]
+        tenant = getattr(prof, "tenant", "")
         with self._mu:
             for name, us in d["phases_us"].items():
-                h = self._phase.get((name, backend))
+                h = self._phase.get((name, backend, tenant))
                 if h is None:
-                    h = self._phase[(name, backend)] = Histogram()
+                    h = self._phase[(name, backend, tenant)] = Histogram()
                 h.observe(us)
         rf = d["roofline"]
         if rf.get("fraction_of_peak"):
@@ -375,8 +382,11 @@ class ProfileStats:
             fam = MetricFamily(
                 "pilosa_query_phase_us", "histogram",
                 "Measured per-phase query wall time (microseconds).")
-            for (name, backend), h in sorted(phases.items()):
-                fam.add_histogram(h, {"phase": name, "backend": backend})
+            for (name, backend, tenant), h in sorted(phases.items()):
+                labels = {"phase": name, "backend": backend}
+                if tenant:
+                    labels["tenant"] = tenant
+                fam.add_histogram(h, labels)
             fams.append(fam)
         if roofs:
             fam = MetricFamily(

@@ -42,7 +42,7 @@ from .parallel.cluster import (
     Node,
 )
 from .parallel.rebalance import Rebalancer
-from .obs import StatMap, Tracer
+from .obs import StatMap, Tracer, slo as obs_slo
 from .utils.stats import ExpvarStats
 from .wire import pb
 
@@ -250,6 +250,15 @@ class Server:
                 estimator=self.executor.estimate_service_us,
                 on_release=self.executor.burst_hint)
             self.handler.scheduler = self.scheduler
+        # SLO observatory ([slo]): replace the handler's default
+        # recorder with the config-declared objectives; tenant label
+        # cardinality is bounded by the [sched] tenant-weights keys.
+        if self.config.slo_enabled:
+            self.handler.slo = obs_slo.SLORecorder(
+                objectives=self.config.slo_objectives(),
+                tenants=self.config.sched_tenant_weights)
+        else:
+            self.handler.slo = None
         if self.spmd is not None:
             if self._spmd_rank == 0:
                 self.handler.spmd = self.spmd

@@ -1,0 +1,438 @@
+"""SLO observatory tests: window-ring rotation/eviction, burn-rate
+math against hand-computed fixtures, budget-exhaustion verdicts,
+/debug/slo + /metrics exposure through the handler, the `top` SLO
+panel, tenant-labeled phase histograms, and seeded loadgen determinism
+through a stub transport (no live server)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from pilosa_tpu.api import Handler
+from pilosa_tpu.core import Holder
+from pilosa_tpu.ctl.main import _parse_prom, render_top
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.obs import profile, slo
+from pilosa_tpu.parallel import new_test_cluster
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import loadgen  # noqa: E402
+
+
+def make_recorder(**kw):
+    """Recorder on a hand-cranked clock with no executor coupling."""
+    clock = [0.0]
+    kw.setdefault("mismatch_source", lambda: 0.0)
+    rec = slo.SLORecorder(now=lambda: clock[0], **kw)
+    return rec, clock
+
+
+class TestOutcomeMapping:
+    @pytest.mark.parametrize("status,partial,want", [
+        (200, False, "ok"),
+        (200, True, "partial"),
+        (400, False, "client_error"),
+        (404, False, "client_error"),
+        (429, False, "shed"),
+        (503, False, "backpressure"),
+        (504, False, "deadline"),
+        (500, False, "error"),
+        (599, False, "error"),
+    ])
+    def test_outcome_for_status(self, status, partial, want):
+        assert slo.outcome_for_status(status, partial) == want
+
+    def test_good_set_is_availability_numerator(self):
+        # 4xx counts good (the service did its job); shed + 5xx do not.
+        assert slo.GOOD_OUTCOMES == {"ok", "partial", "client_error"}
+
+
+class TestWindowRings:
+    def test_rotation_and_eviction(self):
+        rec, clock = make_recorder()
+        for _ in range(10):
+            rec.record("ok", latency_us=100)
+        assert rec.window_stats("5m")["total"] == 10
+        # 4 minutes later: still inside 5m, 1h, 6h.
+        clock[0] = 240.0
+        assert rec.window_stats("5m")["total"] == 10
+        # 6 minutes: evicted from 5m, alive in the longer windows.
+        clock[0] = 360.0
+        assert rec.window_stats("5m")["total"] == 0
+        assert rec.window_stats("1h")["total"] == 10
+        assert rec.window_stats("6h")["total"] == 10
+        # Past 6h: gone everywhere; cumulative totals never reset.
+        clock[0] = 22000.0
+        assert rec.window_stats("6h")["total"] == 0
+        assert sum(rec.outcome_totals.values()) == 10
+
+    def test_ring_memory_is_bounded(self):
+        rec, clock = make_recorder()
+        # A full simulated day of traffic: every ring must hold at
+        # most its slot count, regardless of history length.
+        for minute in range(24 * 60):
+            clock[0] = minute * 60.0
+            rec.record("ok", latency_us=50)
+        for _, ring in rec._rings:
+            assert len(ring.buckets) <= ring.slots
+
+    def test_latency_merge_across_buckets(self):
+        rec, clock = make_recorder()
+        rec.record("ok", latency_us=100)
+        clock[0] = 25.0  # next 5m bucket
+        rec.record("ok", latency_us=100_000)
+        agg = rec.window_stats("5m")
+        assert sum(sum(r) for r in agg["lat"].values()) == 2
+
+
+class TestBurnRateMath:
+    """Hand-computed fixtures for evaluate() — the math of record."""
+
+    OBJ = {"availability": 99.0, "p99_us": 1000.0,
+           "latency_target": 90.0, "shed_rate_max": 0.10}
+
+    def agg(self, rec):
+        return rec.window_stats("6h")
+
+    def test_availability_burn(self):
+        # 98 good + 2 error out of 100 -> bad 2%, budget 1% -> burn 2.
+        rec, _ = make_recorder()
+        for _ in range(98):
+            rec.record("ok", latency_us=10)
+        rec.record("error")
+        rec.record("error")
+        ev = slo.evaluate(self.agg(rec), self.OBJ)
+        assert ev["availability"]["sli"] == pytest.approx(0.98)
+        assert ev["availability"]["burn_rate"] == pytest.approx(2.0)
+
+    def test_latency_burn_counts_exact_threshold(self):
+        # 8 under + 2 over of 10 served -> bad 20%, budget 10% ->
+        # burn 2. The under test is exact (<= p99_us), not bucketed.
+        rec, _ = make_recorder(objectives={"p99_us": 1000.0})
+        for _ in range(8):
+            rec.record("ok", latency_us=1000.0)   # == threshold: under
+        for _ in range(2):
+            rec.record("ok", latency_us=1001.0)   # just over
+        ev = slo.evaluate(self.agg(rec), self.OBJ)
+        assert ev["latency"]["sli"] == pytest.approx(0.8)
+        assert ev["latency"]["burn_rate"] == pytest.approx(2.0)
+
+    def test_shed_burn(self):
+        # 5 shed of 100 -> shed 5%, max 10% -> burn 0.5.
+        rec, _ = make_recorder()
+        for _ in range(95):
+            rec.record("ok", latency_us=10)
+        for _ in range(5):
+            rec.record("shed")
+        ev = slo.evaluate(self.agg(rec), self.OBJ)
+        assert ev["shed_rate"]["burn_rate"] == pytest.approx(0.5)
+        assert ev["shed_rate"]["shed_fraction"] == pytest.approx(0.05)
+
+    def test_empty_window_is_healthy(self):
+        rec, _ = make_recorder()
+        ev = slo.evaluate(self.agg(rec), self.OBJ)
+        for row in ev.values():
+            assert row["burn_rate"] == 0.0
+            assert row["sli"] == 1.0
+
+    def test_sheds_do_not_feed_latency(self):
+        rec, _ = make_recorder()
+        rec.record("shed")
+        rec.record("deadline")
+        agg = self.agg(rec)
+        assert sum(agg["served"].values()) == 0
+
+
+class TestBudgetAndVerdict:
+    def test_budget_exhaustion_flips_verdict(self):
+        rec, _ = make_recorder(objectives={"availability": 99.0})
+        for _ in range(99):
+            rec.record("ok", latency_us=10)
+        st = rec.status()
+        assert st["objectives"]["availability"]["verdict"] == "OK"
+        assert st["verdict"] == "OK"
+        # One error in 100 burns the 1% budget exactly (burn 1.0,
+        # remaining 0) — the verdict must flip.
+        rec.record("error")
+        st = rec.status()
+        avail = st["objectives"]["availability"]
+        assert avail["budget_remaining"] == pytest.approx(0.0)
+        assert avail["verdict"] == "VIOLATED"
+        assert st["verdict"] == "VIOLATED"
+
+    def test_correctness_has_zero_budget(self):
+        mm = [0.0]
+        clock = [0.0]
+        rec = slo.SLORecorder(now=lambda: clock[0],
+                              mismatch_source=lambda: mm[0])
+        rec.record("ok", latency_us=10)
+        assert rec.status()["objectives"]["correctness"]["verdict"] \
+            == "OK"
+        mm[0] = 1.0  # any growth inside the window
+        st = rec.status()
+        assert st["objectives"]["correctness"]["verdict"] == "VIOLATED"
+        assert st["objectives"]["correctness"]["budget_remaining"] == 0.0
+        assert st["verdict"] == "VIOLATED"
+
+    def test_tenant_label_bounded(self):
+        rec, _ = make_recorder(tenants=["gold"])
+        assert rec.tenant_label("gold") == "gold"
+        assert rec.tenant_label("default") == "default"
+        assert rec.tenant_label("rando-42") == "other"
+
+    def test_multi_window_burns_exported(self):
+        rec, clock = make_recorder(objectives={"availability": 99.0})
+        # Old errors: only the long windows still see them.
+        rec.record("error")
+        rec.record("ok", latency_us=10)
+        clock[0] = 400.0  # outside 5m
+        for _ in range(8):
+            rec.record("ok", latency_us=10)
+        st = rec.status()
+        burns = st["objectives"]["availability"]["burn_rates"]
+        assert burns["5m"] == 0.0
+        assert burns["6h"] == pytest.approx(10.0)  # 1/10 bad, 1% budget
+        assert st["objectives"]["availability"]["fastest_burn"] \
+            == pytest.approx(10.0)
+        # 1h and 6h tie at 10.0; the shortest maximal window wins the
+        # label (it's the page-worthy fast signal).
+        assert st["objectives"]["availability"]["fastest_burn_window"] \
+            == "1h"
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    cluster = new_test_cluster(1)
+    ex = Executor(holder, host=cluster.nodes[0].host, cluster=cluster,
+                  use_device=False)
+    handler = Handler(holder, ex, cluster=cluster,
+                      host=cluster.nodes[0].host)
+    yield holder, handler
+    holder.close()
+
+
+def seed(handler):
+    assert handler.handle("POST", "/index/i").status == 200
+    assert handler.handle("POST", "/index/i/frame/f").status == 200
+
+
+class TestHandlerExposure:
+    def test_outcomes_recorded_per_tenant_and_route(self, env):
+        _, h = env
+        h.slo = slo.SLORecorder(tenants=["gold"],
+                                mismatch_source=lambda: 0.0)
+        seed(h)
+        body = b"Count(Bitmap(rowID=1, frame=f))"
+        h.handle("POST", "/index/i/query", body=body)
+        h.handle("POST", "/index/i/query", body=body,
+                 headers={"X-Pilosa-Tenant": "gold"})
+        h.handle("POST", "/index/i/query", body=body,
+                 headers={"X-Pilosa-Tenant": "unknown-tenant"})
+        h.handle("POST", "/index/i/query", body=b"Nope(")
+        totals = h.slo.outcome_totals
+        assert totals[("query", "default", "ok")] == 1
+        assert totals[("query", "gold", "ok")] == 1
+        assert totals[("query", "other", "ok")] == 1
+        assert totals[("query", "default", "client_error")] == 1
+
+    def test_remote_and_explain_not_judged(self, env):
+        _, h = env
+        h.slo = slo.SLORecorder(mismatch_source=lambda: 0.0)
+        seed(h)
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=1, frame=f))",
+                     params={"explain": "true"})
+        assert r.status == 200
+        assert h.slo.outcome_totals == {}
+
+    def test_debug_slo_and_metrics_agree(self, env):
+        _, h = env
+        h.slo = slo.SLORecorder(mismatch_source=lambda: 0.0)
+        seed(h)
+        for _ in range(4):
+            h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=1, frame=f))")
+        r = h.handle("GET", "/debug/slo")
+        assert r.status == 200
+        st = r.json()
+        assert st["verdict"] == "OK"
+        assert st["budget_window"] == "6h"
+        text = h.handle("GET", "/metrics").body.decode()
+        metrics = _parse_prom(text)
+        for obj, row in st["objectives"].items():
+            got = metrics[("pilosa_slo_budget_remaining",
+                           (("objective", obj),))]
+            assert got == pytest.approx(row["budget_remaining"])
+            for window, burn in row["burn_rates"].items():
+                key = ("pilosa_slo_burn_rate",
+                       (("objective", obj), ("window", window)))
+                assert metrics[key] == pytest.approx(burn)
+        assert ("pilosa_query_outcome_total",
+                (("outcome", "ok"), ("route", "query"),
+                 ("tenant", "default"))) in metrics
+
+    def test_slo_disabled(self, env):
+        _, h = env
+        h.slo = None
+        seed(h)
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=1, frame=f))")
+        assert r.status == 200
+        assert h.handle("GET", "/debug/slo").status == 404
+
+    def test_profiled_query_gets_tenant_label(self, env):
+        _, h = env
+        h.slo = slo.SLORecorder(tenants=["gold"],
+                                mismatch_source=lambda: 0.0)
+        seed(h)
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=1, frame=f))",
+                     params={"profile": "true"},
+                     headers={"X-Pilosa-Tenant": "gold"})
+        assert r.status == 200
+        phases, _ = profile.STATS.snapshot()
+        assert any(key[2] == "gold" for key in phases)
+
+
+class TestTopPanel:
+    SCRAPE = """\
+pilosa_uptime_seconds 5
+pilosa_slo_budget_remaining{objective="availability"} 0.75
+pilosa_slo_budget_remaining{objective="latency"} 0
+pilosa_slo_burn_rate{objective="availability",window="5m"} 14.4
+pilosa_slo_burn_rate{objective="availability",window="6h"} 0.25
+pilosa_slo_burn_rate{objective="latency",window="6h"} 1.5
+"""
+
+    def test_slo_row(self):
+        cur = _parse_prom(self.SCRAPE)
+        out = render_top("h:1", cur, {}, 0.0)
+        assert "slo budget:" in out
+        assert "availability 75% (burn 14.40@5m)" in out
+        assert "latency 0% (burn 1.50@6h) VIOLATED" in out
+
+
+class TestLoadgenDeterminism:
+    SPEC = {
+        "seed": 1234,
+        "duration": 3.0,
+        "qps": 40.0,
+        "warmup": 0.5,
+        "mode": "closed",
+        "concurrency": 3,
+        "tenants": ["gold", "silver", "bronze"],
+        "zipf_s": 1.1,
+        "rows": 32,
+        "columns": 4096,
+        "mix": "read=0.6,write=0.2,topn=0.2",
+        "burst": "diurnal",
+        "frame": "f",
+        "objectives": {"availability": 99.0, "p99_us": 50_000.0,
+                       "latency_target": 95.0, "shed_rate_max": 0.05},
+    }
+
+    def test_same_seed_identical_schedule(self):
+        a = loadgen.build_schedule(dict(self.SPEC))
+        b = loadgen.build_schedule(dict(self.SPEC))
+        assert json.dumps(a, sort_keys=True) \
+            == json.dumps(b, sort_keys=True)
+        assert len(a) > 50
+
+    def test_different_seed_differs(self):
+        a = loadgen.build_schedule(dict(self.SPEC))
+        b = loadgen.build_schedule(dict(self.SPEC, seed=99))
+        assert json.dumps(a) != json.dumps(b)
+
+    def test_schedule_shape(self):
+        sched = loadgen.build_schedule(dict(self.SPEC))
+        assert [e["i"] for e in sched] == list(range(len(sched)))
+        assert all(e["phase"] in ("warmup", "run") for e in sched)
+        assert sched[0]["phase"] == "warmup"
+        ops = {e["op"] for e in sched}
+        assert "read" in ops and "range" not in ops
+        # Zipfian tenant skew: first-ranked tenant dominates.
+        counts = {}
+        for e in sched:
+            counts[e["tenant"]] = counts.get(e["tenant"], 0) + 1
+        assert counts["gold"] > counts["bronze"]
+        # Arrival times strictly increase.
+        ts = [e["t"] for e in sched]
+        assert ts == sorted(ts)
+
+    def test_run_via_stub_transport_ok(self):
+        stub = loadgen.StubTransport()
+        report = loadgen.run(dict(self.SPEC), stub)
+        assert report["verdict"] == "OK"
+        assert report["requests_total"] == \
+            len(loadgen.build_schedule(dict(self.SPEC)))
+        # Warmup excluded from judgment.
+        assert report["requests_judged"] < report["requests_total"]
+        assert set(report["per_tenant"]) \
+            <= {"gold", "silver", "bronze"}
+        for row in report["per_tenant"].values():
+            assert row["p50_us"] <= row["p95_us"] <= row["p99_us"]
+
+    def test_stub_sheds_flip_verdict(self):
+        # Every 4th request 429s -> shed rate 0.25 > max 0.05.
+        def fn(entry):
+            return (429, False) if entry["i"] % 4 == 0 else (200, False)
+        report = loadgen.run(dict(self.SPEC),
+                             loadgen.StubTransport(fn))
+        assert report["objectives"]["shed_rate"]["verdict"] \
+            == "VIOLATED"
+        assert report["verdict"] == "VIOLATED"
+        assert report["shed_rate"] == pytest.approx(0.25, abs=0.05)
+
+    def test_mismatch_growth_flips_verdict(self):
+        spec = dict(self.SPEC)
+        spec["_mismatch_growth"] = 2.0
+        report = loadgen.run(spec, loadgen.StubTransport())
+        assert report["objectives"]["correctness"]["verdict"] \
+            == "VIOLATED"
+
+    def test_mix_parsing(self):
+        assert loadgen.parse_mix("read=1")[-1] == ("read", 1.0)
+        with pytest.raises(ValueError):
+            loadgen.parse_mix("bogus=1")
+        with pytest.raises(ValueError):
+            loadgen.parse_mix("read=0")
+
+    def test_zipf_cdf(self):
+        cdf = loadgen.zipf_cdf(4, 1.0)
+        assert cdf[-1] == 1.0
+        assert cdf == sorted(cdf)
+        # rank 1 carries 1/(1+1/2+1/3+1/4) ≈ 48%.
+        assert cdf[0] == pytest.approx(0.48, abs=0.01)
+
+    def test_burst_curves(self):
+        assert loadgen.burst_factor("none", 0.5) == 1.0
+        assert loadgen.burst_factor("spike", 0.5) == 4.0
+        assert loadgen.burst_factor("spike", 0.2) == 1.0
+        assert loadgen.burst_factor("diurnal", 0.25) \
+            == pytest.approx(1.8)
+
+
+class TestConfigWiring:
+    def test_slo_section_roundtrip(self):
+        from pilosa_tpu.config import Config
+        c = Config.from_toml(
+            "[slo]\nenabled = true\navailability = 99.5\n"
+            "p99-us = 20000\nlatency-target = 98.0\n"
+            "shed-rate-max = 0.02\n", is_text=True)
+        assert c.slo_availability == 99.5
+        assert c.slo_p99_us == 20000.0
+        c2 = Config.from_toml(c.to_toml(), is_text=True)
+        assert c2.slo_objectives() == c.slo_objectives()
+
+    def test_objectives_feed_recorder(self):
+        from pilosa_tpu.config import Config
+        c = Config()
+        c.slo_availability = 90.0
+        rec = slo.SLORecorder(objectives=c.slo_objectives(),
+                              mismatch_source=lambda: 0.0)
+        assert rec.objectives["availability"] == 90.0

@@ -1,0 +1,626 @@
+"""pilosa-tpu loadgen — seeded, deterministic traffic generation with
+SLO verdicts.
+
+The other half of the SLO observatory (obs/slo.py): a traffic
+generator whose entire request schedule — arrival times, tenants,
+fragments, operations, PQL texts — derives from one `random.Random`
+seed, so the same `--seed` replays byte-for-byte the same workload
+(`--print-schedule` proves it). Skew is zipfian on both tenants and
+rows (real traffic concentrates), the op mix is declarative
+(`read=0.65,write=0.2,topn=0.15`), and arrival density follows a
+burst curve (steady / diurnal sine / mid-run spike) after a warmup
+phase that is generated and sent but excluded from the verdict.
+
+Two loop disciplines, per the classic open-vs-closed distinction:
+
+- **closed** — `--concurrency` workers each keep exactly one request
+  in flight; offered load adapts to service time (a saturated server
+  slows the clients down — good for capacity probing).
+- **open** — requests fire at their scheduled arrival instants
+  regardless of completions (arrivals don't care that you're slow —
+  the discipline that actually exposes queueing collapse and shed
+  behavior).
+
+During the run it scrapes `/metrics` + `/debug/slo`, and at the end it
+emits a machine-readable `LOADGEN_<seed>.json` report — achieved QPS,
+per-tenant p50/p95/p99 (exact, from client-side timings), shed/error
+rates, shadow-mismatch growth, per-objective verdicts both client-side
+and as the server's own /debug/slo judgment — and exits nonzero on any
+VIOLATED objective, which is what makes the verdict CI-gateable.
+
+`--fault` arms PILOSA_TPU_FAULT seams mid-run (in-process server only)
+for churn scenarios: e.g. `device.exec:error=ResourceExhausted,prob=.5`
+exercises the evict→retry→host-fold ladder under live traffic, where
+the acceptance bar is zero wrong answers and availability degraded
+only within the declared objective.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import math
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_MIX = "read=0.65,write=0.20,topn=0.15,range=0.0"
+
+# Fixed Range() window: the schedule must be seed-deterministic, so no
+# wall-clock reads anywhere in generation.
+RANGE_START = "2016-01-01T00:00"
+RANGE_END = "2026-01-01T00:00"
+
+
+# -- deterministic schedule generation ------------------------------------
+
+
+def parse_mix(text: str) -> List[tuple]:
+    """"read=0.65,write=0.2,..." -> [(op, cum_weight)] CDF."""
+    ops = []
+    total = 0.0
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, w = item.partition("=")
+        name = name.strip()
+        if name not in ("read", "write", "topn", "range"):
+            raise ValueError(f"unknown op {name!r} in mix")
+        total += float(w)
+        ops.append((name, total))
+    if total <= 0:
+        raise ValueError("op mix weights sum to zero")
+    return [(name, cum / total) for name, cum in ops]
+
+
+def zipf_cdf(n: int, s: float) -> List[float]:
+    """CDF over ranks 1..n with P(rank k) ∝ 1/k^s."""
+    weights = [1.0 / (k ** s) for k in range(1, n + 1)]
+    total = sum(weights)
+    out, cum = [], 0.0
+    for w in weights:
+        cum += w / total
+        out.append(cum)
+    out[-1] = 1.0
+    return out
+
+
+def pick(rng: random.Random, cdf: List[float]) -> int:
+    return bisect.bisect_left(cdf, rng.random())
+
+
+def burst_factor(curve: str, frac: float) -> float:
+    """Arrival-rate multiplier at `frac` ∈ [0,1) of the run."""
+    if curve == "diurnal":
+        # One full day compressed into the run: peak 1.8x, trough 0.2x.
+        return max(0.1, 1.0 + 0.8 * math.sin(2.0 * math.pi * frac))
+    if curve == "spike":
+        # 4x square wave through the middle tenth — the shape that
+        # separates open-loop shedding from closed-loop slowdown.
+        return 4.0 if 0.45 <= frac < 0.55 else 1.0
+    return 1.0
+
+
+def build_schedule(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The full request schedule, derived ONLY from spec values via one
+    seeded RNG — same spec, same bytes. Each entry:
+    {i, t (arrival offset s), phase (warmup|run), tenant, op, pql}."""
+    rng = random.Random(int(spec["seed"]))
+    mix = parse_mix(spec.get("mix", DEFAULT_MIX))
+    mix_ops = [m[0] for m in mix]
+    mix_cdf = [m[1] for m in mix]
+    tenants = list(spec.get("tenants") or ("default",))
+    t_cdf = zipf_cdf(len(tenants), float(spec.get("zipf_s", 1.1)))
+    rows = int(spec.get("rows", 64))
+    row_cdf = zipf_cdf(rows, float(spec.get("zipf_s", 1.1)))
+    cols = int(spec.get("columns", 1 << 16))
+    frame = spec.get("frame", "f")
+    duration = float(spec["duration"])
+    warmup = float(spec.get("warmup", 0.0))
+    qps = float(spec["qps"])
+    curve = spec.get("burst", "none")
+
+    out: List[Dict[str, Any]] = []
+    t = -warmup
+    i = 0
+    while t < duration:
+        phase = "warmup" if t < 0 else "run"
+        tenant = tenants[pick(rng, t_cdf)]
+        op = mix_ops[pick(rng, mix_cdf)]
+        row = pick(rng, row_cdf)
+        col = rng.randrange(cols)
+        if op == "read":
+            pql = f"Count(Bitmap(rowID={row}, frame={frame}))"
+        elif op == "write":
+            pql = f"SetBit(rowID={row}, frame={frame}, columnID={col})"
+        elif op == "topn":
+            pql = f"TopN(frame={frame}, n=10)"
+        else:
+            pql = (f'Range(rowID={row}, frame={frame}, '
+                   f'start="{RANGE_START}", end="{RANGE_END}")')
+        out.append({"i": i, "t": round(t + warmup, 6), "phase": phase,
+                    "tenant": tenant, "op": op, "pql": pql})
+        i += 1
+        # Inter-arrival from the burst-curve-modulated rate. The curve
+        # is sampled at the RUN fraction (warmup runs at base rate).
+        frac = max(0.0, t) / duration
+        rate = qps * (burst_factor(curve, frac) if t >= 0 else 1.0)
+        t += 1.0 / max(rate, 1e-9)
+    return out
+
+
+# -- transports ------------------------------------------------------------
+
+
+class HTTPTransport:
+    """Raw urllib POSTs — deliberately NOT InternalClient, whose retry
+    and status classification would hide exactly the 429/503/504
+    outcomes the SLO math is judging."""
+
+    def __init__(self, host: str, index: str = "loadgen",
+                 timeout: float = 10.0, partial: bool = False,
+                 deadline: str = ""):
+        self.base = host if "://" in host else "http://" + host
+        self.index = index
+        self.timeout = timeout
+        params = []
+        if partial:
+            params.append("partial=true")
+        if deadline:
+            params.append(f"deadline={deadline}")
+        self.query_path = (f"/index/{index}/query"
+                           + ("?" + "&".join(params) if params else ""))
+
+    def do(self, entry: Dict[str, Any]) -> tuple:
+        """-> (status, partial flag). Transport-level failure is 599 —
+        counted as an error outcome, never an exception."""
+        req = urllib.request.Request(
+            self.base + self.query_path,
+            data=entry["pql"].encode(),
+            headers={"X-Pilosa-Tenant": entry["tenant"],
+                     "Content-Type": "text/plain"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = r.read()
+                partial = b'"partial": true' in body
+                return r.status, partial
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, False
+        except Exception:  # noqa: BLE001 — refused/reset/timeout
+            return 599, False
+
+    def get_json(self, path: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(self.base + path,
+                                        timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except Exception:  # noqa: BLE001
+            return None
+
+    def get_text(self, path: str) -> str:
+        try:
+            with urllib.request.urlopen(self.base + path,
+                                        timeout=self.timeout) as r:
+                return r.read().decode()
+        except Exception:  # noqa: BLE001
+            return ""
+
+
+class StubTransport:
+    """Test transport: records the entries it was handed and answers
+    from a status function — the determinism tests run a full loadgen
+    pass with no server at all."""
+
+    def __init__(self, status_fn: Optional[Callable] = None):
+        self.entries: List[Dict[str, Any]] = []
+        self._fn = status_fn or (lambda entry: (200, False))
+        self._mu = threading.Lock()
+
+    def do(self, entry):
+        with self._mu:
+            self.entries.append(entry)
+        return self._fn(entry)
+
+    def get_json(self, path):
+        return None
+
+    def get_text(self, path):
+        return ""
+
+
+# -- run + report ----------------------------------------------------------
+
+
+def _mismatch_total(metrics_text: str) -> float:
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith("pilosa_shadow_mismatch_total"):
+            try:
+                total += float(line.rsplit(None, 1)[1])
+            except (ValueError, IndexError):
+                pass
+    return total
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+def run(spec: Dict[str, Any], transport,
+        log: Callable[[str], None] = lambda s: None,
+        fault_cb: Optional[Callable[[], None]] = None) -> Dict[str, Any]:
+    """Execute the schedule through `transport`; returns the report.
+
+    `fault_cb` fires once, when the run crosses `fault_at` × duration
+    (schedule time in open-loop, progress fraction in closed-loop).
+    """
+    schedule = build_schedule(spec)
+    mode = spec.get("mode", "closed")
+    concurrency = max(1, int(spec.get("concurrency", 4)))
+    duration = float(spec["duration"])
+    fault_at = float(spec.get("fault_at", 0.25)) * duration
+    results: List[tuple] = []  # (entry index, status, partial, dt_s)
+    res_mu = threading.Lock()
+    fault_fired = threading.Event()
+
+    def maybe_fault(progressed_s: float):
+        if fault_cb is not None and progressed_s >= fault_at \
+                and not fault_fired.is_set():
+            fault_fired.set()
+            log(f"arming fault seams at t={progressed_s:.1f}s")
+            fault_cb()
+
+    def fire(entry):
+        t0 = time.monotonic()
+        status, partial = transport.do(entry)
+        dt = time.monotonic() - t0
+        with res_mu:
+            results.append((entry["i"], status, partial, dt))
+
+    t_start = time.monotonic()
+    if mode == "open":
+        # Arrivals at their scheduled instants, completions be damned.
+        # The pool is deep so a slow server queues here (visible as
+        # latency), instead of silently closing the loop.
+        with ThreadPoolExecutor(max_workers=concurrency * 8) as pool:
+            for entry in schedule:
+                lag = entry["t"] - (time.monotonic() - t_start)
+                if lag > 0:
+                    time.sleep(lag)
+                maybe_fault(entry["t"])
+                pool.submit(fire, entry)
+    else:
+        idx_mu = threading.Lock()
+        pos = [0]
+
+        def worker():
+            while True:
+                with idx_mu:
+                    i = pos[0]
+                    if i >= len(schedule):
+                        return
+                    pos[0] += 1
+                maybe_fault(len(schedule) and
+                            (i / len(schedule)) * duration)
+                fire(schedule[i])
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    wall = time.monotonic() - t_start
+
+    # -- tally (run phase only; warmup requests were sent, not judged)
+    phases = {e["i"]: e["phase"] for e in schedule}
+    tenants_of = {e["i"]: e["tenant"] for e in schedule}
+    judged = [(i, st, p, dt) for i, st, p, dt in results
+              if phases.get(i) == "run"]
+    total = len(judged)
+    by_outcome: Dict[str, int] = {}
+    lat_by_tenant: Dict[str, List[float]] = {}
+    for i, st, partial, dt in judged:
+        if st == 429:
+            oc = "shed"
+        elif st == 504:
+            oc = "deadline"
+        elif st == 503:
+            oc = "backpressure"
+        elif st >= 500:
+            oc = "error"
+        elif st >= 400:
+            oc = "client_error"
+        else:
+            oc = "partial" if partial else "ok"
+            lat_by_tenant.setdefault(tenants_of[i], []).append(dt * 1e6)
+        by_outcome[oc] = by_outcome.get(oc, 0) + 1
+
+    good = sum(by_outcome.get(o, 0)
+               for o in ("ok", "partial", "client_error"))
+    shed = by_outcome.get("shed", 0)
+    served = sorted(v for lats in lat_by_tenant.values() for v in lats)
+    obj = spec["objectives"]
+    p99_us = float(obj["p99_us"])
+    under = sum(1 for v in served if v <= p99_us)
+
+    mm_growth = spec.get("_mismatch_growth", 0.0)
+    verdicts = {
+        "availability": {
+            "target": obj["availability"],
+            "measured": 100.0 * good / total if total else 100.0,
+        },
+        "latency": {
+            "target": obj["latency_target"],
+            "p99_us_threshold": p99_us,
+            "measured": 100.0 * under / len(served) if served else 100.0,
+        },
+        "shed_rate": {
+            "target": obj["shed_rate_max"],
+            "measured": shed / total if total else 0.0,
+        },
+        "correctness": {
+            "target": 0,
+            "measured": mm_growth,
+        },
+    }
+    verdicts["availability"]["verdict"] = (
+        "OK" if verdicts["availability"]["measured"]
+        >= obj["availability"] else "VIOLATED")
+    verdicts["latency"]["verdict"] = (
+        "OK" if verdicts["latency"]["measured"]
+        >= obj["latency_target"] else "VIOLATED")
+    verdicts["shed_rate"]["verdict"] = (
+        "OK" if verdicts["shed_rate"]["measured"]
+        <= obj["shed_rate_max"] else "VIOLATED")
+    verdicts["correctness"]["verdict"] = ("OK" if mm_growth == 0
+                                          else "VIOLATED")
+
+    per_tenant = {}
+    for t, lats in sorted(lat_by_tenant.items()):
+        lats.sort()
+        per_tenant[t] = {
+            "served": len(lats),
+            "p50_us": round(percentile(lats, 0.50), 1),
+            "p95_us": round(percentile(lats, 0.95), 1),
+            "p99_us": round(percentile(lats, 0.99), 1),
+        }
+
+    report = {
+        "spec": {k: v for k, v in spec.items()
+                 if not k.startswith("_")},
+        "requests_total": len(results),
+        "requests_judged": total,
+        "wall_s": round(wall, 3),
+        "achieved_qps": round(len(results) / wall, 1) if wall > 0 else 0.0,
+        "outcomes": by_outcome,
+        "shed_rate": round(shed / total, 6) if total else 0.0,
+        "error_rate": round((total - good) / total, 6) if total else 0.0,
+        "mismatch_growth": mm_growth,
+        "per_tenant": per_tenant,
+        "objectives": verdicts,
+        "verdict": ("VIOLATED"
+                    if any(v["verdict"] == "VIOLATED"
+                           for v in verdicts.values()) else "OK"),
+    }
+    return report
+
+
+# -- in-process server ----------------------------------------------------
+
+
+def start_inprocess(spec: Dict[str, Any], log) -> tuple:
+    """Boot a single-node Server on a loopback port with the spec's
+    tenants declared in [sched] tenant-weights and shadow verification
+    on — the self-contained target for CI smoke and fault-churn runs.
+    Returns (server, host)."""
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    cfg = Config()
+    cfg.data_dir = tempfile.mkdtemp(prefix="pilosa-loadgen-")
+    cfg.host = "127.0.0.1:0"
+    cfg.cluster_hosts = [cfg.host]
+    cfg.use_device = os.environ.get("PILOSA_TPU_USE_DEVICE", "off")
+    cfg.sched_tenant_weights = {t: 1.0 for t in spec["tenants"]}
+    cfg.integrity_shadow_sample = 4   # every 4th read shadow-verified
+    for k in ("availability", "latency_target", "shed_rate_max"):
+        setattr(cfg, "slo_" + k, float(spec["objectives"][k]))
+    cfg.slo_p99_us = float(spec["objectives"]["p99_us"])
+    srv = Server(cfg)
+    srv.open(port=0)
+    log(f"in-process server at {srv.host} (data {cfg.data_dir})")
+    return srv, srv.host
+
+
+def prepare_index(host: str, index: str, frame: str, log) -> None:
+    """Create index + frame over HTTP, tolerating 409 replays."""
+    for path, body in ((f"/index/{index}", b"{}"),
+                       (f"/index/{index}/frame/{frame}",
+                        b'{"options": {"timeQuantum": "YMD"}}')):
+        req = urllib.request.Request("http://" + host + path, data=body,
+                                     method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code != 409:
+                log(f"setup {path}: HTTP {e.code}")
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu loadgen",
+        description="seeded deterministic load generation with SLO "
+                    "verdicts")
+    p.add_argument("--host", default="127.0.0.1:10101",
+                   help="target node (host:port)")
+    p.add_argument("--in-process", action="store_true",
+                   help="boot a throwaway single-node server to target")
+    p.add_argument("--index", default="loadgen")
+    p.add_argument("--frame", default="f")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="run seconds (schedule span, not wall bound)")
+    p.add_argument("--qps", type=float, default=100.0,
+                   help="offered rate (modulated by --burst)")
+    p.add_argument("--warmup", type=float, default=0.0,
+                   help="warmup seconds sent before t=0, not judged")
+    p.add_argument("--mode", choices=("open", "closed"),
+                   default="closed")
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--tenants", default="gold,silver,bronze",
+                   help="comma list; zipf-skewed in this order")
+    p.add_argument("--zipf-s", type=float, default=1.1,
+                   help="zipf exponent for tenant + row skew")
+    p.add_argument("--rows", type=int, default=64)
+    p.add_argument("--columns", type=int, default=1 << 16)
+    p.add_argument("--mix", default=DEFAULT_MIX)
+    p.add_argument("--burst", choices=("none", "diurnal", "spike"),
+                   default="none")
+    p.add_argument("--partial", action="store_true",
+                   help="send ?partial=true (graceful degradation)")
+    p.add_argument("--deadline", default="",
+                   help='per-query deadline (Go duration, e.g. "50ms")')
+    p.add_argument("--availability", type=float, default=99.9)
+    p.add_argument("--p99-us", type=float, default=50_000.0)
+    p.add_argument("--latency-target", type=float, default=99.0)
+    p.add_argument("--shed-rate-max", type=float, default=0.05)
+    p.add_argument("--fault", default="",
+                   help="PILOSA_TPU_FAULT spec armed mid-run "
+                        "(in-process only)")
+    p.add_argument("--fault-at", type=float, default=0.25,
+                   help="arm --fault at this fraction of the run")
+    p.add_argument("--report", default="",
+                   help="report path (default LOADGEN_<seed>.json)")
+    p.add_argument("--print-schedule", action="store_true",
+                   help="dump the request schedule as JSON and exit 0 "
+                        "(the determinism probe)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def spec_from_args(args) -> Dict[str, Any]:
+    return {
+        "seed": args.seed,
+        "duration": args.duration,
+        "qps": args.qps,
+        "warmup": args.warmup,
+        "mode": args.mode,
+        "concurrency": args.concurrency,
+        "tenants": [t.strip() for t in args.tenants.split(",")
+                    if t.strip()],
+        "zipf_s": args.zipf_s,
+        "rows": args.rows,
+        "columns": args.columns,
+        "mix": args.mix,
+        "burst": args.burst,
+        "frame": args.frame,
+        "fault_at": args.fault_at,
+        "objectives": {
+            "availability": args.availability,
+            "p99_us": args.p99_us,
+            "latency_target": args.latency_target,
+            "shed_rate_max": args.shed_rate_max,
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    log = (lambda s: None) if args.quiet else \
+        (lambda s: print(f"loadgen: {s}", file=sys.stderr))
+    spec = spec_from_args(args)
+
+    if args.print_schedule:
+        for entry in build_schedule(spec):
+            sys.stdout.write(json.dumps(entry, sort_keys=True) + "\n")
+        return 0
+
+    srv = None
+    host = args.host
+    if args.in_process:
+        srv, host = start_inprocess(spec, log)
+    transport = HTTPTransport(host, index=args.index,
+                              partial=args.partial,
+                              deadline=args.deadline)
+
+    fault_cb = None
+    fault_rules: list = []
+    if args.fault:
+        if not args.in_process:
+            log("--fault requires --in-process (seams live in the "
+                "server process); ignoring")
+        else:
+            from pilosa_tpu import fault as _fault
+
+            def fault_cb():
+                fault_rules.extend(_fault.load_spec(args.fault))
+
+    try:
+        prepare_index(host, args.index, args.frame, log)
+        mm0 = _mismatch_total(transport.get_text("/metrics"))
+        n = len(build_schedule(spec))
+        log(f"running {n} requests over ~{args.duration:.0f}s "
+            f"({args.mode}-loop, seed {args.seed})")
+        report = run(dict(spec), transport, log=log, fault_cb=fault_cb)
+        mm1 = _mismatch_total(transport.get_text("/metrics"))
+        growth = max(0.0, mm1 - mm0)
+        report["mismatch_growth"] = growth
+        report["objectives"]["correctness"]["measured"] = growth
+        if growth > 0:
+            report["objectives"]["correctness"]["verdict"] = "VIOLATED"
+            report["verdict"] = "VIOLATED"
+        server_slo = transport.get_json("/debug/slo")
+        if server_slo is not None:
+            # The server's own judgment rides along so the report and
+            # the pilosa_slo_* families can be cross-checked.
+            report["server_slo"] = {
+                "verdict": server_slo.get("verdict"),
+                "objectives": {
+                    k: {"budget_remaining": v.get("budget_remaining"),
+                        "fastest_burn": v.get("fastest_burn"),
+                        "verdict": v.get("verdict")}
+                    for k, v in server_slo.get("objectives",
+                                               {}).items()},
+            }
+        if fault_rules:
+            from pilosa_tpu import fault as _fault
+            report["faults_fired"] = len(_fault.log())
+            _fault.reset()
+    finally:
+        if srv is not None:
+            srv.close()
+
+    path = args.report or f"LOADGEN_{args.seed}.json"
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"report -> {path}")
+    log(f"verdict: {report['verdict']} "
+        f"(qps {report['achieved_qps']}, shed {report['shed_rate']}, "
+        f"error {report['error_rate']}, mismatches "
+        f"{report['mismatch_growth']})")
+    return 0 if report["verdict"] == "OK" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
