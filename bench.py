@@ -360,14 +360,16 @@ def main():
                   [sys.executable, os.path.abspath(__file__)],
                   _cpu_reexec_env())
 
-    # -- Pallas availability probe (r5) --------------------------------------
-    # The coarse Pallas kernels beat the XLA gather programs on-chip
-    # (PROFILE_RELAY.md §4: 1.14-1.25x single query, and the shared
-    # batch kernel 857 vs 689 QPS over the plain batch at headline
-    # scale), but the r3/r4 relay HUNG any pallas compile — so the
-    # serving default stays XLA and the bench opts in only after
-    # proving a trivial kernel compiles, under its own watchdog: a
-    # hang re-execs this process with pallas pinned off.
+    # -- Count-backend calibration (r5 probe -> measured race) ---------------
+    # The serving default is "auto": ops/calibrate.py runs the trivial-
+    # kernel canary (the r5 probe — the r3/r4 relay HUNG any pallas
+    # compile) and then a timed CSA-Pallas-vs-fused-XLA race on a
+    # representative uniform coarse shape, and dispatch routes through
+    # the winner. The bench forces the resolution up front so every
+    # section below runs on the calibrated backend, under its own
+    # watchdog belt: calibrate has an internal bounded wait, but a hang
+    # before that wait arms (import, canary) re-execs with pallas
+    # pinned off.
     if on_tpu and os.environ.get("PILOSA_TPU_COUNT_BACKEND") is None:
         mode = os.environ.get("PILOSA_TPU_PALLAS", "probe")
         if mode == "on":
@@ -377,23 +379,25 @@ def main():
 
             def pallas_watchdog():
                 if not pallas_done.wait(float(os.environ.get(
-                        "PILOSA_TPU_PALLAS_TIMEOUT", "120"))):
-                    _progress("pallas probe hung; re-running with "
+                        "PILOSA_TPU_PALLAS_TIMEOUT", "150"))):
+                    _progress("count calibration hung; re-running with "
                               "pallas off")
                     os.execve(sys.executable,
                               [sys.executable, os.path.abspath(__file__)],
                               dict(os.environ, PILOSA_TPU_PALLAS="off"))
 
             threading.Thread(target=pallas_watchdog, daemon=True).start()
-            from pilosa_tpu.ops.kernels import pallas_probe_ok
+            from pilosa_tpu.ops.calibrate import calibrate_count_backend
 
-            pallas_ok = pallas_probe_ok()
-            if not pallas_ok:
-                _progress("pallas probe failed; staying on xla")
+            cal = calibrate_count_backend()
             pallas_done.set()
-            if pallas_ok:
-                os.environ["PILOSA_TPU_COUNT_BACKEND"] = "pallas"
-                _progress("pallas probe OK; count backend = pallas")
+            _progress("count calibration: backend=%s source=%s" % (
+                cal.get("backend"), cal.get("source")))
+        else:
+            # "off": pin xla explicitly — the auto default would
+            # otherwise re-enter the pallas race this mode exists to
+            # avoid (the hang-recovery re-exec path).
+            os.environ["PILOSA_TPU_COUNT_BACKEND"] = "xla"
 
     # -- run budget + headline checkpoint (VERDICT r3 #1) --------------------
     # The headline config runs FIRST and its result is checkpointed the
@@ -515,7 +519,11 @@ def main():
         "host_baseline": "ops/native.py C++ kernels "
                          "(assembly stand-in; no Go toolchain)",
         "host_cores": ncores,
-        "count_backend": os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")}
+        "count_backend": os.environ.get("PILOSA_TPU_COUNT_BACKEND", "auto")}
+    from pilosa_tpu.ops.calibrate import calibration_snapshot
+
+    if calibration_snapshot() is not None:
+        details["diagnostics"]["count_calibration"] = calibration_snapshot()
 
     # -- headline (config 5): 1B-column Intersect+Count through serving ------
     _progress(f"headline: building {num_slices}-slice {head_rows}-row "
@@ -551,6 +559,12 @@ def main():
     details["diagnostics"]["stage_gbps"] = pool_bytes / 1e9 / stage_s
     details["diagnostics"]["h2d_dispatch_s"] = \
         mgr.stats["h2d_dispatch_us"] / 1e6
+    # Which staging path ran (chunks > 1 proves the pipelined packer)
+    # and which count backend the calibrator actually routed to.
+    details["diagnostics"]["h2d_chunks"] = mgr.stats["h2d_chunks"]
+    details["diagnostics"]["h2d_chunk_slices"] = \
+        mgr.stats["h2d_chunk_slices"]
+    details["diagnostics"]["count_backend_resolved"] = mgr._count_backend()
 
     _progress("headline: first serving query (compile)")
     t_c0 = time.perf_counter()
@@ -767,6 +781,119 @@ def main():
         (bsz / bdt) / host_mt_qps
     details["mapreduce_count"]["throughput_distinct_pairs"] = bsz
     set_headline()  # TPU rows survive any later stall from here on
+
+    with section("staging_bandwidth"):
+        # Pipelined H2D staging priced on its own: a second cold stage
+        # of the headline pool straight through build_sharded_index,
+        # profiled, against the r5b relay floor of 0.0094 GB/s — the
+        # chunked packer-thread pipeline must clear 10x that floor or
+        # staging has regressed to the serial pack-then-put shape.
+        _progress("staging: profiled cold re-stage of the headline pool")
+        from pilosa_tpu.obs import profile as _sprof
+        from pilosa_tpu.parallel.mesh import build_sharded_index as _bsi
+
+        bms = [h.fragment("i", "general", "standard", s_).storage
+               for s_ in range(num_slices)]
+        st1: dict = {}
+        prof = _sprof.QueryProfile()
+        tok = _sprof.activate(prof)
+        t_s0 = time.perf_counter()
+        try:
+            idx_cold = _bsi(bms, mgr.mesh, stats_out=st1)[0]
+            idx_cold.words.block_until_ready()
+        finally:
+            _sprof.deactivate(tok)
+            prof.finish()
+        t_stage = time.perf_counter() - t_s0
+        pd = prof.to_dict()
+        cold_bytes = st1["h2d_bytes"]
+        gbps = cold_bytes / 1e9 / t_stage
+        idx_cold = None  # noqa: F841 — drop the duplicate pool first
+
+        # Overlap proof: the same stage again WHILE the batched
+        # headline program executes on the already-resident pool — the
+        # chunk transfers stream between kernel launches, so the
+        # combined wall must undercut the serial sum on-chip.
+        n_ex = max(2, min(200, int(t_stage / max(bdt, 1e-4) / 2)))
+
+        def _exec_loop():
+            for _ in range(n_ex):
+                np.asarray(fnb(words_t, start_flat, valid_flat, dmask))
+
+        t0_ = time.perf_counter()
+        _exec_loop()
+        t_exec = time.perf_counter() - t0_
+        th = threading.Thread(target=_exec_loop)
+        t0_ = time.perf_counter()
+        th.start()
+        idx2 = _bsi(bms, mgr.mesh)[0]
+        idx2.words.block_until_ready()
+        th.join()
+        t_both = time.perf_counter() - t0_
+        idx2 = None  # noqa: F841
+        overlap = (t_stage + t_exec - t_both) / max(
+            min(t_stage, t_exec), 1e-9)
+        details["staging_bandwidth"] = {
+            "cold_stage_s": t_stage,
+            "cold_stage_bytes": cold_bytes,
+            "cold_stage_gbps": gbps,
+            "h2d_chunks": st1["h2d_chunks"],
+            "h2d_chunk_slices": st1["h2d_chunk_slices"],
+            "chunk_mb": int(os.environ.get(
+                "PILOSA_TPU_STAGE_CHUNK_MB", "64")),
+            "profile_phases_us": pd["phases_us"],
+            "profile_bytes_staged": pd["bytes"].get("bytes_staged", 0),
+            "r5b_floor_gbps": 0.0094,
+            "vs_r5b_floor": gbps / 0.0094,
+            "exec_alone_s": t_exec,
+            "stage_plus_exec_serial_s": t_stage + t_exec,
+            "stage_with_exec_concurrent_s": t_both,
+            "overlap_recovered_frac": overlap}
+        # Both gates are TPU acceptance criteria: the floor is an r5b
+        # RELAY number, and a CPU fallback run's python pack loop sits
+        # legitimately near it — recorded there, asserted here.
+        if on_tpu:
+            assert gbps >= 10 * 0.0094, \
+                f"staging {gbps:.4f} GB/s under 10x the 0.0094 GB/s floor"
+            assert t_both < 0.95 * (t_stage + t_exec), \
+                "no stage/exec overlap: %.2fs vs serial %.2fs" % (
+                    t_both, t_stage + t_exec)
+
+    with section("count_roofline"):
+        # Roofline fraction for BOTH count backends over the same
+        # headline Intersect+Count: bytes touched (two operand rows,
+        # each read once by both the fused-XLA and the CSA Pallas
+        # program) over the measured per-call wall, against the
+        # backend peak table (config.peak_memory_bandwidth). On a CPU
+        # fallback run only xla is priced — interpret-mode pallas wall
+        # prices the Python interpreter, not the kernel.
+        from pilosa_tpu.obs.profile import default_backend as _dbk
+        from pilosa_tpu.obs.profile import peak_bytes_per_s as _peak
+
+        q_bytes = 2 * pool_bytes // head_rows  # two rows of the pool
+        peak = _peak(_dbk())
+        rf = {"bytes_per_query": q_bytes, "peak_gbps": peak / 1e9,
+              "calibrated_backend": mgr._count_backend()}
+        prev_be = os.environ.get("PILOSA_TPU_COUNT_BACKEND")
+        try:
+            for be in (("xla", "pallas") if on_tpu else ("xla",)):
+                _progress(f"count roofline: {be}")
+                os.environ["PILOSA_TPU_COUNT_BACKEND"] = be
+                cnt_be, call_be = serve_count_call(
+                    e, "i", pql, list(range(num_slices)))
+                assert cnt_be == host_count, (be, cnt_be, host_count)
+                dt_be = best_of(call_be, reps, max(2, iters // 4))
+                bps = q_bytes / dt_be
+                rf[be] = {"mean_ms": dt_be * 1e3,
+                          "achieved_gbps": bps / 1e9,
+                          "roofline_fraction": (bps / peak) if peak
+                          else 0.0}
+        finally:
+            if prev_be is None:
+                os.environ.pop("PILOSA_TPU_COUNT_BACKEND", None)
+            else:
+                os.environ["PILOSA_TPU_COUNT_BACKEND"] = prev_be
+        details["count_roofline"] = rf
 
     # The checkpoint exists; from here EVERYTHING runs inside section()
     # so no later failure can lose the headline. best_dt/headline_call

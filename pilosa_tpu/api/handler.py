@@ -1064,6 +1064,17 @@ class Handler:
                 except Exception:  # noqa: BLE001 — debug never 500s
                     pass
             snap = dict(snap, mesh=mesh_snap)
+        # Count-backend calibration: the measured Pallas-vs-XLA record
+        # behind the "auto" dispatch (None until first resolution). The
+        # acceptance trail for "the calibrator picked the faster
+        # backend" lives HERE, not in a log line.
+        try:
+            from ..ops.calibrate import calibration_snapshot
+            cal = calibration_snapshot()
+            if cal is not None:
+                snap = dict(snap, count_calibration=cal)
+        except Exception:  # noqa: BLE001 — debug never 500s
+            pass
         hc = getattr(self.executor, "host_cache_stats", None)
         if hc:
             snap = dict(snap, host_cache=dict(hc))

@@ -84,6 +84,15 @@ TOML schema:
                                 # serves it meanwhile)
     quarantine-ttl = "60s"      # how long a quarantined plan signature
                                 # stays off the device path
+    stage-chunk-mb = 64         # H2D staging chunk: shards larger than
+                                # this pipeline as chunked device_puts
+                                # with packing double-buffered against
+                                # the transfer (PILOSA_TPU_STAGE_CHUNK_MB
+                                # env wins when set)
+    count-backend = "auto"      # count dispatch: auto (measured
+                                # startup calibration, ops/calibrate),
+                                # pallas, xla, pallas_interpret
+                                # (PILOSA_TPU_COUNT_BACKEND env wins)
 
     [storage]
     fsync-policy = "group"      # never | group | always: what an acked
@@ -292,6 +301,12 @@ class Config:
         self.mesh_hbm_headroom: float = 0.15
         self.mesh_quarantine_after: int = 2
         self.mesh_quarantine_ttl: float = 60.0
+        # Staging chunk size (mesh._stage_chunk_bytes) and the count
+        # backend dispatch ("auto" = measured calibration). Both are
+        # applied as process-env DEFAULTS at server boot — an explicit
+        # PILOSA_TPU_STAGE_CHUNK_MB / PILOSA_TPU_COUNT_BACKEND wins.
+        self.mesh_stage_chunk_mb: int = 64
+        self.mesh_count_backend: str = "auto"
         # [storage] — durable sustained-write ingest (core/wal.py):
         # group-commit fsync policy, WAL bound + backpressure deadline,
         # snapshot threshold override (0 = fragment default).
@@ -416,6 +431,10 @@ class Config:
                                              c.mesh_quarantine_after))
         if "quarantine-ttl" in me:
             c.mesh_quarantine_ttl = parse_duration(me["quarantine-ttl"])
+        c.mesh_stage_chunk_mb = int(me.get("stage-chunk-mb",
+                                           c.mesh_stage_chunk_mb))
+        c.mesh_count_backend = str(me.get("count-backend",
+                                          c.mesh_count_backend))
         st = data.get("storage", {})
         c.storage_fsync_policy = str(st.get("fsync-policy",
                                             c.storage_fsync_policy))
@@ -477,7 +496,23 @@ class Config:
             "hbm_headroom": self.mesh_hbm_headroom,
             "quarantine_after": self.mesh_quarantine_after,
             "quarantine_ttl": self.mesh_quarantine_ttl,
+            "stage_chunk_mb": self.mesh_stage_chunk_mb,
+            "count_backend": self.mesh_count_backend,
         }
+
+    def apply_mesh_env(self) -> None:
+        """Install the [mesh] staging/backend knobs as process-env
+        DEFAULTS (setdefault — an explicitly exported env var wins).
+        The consumers are module-level hot-path functions
+        (mesh._stage_chunk_bytes, serve._count_backend) that read env,
+        so config flows through the same single resolution point
+        instead of a parallel plumbing path."""
+        import os
+
+        os.environ.setdefault("PILOSA_TPU_STAGE_CHUNK_MB",
+                              str(self.mesh_stage_chunk_mb))
+        os.environ.setdefault("PILOSA_TPU_COUNT_BACKEND",
+                              str(self.mesh_count_backend))
 
     def slo_objectives(self) -> dict:
         """The [slo] targets keyed the way obs.slo.SLORecorder expects
@@ -559,6 +594,8 @@ class Config:
             f"quarantine-after = {self.mesh_quarantine_after}\n"
             f'quarantine-ttl = '
             f'"{int(self.mesh_quarantine_ttl * 1000)}ms"\n'
+            f"stage-chunk-mb = {self.mesh_stage_chunk_mb}\n"
+            f'count-backend = "{self.mesh_count_backend}"\n'
             + f"\n[storage]\n"
             f'fsync-policy = "{self.storage_fsync_policy}"\n'
             f"group-commit-window-us = "

@@ -12,6 +12,7 @@ elsewhere) is the analog of the reference's hasAsm runtime dispatch
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -23,8 +24,88 @@ from jax.experimental.pallas import tpu as pltpu
 from .bitops import BINARY_OPS, count_pair, fold_tree
 from .pool import CONTAINER_WORDS, ROW_SPAN
 
-# Rows of 2048-word containers processed per grid step (512 KB/input block).
-_BLOCK_M = 64
+# Max rows of 2048-word containers per pairwise grid step: two operand
+# blocks, each Mosaic-double-buffered, at 256 rows bill 8 MB of the
+# 16 MB VMEM window (same budget note as _uniform_pick_t). Bigger
+# blocks mean fewer grid steps, so less per-step DMA issue overhead on
+# large inputs; _pair_pick_block shrinks the block (and the padding
+# waste) for small ones.
+_BLOCK_M = 256
+
+
+def _pair_pick_block(m: int) -> int:
+    """Rows per grid step for the pairwise kernel: the full _BLOCK_M
+    when the input fills it, else the input rounded up to the 8-sublane
+    tile so a small pair runs as ONE grid step with < 8 rows of
+    zero-padding (the old fixed 64-row block padded a 1-row pair to
+    64)."""
+    if m >= _BLOCK_M:
+        return _BLOCK_M
+    return max(8, -(-m // 8) * 8)
+
+
+# -- carry-save (Harley-Seal) popcount accumulation --------------------------
+#
+# Every count kernel's epilogue is "popcount each word, sum to a
+# scalar". The carry-save-adder ladder (Faster Population Counts Using
+# AVX2 Instructions, arXiv:1611.07612 §2; blocked positional scheme in
+# arXiv:2412.16370) folds EIGHT word slabs into four accumulator slabs
+# (ones/twos/fours/eights) with 16 cheap bitwise VPU ops, then
+# popcounts only the accumulators — half the popcount volume at
+# one-eighth-volume bitwise cost. That wins exactly when the backend
+# lowers lax.population_count as a multi-op SWAR sequence rather than
+# one native instruction, which is hardware-dependent — so the backend
+# *choice* is measured (ops/calibrate.py), and the ladder itself can be
+# pinned off with PILOSA_TPU_CSA=0 (read at trace time; compiled
+# programs keep whichever epilogue they were traced with).
+
+
+def _csa_enabled() -> bool:
+    return os.environ.get("PILOSA_TPU_CSA", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def _csa(a, b, c):
+    """One carry-save adder: (sum, carry) bit-planes of a + b + c."""
+    u = a ^ b
+    return u ^ c, (a & b) | (u & c)
+
+
+def csa_popcount_sum(v, *, force: bool | None = None):
+    """Scalar int32 popcount-sum of a uint-word array.
+
+    The leading dims collapse and split into eight contiguous row
+    slabs — both are leading-dim reshapes, which are layout-preserving
+    on Mosaic (no lane retiling; see _runs_view) — and one seven-CSA
+    ladder reduces them. Exact: sum-of-bits = pc(ones) + 2*pc(twos) +
+    4*pc(fours) + 8*pc(eights) by the carry-save invariant. Falls back
+    to the naive popcount-everything epilogue when the row count is
+    not a multiple of 8 or the ladder is disabled (`force` overrides
+    the env gate for differential tests; works outside kernels too,
+    so tests exercise the ladder directly)."""
+    def naive(x):
+        return jnp.sum(lax.population_count(x).astype(jnp.int32))
+
+    lanes = v.shape[-1]
+    rows = 1
+    for d in v.shape[:-1]:
+        rows *= d
+    use = _csa_enabled() if force is None else force
+    if not use or rows < 8 or rows % 8 != 0:
+        return naive(v)
+    w = v.reshape(8, rows // 8, lanes)
+    ones = w[0] ^ w[1]
+    twos_a = w[0] & w[1]
+    ones, twos_b = _csa(ones, w[2], w[3])
+    twos = twos_a ^ twos_b
+    fours_a = twos_a & twos_b
+    ones, twos_a = _csa(ones, w[4], w[5])
+    ones, twos_b = _csa(ones, w[6], w[7])
+    twos, fours_b = _csa(twos, twos_a, twos_b)
+    fours = fours_a ^ fours_b
+    eights = fours_a & fours_b
+    return (naive(ones) + 2 * naive(twos) + 4 * naive(fours)
+            + 8 * naive(eights))
 
 
 def pallas_probe_ok() -> bool:
@@ -49,14 +130,22 @@ def pallas_probe_ok() -> bool:
 def use_pallas() -> bool:
     """True when the Pallas TPU path should be used.
 
-    Measured on a real v5e chip (960-slice 1B-column Intersect+Count,
-    2026-07): XLA flat-gather 5.1 ms, Pallas streaming kernel 7.4 ms —
-    the slab scan's multiple launches each pay the dispatch floor, so
-    XLA stays the default count backend (PILOSA_TPU_COUNT_BACKEND=pallas
-    opts in; both backends are hardware-validated and differentially
-    tested). This dispatch gate covers the pairwise kernels, where
-    Pallas wins."""
-    return jax.default_backend() == "tpu"
+    Non-TPU backends always answer False (Pallas interpret mode is a
+    test vehicle, never a serving dispatch). On TPU the verdict is no
+    longer a comment-driven constant: PILOSA_TPU_COUNT_BACKEND=pallas
+    or =xla pins it, and the default ("auto") asks ops/calibrate.py,
+    which measures both backends once per process on a representative
+    shape — under the same probe watchdog the serving layer uses — and
+    caches (optionally persists) the winner. The historical context
+    the constant encoded (r5 v5e: XLA flat-gather 5.1 ms vs Pallas
+    slab-scan 7.4 ms on the 960-slice Intersect+Count, but coarse
+    Pallas 1.7-5.2x FASTER on native-shape pools) is exactly why a
+    measurement, not a comment, owns this dispatch."""
+    if jax.default_backend() != "tpu":
+        return False
+    from .calibrate import resolve_backend
+
+    return resolve_backend() == "pallas"
 
 
 def _pair_count_kernel(op_name: str, a_ref, b_ref, o_ref):
@@ -66,18 +155,20 @@ def _pair_count_kernel(op_name: str, a_ref, b_ref, o_ref):
     def _init():
         o_ref[0, 0] = jnp.int32(0)
 
-    o_ref[0, 0] += jnp.sum(
-        lax.population_count(op(a_ref[:], b_ref[:])).astype(jnp.int32)
-    )
+    o_ref[0, 0] += csa_popcount_sum(op(a_ref[:], b_ref[:]))
 
 
 @functools.partial(jax.jit, static_argnames=("op", "interpret"))
 def _pallas_pair_count(a, b, op: str = "and", interpret: bool = False):
     m = a.shape[0]
-    grid = (max(1, (m + _BLOCK_M - 1) // _BLOCK_M),)
+    block = _pair_pick_block(m)
+    grid = (max(1, (m + block - 1) // block),)
     # Zero-pad to a block multiple: padding contributes no set bits for
-    # any of the four ops (0 op 0 == 0).
-    padded = grid[0] * _BLOCK_M
+    # any of the four ops (0 op 0 == 0). Each operand streams HBM->VMEM
+    # exactly once — the grid blocks are disjoint row slabs and Mosaic
+    # double-buffers them, so block i+1 prefetches under block i's
+    # fold+popcount.
+    padded = grid[0] * block
     if padded != m:
         pad = ((0, padded - m), (0, 0))
         a = jnp.pad(a, pad)
@@ -87,8 +178,8 @@ def _pallas_pair_count(a, b, op: str = "and", interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_M, CONTAINER_WORDS), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_M, CONTAINER_WORDS), lambda i: (i, 0)),
+            pl.BlockSpec((block, CONTAINER_WORDS), lambda i: (i, 0)),
+            pl.BlockSpec((block, CONTAINER_WORDS), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         interpret=interpret,
@@ -156,8 +247,7 @@ def _tree_count_kernel(tree, num_leaves, idx_ref, hit_ref, *refs):
         keep = hit_ref[i, s, j] != 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
-    o_ref[0, 0] += jnp.sum(
-        lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+    o_ref[0, 0] += csa_popcount_sum(fold_tree(tree, leaf))
 
 
 # SMEM budget for one pallas_call's scalar-prefetch tables: the
@@ -204,8 +294,7 @@ def _coarse_count_kernel(tree, num_leaves, starts_ref, *refs):
         keep = starts_ref[i, s] >= 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
-    o_ref[0, s] = jnp.sum(
-        lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+    o_ref[0, s] = csa_popcount_sum(fold_tree(tree, leaf))
 
 
 def coarse_count_per_slice(views, starts, tree, *,
@@ -263,8 +352,7 @@ def _identity_batch_kernel(tree, num_leaves, starts_ref, *refs):
         keep = starts_ref[b * num_leaves + i, s] >= 0
         return jnp.where(keep, blk, jnp.uint32(0))
 
-    o_ref[b, s] = jnp.sum(
-        lax.population_count(fold_tree(tree, leaf)).astype(jnp.int32))
+    o_ref[b, s] = csa_popcount_sum(fold_tree(tree, leaf))
 
 
 def coarse_count_identity_batch(pools, starts, tree, *,
@@ -362,8 +450,7 @@ def _uniform_kernel(tree, num_leaves, t, starts_ref, *refs):
     # into SMEM, but not vector-element extracts (a partial
     # axis=(1,2,3) reduce + per[j] store fails "Invalid input layout").
     for j in range(t):
-        o_ref[0, base + j] = jnp.sum(
-            lax.population_count(folded[j]).astype(jnp.int32))
+        o_ref[0, base + j] = csa_popcount_sum(folded[j])
 
 
 def coarse_count_uniform(views, starts, tree, *,
@@ -420,8 +507,7 @@ def _uniform_batch_kernel(tree, num_leaves, t, starts_ref, *refs):
 
     folded = fold_tree(tree, leaf)
     for j in range(t):
-        o_ref[b, base + j] = jnp.sum(
-            lax.population_count(folded[j]).astype(jnp.int32))
+        o_ref[b, base + j] = csa_popcount_sum(folded[j])
 
 
 def coarse_count_uniform_batch(pools, starts, tree, *,
@@ -473,10 +559,8 @@ def _coarse_batch_kernel(tree, leaf_map, num_unique, starts_ref, *refs):
         keep = starts_ref[u, s] >= 0
         blocks.append(jnp.where(keep, blk, jnp.uint32(0)))
     for b, lm in enumerate(leaf_map):
-        cnt = jnp.sum(lax.population_count(
-            fold_tree(tree, lambda i, lm=lm: blocks[lm[i]])
-        ).astype(jnp.int32))
-        o_ref[b, s] = cnt
+        o_ref[b, s] = csa_popcount_sum(
+            fold_tree(tree, lambda i, lm=lm: blocks[lm[i]]))
 
 
 def coarse_count_batch_per_slice(views, starts, tree, leaf_map, *,
@@ -548,8 +632,7 @@ def _shared_uniform_kernel(tree, leaf_map, num_unique, t,
     for b, lm in enumerate(leaf_map):
         folded = fold_tree(tree, lambda i, lm=lm: blocks[lm[i]])
         for j in range(t):
-            o_ref[b, base + j] = jnp.sum(
-                lax.population_count(folded[j]).astype(jnp.int32))
+            o_ref[b, base + j] = csa_popcount_sum(folded[j])
 
 
 def coarse_count_shared_uniform(views, starts, tree, leaf_map, *,

@@ -78,18 +78,72 @@ class ShardedIndex(NamedTuple):
 
 def _stage_chunk_bytes() -> int:
     """H2D staging chunk size (PILOSA_TPU_STAGE_CHUNK_MB env, default
-    1024 MB): below the chunk size a shard moves as ONE device_put;
-    above it, as a pipeline of chunk-sized device_puts so host packing
-    of chunk i+1 overlaps the in-flight transfer of chunk i. The
-    default keeps sub-GB shards on the single-put path (no assembly
-    cost) until profiling on the target rig shows the pipeline wins."""
+    64 MB): below the chunk size a shard moves as ONE device_put;
+    above it, as a pipeline of chunk-sized device_puts with host
+    packing double-buffered against the in-flight transfer
+    (_stage_pipeline). The old 1024 MB default meant every sub-GB
+    shard took the single-put path — zero pipelining, pack time and
+    transfer time strictly serial, the shape of the r5b 0.0094 GB/s
+    staging floor. 64 MB is small enough that typical shards cut into
+    several chunks (the headline ~1 GB pool: 16) and large enough
+    that per-put dispatch overhead stays < 1% of a chunk's transfer
+    at PCIe/ICI rates."""
     import os
 
     try:
-        mb = int(os.environ.get("PILOSA_TPU_STAGE_CHUNK_MB", "1024"))
+        mb = int(os.environ.get("PILOSA_TPU_STAGE_CHUNK_MB", "64"))
     except ValueError:
-        mb = 1024
+        mb = 64
     return max(1, mb) << 20
+
+
+def _stage_pipeline(pack_range, ranges, dev, on_chunk=None):
+    """Pipelined chunk transfers for one shard: pack || transfer.
+
+    ranges is the ordered [lo, hi) chunk list. A producer thread packs
+    chunk i+1 while chunk i's device_put dispatches and its async
+    transfer streams; because device_put never blocks, the in-flight
+    transfers additionally overlap device EXECUTION of already-resident
+    work (bench's staging_bandwidth section proves the overlap via the
+    stage_h2d/device_exec profile phases). The queue depth bounds host
+    memory at two packed-but-unshipped chunks. A single-chunk shard
+    skips the thread — no pipeline exists to win there.
+
+    on_chunk(nbytes) fires after each chunk's put dispatches: the
+    per-chunk cumulative byte accounting (every chunk counts toward
+    bytes_staged, not just the final one). Returns the device pieces
+    in range order; a pack error re-raises here, a device_put error
+    propagates with the producer thread parked (daemon, bounded by the
+    queue) for the fallback path to proceed past."""
+    if len(ranges) == 1:
+        host = pack_range(*ranges[0])
+        piece = jax.device_put(host, dev)
+        if on_chunk is not None:
+            on_chunk(host.nbytes)
+        return [piece]
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+
+    def produce():
+        try:
+            for lo, hi in ranges:
+                q.put(("ok", pack_range(lo, hi)))
+        except BaseException as e:  # noqa: BLE001 — surfaced on the
+            # consumer side; the packer must not die silently
+            q.put(("err", e))
+
+    threading.Thread(target=produce, daemon=True, name="h2d-pack").start()
+    pieces = []
+    for _ in ranges:
+        tag, payload = q.get()
+        if tag == "err":
+            raise payload
+        pieces.append(jax.device_put(payload, dev))
+        if on_chunk is not None:
+            on_chunk(payload.nbytes)
+    return pieces
 
 
 _FOLD_CHUNK = None
@@ -150,11 +204,16 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
         re-distribution — on a multi-host mesh each process packs and
         ships only its own slices);
       - each shard moves as a pipeline of chunk-sized device_puts
-        (_stage_chunk_bytes), so packing overlaps the async transfer;
+        (_stage_chunk_bytes, default 64 MB) with a dedicated packer
+        thread (_stage_pipeline): chunk i+1 packs WHILE chunk i's
+        transfer streams, so the wall cost approaches
+        max(pack, transfer) instead of their sum;
       - nothing blocks on completion: the returned arrays are async
         futures and the first query's compile proceeds while the
-        transfer streams. stats_out (if given) gets the host-side
-        dispatch seconds and byte counts for /debug/vars.
+        transfer streams — in-flight chunks also overlap device
+        execution of already-resident work. stats_out (if given) gets
+        the host-side dispatch seconds, byte counts, and the
+        chunk-count proof of which path ran, for /debug/vars.
     """
     import time as _time
 
@@ -208,14 +267,27 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     slice_bytes = cap * CONTAINER_WORDS * 4
     chunk_slices = max(1, _stage_chunk_bytes() // max(1, slice_bytes))
     h2d_bytes = 0
+    h2d_chunks = 0
+
+    def on_chunk(nbytes: int) -> None:
+        # Cumulative per-chunk accounting AS chunks dispatch — a
+        # mid-stage profile dump (or an exception between chunks)
+        # reports the bytes actually shipped, and the chunk count
+        # proves which path (pipelined vs single-put) ran.
+        nonlocal h2d_bytes, h2d_chunks
+        h2d_bytes += nbytes
+        h2d_chunks += 1
+        profile.add_bytes("bytes_staged", nbytes)
+
+    def chunk_ranges(lo: int, hi: int):
+        return [(c, min(c + chunk_slices, hi))
+                for c in range(lo, hi, chunk_slices)]
 
     if mesh is None:
-        pieces = [jax.device_put(pack_range(lo, min(lo + chunk_slices,
-                                                    s_pad)))
-                  for lo in range(0, s_pad, chunk_slices)]
-        h2d_bytes = s_pad * slice_bytes
+        ranges = chunk_ranges(0, s_pad)
+        pieces = _stage_pipeline(pack_range, ranges, None, on_chunk)
         words_arr = _assemble_shard(
-            pieces, list(range(0, s_pad, chunk_slices)),
+            pieces, [r[0] for r in ranges],
             (s_pad, cap, CONTAINER_WORDS), None)
         keys_arr = jnp.asarray(keys)
     else:
@@ -227,12 +299,11 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
             for dev, idxs in imap.items():
                 lo = idxs[0].start or 0
                 hi = idxs[0].stop if idxs[0].stop is not None else s_pad
-                pieces = [jax.device_put(
-                    pack_range(c, min(c + chunk_slices, hi)), dev)
-                    for c in range(lo, hi, chunk_slices)]
-                h2d_bytes += (hi - lo) * slice_bytes
+                ranges = chunk_ranges(lo, hi)
+                pieces = _stage_pipeline(pack_range, ranges, dev,
+                                         on_chunk)
                 shards.append(_assemble_shard(
-                    pieces, [c - lo for c in range(lo, hi, chunk_slices)],
+                    pieces, [c - lo for c, _ in ranges],
                     (hi - lo, cap, CONTAINER_WORDS), dev))
             words_arr = jax.make_array_from_single_device_arrays(
                 shape, sharding, shards)
@@ -255,17 +326,20 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
                                             f"{fb_err}"
             shards = pieces = None  # noqa: F841 — release device refs
             words_arr = jax.device_put(pack_range(0, s_pad), sharding)
-            # += : chunks shipped before the failure were real traffic.
-            h2d_bytes += s_pad * slice_bytes
+            # on_chunk: chunks shipped before the failure were real
+            # traffic and already counted; the whole-pool retry adds
+            # its own bytes on top.
+            on_chunk(s_pad * slice_bytes)
         keys_arr = jax.device_put(keys, sharding)
     if stats_out is not None:
         stats_out["h2d_dispatch_s"] = _time.monotonic() - t0
         stats_out["h2d_bytes"] = h2d_bytes + keys.nbytes
         stats_out["h2d_chunk_slices"] = chunk_slices
+        stats_out["h2d_chunks"] = h2d_chunks
     h2d_sp.tag(h2d_bytes=h2d_bytes + keys.nbytes,
-               chunk_slices=chunk_slices).finish()
+               chunk_slices=chunk_slices, chunks=h2d_chunks).finish()
     h2d_ph.stop()
-    profile.add_bytes("bytes_staged", h2d_bytes + keys.nbytes)
+    profile.add_bytes("bytes_staged", keys.nbytes)
     idx = ShardedIndex(keys=keys_arr, words=words_arr)
     if with_host_keys:
         return idx, row_ids, keys
@@ -351,16 +425,21 @@ def compile_mesh_count(mesh: Mesh, tree_shape, num_leaves: int,
     backend: "xla" = vmapped gather + fused XLA combine, "pallas" =
     fused in-kernel container streaming (ops/kernels.tree_count_pallas),
     "pallas_interpret" = the Pallas kernel in interpret mode
-    (differential tests on CPU). None = auto: the
-    PILOSA_TPU_COUNT_BACKEND env var if set, else "xla" — Pallas
-    compilation hangs through the single-chip axon relay this rig
-    benches on, so it is opt-in until validated on direct-attached TPUs.
+    (differential tests on CPU). None: the PILOSA_TPU_COUNT_BACKEND
+    env var if set, else "xla". "auto" (what config.apply_mesh_env
+    installs as the serving default) resolves through the measured
+    startup calibration (ops/calibrate) the same way the serving
+    layer's dispatch does — xla while a probe is still pending.
     """
     sig = json.dumps(_tree_signature(tree_shape))
     tree = json.loads(sig)
     if backend is None:
         import os
         backend = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+    if backend == "auto":
+        from ..ops.calibrate import resolve_backend
+        backend = "pallas" if resolve_backend(wait=False) == "pallas" \
+            else "xla"
     if backend not in ("xla", "pallas", "pallas_interpret"):
         raise ValueError(f"unknown count backend: {backend!r} "
                          "(want xla, pallas, or pallas_interpret)")

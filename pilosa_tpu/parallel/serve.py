@@ -492,7 +492,10 @@ class MeshManager:
             # fused-plan LRU), so first-shape stalls are attributable
             # from /metrics without a profiler run.
             "compile_count": 0, "compile_us": 0,
-            "h2d_chunk_slices": 0,
+            # Staging pipeline shape of the LAST stage: slices per
+            # chunk, and how many chunked device_puts actually ran
+            # (1 = single-put path, >1 = the pack/transfer pipeline).
+            "h2d_chunk_slices": 0, "h2d_chunks": 0,
             # Drains whose window was held open by a scheduler cohort
             # hint (expect_burst) — how often the sched/ layer actually
             # steered coalescing.
@@ -866,6 +869,7 @@ class MeshManager:
             stage_io.get("h2d_dispatch_s", 0.0) * 1e6))
         self.stats.set("h2d_chunk_slices",
                        stage_io.get("h2d_chunk_slices", 0))
+        self.stats.set("h2d_chunks", stage_io.get("h2d_chunks", 0))
         sp.tag(h2d_bytes=stage_io.get("h2d_bytes", 0),
                h2d_dispatch_us=int(stage_io.get("h2d_dispatch_s", 0.0)
                                    * 1e6))
@@ -1426,86 +1430,54 @@ class MeshManager:
             entry="count")
 
     # "auto" resolution cache: None = unresolved, else "pallas"/"xla".
-    # Process-wide (the probe compiles one trivial kernel; its verdict
-    # holds for every manager in the process).
+    # Process-wide (ops/calibrate.py measures once; its verdict holds
+    # for every manager in the process — this mirror only saves the
+    # cross-module call on the hot dispatch path).
     _AUTO_BACKEND: "Optional[str]" = None
-    _AUTO_MU = threading.Lock()
 
     @classmethod
     def _count_backend(cls) -> str:
-        """PILOSA_TPU_COUNT_BACKEND: "xla" (default), "pallas",
-        "pallas_interpret" (CPU test path), or "auto". r5 hardware
-        measurements (PROFILE_RELAY.md §4): with the pools streamed in
-        native shape the coarse Pallas kernels beat the XLA gather
-        programs 1.7-2.7x single-query, 2.2x at herd width 16, and
-        5.2x on the 28-pair shared batch. The default stays XLA
-        because a relay regression re-introducing the r3/r4
-        Pallas-compile hang would wedge a server at first query;
-        bench.py probes Pallas IN-PROCESS under a watchdog that
-        re-execs the bench with pallas pinned off on a hang.
-
-        "auto" (opt-in) does that probe here, once, at first use: a
-        trivial kernel compiles under a watchdog
-        (PILOSA_TPU_PALLAS_PROBE_TIMEOUT_S, default 60); pass →
-        pallas, fail or non-TPU backend → xla. On a hang the probe
-        thread is abandoned (daemon) and pallas is pinned off for the
-        process — on rigs whose transport serializes compiles with
-        dispatch the hung compile can still wedge later traffic,
-        which is WHY auto is opt-in and the hang verdict is cached; on
-        direct-attached TPUs there is no known hang class and auto is
-        the recommended server setting."""
+        """PILOSA_TPU_COUNT_BACKEND: "auto" (default), "pallas",
+        "pallas_interpret" (CPU test path), or "xla". The explicit
+        values pin the dispatch; "auto" resolves through the measured
+        startup calibration (ops/calibrate.py): trivial-kernel canary
+        probe, then a timed Pallas-vs-XLA race on a representative
+        uniform coarse-count shape, winner cached per process (and per
+        device kind via PILOSA_TPU_CALIBRATION_FILE). The whole
+        resolution runs in an abandonable daemon thread under a
+        bounded wait, so the r3/r4 relay class of hung Pallas compiles
+        verdicts "xla" instead of wedging the server — the reason the
+        old default hardcoded XLA. Non-TPU backends resolve instantly
+        to "xla". The record behind the verdict is surfaced at
+        /debug/vars under "count_calibration"."""
         import os
 
-        v = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "xla")
+        v = os.environ.get("PILOSA_TPU_COUNT_BACKEND", "auto")
         if v == "auto":
             return cls._resolve_auto_backend()
-        return v if v in ("pallas", "pallas_interpret") else "xla"
+        if v not in ("pallas", "pallas_interpret", "xla"):
+            # A typo'd pin degrades to the conservative constant — it
+            # must NOT trigger the probe the operator was pinning away
+            # from (and must not memoize a verdict into _AUTO_BACKEND).
+            return "xla"
+        return v
 
     @classmethod
     def _resolve_auto_backend(cls) -> str:
-        # Lock-free fast path: the verdict is written once, under the
-        # lock; reading a stale None merely re-enters the arbitration
-        # below. Queries arriving DURING the (up to 60 s) probe serve
-        # on xla instead of blocking behind it — the compile keys
-        # differ per backend, so the switch mid-stream is safe.
+        # Lock-free fast path: the verdict is written once; reading a
+        # stale None merely re-enters the resolution below. Queries
+        # arriving DURING the (bounded) calibration serve on xla
+        # (wait=False) instead of blocking behind it — the compile
+        # keys differ per backend, so the switch mid-stream is safe.
         v = cls._AUTO_BACKEND
         if v is not None:
             return v
-        if not cls._AUTO_MU.acquire(blocking=False):
-            return "xla"
-        try:
-            if cls._AUTO_BACKEND is not None:
-                return cls._AUTO_BACKEND
-            import os
+        from ..ops.calibrate import calibration_snapshot, resolve_backend
 
-            import jax
-
-            if jax.default_backend() != "tpu":
-                cls._AUTO_BACKEND = "xla"
-                return "xla"
-            try:
-                timeout = float(os.environ.get(
-                    "PILOSA_TPU_PALLAS_PROBE_TIMEOUT_S", "60"))
-            except ValueError:  # malformed env: degrade, don't crash
-                timeout = 60.0
-            ok_box = {"ok": False}
-            done = threading.Event()
-
-            def probe():
-                from ..ops.kernels import pallas_probe_ok
-
-                try:
-                    ok_box["ok"] = pallas_probe_ok()
-                finally:
-                    done.set()
-
-            threading.Thread(target=probe, daemon=True,
-                             name="pallas-auto-probe").start()
-            done.wait(timeout)
-            cls._AUTO_BACKEND = "pallas" if ok_box["ok"] else "xla"
-            return cls._AUTO_BACKEND
-        finally:
-            cls._AUTO_MU.release()
+        b = "pallas" if resolve_backend(wait=False) == "pallas" else "xla"
+        if calibration_snapshot() is not None:  # resolved, not provisional
+            cls._AUTO_BACKEND = b
+        return b
 
     def _uniform_starts(self, coarse_ts):
         """(B*L,) int32 scalar starts for the uniform Pallas programs,

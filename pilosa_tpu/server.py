@@ -182,6 +182,10 @@ class Server:
                              "(want static, http, gossip, or spmd)")
         self.holder.broadcaster = self.broadcaster
 
+        # Staging/backend knobs become env defaults BEFORE the executor
+        # (and any staging or backend resolution) exists; an exported
+        # env var still wins.
+        self.config.apply_mesh_env()
         use_device = self.config.use_device_flag()
         if self.spmd is not None and self._spmd_rank != 0:
             # A worker's executor must NEVER drive mesh collectives by
@@ -189,6 +193,21 @@ class Server:
             # every rank); HTTP queries landing here serve from the
             # host roaring path over the replicated holder.
             use_device = False
+        if use_device is not False:
+            # Resolve the count backend NOW instead of lazily on the
+            # first coarse-eligible count: the /debug/vars
+            # count_calibration record exists as soon as the server is
+            # up, and a TPU boot absorbs the (bounded, abandonable)
+            # measurement before traffic arrives. A pinned
+            # PILOSA_TPU_COUNT_BACKEND returns without measuring.
+            def _kick():
+                try:
+                    from .ops.calibrate import resolve_backend
+                    resolve_backend()
+                except Exception:  # noqa: BLE001 — boot never dies here
+                    pass
+            threading.Thread(target=_kick, daemon=True,
+                             name="count-calibrate-boot").start()
         self.executor = Executor(
             self.holder, host=self.host, cluster=self.cluster,
             client=self.client, use_device=use_device,

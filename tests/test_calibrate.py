@@ -1,0 +1,111 @@
+"""Count-backend auto-calibration: the machinery end to end on CPU.
+
+The real measurement runs on TPU at startup; here the forced
+interpret-mode path exercises probe -> cross-check -> timed race ->
+verdict -> cache -> routing, so a broken calibrator fails tier-1
+instead of silently pinning the wrong serving backend on-chip.
+"""
+
+import time
+
+import pytest
+
+from pilosa_tpu.ops import calibrate
+from pilosa_tpu.ops.kernels import use_pallas
+from pilosa_tpu.parallel.serve import MeshManager
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    calibrate.reset_for_tests()
+    monkeypatch.setattr(MeshManager, "_AUTO_BACKEND", None)
+    for var in ("PILOSA_TPU_COUNT_BACKEND", "PILOSA_TPU_CALIBRATION_FILE",
+                "PILOSA_TPU_CALIBRATE"):
+        monkeypatch.delenv(var, raising=False)
+    # Tiny measurement shape: the forced interpret-mode race must cost
+    # milliseconds in CI, not minutes.
+    monkeypatch.setenv("PILOSA_TPU_CALIBRATE_SLICES", "4")
+    monkeypatch.setenv("PILOSA_TPU_CALIBRATE_ROWS", "2")
+    yield
+    calibrate.reset_for_tests()
+
+
+def test_non_tpu_resolves_instantly_to_xla():
+    rec = calibrate.calibrate_count_backend()
+    assert rec["backend"] == "xla"
+    assert rec["source"] == "non-tpu"
+    assert calibrate.resolve_backend() == "xla"
+    assert calibrate.calibration_snapshot()["source"] == "non-tpu"
+    assert use_pallas() is False
+
+
+def test_forced_measurement_picks_a_backend():
+    # The CI smoke: the calibrator must run a REAL race (interpret
+    # mode on CPU), pick some backend, record both timings, and route
+    # subsequent resolution through the winner.
+    rec = calibrate.calibrate_count_backend(force_measure=True)
+    assert rec["source"] == "measured"
+    assert rec["backend"] in ("pallas", "xla")
+    assert rec["pallas_ms"] > 0 and rec["xla_ms"] > 0
+    assert rec["interpret"] is True
+    assert rec["shape"] == {"slices": 4, "capacity": 32}
+    snap = calibrate.calibration_snapshot()
+    assert snap["backend"] == rec["backend"]
+    assert calibrate.resolve_backend() == rec["backend"]
+    # Second call returns the cached record without re-measuring.
+    assert calibrate.calibrate_count_backend() is rec
+
+
+def test_env_pin_bypasses_calibration(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "pallas")
+    assert calibrate.resolve_backend() == "pallas"
+    assert calibrate.calibration_snapshot() is None  # never measured
+    monkeypatch.setenv("PILOSA_TPU_COUNT_BACKEND", "xla")
+    assert calibrate.resolve_backend() == "xla"
+
+
+def test_cache_file_roundtrip(tmp_path, monkeypatch):
+    path = tmp_path / "cal.json"
+    monkeypatch.setenv("PILOSA_TPU_CALIBRATION_FILE", str(path))
+    rec = calibrate.calibrate_count_backend(force_measure=True)
+    assert rec["source"] == "measured"
+    assert path.exists()
+    # A fresh process (reset) on the same device reuses the verdict.
+    calibrate.reset_for_tests()
+    rec2 = calibrate.calibrate_count_backend(force_measure=True)
+    assert rec2["source"] == "cache-file"
+    assert rec2["backend"] == rec["backend"]
+    assert rec2["device"] == rec["device"]
+
+
+def test_measurement_timeout_verdicts_xla(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_CALIBRATE_TIMEOUT_S", "0.2")
+
+    def slow_measure(interpret):
+        time.sleep(3)
+        return {"backend": "pallas", "source": "measured"}
+
+    monkeypatch.setattr(calibrate, "_measure", slow_measure)
+    rec = calibrate.calibrate_count_backend(force_measure=True)
+    assert rec["backend"] == "xla"
+    assert rec["source"] == "timeout"
+
+
+def test_measurement_error_verdicts_xla(monkeypatch):
+    def broken_measure(interpret):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(calibrate, "_measure", broken_measure)
+    rec = calibrate.calibrate_count_backend(force_measure=True)
+    assert rec["backend"] == "xla"
+    assert rec["source"] == "error"
+    assert "boom" in rec["error"]
+
+
+def test_serving_layer_routes_through_calibration():
+    # MeshManager's "auto" resolution must agree with the calibrator
+    # and memoize the verdict in its dispatch-path mirror.
+    rec = calibrate.calibrate_count_backend(force_measure=True)
+    want = "pallas" if rec["backend"] == "pallas" else "xla"
+    assert MeshManager._count_backend() == want
+    assert MeshManager._AUTO_BACKEND == want

@@ -1,0 +1,282 @@
+"""Differential suite for the CSA count kernels.
+
+Three independent implementations of every count must agree bit-exact:
+the Pallas kernels (interpret mode on the CPU suite), the fused-XLA
+fold (ops/bitops), and a host fold over the same words (numpy, with a
+roaring-built pool as the end-to-end model). Random dense + sparse
+pools, plus the edge widths the CSA ladder and the block padding must
+survive: empty rows, a last partial block, a single set word.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.ops import build_pool, count_pair, fused_pair_count, gather_row
+from pilosa_tpu.ops.kernels import (
+    _BLOCK_M,
+    _pair_pick_block,
+    coarse_count_per_slice,
+    coarse_count_uniform,
+    csa_popcount_sum,
+)
+from pilosa_tpu.roaring import Bitmap
+
+W = 2048  # container words
+ROW_SPAN = 16  # containers per row
+
+HOST_OPS = {
+    "and": np.bitwise_and,
+    "or": np.bitwise_or,
+    "xor": np.bitwise_xor,
+    "andnot": lambda a, b: a & ~b,
+}
+
+
+def host_popcount(arr) -> int:
+    return int(np.unpackbits(np.ascontiguousarray(arr).view(np.uint8)).sum())
+
+
+def rand_words(rng, m, sparse=False):
+    """(m, W) uint32; `sparse` ANDs four draws (~6% bit density)."""
+    a = rng.integers(0, 1 << 32, size=(m, W), dtype=np.uint32)
+    if sparse:
+        for _ in range(3):
+            a &= rng.integers(0, 1 << 32, size=(m, W), dtype=np.uint32)
+    return a
+
+
+# -- csa_popcount_sum: the ladder itself ---------------------------------
+
+
+@pytest.mark.parametrize("shape", [(8, 128), (16, W), (32, 256), (64, 128),
+                                   (2, 8, 128)])
+def test_csa_ladder_exact(shape):
+    rng = np.random.default_rng(0xC5A)
+    x = rng.integers(0, 1 << 32, size=shape, dtype=np.uint32)
+    want = host_popcount(x)
+    assert int(csa_popcount_sum(jnp.asarray(x), force=True)) == want
+    assert int(csa_popcount_sum(jnp.asarray(x), force=False)) == want
+
+
+@pytest.mark.parametrize("rows", [1, 7, 13])
+def test_csa_odd_rows_fall_back(rows):
+    # Row counts the 8-slab split cannot take go through the naive
+    # epilogue inside csa_popcount_sum — still exact.
+    rng = np.random.default_rng(rows)
+    x = rng.integers(0, 1 << 32, size=(rows, 128), dtype=np.uint32)
+    assert int(csa_popcount_sum(jnp.asarray(x), force=True)) == \
+        host_popcount(x)
+
+
+def test_csa_env_gate(monkeypatch):
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 1 << 32, size=(16, 128), dtype=np.uint32)
+    want = host_popcount(x)
+    monkeypatch.setenv("PILOSA_TPU_CSA", "0")
+    assert int(csa_popcount_sum(jnp.asarray(x))) == want
+    monkeypatch.setenv("PILOSA_TPU_CSA", "1")
+    assert int(csa_popcount_sum(jnp.asarray(x))) == want
+
+
+def test_csa_extremes():
+    zeros = jnp.zeros((16, 128), jnp.uint32)
+    ones = jnp.full((16, 128), 0xFFFFFFFF, jnp.uint32)
+    assert int(csa_popcount_sum(zeros, force=True)) == 0
+    assert int(csa_popcount_sum(ones, force=True)) == 16 * 128 * 32
+
+
+def test_pair_pick_block():
+    # Small operands shrink the block to the padded row count (8-row
+    # granularity); at/above _BLOCK_M the fixed block tiles the grid.
+    assert _pair_pick_block(1) == 8
+    assert _pair_pick_block(8) == 8
+    assert _pair_pick_block(9) == 16
+    assert _pair_pick_block(_BLOCK_M - 1) == _BLOCK_M
+    assert _pair_pick_block(_BLOCK_M) == _BLOCK_M
+    assert _pair_pick_block(4 * _BLOCK_M) == _BLOCK_M
+
+
+# -- pair counts: pallas vs XLA vs host ----------------------------------
+
+
+def assert_pair_agrees(a, b, op):
+    want = host_popcount(HOST_OPS[op](a, b))
+    aj, bj = jnp.asarray(a), jnp.asarray(b)
+    assert int(count_pair(aj, bj, op)) == want, f"xla {op}"
+    got = int(fused_pair_count(aj, bj, op, force_pallas=True,
+                               interpret=True))
+    assert got == want, f"pallas {op}"
+
+
+@pytest.mark.parametrize("op", sorted(HOST_OPS))
+@pytest.mark.parametrize("m,sparse", [(1, False), (16, False), (16, True),
+                                      (257, True)])
+def test_pair_differential(op, m, sparse):
+    # m=1: single container (CSA fallback + block padding to 8);
+    # m=16: one aligned block; m=257: last-partial-block wrt the
+    # 256-row grid block (255 padded rows fold as zeros).
+    rng = np.random.default_rng(sum(map(ord, op)) * 1000 + m + int(sparse))
+    assert_pair_agrees(rand_words(rng, m, sparse),
+                       rand_words(rng, m, sparse), op)
+
+
+@pytest.mark.parametrize("op", sorted(HOST_OPS))
+def test_pair_single_word(op):
+    # Exactly one set word in one operand, none in the other.
+    a = np.zeros((3, W), dtype=np.uint32)
+    a[1, 777] = 0x80000001
+    b = np.zeros((3, W), dtype=np.uint32)
+    assert_pair_agrees(a, b, op)
+    assert_pair_agrees(b, a, op)
+
+
+@pytest.mark.parametrize("op", sorted(HOST_OPS))
+def test_pair_empty_rows(op):
+    # Zero rows interleaved with dense rows: empty containers must
+    # contribute nothing on any path.
+    rng = np.random.default_rng(11)
+    a = rand_words(rng, 24)
+    b = rand_words(rng, 24)
+    a[::2] = 0
+    b[1::3] = 0
+    assert_pair_agrees(a, b, op)
+
+
+def test_pair_roaring_model():
+    # End-to-end against the host roaring layer: bits -> Bitmap ->
+    # pool -> gathered rows, counts vs set algebra on the values.
+    rng = np.random.default_rng(99)
+    b = Bitmap()
+    vals = {}
+    for r in (0, 1):
+        cols = np.unique(rng.integers(0, SLICE_WIDTH, size=4000,
+                                      dtype=np.uint64))
+        b.add_many((np.uint64(r) << np.uint64(20)) | cols)
+        vals[r] = set(int(c) for c in cols)
+    pool, row_ids = build_pool(b)
+    r0 = gather_row(pool, 0)
+    r1 = gather_row(pool, 1)
+    for op, setop in [("and", vals[0] & vals[1]), ("or", vals[0] | vals[1]),
+                      ("andnot", vals[0] - vals[1])]:
+        want = len(setop)
+        assert int(count_pair(r0, r1, op)) == want
+        assert int(fused_pair_count(r0, r1, op, force_pallas=True,
+                                    interpret=True)) == want
+
+
+# -- N-ary coarse folds: pallas vs XLA vs host ---------------------------
+
+TREES = {
+    "and3": (["and", ["and", ["leaf", 0], ["leaf", 1]], ["leaf", 2]],
+             [0, 1, 0]),
+    "or3": (["or", ["or", ["leaf", 0], ["leaf", 1]], ["leaf", 2]],
+            [1, 0, 1]),
+    "andnot_or": (["andnot", ["or", ["leaf", 0], ["leaf", 1]],
+                   ["leaf", 2]], [0, 1, 1]),
+    "or3_absent": (["or", ["or", ["leaf", 0], ["leaf", 1]], ["leaf", 2]],
+                   [0, -1, 1]),
+}
+
+NP_FOLD = {"and": np.bitwise_and, "or": np.bitwise_or,
+           "xor": np.bitwise_xor, "andnot": lambda a, b: a & ~b}
+
+
+def host_tree_counts(pool, tree, starts_by_leaf):
+    """Per-slice host fold mirroring the kernels' keep-semantics:
+    a negative start reads as an all-zero row block."""
+    s_n = pool.shape[0]
+
+    def fold(node, s):
+        if node[0] == "leaf":
+            st = starts_by_leaf[node[1]]
+            if np.ndim(st):
+                st = st[s]
+            if st < 0:
+                return np.zeros((ROW_SPAN, W), dtype=np.uint32)
+            return pool[s, st * ROW_SPAN:(st + 1) * ROW_SPAN]
+        return NP_FOLD[node[0]](fold(node[1], s), fold(node[2], s))
+
+    return [host_popcount(fold(tree, s)) for s in range(s_n)]
+
+
+def make_pool(rng, s_n=4, runs=2, sparse=False):
+    pool = rng.integers(0, 1 << 32, size=(s_n, runs * ROW_SPAN, W),
+                        dtype=np.uint32)
+    if sparse:
+        pool &= rng.integers(0, 1 << 32, size=pool.shape, dtype=np.uint32)
+        pool &= rng.integers(0, 1 << 32, size=pool.shape, dtype=np.uint32)
+    return pool
+
+
+def xla_uniform_counts(pool, tree, starts):
+    """The fused-XLA comparator: static row-run slices + jnp fold."""
+    def fold(node):
+        if node[0] == "leaf":
+            st = int(starts[node[1]])
+            if st < 0:
+                return jnp.zeros((pool.shape[0], ROW_SPAN, W), jnp.uint32)
+            return jnp.asarray(
+                pool[:, st * ROW_SPAN:(st + 1) * ROW_SPAN])
+        a, b = fold(node[1]), fold(node[2])
+        if node[0] == "and":
+            return a & b
+        if node[0] == "or":
+            return a | b
+        if node[0] == "xor":
+            return a ^ b
+        return a & ~b
+
+    return np.asarray(jnp.sum(
+        lax.population_count(fold(tree)).astype(jnp.int32), axis=(1, 2)))
+
+
+@pytest.mark.parametrize("name", sorted(TREES))
+@pytest.mark.parametrize("sparse", [False, True])
+def test_coarse_uniform_differential(name, sparse):
+    tree, starts = TREES[name]
+    rng = np.random.default_rng(sum(map(ord, name)) + int(sparse))
+    pool = make_pool(rng, sparse=sparse)
+    want = host_tree_counts(pool, tree, starts)
+    assert list(xla_uniform_counts(pool, tree, starts)) == want
+    views = tuple(jnp.asarray(pool) for _ in range(3))
+    got = np.asarray(coarse_count_uniform(
+        views, jnp.asarray(starts, dtype=jnp.int32), tree,
+        interpret=True))[0]
+    assert list(got) == want
+
+
+def test_coarse_uniform_empty_pool_rows():
+    # A slice whose rows are entirely zero words, and an all-absent
+    # leaf: both must count zero without disturbing the others.
+    rng = np.random.default_rng(5)
+    pool = make_pool(rng)
+    pool[2] = 0
+    tree, starts = TREES["and3"]
+    want = host_tree_counts(pool, tree, starts)
+    views = tuple(jnp.asarray(pool) for _ in range(3))
+    got = np.asarray(coarse_count_uniform(
+        views, jnp.asarray(starts, dtype=jnp.int32), tree,
+        interpret=True))[0]
+    assert list(got) == want
+    assert got[2] == 0
+
+
+@pytest.mark.parametrize("name", ["and3", "or3", "andnot_or"])
+def test_coarse_per_slice_differential(name):
+    # The general kernel: per-(leaf, slice) starts, with per-slice
+    # absences (negative starts) mixed in.
+    tree, base = TREES[name]
+    rng = np.random.default_rng(len(name))
+    pool = make_pool(rng, s_n=4, runs=3)
+    starts = np.tile(np.asarray(base, dtype=np.int32)[:, None], (1, 4))
+    starts[1, 2] = -1  # leaf 1 absent on slice 2
+    starts[2, 0] = 2   # leaf 2 reads a different run on slice 0
+    want = host_tree_counts(pool, tree, starts)
+    views = tuple(jnp.asarray(pool) for _ in range(3))
+    got = np.asarray(coarse_count_per_slice(
+        views, jnp.asarray(starts), tree, interpret=True))[0]
+    assert list(got) == want

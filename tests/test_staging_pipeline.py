@@ -1,0 +1,126 @@
+"""Pipelined H2D staging: chunked transfers must be invisible except
+in speed. The chunk boundary math, the packer-thread handoff, and the
+per-chunk byte accounting all get exercised against the single-put
+path on the same bitmaps.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.obs import profile as profile_mod
+from pilosa_tpu.parallel import build_sharded_index, default_mesh
+from pilosa_tpu.parallel.mesh import _stage_chunk_bytes, _stage_pipeline
+from pilosa_tpu.roaring import Bitmap
+
+
+def make_bitmaps(num_slices, rows=(3, 9), per_row=400, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for s in range(num_slices):
+        b = Bitmap()
+        for r in rows:
+            cols = rng.choice(SLICE_WIDTH, size=per_row, replace=False)
+            b.add_many((np.uint64(r) << np.uint64(20))
+                       | cols.astype(np.uint64))
+        out.append(b)
+    return out
+
+
+def stage(bitmaps, mesh=None, chunk_mb=None, monkeypatch=None):
+    stats = {}
+    if chunk_mb is not None:
+        monkeypatch.setenv("PILOSA_TPU_STAGE_CHUNK_MB", str(chunk_mb))
+    idx, row_ids = build_sharded_index(bitmaps, mesh, stats_out=stats)
+    return idx, row_ids, stats
+
+
+def test_chunk_size_env():
+    assert _stage_chunk_bytes() == 64 << 20  # the r12 default
+
+
+def test_multi_chunk_equals_single_put(monkeypatch):
+    # 20 slices x 256 KB (two 16-container rows) at a 1 MB chunk =
+    # 4 slices/chunk = 5 chunks; the assembled pool must be
+    # bit-identical to the one-put stage.
+    bitmaps = make_bitmaps(20)
+    idx1, rows1, st1 = stage(bitmaps, chunk_mb=4096,
+                             monkeypatch=monkeypatch)
+    assert st1["h2d_chunks"] == 1
+    idx2, rows2, st2 = stage(bitmaps, chunk_mb=1, monkeypatch=monkeypatch)
+    assert st2["h2d_chunks"] == 5
+    assert st2["h2d_chunk_slices"] == 4
+    np.testing.assert_array_equal(rows1, rows2)
+    np.testing.assert_array_equal(np.asarray(idx1.keys),
+                                  np.asarray(idx2.keys))
+    np.testing.assert_array_equal(np.asarray(idx1.words),
+                                  np.asarray(idx2.words))
+    # Same bytes shipped either way, counted per chunk.
+    assert st1["h2d_bytes"] == st2["h2d_bytes"]
+
+
+def test_sharded_multi_chunk_equivalence(monkeypatch):
+    # Across the 8-device test mesh each shard pipelines its own
+    # chunks; the assembled sharded pool must match the single-put one.
+    mesh = default_mesh()
+    bitmaps = make_bitmaps(16, seed=7)
+    idx1, _, st1 = stage(bitmaps, mesh, chunk_mb=4096,
+                         monkeypatch=monkeypatch)
+    idx2, _, st2 = stage(bitmaps, mesh, chunk_mb=1, monkeypatch=monkeypatch)
+    assert st2["h2d_chunks"] >= st1["h2d_chunks"]
+    np.testing.assert_array_equal(np.asarray(idx1.words),
+                                  np.asarray(idx2.words))
+    np.testing.assert_array_equal(np.asarray(idx1.keys),
+                                  np.asarray(idx2.keys))
+
+
+def test_cumulative_byte_accounting(monkeypatch):
+    # Every chunk's dispatch adds to bytes_staged AS IT SHIPS (the
+    # profile-phase fix): the profiled total equals the stats total,
+    # which equals words + keys bytes exactly.
+    bitmaps = make_bitmaps(20, seed=3)
+    prof = profile_mod.QueryProfile()
+    tok = profile_mod.activate(prof)
+    try:
+        idx, _, stats = stage(bitmaps, chunk_mb=1, monkeypatch=monkeypatch)
+    finally:
+        profile_mod.deactivate(tok)
+        prof.finish()
+    d = prof.to_dict()
+    words_b = int(np.prod(np.asarray(idx.words).shape)) * 4
+    keys_b = int(np.prod(np.asarray(idx.keys).shape)) * 4
+    assert stats["h2d_bytes"] == words_b + keys_b
+    assert d["bytes"]["bytes_staged"] == stats["h2d_bytes"]
+    assert d["phases_us"].get("stage_h2d", 0) > 0
+
+
+def test_pipeline_pack_error_propagates():
+    calls = []
+
+    def pack(lo, hi):
+        if lo >= 4:
+            raise ValueError("pack exploded")
+        calls.append((lo, hi))
+        return np.zeros((hi - lo, 4), dtype=np.uint32)
+
+    with pytest.raises(ValueError, match="pack exploded"):
+        _stage_pipeline(pack, [(0, 4), (4, 8)], None)
+    assert calls == [(0, 4)]
+
+
+def test_pipeline_single_chunk_skips_thread():
+    seen = []
+    out = _stage_pipeline(
+        lambda lo, hi: np.ones((hi - lo, 4), dtype=np.uint32),
+        [(0, 2)], None, on_chunk=seen.append)
+    assert len(out) == 1
+    assert seen == [2 * 4 * 4]
+
+
+def test_pipeline_chunk_order_and_bytes():
+    sizes = []
+    out = _stage_pipeline(
+        lambda lo, hi: np.full((hi - lo, 4), lo, dtype=np.uint32),
+        [(0, 2), (2, 5), (5, 6)], None, on_chunk=sizes.append)
+    assert [int(np.asarray(p)[0, 0]) for p in out] == [0, 2, 5]
+    assert sizes == [2 * 16, 3 * 16, 1 * 16]
