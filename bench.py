@@ -2083,6 +2083,144 @@ def main():
             if node1 is not None:
                 node1.close()
 
+    with section("write_availability"):
+        # Write-path replication resilience (ISSUE 13): acked-write
+        # latency and shed rate through a replica kill + restart on a
+        # 3-node cluster at replica_n=3/quorum, plus the hint-drain
+        # time that bounds how long an acked write stays divergent.
+        # Acceptance: zero 5xx during the outage (quorum holds with 2
+        # of 3), and steady-state write p99 regression ≤ 5% PR-over-PR
+        # (the steady_p99_us row is the comparison anchor).
+        _progress("write availability: replica kill/restart mid-stream")
+        import tempfile as _tf3
+        import urllib.request as _ur3
+
+        from pilosa_tpu.config import Config as _WCfg
+        from pilosa_tpu.server import Server as _WSrv
+
+        def _wfreeport():
+            import socket as _sk3
+            s_ = _sk3.socket()
+            s_.bind(("127.0.0.1", 0))
+            p_ = s_.getsockname()[1]
+            s_.close()
+            return p_
+
+        wahosts = [f"127.0.0.1:{_wfreeport()}" for _ in range(3)]
+        wacfgs = []
+        for i_, h_ in enumerate(wahosts):
+            c_ = _WCfg()
+            c_.data_dir = _tf3.mkdtemp(prefix=f"bench_wavail{i_}_")
+            c_.host = h_
+            c_.cluster_hosts = list(wahosts)
+            c_.replica_n = 3
+            c_.anti_entropy_interval = 3600
+            c_.polling_interval = 3600
+            c_.sched_enabled = False
+            wacfgs.append(c_)
+        wasrvs = [_WSrv(c_) for c_ in wacfgs]
+        for s_ in wasrvs:
+            s_.open()
+        try:
+            def _wpost(pql_):
+                req = _ur3.Request(
+                    f"http://{wahosts[0]}/index/wa/query",
+                    data=pql_.encode(), method="POST")
+                with _ur3.urlopen(req, timeout=10) as r_:
+                    r_.read()
+                    return r_.status
+
+            _ur3.urlopen(_ur3.Request(
+                f"http://{wahosts[0]}/index/wa", data=b"",
+                method="POST"), timeout=10).read()
+            _ur3.urlopen(_ur3.Request(
+                f"http://{wahosts[0]}/index/wa/frame/f", data=b"",
+                method="POST"), timeout=10).read()
+
+            col_seq = [0]
+
+            def _stream(seconds_):
+                """Sequential acked SetBits for `seconds_`; returns
+                (latencies_us, n_5xx). Every 200 is a promise the
+                convergence check collects on at the end."""
+                lats, bad = [], 0
+                t_end = time.perf_counter() + seconds_
+                while time.perf_counter() < t_end:
+                    col_ = col_seq[0]
+                    col_seq[0] += 1
+                    t0_ = time.perf_counter()
+                    try:
+                        st_ = _wpost(f"SetBit(rowID=1, frame=f, "
+                                     f"columnID={col_})")
+                    except Exception:  # noqa: BLE001 — a 5xx outcome
+                        st_ = 599
+                    dt_ = time.perf_counter() - t0_
+                    if st_ == 200:
+                        lats.append(dt_ * 1e6)
+                    else:
+                        bad += 1
+                        col_seq[0] -= 1  # not acked, not promised
+                return lats, bad
+
+            def _p(lats_, q_):
+                if not lats_:
+                    return 0.0
+                lats_ = sorted(lats_)
+                return lats_[min(len(lats_) - 1, int(q_ * len(lats_)))]
+
+            steady, steady_bad = _stream(2.0)
+            wasrvs[2].close()                       # the outage
+            outage, outage_bad = _stream(2.0)
+            wasrvs[2] = _WSrv(wacfgs[2])            # same data dir
+            wasrvs[2].open()
+            # production reconnect path: breaker close -> mark_live ->
+            # hints.notify; force the close instead of waiting out the
+            # half-open cooldown
+            wasrvs[0].client.breakers.for_host(
+                wahosts[2]).record_success()
+            t_dr = time.perf_counter()
+            drained = wasrvs[0].hints.wait_drained(timeout=60)
+            drain_s = time.perf_counter() - t_dr
+            recovery, recovery_bad = _stream(1.0)
+            assert drained and wasrvs[0].hints.wait_drained(timeout=60)
+
+            # every acked write is on every replica, bit for bit
+            from pilosa_tpu.api import InternalClient as _WCli
+            blocks_ = [_WCli(h_).fragment_blocks("wa", "f", "standard",
+                                                 0) for h_ in wahosts]
+            assert blocks_[0] and blocks_[0] == blocks_[1] == blocks_[2]
+            n_acked = len(steady) + len(outage) + len(recovery)
+            assert wasrvs[2].holder.fragment(
+                "wa", "f", "standard", 0).row(1).count() == n_acked
+
+            snap_ = wasrvs[0].hints.snapshot()
+            details["write_availability"] = {
+                "nodes": 3, "replica_n": 3, "consistency": "quorum",
+                "steady_writes": len(steady),
+                "steady_p50_us": _p(steady, 0.50),
+                "steady_p99_us": _p(steady, 0.99),
+                "outage_writes": len(outage),
+                "outage_p50_us": _p(outage, 0.50),
+                "outage_p99_us": _p(outage, 0.99),
+                "outage_5xx": outage_bad,
+                "outage_shed_rate": outage_bad / max(
+                    1, len(outage) + outage_bad),
+                "recovery_p99_us": _p(recovery, 0.99),
+                "hints_queued": sum(
+                    t_["queued_total"]
+                    for t_ in snap_["targets"].values()),
+                "hint_drain_s": drain_s,
+                "outage_over_steady_p99": (
+                    _p(outage, 0.99) / _p(steady, 0.99)
+                    if steady else 0.0),
+                "total_5xx": steady_bad + outage_bad + recovery_bad}
+        finally:
+            for s_ in wasrvs:
+                try:
+                    s_.close()
+                except Exception:  # noqa: BLE001 — victim mid-restart
+                    pass
+
     with section("sustained_ingest"):
         # Durable-ingest headline (ISSUE 8): a sustained set_bit stream
         # under the group-commit WAL while max_op_n forces background

@@ -78,16 +78,30 @@ class CircuitBreaker:
     `_slices_by_node` prefers replicas whose breaker is closed."""
 
     def __init__(self, host: str, threshold: int = 5,
-                 cooldown: float = 5.0, stats: Optional[StatMap] = None):
+                 cooldown: float = 5.0, stats: Optional[StatMap] = None,
+                 on_change=None):
         self.host = host
         self.threshold = threshold
         self.cooldown = cooldown
         self.stats = stats if stats is not None else STATS
+        # on_change(host, new_state) fires on open/close edges, OUTSIDE
+        # the breaker lock — the liveness feedback seam (an opening
+        # breaker marks the node DOWN cluster-wide so the write path
+        # stops paying timeouts to it; a close wakes hint drainers).
+        self.on_change = on_change
         self._mu = threading.Lock()
         self._state = BREAKER_CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
+
+    def _notify(self, state: str) -> None:
+        if self.on_change is None:
+            return
+        try:
+            self.on_change(self.host, state)
+        except Exception:  # noqa: BLE001 — liveness hook never breaks RPC
+            pass
 
     @property
     def state(self) -> str:
@@ -123,16 +137,21 @@ class CircuitBreaker:
     def record_success(self) -> None:
         if self.threshold <= 0:
             return
+        closed = False
         with self._mu:
             if self._state != BREAKER_CLOSED:
                 self.stats.inc("breaker.close")
+                closed = True
             self._state = BREAKER_CLOSED
             self._failures = 0
             self._probing = False
+        if closed:
+            self._notify(BREAKER_CLOSED)
 
     def record_failure(self) -> None:
         if self.threshold <= 0:
             return
+        opened = False
         with self._mu:
             self._failures += 1
             self._probing = False
@@ -140,8 +159,11 @@ class CircuitBreaker:
                     or self._failures >= self.threshold):
                 if self._state != BREAKER_OPEN:
                     self.stats.inc("breaker.open")
+                    opened = True
                 self._state = BREAKER_OPEN
                 self._opened_at = time.monotonic()
+        if opened:
+            self._notify(BREAKER_OPEN)
 
 
 class BreakerRegistry:
@@ -149,10 +171,14 @@ class BreakerRegistry:
     (threshold, cooldown, stats) policy."""
 
     def __init__(self, threshold: int = 5, cooldown: float = 5.0,
-                 stats: Optional[StatMap] = None):
+                 stats: Optional[StatMap] = None, on_change=None):
         self.threshold = threshold
         self.cooldown = cooldown
         self.stats = stats
+        # Shared open/close hook threaded into every breaker this
+        # registry creates (settable after construction — the server
+        # wires it once cluster + hints exist).
+        self.on_change = on_change
         self._mu = threading.Lock()
         self._by_host: Dict[str, CircuitBreaker] = {}
 
@@ -161,8 +187,14 @@ class BreakerRegistry:
             b = self._by_host.get(host)
             if b is None:
                 b = self._by_host[host] = CircuitBreaker(
-                    host, self.threshold, self.cooldown, stats=self.stats)
+                    host, self.threshold, self.cooldown, stats=self.stats,
+                    on_change=lambda h, s: self._fire(h, s))
             return b
+
+    def _fire(self, host: str, state: str) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb(host, state)
 
     def state(self, host: str) -> str:
         with self._mu:
@@ -380,14 +412,20 @@ class InternalClient:
 
     def import_bits(self, index: str, frame: str, slice_: int,
                     row_ids: Sequence[int], column_ids: Sequence[int],
-                    timestamps: Optional[Sequence[int]] = None):
-        """POST /import protobuf ImportRequest (client.go:304-390)."""
+                    timestamps: Optional[Sequence[int]] = None,
+                    remote: bool = False):
+        """POST /import protobuf ImportRequest (client.go:304-390).
+        `remote=True` marks the batch already-coordinated (a replica
+        leg of a quorum import or a hint replay): the receiver applies
+        it locally without re-fanning-out to the other owners."""
         req = pb.ImportRequest(index=index, frame=frame, slice=slice_)
         req.row_ids.extend(int(r) for r in row_ids)
         req.column_ids.extend(int(c) for c in column_ids)
         if timestamps:
             req.timestamps.extend(int(t) for t in timestamps)
         status, data = self._do("POST", "/import",
+                                params={"remote": "true"} if remote
+                                else None,
                                 body=req.SerializeToString(),
                                 content_type=PROTOBUF_CT)
         self._check(status, data, "import")

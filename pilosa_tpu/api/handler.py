@@ -71,6 +71,7 @@ from ..errors import (
     PilosaError,
     QueryError,
     WriteBackpressureError,
+    WriteConsistencyError,
 )
 from ..pql import ParseError, parse_string_cached
 from ..executor import ExecOptions
@@ -368,7 +369,7 @@ def _error_status(err: Exception) -> int:
         return 504
     if isinstance(err, AdmissionError):
         return 429
-    if isinstance(err, WriteBackpressureError):
+    if isinstance(err, (WriteBackpressureError, WriteConsistencyError)):
         return 503
     if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
                         FragmentNotFoundError)):
@@ -466,6 +467,14 @@ class Handler:
         # families and the /debug/vars integrity section. None =
         # embedded/test handlers without one.
         self.scrubber = None
+        # Hinted-handoff manager (parallel.hints.HintManager, server
+        # wiring) + the [cluster] write-consistency level. When hints
+        # is set, POST /import coordinates quorum replication to the
+        # other replica owners (?remote=true legs apply locally only)
+        # and journals misses; None = local-apply-only (embedded/test
+        # handlers, single-node).
+        self.hints = None
+        self.write_consistency = "quorum"
         # SLO observatory (obs.slo.SLORecorder; [slo] config). Every
         # coordinator query outcome — success, partial, shed 429,
         # deadline 504, backpressure 503, other errors — is recorded
@@ -552,7 +561,14 @@ class Handler:
             try:
                 return route.fn(m.groupdict(), params, headers, body)
             except PilosaError as e:
-                return _json_resp({"error": str(e)}, _error_status(e))
+                resp = _json_resp({"error": str(e)}, _error_status(e))
+                retry = getattr(e, "retry_after_s", None)
+                if retry is not None and resp.status == 503:
+                    # Transient write sheds (backpressure, below-
+                    # consistency) tell clients when to come back.
+                    resp.headers["Retry-After"] = str(
+                        max(1, int(round(retry))))
+                return resp
             except (ValueError, KeyError, TypeError, binascii.Error) as e:
                 return _json_resp({"error": str(e) or type(e).__name__}, 400)
             except Exception as e:  # noqa: BLE001 — never drop the connection
@@ -612,6 +628,7 @@ class Handler:
         reg.register_collector(self._collect_fragments)
         reg.register_collector(self._collect_storage)
         reg.register_collector(self._collect_integrity)
+        reg.register_collector(self._collect_hints)
         reg.register_collector(self._collect_slo)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
@@ -1039,6 +1056,58 @@ class Handler:
         fams += [shadow_c, shadow_m]
         return fams
 
+    def _collect_hints(self) -> list:
+        """Hinted-handoff telemetry (parallel/hints.HINT_STATS +
+        per-target backlog): queued/replayed/dropped lifetime counters
+        labeled by target, current backlog bytes, and the write-
+        consistency outcome counters (executor.CONSISTENCY_STATS). The
+        operator invariant: replicas are convergent once
+        queued_total == replayed_total (+ dropped handled by
+        anti-entropy) with zero backlog bytes."""
+        prom = obs.prom
+        from ..executor import CONSISTENCY_STATS
+        from ..parallel.hints import HINT_STATS
+
+        stats = HINT_STATS.copy()
+        targets = sorted({k.split(":", 1)[1] for k in stats
+                          if k.startswith(("queued:", "replayed:",
+                                           "dropped:"))})
+        queued = prom.MetricFamily(
+            "pilosa_hints_queued_total", "counter",
+            "Missed replica writes durably journaled as hints.")
+        replayed = prom.MetricFamily(
+            "pilosa_hints_replayed_total", "counter",
+            "Hints replayed and acked by their target.")
+        dropped = prom.MetricFamily(
+            "pilosa_hints_dropped_total", "counter",
+            "Hints spilled oldest-first past hint-max-bytes or lost to "
+            "a torn log tail (anti-entropy heals these).")
+        for t in targets:
+            queued.add(stats.get(f"queued:{t}", 0), {"target": t})
+            replayed.add(stats.get(f"replayed:{t}", 0), {"target": t})
+            dropped.add(stats.get(f"dropped:{t}", 0), {"target": t})
+        fams = [queued, replayed, dropped]
+        if self.hints is not None:
+            hb = prom.MetricFamily(
+                "pilosa_hint_bytes", "gauge",
+                "Current hint-log backlog bytes per target.")
+            for t, nbytes in sorted(
+                    self.hints.backlog_bytes_by_target().items()):
+                hb.add(nbytes, {"target": t})
+            fams.append(hb)
+        wc = prom.MetricFamily(
+            "pilosa_write_consistency_total", "counter",
+            "Replicated-write outcomes by consistency level: ok "
+            "(all replicas acked), hinted (level reached, misses "
+            "journaled), below_consistency (503 after dispatch), "
+            "rejected_unavailable (503 before local apply).")
+        for key, n in sorted(CONSISTENCY_STATS.copy().items()):
+            level, _, outcome = key.partition(":")
+            if outcome:
+                wc.add(n, {"level": level, "outcome": outcome})
+        fams.append(wc)
+        return fams
+
     def _get_expvar(self, pv, params, headers, body) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
         snap["uptime_seconds"] = round(
@@ -1121,6 +1190,11 @@ class Handler:
             integrity["scrub"] = self.scrubber.snapshot()
         if integrity:
             snap = dict(snap, integrity=integrity)
+        # Hinted-handoff queue state: per-target backlog (records,
+        # bytes, lifetime counters) — the operator's first stop when
+        # pilosa_hint_bytes grows (README runbook).
+        if self.hints is not None:
+            snap = dict(snap, hints=self.hints.snapshot())
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:
@@ -1871,11 +1945,13 @@ class Handler:
         return _json_resp(out)
 
     def _query_error(self, e, headers) -> Response:
-        if isinstance(e, WriteBackpressureError):
-            # Write shed (WAL bound exceeded, snapshot behind): 503 +
-            # Retry-After, the write-path sibling of _shed_response —
-            # transient, so the cluster client's retry classification
-            # backs off and retries instead of failing the import.
+        if isinstance(e, (WriteBackpressureError, WriteConsistencyError)):
+            # Write shed (WAL bound exceeded / too few replica acks):
+            # 503 + Retry-After, the write-path sibling of
+            # _shed_response — transient, so the cluster client's retry
+            # classification backs off and retries instead of failing
+            # the import. Never a 500: a below-consistency write either
+            # rejected pre-apply or journaled its misses as hints.
             retry = max(1, int(round(e.retry_after_s)))
             if self._accepts_proto(headers):
                 resp = _proto_resp(pb.QueryResponse(err=str(e)), 503)
@@ -1971,6 +2047,14 @@ class Handler:
         if self.spmd_worker:
             return _json_resp(
                 {"error": "imports must be sent to SPMD rank 0"}, 400)
+        # ?remote=true marks an already-coordinated leg (a replica copy
+        # of a quorum import, or a hint replay): apply locally only.
+        remote = str(params.get("remote", "")).lower() == "true"
+        coord = None
+        if (not remote and self.spmd is None and self.hints is not None
+                and self.cluster is not None
+                and self.client_factory is not None):
+            coord = self._import_precheck(req)  # may raise 503 pre-apply
         if self.spmd is not None:
             # Replicate through the descriptor stream (chunked) so every
             # rank's holder receives the bits in query order.
@@ -1980,9 +2064,85 @@ class Handler:
         else:
             f.import_bits(list(req.row_ids), list(req.column_ids),
                           timestamps)
+        if coord is not None:
+            self._import_replicate(req, coord)
         if self._accepts_proto(headers):
             return _proto_resp(pb.ImportResponse())
         return _json_resp({})
+
+    def _import_precheck(self, req):
+        """Quorum import, phase 1 (BEFORE local apply): split the other
+        replica owners into live vs known-down and reject with 503 when
+        the consistency level is unreachable — no acked-but-ambiguous
+        state, and no timeout paid to a node the failure detector
+        already marked DOWN. Returns (live, down, required, level), or
+        None when this host is the slice's only owner."""
+        from ..executor import CONSISTENCY_STATS, required_acks
+        from ..parallel.cluster import NODE_STATE_DOWN
+
+        owners = self.cluster.fragment_nodes(req.index, req.slice)
+        others = [n for n in owners if n.host != self.host]
+        if not others:
+            return None
+        level = self.write_consistency
+        required = required_acks(level, len(owners))
+        down = [n for n in others if n.state == NODE_STATE_DOWN]
+        live = [n for n in others if n.state != NODE_STATE_DOWN]
+        if 1 + len(live) < required:
+            CONSISTENCY_STATS.inc(f"{level}:rejected_unavailable")
+            raise WriteConsistencyError(
+                f"import: write-consistency={level} needs {required} of "
+                f"{len(owners)} replicas, only {1 + len(live)} reachable",
+                level=level, required=required, acked=0)
+        return live, down, required, level
+
+    def _import_replicate(self, req, coord) -> None:
+        """Quorum import, phase 2 (AFTER local apply): fan the batch
+        out to the live replica owners in parallel with ?remote=true,
+        journal every miss (down or failed) as an import hint, and
+        raise 503 when acks fall below the level — the hints are
+        already durable, so an idempotent client retry is safe."""
+        from ..executor import CONSISTENCY_STATS
+
+        live, down, required, level = coord
+        rows, cols = list(req.row_ids), list(req.column_ids)
+        ts = list(req.timestamps) or None
+
+        def send(node):
+            self.client_factory(node.host).import_bits(
+                req.index, req.frame, req.slice, rows, cols, ts,
+                remote=True)
+
+        failures = []
+        pool = getattr(self.executor, "_pool", None)
+        if pool is not None and len(live) > 1:
+            futs = [(n, pool.submit(send, n)) for n in live]
+            for n, fut in futs:
+                try:
+                    fut.result()
+                except Exception as e:  # noqa: BLE001 — collected
+                    failures.append((n.host, e))
+        else:
+            for n in live:
+                try:
+                    send(n)
+                except Exception as e:  # noqa: BLE001 — collected
+                    failures.append((n.host, e))
+
+        for host in [n.host for n in down] + [h for h, _ in failures]:
+            self.hints.enqueue_import(host, req.index, req.frame,
+                                      req.slice, rows, cols, ts)
+        acked = 1 + len(live) - len(failures)
+        if acked >= required:
+            CONSISTENCY_STATS.inc(
+                f"{level}:hinted" if (down or failures) else f"{level}:ok")
+            return
+        CONSISTENCY_STATS.inc(f"{level}:below_consistency")
+        raise WriteConsistencyError(
+            f"import: write-consistency={level}: {acked} of {required} "
+            f"required replica acks ({len(failures)} failed mid-import; "
+            f"misses journaled as hints)",
+            level=level, required=required, acked=acked)
 
     def _get_export(self, pv, params, headers, body) -> Response:
         index, frame, view, slice_ = self._fragment_args(params)

@@ -29,6 +29,19 @@ TOML schema:
                                 # instead of the ring-order primary
                                 # (keeps QPS flat across a resize when
                                 # replica sets overlap)
+    # -- write consistency + hinted handoff (README section) --
+    write-consistency = "quorum"  # one | quorum | all: replica acks
+                                # (local apply included) required
+                                # before a write is acked; the rest
+                                # become hints. Below-consistency =
+                                # 503 + Retry-After, never an acked-
+                                # but-ambiguous write.
+    hint-max-bytes = 67108864   # per-target hint log bound (64 MiB);
+                                # oldest hints spill to anti-entropy
+                                # first. 0 = unbounded.
+    hint-drain-interval = "1s"  # drainer pacing; recovering targets
+                                # also wake it immediately via gossip/
+                                # status-poll/breaker-close notify
 
     [anti-entropy]
     interval = "10m"
@@ -189,6 +202,21 @@ def parse_duration(s) -> float:
     return total
 
 
+WRITE_CONSISTENCY_LEVELS = ("one", "quorum", "all")
+
+
+def parse_write_consistency(value: str) -> str:
+    """Validate [cluster] write-consistency. Raises on anything else —
+    a typo ("qourum") silently downgrading to some default would
+    change what an ack means."""
+    v = str(value or "").strip().lower()
+    if v not in WRITE_CONSISTENCY_LEVELS:
+        raise ValueError(
+            f"write-consistency must be one of "
+            f"{'/'.join(WRITE_CONSISTENCY_LEVELS)}, got {value!r}")
+    return v
+
+
 def parse_use_device(value: str):
     """Shared use-device token parse (config, env, Executor auto):
     True/False = forced on/off, None = auto. Raises ValueError on
@@ -243,6 +271,12 @@ class Config:
         # read-heavy single-coordinator deployments so a resize with
         # overlapping replica sets keeps QPS flat.
         self.prefer_local_reads: bool = False
+        # [cluster] write consistency + hinted handoff: replica acks
+        # required before a write is acked (one|quorum|all), the
+        # per-target hint log byte bound, and the drainer pacing.
+        self.write_consistency: str = "quorum"
+        self.hint_max_bytes: int = 64 << 20
+        self.hint_drain_interval: float = 1.0
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
         self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
         # [anti-entropy] — jitter spreads pass starts across nodes
@@ -374,6 +408,12 @@ class Config:
             c.breaker_cooldown = parse_duration(cl["breaker-cooldown"])
         c.prefer_local_reads = bool(cl.get("prefer-local-reads",
                                            c.prefer_local_reads))
+        c.write_consistency = parse_write_consistency(
+            cl.get("write-consistency", c.write_consistency))
+        c.hint_max_bytes = int(cl.get("hint-max-bytes", c.hint_max_bytes))
+        if "hint-drain-interval" in cl:
+            c.hint_drain_interval = parse_duration(
+                cl["hint-drain-interval"])
         if "polling-interval" in cl:
             c.polling_interval = parse_duration(cl["polling-interval"])
         ae = data.get("anti-entropy", {})
@@ -557,6 +597,10 @@ class Config:
             f'breaker-cooldown = "{int(self.breaker_cooldown * 1000)}ms"\n'
             f"prefer-local-reads = "
             f"{'true' if self.prefer_local_reads else 'false'}\n"
+            f'write-consistency = "{self.write_consistency}"\n'
+            f"hint-max-bytes = {self.hint_max_bytes}\n"
+            f'hint-drain-interval = '
+            f'"{int(self.hint_drain_interval * 1000)}ms"\n'
             f'polling-interval = "{int(self.polling_interval)}s"\n'
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'

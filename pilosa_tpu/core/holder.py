@@ -41,6 +41,10 @@ class Holder:
     def open(self):
         os.makedirs(self.path, exist_ok=True)
         for name in sorted(os.listdir(self.path)):
+            if name.startswith("."):
+                # Dot-directories are subsystem state (.hints/ hint
+                # logs), never indexes.
+                continue
             ipath = os.path.join(self.path, name)
             if not os.path.isdir(ipath):
                 continue

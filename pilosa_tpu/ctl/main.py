@@ -152,13 +152,18 @@ def cmd_import(args) -> int:
             tss = [g[2] for g in group]
             if not any(tss):
                 tss = None
-            # send to every owner node (client.go:355-390)
+            # Send each batch to ONE owner — the coordinator fans it
+            # out to its replica peers at the configured write-
+            # consistency and hints the misses. (The reference client
+            # sent every owner itself, client.go:355-390, which double-
+            # applies and can't tell a replica miss from a failure.)
             nodes = client.fragment_nodes(args.index, slice_)
-            for nd in nodes or [{"host": args.host}]:
-                InternalClient(nd["host"]).import_bits(
-                    args.index, args.frame, slice_, rows, cols, tss)
+            target = (nodes or [{"host": args.host}])[0]["host"]
+            InternalClient(target).import_bits(
+                args.index, args.frame, slice_, rows, cols, tss)
             print(f"imported {len(group)} bits into slice {slice_} "
-                  f"({len(nodes) or 1} node(s))", file=sys.stderr)
+                  f"(via {target}, {len(nodes) or 1} owner(s))",
+                  file=sys.stderr)
 
     buf: List[Tuple[int, int, int]] = []
     for path in args.paths:
@@ -541,6 +546,28 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
         state_names = {0: "closed", 1: "half-open", 2: "open"}
         lines.append("breakers: " + "  ".join(
             f"{h}={state_names.get(int(v), '?')}" for h, v in brk))
+
+    # Hinted-handoff panel: queued/replayed/dropped totals plus live
+    # backlog bytes per target. Healthy steady state reads
+    # queued == replayed with no backlog; a growing backlog names the
+    # target that needs attention (README runbook).
+    hq = sum(v for (name, _labels), v in cur.items()
+             if name == "pilosa_hints_queued_total")
+    hr = sum(v for (name, _labels), v in cur.items()
+             if name == "pilosa_hints_replayed_total")
+    hd = sum(v for (name, _labels), v in cur.items()
+             if name == "pilosa_hints_dropped_total")
+    backlog = [(dict(labels).get("target", ""), v)
+               for (name, labels), v in sorted(cur.items())
+               if name == "pilosa_hint_bytes" and v > 0]
+    if hq or hr or hd or backlog:
+        line = f"hints: queued {int(hq)}   replayed {int(hr)}"
+        if hd:
+            line += f"   dropped {int(hd)}"
+        if backlog:
+            line += "   backlog " + "  ".join(
+                f"{t}={_fmt_bytes(v)}" for t, v in backlog[:6])
+        lines.append(line)
 
     hbm = [(dict(labels).get("device", ""), v)
            for (name, labels), v in sorted(cur.items())

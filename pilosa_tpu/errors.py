@@ -107,6 +107,30 @@ class DeviceResourceError(PilosaError):
         self.reason = reason
 
 
+class WriteConsistencyError(PilosaError):
+    """A replicated write could not reach its configured
+    [cluster] write-consistency level — either rejected up front
+    (too few replica owners reachable, *before* local apply, so no
+    acked-but-ambiguous state exists) or after dispatch (live owners
+    failed mid-write; the missed ops are already journaled as hints).
+    Maps to HTTP 503 with a Retry-After header, NOT 500: replicas are
+    not divergent behind an ack, and the condition clears when nodes
+    recover or the breaker half-opens. `transient = True`: SetBit/
+    ClearBit/import are idempotent, so a backed-off retry is safe even
+    if some replicas already applied the op."""
+
+    transient = True
+
+    def __init__(self, msg: str, level: str = "quorum",
+                 required: int = 0, acked: int = 0,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.level = level
+        self.required = int(required)
+        self.acked = int(acked)
+        self.retry_after_s = float(retry_after_s)
+
+
 class BroadcastError(PilosaError):
     """A write broadcast failed on one or more peers. Carries every
     per-node outcome (`failures`: list of (host, exception)) instead of

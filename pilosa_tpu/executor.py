@@ -38,8 +38,10 @@ from .errors import (
     IndexRequiredError,
     QueryError,
     SliceUnavailableError,
+    WriteConsistencyError,
 )
 from .parallel.cluster import (
+    NODE_STATE_DOWN,
     NODE_STATE_UP,
     SERVING_STATES,
     preferred_owner,
@@ -67,6 +69,24 @@ _WRITE_CALLS = ("ClearBit", "SetBit", "SetRowAttrs", "SetColumnAttrs")
 # roaring fold for the same tree: miscompiled plan, bad staging, or
 # silent device fault — the one failure class checksums can't see.
 SHADOW_STATS = obs.StatMap()
+
+# Write-consistency outcome counters, keyed "<level>:<outcome>" —
+# exported as pilosa_write_consistency_total{level,outcome}. Outcomes:
+# ok (all replicas acked), hinted (consistency reached, misses
+# journaled as hints), below_consistency (dispatched but too few acks
+# — 503 after hints enqueued), rejected_unavailable (too few owners
+# reachable, rejected BEFORE local apply).
+CONSISTENCY_STATS = obs.StatMap()
+
+
+def required_acks(level: str, owners: int) -> int:
+    """Replica acks (local apply included) a write needs before it is
+    acked to the client."""
+    if level == "one":
+        return 1
+    if level == "all":
+        return owners
+    return owners // 2 + 1  # quorum
 
 
 class ExecOptions:
@@ -167,6 +187,13 @@ class Executor:
         # reference routes each slice to ring order, spreading load
         # across replicas, which is right when clients hit every node.
         self.prefer_local_reads = prefer_local_reads
+        # Write-path replication (ISSUE 13): replica acks required
+        # before a mutation acks ("one" | "quorum" | "all"), and the
+        # hinted-handoff manager that journals missed replica ops.
+        # Both server-wired; a bare executor (unit tests) keeps the
+        # legacy fail-on-remote-error behavior while `hints` is None.
+        self.write_consistency: str = "quorum"
+        self.hints = None
         # None = auto (device path when available); False = host roaring only.
         self.use_device = use_device
         # Cost-routing threshold (see _route_to_host); None = resolve
@@ -790,6 +817,16 @@ class Executor:
         if c.name in _WRITE_CALLS:
             info["route"] = "write"
             info["placement"] = self._explain_placement(index, slices)
+            owners = (self.cluster.replica_n
+                      if self.cluster is not None and self.cluster.nodes
+                      else 1)
+            info["consistency"] = {
+                "level": self.write_consistency,
+                "replicas": owners,
+                "required_acks": required_acks(
+                    self.write_consistency, owners),
+                "hinted_handoff": self.hints is not None,
+            }
             return info
         if c.name != "Count" or len(c.children) != 1:
             # Non-Count reads run the per-slice roaring map-reduce.
@@ -1444,20 +1481,102 @@ class Executor:
     def _execute_mutate_view(self, index: str, c: Call, opt: ExecOptions,
                              col_id: int, local_fn: Callable[[], bool]) -> bool:
         """Route a bit mutation to every replica owner of its slice
-        (executor.go:767-797)."""
+        (executor.go:767-797), with quorum semantics instead of the
+        reference's serial first-error-fails fan-out.
+
+        Owners are dispatched in PARALLEL and every future is awaited
+        (the _broadcast_query discipline). The write acks once
+        `write-consistency` replicas — local apply included — succeed;
+        misses are journaled as hints for the drainer to replay, so an
+        acked write converges without waiting for anti-entropy. Two
+        orderings are load-bearing: owners the failure detector already
+        knows are down (node state DOWN, breaker open) are counted
+        BEFORE local apply — a write that cannot possibly reach
+        consistency is rejected with no state mutated anywhere, so
+        there is no acked-but-ambiguous outcome and the write path
+        never pays a timeout to a known-dead node; and hints are
+        enqueued even on the below-consistency path, because any
+        replica that DID apply must still converge with the rest."""
         slice_ = col_id // SLICE_WIDTH
-        ret = False
-        for node in self._fragment_nodes(index, slice_):
-            if node is None or node.host == self.host:
+        owners = self._fragment_nodes(index, slice_)
+        locals_ = [n for n in owners if n is None or n.host == self.host]
+        remotes = [n for n in owners if n is not None and n.host != self.host]
+
+        if opt.remote or not remotes:
+            # Remote leg (the coordinator counts this node's ack) or a
+            # single-owner slice: plain local apply.
+            ret = False
+            for _ in locals_:
                 if local_fn():
                     ret = True
-                continue
-            if opt.remote:
-                continue
-            res = self._exec_remote(node, index, Query(calls=[c]), None, opt)
-            if res and res[0]:
+            return ret
+
+        level = self.write_consistency
+        required = required_acks(level, len(owners))
+        hints = self.hints
+
+        down: list = []
+        live = list(remotes)
+        if hints is not None:
+            breaker = self._breaker_callable()
+            down = [n for n in remotes
+                    if n.state == NODE_STATE_DOWN
+                    or (breaker is not None
+                        and breaker(n.host) == "open")]
+            live = [n for n in remotes if n not in down]
+            if len(locals_) + len(live) < required:
+                CONSISTENCY_STATS.inc(f"{level}:rejected_unavailable")
+                raise WriteConsistencyError(
+                    f"write-consistency={level} needs {required} of "
+                    f"{len(owners)} replicas, only "
+                    f"{len(locals_) + len(live)} reachable",
+                    level=level, required=required, acked=0)
+
+        ret = False
+        acked = 0
+        for _ in locals_:
+            if local_fn():
                 ret = True
-        return ret
+            acked += 1
+
+        q = Query(calls=[c])
+        futures = [
+            (node, self._pool.submit(obs.wrap_ctx(self._exec_remote),
+                                     node, index, q, None, opt))
+            for node in live
+        ]
+        failures = []
+        for node, fut in futures:
+            try:
+                res = fut.result()
+                if res and res[0]:
+                    ret = True
+                acked += 1
+            except Exception as err:  # noqa: BLE001 — collected below
+                failures.append((node.host, err))
+
+        if hints is None:
+            # Legacy contract for bare executors: no handoff plane
+            # means no repair path, so a remote failure must surface.
+            if failures:
+                raise failures[0][1]
+            return ret
+
+        pql = str(q)
+        missed = [n.host for n in down] + [h for h, _ in failures]
+        for host in missed:
+            hints.enqueue_query(host, index, pql)
+
+        if acked >= required:
+            CONSISTENCY_STATS.inc(
+                f"{level}:hinted" if missed else f"{level}:ok")
+            return ret
+        CONSISTENCY_STATS.inc(f"{level}:below_consistency")
+        raise WriteConsistencyError(
+            f"write-consistency={level}: {acked} of {required} required "
+            f"replica acks ({len(failures)} failed mid-write; misses "
+            f"journaled as hints)",
+            level=level, required=required, acked=acked)
 
     def _fragment_nodes(self, index: str, slice_: int):
         if self.cluster is None or not self.cluster.nodes:
@@ -1494,7 +1613,7 @@ class Executor:
         f.row_attr_store.set_attrs(row_id, attrs)
 
         if not opt.remote:
-            self._broadcast_query(index, Query(calls=[c]), opt)
+            self._broadcast_with_hints(index, Query(calls=[c]), opt)
         return None
 
     def _execute_bulk_set_row_attrs(self, index: str, calls: Sequence[Call],
@@ -1524,7 +1643,7 @@ class Executor:
             self.holder.frame(index, frame_name).row_attr_store.set_bulk_attrs(items)
 
         if not opt.remote:
-            self._broadcast_query(index, Query(calls=list(calls)), opt)
+            self._broadcast_with_hints(index, Query(calls=list(calls)), opt)
         return [None] * len(calls)
 
     def _execute_set_column_attrs(self, index: str, c: Call, opt: ExecOptions):
@@ -1549,7 +1668,7 @@ class Executor:
         idx.column_attr_store.set_attrs(id_, attrs)
 
         if not opt.remote:
-            self._broadcast_query(index, Query(calls=[c]), opt)
+            self._broadcast_with_hints(index, Query(calls=[c]), opt)
         return None
 
     def _broadcast_query(self, index: str, q: Query, opt: ExecOptions):
@@ -1575,6 +1694,23 @@ class Executor:
                 failures.append((node.host, err))
         if failures:
             raise BroadcastError(failures, len(nodes))
+
+    def _broadcast_with_hints(self, index: str, q: Query,
+                              opt: ExecOptions) -> None:
+        """Attr broadcasts mutate the local store BEFORE fanning out,
+        so a failed peer used to leave local state mutated with no
+        repair path behind the error. With a hint manager wired, the
+        failed hosts' calls are journaled and replayed — attrs
+        converge the same way bits do and the write acks; without one
+        (bare executors), the BroadcastError surfaces as before."""
+        try:
+            self._broadcast_query(index, q, opt)
+        except BroadcastError as err:
+            if self.hints is None:
+                raise
+            pql = str(q)
+            for host, _e in err.failures:
+                self.hints.enqueue_query(host, index, pql)
 
     # -- distributed fan-out -------------------------------------------------
 

@@ -166,6 +166,29 @@ class Cluster:
                 return n
         return None
 
+    def mark_unreachable(self, host: str) -> bool:
+        """Liveness collapse by host — the failure-detector feeds
+        (status poll, gossip, an OPENING circuit breaker) all converge
+        here so the write path stops paying per-write timeouts to a
+        node everyone already knows is down. Returns True on an actual
+        state change (was not already DOWN)."""
+        n = self.node_by_host(host)
+        if n is None or n.state == NODE_STATE_DOWN:
+            return False
+        n.mark_unreachable()
+        return True
+
+    def mark_live(self, host: str) -> bool:
+        """Liveness recovery by host (DOWN -> UP only; lifecycle
+        states belong to the rebalancer). Returns True when the node
+        actually came back — callers use that edge to wake hint
+        drainers immediately instead of on their timer."""
+        n = self.node_by_host(host)
+        if n is None or n.state != NODE_STATE_DOWN:
+            return False
+        n.mark_live()
+        return True
+
     def node_states(self) -> Dict[str, str]:
         """host -> lifecycle state, degraded to DOWN when the liveness
         feed no longer sees the host (reference cluster.go:156-169)."""

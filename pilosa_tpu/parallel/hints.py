@@ -1,0 +1,489 @@
+"""Durable hinted handoff (ISSUE 13).
+
+When a replicated write reaches its consistency level but misses one
+or more replica owners (node down, breaker open, transient transport
+failure), the missed op is not an error — it is a *hint*: a durable,
+per-target journal entry that a drainer replays once the target comes
+back. This closes the gap the reference leaves to interval
+anti-entropy: replicas converge seconds after a restart instead of at
+the next (default 10-minute) sync pass, and an acked write is never
+silently divergent for longer than the outage itself.
+
+Layout: one append-only log per target host under
+`<data-dir>/.hints/<sanitized-host>.hintlog`. Record framing follows
+the PR-10 integrity-footer shape:
+
+    u8 magic (0xF9) | u32 payload_len | payload (JSON) | u32 fnv32a(payload)
+
+Payloads are JSON, not protobuf, on purpose: hints are rare-path
+repair traffic, and a human debugging a backlog can `less` the log.
+Two kinds: {"kind": "query", "index", "pql"} replayed via
+execute_query(remote=True), and {"kind": "import", "index", "frame",
+"slice", "rows", "cols", "ts"} replayed via import_bits(remote=True).
+Both replay idempotently (SetBit/import are set-semantics), so the
+drainer can die between a target's ack and the log truncation and
+simply replay again.
+
+Durability reuses the core/wal.py group-commit machinery: every
+append goes through a per-log WalCommitter — concurrent writers
+coalesce into one buffered write + one fsync per commit window, and
+`enqueue` returns only after the hint's commit. A hint is therefore
+exactly as durable as the acked write it repairs.
+
+Crash recovery follows the PR-7 torn-tail contract, adapted to the
+hint log's weaker obligations: on open, records are scanned in order
+and the log is truncated at the FIRST damaged record (partial tail,
+bad checksum). For the fragment WAL a mid-log checksum error is rot
+and must raise; a hint log may truncate there too, because every hint
+is a *repair accelerator* — anything dropped is healed by the next
+anti-entropy pass. Drops are counted (`dropped_total`), never silent.
+
+Backlog bound: `[cluster] hint-max-bytes` per target. When an append
+would exceed it, the OLDEST hints spill first (they are the ones
+anti-entropy will reach soonest) until the new hint fits — the log
+never grows without bound under a long outage.
+
+The drainer is a single paced thread: each tick (or immediately on
+`notify(host)` — recovering nodes announce readiness via gossip /
+status poll / breaker close) it walks the non-empty logs, skips
+targets whose breaker is OPEN (a half-open breaker admits the
+drainer's first replay as the probe), and replays each log in order,
+truncating only after the target acks everything replayed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .. import fault
+from ..core.wal import WalCommitter, WalConfig
+from ..obs import StatMap, get_logger
+from ..roaring.serialize import fnv32a
+
+HINT_MAGIC = 0xF9
+_HEADER = struct.Struct("<BI")   # magic, payload length
+_CRC = struct.Struct("<I")
+
+# Process-wide hint telemetry, exported at /metrics as
+# pilosa_hints_{queued,replayed,dropped}_total{target} by the
+# handler's hints collector. Keys: "queued:<target>",
+# "replayed:<target>", "dropped:<target>", "torn_tails",
+# "replay_failures".
+HINT_STATS = StatMap()
+
+DEFAULT_HINT_MAX_BYTES = 64 << 20
+DEFAULT_DRAIN_INTERVAL = 1.0
+
+
+def _sanitize(host: str) -> str:
+    """Filesystem-safe log name for a host ("127.0.0.1:10101")."""
+    return "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                   for ch in host) or "_"
+
+
+def encode_hint(payload: dict) -> bytes:
+    body = json.dumps(payload, separators=(",", ":"),
+                      sort_keys=True).encode()
+    return (_HEADER.pack(HINT_MAGIC, len(body)) + body
+            + _CRC.pack(fnv32a(body)))
+
+
+def scan_hints(data: bytes):
+    """Crash-tolerant log parse -> (payloads, valid_bytes).
+
+    Truncation point is the FIRST damaged record: a partial tail is
+    the expected crash-mid-append shape (PR-7 torn-tail contract);
+    a checksum mismatch anywhere is treated the same way because a
+    hint log owes only acceleration, not authority — anti-entropy
+    heals whatever is dropped, and the caller counts the drop."""
+    out: List[dict] = []
+    off = 0
+    n = len(data)
+    while off + _HEADER.size <= n:
+        magic, length = _HEADER.unpack_from(data, off)
+        end = off + _HEADER.size + length + _CRC.size
+        if magic != HINT_MAGIC or end > n:
+            break
+        body = data[off + _HEADER.size:end - _CRC.size]
+        (crc,) = _CRC.unpack_from(data, end - _CRC.size)
+        if crc != fnv32a(body):
+            break
+        try:
+            out.append(json.loads(body.decode()))
+        except ValueError:
+            break
+        off = end
+    return out, off
+
+
+class HintLog:
+    """One target's durable hint journal.
+
+    All mutation happens under `_mu`; the WalCommitter provides the
+    fsync batching (its own condition variable layers under `_mu`
+    the same way it layers under Fragment._mu — nothing under the
+    committer lock ever takes `_mu`)."""
+
+    def __init__(self, path: str, target: str, wal_cfg: WalConfig,
+                 max_bytes: int = DEFAULT_HINT_MAX_BYTES, logger=None):
+        self.path = path
+        self.target = target
+        self.max_bytes = int(max_bytes)
+        self.logger = logger or get_logger("hints")
+        self._mu = threading.RLock()
+        self._records: deque = deque()   # (payload dict, encoded length)
+        self._bytes = 0
+        self._fh = None
+        self._committer = WalCommitter(wal_cfg, path=path)
+        self._open()
+
+    # -- storage -------------------------------------------------------------
+
+    def _open(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        data = b""
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+        payloads, valid = scan_hints(data)
+        if valid < len(data):
+            # Torn/damaged tail: keep the valid prefix, drop the rest
+            # (counted — anti-entropy covers what a hint log loses).
+            self.logger.warning(
+                "hint log %s: truncating %d damaged byte(s) at offset "
+                "%d (torn tail)", self.path, len(data) - valid, valid)
+            HINT_STATS.inc("torn_tails")
+            with open(self.path, "r+b") as f:
+                f.truncate(valid)
+                f.flush()
+                os.fsync(f.fileno())
+        for p in payloads:
+            self._records.append((p, len(encode_hint(p))))
+        self._bytes = valid
+        self._fh = open(self.path, "ab", buffering=0)
+        self._committer.retarget(self._fh)
+
+    def append(self, payload: dict) -> None:
+        """Durably journal one hint; returns after its group commit."""
+        rec = encode_hint(payload)
+        with self._mu:
+            if self.max_bytes > 0 and self._bytes + len(rec) > self.max_bytes:
+                self._spill_locked(len(rec))
+            self._committer.write(rec)
+            seq = self._committer.seq()
+            self._records.append((payload, len(rec)))
+            self._bytes += len(rec)
+        self._committer.wait_durable(seq)
+        HINT_STATS.inc(f"queued:{self.target}")
+
+    def _spill_locked(self, need: int) -> None:
+        """Oldest-first drop until `need` bytes fit under the bound.
+        The dropped ops are exactly the ones the next anti-entropy
+        pass reaches soonest; the counter keeps the spill honest."""
+        dropped = 0
+        while self._records and (self._bytes + need > self.max_bytes):
+            _, length = self._records.popleft()
+            self._bytes -= length
+            dropped += 1
+        if dropped:
+            HINT_STATS.inc(f"dropped:{self.target}", dropped)
+            self.logger.warning(
+                "hint log %s: spilled %d oldest hint(s) to anti-entropy "
+                "(hint-max-bytes=%d)", self.path, dropped, self.max_bytes)
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the log to exactly the live records (tmp + fsync +
+        rename, the snapshot idiom), then retarget the committer at
+        the fresh file so subsequent appends land there."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for p, _length in self._records:
+                f.write(encode_hint(p))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(self.path, "ab", buffering=0)
+        self._committer.retarget(self._fh)
+        self._bytes = sum(length for _, length in self._records)
+
+    # -- drain ---------------------------------------------------------------
+
+    def peek_all(self) -> List[dict]:
+        with self._mu:
+            return [p for p, _ in self._records]
+
+    def ack(self, n: int) -> None:
+        """The target acked the first `n` records: drop them and
+        compact so the on-disk log shrinks with the backlog (the log
+        is truncated only AFTER the ack — a crash in between replays
+        idempotently)."""
+        if n <= 0:
+            return
+        with self._mu:
+            for _ in range(min(n, len(self._records))):
+                self._records.popleft()
+            self._compact_locked()
+
+    def record_count(self) -> int:
+        with self._mu:
+            return len(self._records)
+
+    def byte_size(self) -> int:
+        with self._mu:
+            return self._bytes
+
+    def close(self):
+        with self._mu:
+            self._committer.detach()
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class HintManager:
+    """All targets' hint logs + the paced drainer.
+
+    `client_factory(host) -> InternalClient` is the replay plane;
+    `breaker_state(host) -> str` (optional) gates replay so an OPEN
+    breaker is never hammered (half-open admits the drainer's first
+    replay as the probe). `on_drained(host)` (optional) fires after a
+    target's backlog reaches zero."""
+
+    def __init__(self, directory: str,
+                 client_factory: Optional[Callable] = None,
+                 breaker_state: Optional[Callable[[str], str]] = None,
+                 max_bytes: int = DEFAULT_HINT_MAX_BYTES,
+                 drain_interval: float = DEFAULT_DRAIN_INTERVAL,
+                 wal_cfg: Optional[WalConfig] = None,
+                 logger=None, stats=None):
+        self.directory = directory
+        self.client_factory = client_factory
+        self.breaker_state = breaker_state
+        self.max_bytes = int(max_bytes)
+        self.drain_interval = float(drain_interval)
+        self.wal_cfg = wal_cfg or WalConfig()
+        self.logger = logger or get_logger("hints")
+        self.stats = stats
+        self.on_drained: Optional[Callable[[str], None]] = None
+        self._mu = threading.Lock()
+        self._logs: Dict[str, HintLog] = {}
+        self._wake = threading.Event()
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._recover_existing()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _recover_existing(self):
+        """Reopen every surviving hint log so a restarted node resumes
+        its repair obligations (hints are durable state, not session
+        state)."""
+        if not os.path.isdir(self.directory):
+            return
+        for name in sorted(os.listdir(self.directory)):
+            if not name.endswith(".hintlog"):
+                continue
+            path = os.path.join(self.directory, name)
+            target = name[:-len(".hintlog")]
+            try:
+                log = HintLog(path, target, self.wal_cfg,
+                              max_bytes=self.max_bytes, logger=self.logger)
+            except OSError as e:
+                self.logger.warning("hint log %s unreadable: %s", path, e)
+                continue
+            if log.record_count() == 0:
+                log.close()
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            self._logs[target] = log
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        name="hint-drain", daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._closed.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._mu:
+            for log in self._logs.values():
+                log.close()
+            self._logs.clear()
+
+    # -- enqueue -------------------------------------------------------------
+
+    def _log_for(self, host: str) -> HintLog:
+        key = _sanitize(host)
+        with self._mu:
+            log = self._logs.get(key)
+            if log is None:
+                path = os.path.join(self.directory, key + ".hintlog")
+                log = self._logs[key] = HintLog(
+                    path, key, self.wal_cfg, max_bytes=self.max_bytes,
+                    logger=self.logger)
+            return log
+
+    def enqueue_query(self, host: str, index: str, pql: str) -> None:
+        """Journal a missed PQL write for `host` (SetBit/ClearBit/
+        attr broadcasts all travel as their canonical serialization,
+        the same encoding the live fan-out uses)."""
+        self._log_for(host).append({
+            "kind": "query", "host": host, "index": index, "pql": pql})
+
+    def enqueue_import(self, host: str, index: str, frame: str,
+                       slice_: int, rows, cols, ts=None) -> None:
+        self._log_for(host).append({
+            "kind": "import", "host": host, "index": index,
+            "frame": frame, "slice": int(slice_),
+            "rows": [int(r) for r in rows],
+            "cols": [int(c) for c in cols],
+            "ts": [int(t) for t in ts] if ts else None})
+
+    def notify(self, host: str) -> None:
+        """A target announced readiness (gossip alive, status-poll
+        success, breaker close): wake the drainer now instead of on
+        its timer."""
+        self._wake.set()
+
+    # -- drain ---------------------------------------------------------------
+
+    def _drain_loop(self):
+        while not self._closed.is_set():
+            self._wake.wait(self.drain_interval)
+            self._wake.clear()
+            if self._closed.is_set():
+                return
+            try:
+                self.drain_once()
+            except Exception as e:  # noqa: BLE001 — drainer never dies
+                self.logger.warning("hint drain pass failed: %s", e)
+
+    def drain_once(self) -> int:
+        """One replay pass over every non-empty log; returns hints
+        replayed. Per target: skip while the breaker is OPEN (half-
+        open admits the first replay as the probe), replay in order,
+        stop at the first failure (order is the contract), truncate
+        only what was acked."""
+        with self._mu:
+            logs = dict(self._logs)
+        replayed = 0
+        for target, log in logs.items():
+            if self._closed.is_set():
+                break
+            if log.record_count() == 0:
+                continue
+            host = None
+            acked = 0
+            try:
+                for payload in log.peek_all():
+                    if self._closed.is_set():
+                        break
+                    host = payload.get("host", target)
+                    state = (self.breaker_state(host)
+                             if self.breaker_state is not None else "closed")
+                    if state == "open":
+                        break  # known-down: wait for half-open/notify
+                    fault.point("hints.replay", target=host,
+                                kind=payload.get("kind", ""))
+                    self._replay(host, payload)
+                    acked += 1
+            except Exception as e:  # noqa: BLE001 — stop, keep order
+                HINT_STATS.inc("replay_failures")
+                self.logger.info(
+                    "hint replay to %s stopped after %d: %s",
+                    host or target, acked, e)
+            if acked:
+                log.ack(acked)
+                HINT_STATS.inc(f"replayed:{target}", acked)
+                replayed += acked
+                if self.stats is not None:
+                    # "...N" idiom (setN, wal_fsyncN): keeps the expvar
+                    # prom bridge from colliding with the labeled
+                    # pilosa_hints_replayed_total family
+                    self.stats.count("hintReplayN", acked)
+                if log.record_count() == 0 and self.on_drained is not None:
+                    try:
+                        self.on_drained(host or target)
+                    except Exception:  # noqa: BLE001
+                        pass
+        return replayed
+
+    def _replay(self, host: str, payload: dict) -> None:
+        if self.client_factory is None:
+            raise RuntimeError("hint replay has no client factory")
+        client = self.client_factory(host)
+        kind = payload.get("kind")
+        if kind == "query":
+            client.execute_query(None, payload["index"], payload["pql"],
+                                 [], remote=True)
+        elif kind == "import":
+            client.import_bits(payload["index"], payload["frame"],
+                               payload["slice"], payload["rows"],
+                               payload["cols"], payload.get("ts"),
+                               remote=True)
+        else:
+            raise ValueError(f"unknown hint kind: {kind!r}")
+
+    # -- introspection -------------------------------------------------------
+
+    def backlog_records(self) -> int:
+        with self._mu:
+            logs = list(self._logs.values())
+        return sum(log.record_count() for log in logs)
+
+    def backlog_bytes_by_target(self) -> Dict[str, int]:
+        with self._mu:
+            logs = dict(self._logs)
+        return {t: log.byte_size() for t, log in logs.items()
+                if log.record_count() > 0}
+
+    def snapshot(self) -> dict:
+        """The /debug/vars `hints` section: per-target queue state
+        plus the lifetime counters."""
+        with self._mu:
+            logs = dict(self._logs)
+        stats = HINT_STATS.copy()
+        targets = {}
+        for t, log in logs.items():
+            targets[t] = {
+                "records": log.record_count(),
+                "bytes": log.byte_size(),
+                "queued_total": stats.get(f"queued:{t}", 0),
+                "replayed_total": stats.get(f"replayed:{t}", 0),
+                "dropped_total": stats.get(f"dropped:{t}", 0),
+            }
+        return {
+            "targets": targets,
+            "backlog_records": sum(v["records"] for v in targets.values()),
+            "backlog_bytes": sum(v["bytes"] for v in targets.values()),
+            "torn_tails": stats.get("torn_tails", 0),
+            "replay_failures": stats.get("replay_failures", 0),
+        }
+
+    def wait_drained(self, timeout: float = 10.0) -> bool:
+        """Block until every backlog is empty (tests, loadgen exit
+        gate). Pokes the drainer while waiting."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.backlog_records() == 0:
+                return True
+            self._wake.set()
+            time.sleep(0.05)
+        return self.backlog_records() == 0

@@ -18,12 +18,13 @@ daemons:
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, Optional
 
 from .api import APIServer, Handler, InternalClient
-from .api.client import BreakerRegistry
+from .api.client import BREAKER_CLOSED, BREAKER_OPEN, BreakerRegistry
 from .config import Config
 from .core.fragment import (
     IntegrityContext,
@@ -37,10 +38,12 @@ from .core.view import VIEW_INVERSE, VIEW_STANDARD
 from .executor import Executor
 from .parallel.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
 from .parallel.cluster import (
+    NODE_STATE_DOWN,
     NODE_STATE_UP,
     Cluster,
     Node,
 )
+from .parallel.hints import HintManager
 from .parallel.rebalance import Rebalancer
 from .obs import StatMap, Tracer, slo as obs_slo
 from .utils.stats import ExpvarStats
@@ -235,12 +238,37 @@ class Server:
                 # diverge the replicas.
                 self.executor._mesh_mgr = self.spmd.manager
                 self.executor.spmd_reject_writes = True
+        # Write-path replication resilience (ISSUE 13): quorum acks +
+        # durable hinted handoff. The hint plane only exists on real
+        # multi-node HTTP/gossip clusters — SPMD replicates through the
+        # descriptor stream, and a single-node ring has no replicas to
+        # miss (so single-node tests pay zero threads/dirs for it).
+        self.executor.write_consistency = self.config.write_consistency
+        self.hints: Optional[HintManager] = None
+        if self.spmd is None and (len(self.cluster.nodes) > 1
+                                  or ctype == "gossip"):
+            self.hints = HintManager(
+                os.path.join(self.config.expanded_data_dir(), ".hints"),
+                client_factory=self.client.for_host,
+                breaker_state=self.client.breaker_state,
+                max_bytes=self.config.hint_max_bytes,
+                drain_interval=self.config.hint_drain_interval,
+                wal_cfg=self.config.wal_config(),
+                logger=self.logger, stats=self.stats)
+            self.executor.hints = self.hints
+            # Failure-detection feedback: an opening breaker marks the
+            # node DOWN cluster-wide (the write path then hints instead
+            # of paying its timeout per write); a close marks it live
+            # and wakes the drainer immediately.
+            self.client.breakers.on_change = self._breaker_change
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
             client_factory=self.client.for_host, stats=self.stats,
             logger=self.logger, tracer=self.tracer)
+        self.handler.hints = self.hints
+        self.handler.write_consistency = self.config.write_consistency
         # Default per-query budget ([cluster] query-deadline; 0 = none).
         self.handler.default_deadline = self.config.query_deadline
         # Sampled-gauge cadence for /metrics ([obs]
@@ -340,6 +368,8 @@ class Server:
                 self.node_set.local_host = self.host
         self._api.start()
         self.node_set.open()
+        if self.hints is not None:
+            self.hints.start()
 
         for name, fn, interval, jitter in [
             ("anti-entropy", self._anti_entropy_tick,
@@ -399,6 +429,8 @@ class Server:
         for t in self._threads:
             if t.name == "warm":
                 t.join(timeout=10)
+        if self.hints is not None:
+            self.hints.close()
         self.node_set.close()
         if self._api is not None:
             self._api.close()
@@ -413,13 +445,21 @@ class Server:
         self.cluster.node_set_hosts = hosts
         joined = False
         for h in hosts:
-            if h != self.host and self.cluster.node_by_host(h) is None:
+            if h == self.host:
+                continue
+            if self.cluster.node_by_host(h) is None:
                 try:
                     self.cluster.begin_join(h)
                     joined = True
                     self.logger.info(f"gossip: new member {h} JOINING")
                 except ValueError:
                     pass
+            elif self.cluster.mark_live(h):
+                # A known member came back from DOWN: its backlog of
+                # missed writes can drain now, not at the next timer.
+                self.logger.info(f"gossip: member {h} back UP")
+                if self.hints is not None:
+                    self.hints.notify(h)
         if joined:
             self.rebalancer.trigger()
 
@@ -459,9 +499,28 @@ class Server:
             except Exception:  # noqa: BLE001 — unreachable peer
                 node.mark_unreachable()
                 continue
+            was_down = node.state == NODE_STATE_DOWN
             node.mark_live()
+            if was_down and self.hints is not None:
+                # Recovery observed by the poll: wake the drainer now.
+                self.hints.notify(node.host)
             self._peer_status[node.host] = status
             self.handle_remote_status(status)
+
+    def _breaker_change(self, host: str, state: str):
+        """Circuit-breaker liveness feedback (BreakerRegistry
+        on_change, fired outside the breaker lock): an opening breaker
+        collapses the node to DOWN so every writer stops paying its
+        timeout; a close (successful probe) marks it live and wakes
+        the hint drainer for immediate catch-up."""
+        if state == BREAKER_OPEN:
+            if self.cluster.mark_unreachable(host):
+                self.logger.info(f"breaker open: marked {host} DOWN")
+        elif state == BREAKER_CLOSED:
+            if self.cluster.mark_live(host):
+                self.logger.info(f"breaker closed: {host} back UP")
+            if self.hints is not None:
+                self.hints.notify(host)
 
     def _cache_flush_tick(self):
         self.holder.flush_caches()

@@ -1,0 +1,43 @@
+"""Child process for the hinted-handoff chaos test (test_hints.py):
+boot ONE member of a static multi-node cluster on the given data dir +
+host list, then serve until killed. The parent SIGKILLs this replica
+mid-SetBit-stream and later respawns it on the same data dir to assert
+that hint replay converges it bit-for-bit with the survivors.
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    data_dir, host, hosts_csv, replica_n = (
+        sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    c = Config()
+    c.data_dir = data_dir
+    c.host = host
+    c.cluster_hosts = hosts_csv.split(",")
+    c.replica_n = replica_n
+    c.anti_entropy_interval = 3600
+    c.polling_interval = 3600
+    c.sched_enabled = False
+    s = Server(c)
+    s.open()
+    print(f"READY {host}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,682 @@
+"""Write-path replication resilience (ISSUE 13): durable hinted
+handoff, quorum write semantics, and replica catch-up.
+
+Three layers, mirroring the subsystem's seams:
+  - HintLog / scan_hints contract tests (durability, torn-tail
+    truncation, the hint-max-bytes oldest-first spill, ack/compact);
+  - executor-level quorum semantics with mocked remote clients
+    (consistency levels, pre-apply rejection, hint classification,
+    the legacy no-hints contract, attr-broadcast fallback);
+  - real 3-node HTTP clusters: a downed replica must not cost write
+    availability at quorum, and hint replay must converge the replica
+    bit-for-bit after restart — including the SIGKILL chaos variant
+    (subprocess, slow) modeled on test_crash_recovery.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.api import InternalClient
+from pilosa_tpu.config import Config, parse_write_consistency
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.wal import WalConfig
+from pilosa_tpu.errors import BroadcastError, WriteConsistencyError
+from pilosa_tpu.executor import Executor, required_acks
+from pilosa_tpu.parallel import Cluster, ModHasher, Node
+from pilosa_tpu.parallel.hints import (
+    HINT_STATS,
+    HintLog,
+    HintManager,
+    encode_hint,
+    scan_hints,
+)
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu.server import Server
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "hint_child.py")
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _stat(key):
+    return HINT_STATS.copy().get(key, 0)
+
+
+# -- hint log contract --------------------------------------------------------
+
+
+class TestScanHints:
+    def test_roundtrip(self):
+        recs = [{"kind": "query", "host": "h:1", "index": "i", "pql": "x"},
+                {"kind": "import", "slice": 3}]
+        data = b"".join(encode_hint(r) for r in recs)
+        out, valid = scan_hints(data)
+        assert out == recs and valid == len(data)
+
+    def test_partial_tail_truncated(self):
+        data = encode_hint({"a": 1}) + encode_hint({"b": 2})[:-3]
+        out, valid = scan_hints(data)
+        assert out == [{"a": 1}]
+        assert valid == len(encode_hint({"a": 1}))
+
+    def test_first_damaged_record_stops_scan(self):
+        """A mid-log checksum flip drops that record AND everything
+        after it — a hint log owes acceleration, not authority, so the
+        safe recovery is the valid prefix."""
+        r1, r2, r3 = encode_hint({"a": 1}), encode_hint({"b": 2}), \
+            encode_hint({"c": 3})
+        mangled = bytearray(r1 + r2 + r3)
+        mangled[len(r1) + 6] ^= 0xFF  # inside r2's payload
+        out, valid = scan_hints(bytes(mangled))
+        assert out == [{"a": 1}] and valid == len(r1)
+
+
+class TestHintLog:
+    def _log(self, tmp_path, **kw):
+        return HintLog(str(tmp_path / "t.hintlog"), "t", WalConfig(), **kw)
+
+    def test_append_survives_reopen(self, tmp_path):
+        log = self._log(tmp_path)
+        payloads = [{"kind": "query", "host": "h", "index": "i",
+                     "pql": f"SetBit(columnID={n})"} for n in range(3)]
+        for p in payloads:
+            log.append(p)
+        log.close()
+        log2 = self._log(tmp_path)
+        assert log2.peek_all() == payloads
+        assert log2.byte_size() == sum(len(encode_hint(p))
+                                       for p in payloads)
+        log2.close()
+
+    def test_torn_tail_recovered_and_counted(self, tmp_path):
+        log = self._log(tmp_path)
+        log.append({"n": 1})
+        log.append({"n": 2})
+        log.close()
+        path = str(tmp_path / "t.hintlog")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 3)
+        before = _stat("torn_tails")
+        log2 = self._log(tmp_path)
+        assert log2.peek_all() == [{"n": 1}]
+        assert _stat("torn_tails") == before + 1
+        # the truncation is durable and the log accepts appends again
+        log2.append({"n": 3})
+        log2.close()
+        log3 = self._log(tmp_path)
+        assert log3.peek_all() == [{"n": 1}, {"n": 3}]
+        log3.close()
+
+    def test_max_bytes_spills_oldest_first(self, tmp_path):
+        one = len(encode_hint({"n": 0}))
+        log = self._log(tmp_path, max_bytes=3 * one)
+        before = _stat("dropped:t")
+        for n in range(10):
+            log.append({"n": n})
+        assert [p["n"] for p in log.peek_all()] == [7, 8, 9]
+        assert log.byte_size() <= 3 * one
+        assert _stat("dropped:t") == before + 7
+        # on-disk file was compacted to the survivors
+        assert os.path.getsize(str(tmp_path / "t.hintlog")) == 3 * one
+        log.close()
+
+    def test_ack_compacts_on_disk(self, tmp_path):
+        log = self._log(tmp_path)
+        for n in range(3):
+            log.append({"n": n})
+        log.ack(2)
+        assert log.peek_all() == [{"n": 2}]
+        log.close()
+        log2 = self._log(tmp_path)
+        assert log2.peek_all() == [{"n": 2}]
+        log2.close()
+
+
+class _ReplayClient:
+    """Replay-plane fake: records calls in order; raises for hosts in
+    `fail_hosts` to exercise stop-at-first-failure ordering."""
+
+    def __init__(self, fail_hosts=()):
+        self.calls = []
+        self.fail_hosts = set(fail_hosts)
+
+    def _bound(self, host):
+        self.host = host
+        return self
+
+    def execute_query(self, node, index, pql, slices, remote=True,
+                      **kw):
+        if self.host in self.fail_hosts:
+            raise ConnectionError(f"{self.host} down")
+        self.calls.append(("query", self.host, index, pql))
+        return [True]
+
+    def import_bits(self, index, frame, slice_, rows, cols, ts=None,
+                    remote=False):
+        if self.host in self.fail_hosts:
+            raise ConnectionError(f"{self.host} down")
+        self.calls.append(("import", self.host, index, frame, slice_,
+                           list(rows), list(cols)))
+
+
+class TestHintManager:
+    def _mgr(self, tmp_path, client=None, breaker=None):
+        return HintManager(
+            str(tmp_path / "hints"),
+            client_factory=client._bound if client else None,
+            breaker_state=breaker,
+            drain_interval=3600)
+
+    def test_drain_replays_in_order(self, tmp_path):
+        cli = _ReplayClient()
+        m = self._mgr(tmp_path, cli)
+        m.enqueue_query("h:1", "i", "SetBit(columnID=1)")
+        m.enqueue_import("h:1", "i", "f", 0, [1], [2], None)
+        m.enqueue_query("h:2", "i", "SetBit(columnID=9)")
+        assert m.backlog_records() == 3
+        assert m.drain_once() == 3
+        assert m.backlog_records() == 0
+        h1 = [c for c in cli.calls if c[1] == "h:1"]
+        assert [c[0] for c in h1] == ["query", "import"]
+        assert h1[1][5:] == ([1], [2])
+        m.close()
+
+    def test_open_breaker_defers_half_open_admits(self, tmp_path):
+        cli = _ReplayClient()
+        state = {"h:1": "open"}
+        m = self._mgr(tmp_path, cli, breaker=lambda h: state.get(h, "closed"))
+        m.enqueue_query("h:1", "i", "SetBit(columnID=1)")
+        assert m.drain_once() == 0
+        assert m.backlog_records() == 1
+        state["h:1"] = "half-open"  # the replay IS the probe
+        assert m.drain_once() == 1
+        assert m.backlog_records() == 0
+        m.close()
+
+    def test_replay_failure_stops_in_order_then_resumes(self, tmp_path):
+        cli = _ReplayClient(fail_hosts={"h:1"})
+        m = self._mgr(tmp_path, cli)
+        for n in range(3):
+            m.enqueue_query("h:1", "i", f"SetBit(columnID={n})")
+        before = _stat("replay_failures")
+        assert m.drain_once() == 0
+        assert m.backlog_records() == 3  # nothing acked, order intact
+        assert _stat("replay_failures") == before + 1
+        cli.fail_hosts.clear()
+        assert m.drain_once() == 3
+        assert [c[3] for c in cli.calls] == [
+            f"SetBit(columnID={n})" for n in range(3)]
+        m.close()
+
+    def test_backlog_survives_manager_restart(self, tmp_path):
+        m = self._mgr(tmp_path)
+        m.enqueue_query("h:1", "i", "SetBit(columnID=1)")
+        m.enqueue_query("h:2", "i", "SetBit(columnID=2)")
+        m.close()
+        cli = _ReplayClient()
+        m2 = self._mgr(tmp_path, cli)
+        assert m2.backlog_records() == 2
+        assert set(m2.backlog_bytes_by_target()) == {"h_1", "h_2"}
+        assert m2.drain_once() == 2
+        m2.close()
+
+    def test_notify_wakes_drainer_thread(self, tmp_path):
+        cli = _ReplayClient()
+        m = self._mgr(tmp_path, cli)
+        m.start()
+        m.enqueue_query("h:1", "i", "SetBit(columnID=1)")
+        m.notify("h:1")
+        deadline = time.monotonic() + 5
+        while m.backlog_records() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert m.backlog_records() == 0
+        m.close()
+
+
+# -- executor quorum semantics (mocked remotes) -------------------------------
+
+
+class _QuorumClient:
+    """Executor remote seam: acks every host except `fail_hosts`."""
+
+    def __init__(self, fail_hosts=()):
+        self.fail_hosts = set(fail_hosts)
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote):
+        if node.host in self.fail_hosts:
+            raise ConnectionError(f"{node.host} down")
+        self.calls.append((node.host, query))
+        return [True]
+
+
+class TestQuorumWrites:
+    def _cluster(self, replica_n=3):
+        return Cluster(nodes=[Node("host0"), Node("host1"), Node("host2")],
+                       hasher=ModHasher(), partition_n=4,
+                       replica_n=replica_n)
+
+    def _executor(self, tmp_path, holder, client, level="quorum",
+                  with_hints=True, cluster=None):
+        e = Executor(holder, host="host0",
+                     cluster=cluster or self._cluster(),
+                     client=client, use_device=False)
+        e.write_consistency = level
+        if with_hints:
+            e.hints = HintManager(str(tmp_path / "hints"),
+                                  drain_interval=3600)
+        return e
+
+    def _setbit(self, e):
+        return e.execute(
+            "i", parse_string('SetBit(frame="general", rowID=1, columnID=0)'),
+            None, None)[0]
+
+    def test_required_acks(self):
+        assert required_acks("one", 3) == 1
+        assert required_acks("quorum", 3) == 2
+        assert required_acks("quorum", 2) == 2
+        assert required_acks("all", 3) == 3
+
+    def test_parse_write_consistency_rejects_typo(self):
+        assert parse_write_consistency("ALL") == "all"
+        with pytest.raises(ValueError):
+            parse_write_consistency("bogus")
+
+    def test_quorum_acks_with_one_replica_failed(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        e = self._executor(tmp_path, h, _QuorumClient({"host2"}))
+        assert self._setbit(e) is True
+        # local applied, host1 acked, host2's miss journaled
+        assert list(h.fragment("i", "general", "standard", 0).row(1)) == [0]
+        assert e.hints.backlog_records() == 1
+        (p,) = e.hints._log_for("host2").peek_all()
+        assert p["kind"] == "query" and "SetBit" in p["pql"]
+        e.hints.close()
+        h.close()
+
+    def test_below_consistency_raises_but_still_hints(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        e = self._executor(tmp_path, h, _QuorumClient({"host1", "host2"}),
+                           level="all")
+        with pytest.raises(WriteConsistencyError) as ei:
+            self._setbit(e)
+        assert ei.value.required == 3 and ei.value.acked == 1
+        assert ei.value.transient  # maps to 503 + Retry-After, not 500
+        # applied replicas (local) still converge via the hints
+        assert e.hints.backlog_records() == 2
+        e.hints.close()
+        h.close()
+
+    def test_known_down_replicas_reject_before_local_apply(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        cluster = self._cluster()
+        for node in cluster.nodes[1:]:
+            node.set_state("DOWN")
+        e = self._executor(tmp_path, h, _QuorumClient(), cluster=cluster)
+        with pytest.raises(WriteConsistencyError) as ei:
+            self._setbit(e)
+        assert ei.value.acked == 0
+        # rejected BEFORE local apply: no acked-but-ambiguous state
+        assert h.fragment("i", "general", "standard", 0) is None
+        assert e.hints.backlog_records() == 0
+        e.hints.close()
+        h.close()
+
+    def test_consistency_one_acks_locally_hints_down_peers(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        cluster = self._cluster()
+        for node in cluster.nodes[1:]:
+            node.set_state("DOWN")
+        e = self._executor(tmp_path, h, _QuorumClient(), level="one",
+                           cluster=cluster)
+        assert self._setbit(e) is True
+        # down peers were never dialed (no timeout paid), just hinted
+        assert e.hints.backlog_records() == 2
+        e.hints.close()
+        h.close()
+
+    def test_no_hints_keeps_legacy_fail_fast(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        e = self._executor(tmp_path, h, _QuorumClient({"host1", "host2"}),
+                           with_hints=False)
+        with pytest.raises(ConnectionError):
+            self._setbit(e)
+        h.close()
+
+    def test_attr_broadcast_failure_becomes_hint(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        e = self._executor(tmp_path, h, _QuorumClient({"host2"}))
+        e.execute("i", parse_string(
+            'SetRowAttrs(frame="general", rowID=7, color="red")'),
+            None, None)
+        assert h.frame("i", "general").row_attr_store.attrs(7) == \
+            {"color": "red"}
+        (p,) = e.hints._log_for("host2").peek_all()
+        assert "SetRowAttrs" in p["pql"]
+        e.hints.close()
+        # without a hint plane the same failure surfaces, as before
+        e2 = self._executor(tmp_path, h, _QuorumClient({"host2"}),
+                            with_hints=False)
+        with pytest.raises(BroadcastError):
+            e2.execute("i", parse_string(
+                'SetRowAttrs(frame="general", rowID=8, color="blue")'),
+                None, None)
+        h.close()
+
+    def test_explain_reports_consistency(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        h.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "general")
+        e = self._executor(tmp_path, h, _QuorumClient())
+        info = e.explain("i", parse_string(
+            'SetBit(frame="general", rowID=1, columnID=0)'))["calls"][0]
+        assert info["consistency"] == {
+            "level": "quorum", "replicas": 3, "required_acks": 2,
+            "hinted_handoff": True}
+        e.hints.close()
+        h.close()
+
+
+# -- real 3-node HTTP clusters ------------------------------------------------
+
+
+def _boot(tmp_path, hosts, i, consistency="quorum"):
+    c = Config()
+    c.data_dir = str(tmp_path / f"hnode{i}")
+    c.host = hosts[i]
+    c.cluster_hosts = list(hosts)
+    c.replica_n = 3
+    c.write_consistency = consistency
+    c.hint_drain_interval = 3600  # tests drive the drainer explicitly
+    c.anti_entropy_interval = 3600
+    c.polling_interval = 3600
+    s = Server(c)
+    s.open()
+    return s
+
+
+def _reconnect(coordinator: Server, host: str):
+    """Tell the coordinator the replica is back: close its breaker
+    (fires mark_live + hints.notify via the on_change wiring — the
+    fast path that gossip/status-poll take in production)."""
+    coordinator.client.breakers.for_host(host).record_success()
+
+
+class TestQuorumHTTP:
+    def test_replica_down_keeps_acking_then_converges(self, tmp_path):
+        ports = free_ports(3)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = [_boot(tmp_path, hosts, i) for i in range(3)]
+        try:
+            cli = InternalClient(hosts[0])
+            cli.create_index("q")
+            cli.create_frame("q", "f")
+            # warm writes land on ALL three owners
+            assert cli.execute_query(
+                None, "q", "SetBit(rowID=1, frame=f, columnID=0)", [],
+                remote=False) == [True]
+            for s in servers:
+                assert s.holder.fragment("q", "f", "standard", 0) \
+                    .count() == 1
+
+            # kill one replica; every subsequent quorum write must
+            # STILL ack (no 5xx — this is the availability contract)
+            servers[2].close()
+            cols = list(range(1, 41))
+            for col in cols:
+                assert cli.execute_query(
+                    None, "q",
+                    f"SetBit(rowID=1, frame=f, columnID={col})", [],
+                    remote=False) == [True]
+            assert servers[0].hints.backlog_records() >= len(cols)
+            assert servers[1].holder.fragment("q", "f", "standard", 0) \
+                .count() == len(cols) + 1
+
+            # restart the replica on the SAME data dir, reconnect, and
+            # drain: it must converge to bit-identical
+            servers[2] = _boot(tmp_path, hosts, 2)
+            _reconnect(servers[0], hosts[2])
+            assert servers[0].hints.wait_drained(30)
+            want = sorted([0] + cols)
+            assert sorted(servers[2].holder.fragment(
+                "q", "f", "standard", 0).row(1)) == want
+            # block-level convergence, the anti-entropy currency
+            blocks = [InternalClient(h).fragment_blocks("q", "f",
+                                                        "standard", 0)
+                      for h in hosts]
+            assert blocks[0] == blocks[1] == blocks[2]
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    def test_below_consistency_is_503_with_retry_after(self, tmp_path):
+        ports = free_ports(3)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = [_boot(tmp_path, hosts, i, consistency="all")
+                   for i in range(3)]
+        try:
+            cli = InternalClient(hosts[0])
+            cli.create_index("q")
+            cli.create_frame("q", "f")
+            servers[2].close()
+            req = urllib.request.Request(
+                f"http://{hosts[0]}/index/q/query",
+                data=b"SetBit(rowID=1, frame=f, columnID=5)",
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert float(ei.value.headers["Retry-After"]) > 0
+            # the miss is still journaled: the replica that applied
+            # must converge even though the client saw a retryable 503
+            assert servers[0].hints.backlog_records() >= 1
+
+            # once the failure detector knows the node is DOWN, the
+            # same write is rejected BEFORE any replica applies
+            servers[0].cluster.node_by_host(hosts[2]).set_state("DOWN")
+            before = servers[0].hints.backlog_records()
+            with pytest.raises(urllib.error.HTTPError) as ei2:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://{hosts[0]}/index/q/query",
+                        data=b"SetBit(rowID=1, frame=f, columnID=6)",
+                        method="POST"), timeout=30)
+            assert ei2.value.code == 503
+            assert servers[0].hints.backlog_records() == before
+            frag = servers[0].holder.fragment("q", "f", "standard", 0)
+            assert frag is None or 6 not in list(frag.row(1))
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    def test_import_quorum_and_hint_replay(self, tmp_path):
+        ports = free_ports(3)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = [_boot(tmp_path, hosts, i) for i in range(3)]
+        try:
+            cli = InternalClient(hosts[0])
+            cli.create_index("q")
+            cli.create_frame("q", "f")
+            servers[2].close()
+            rows = [2] * 30
+            cols = list(range(30))
+            cli.import_bits("q", "f", 0, rows, cols)  # coordinated leg
+            assert sorted(servers[0].holder.fragment(
+                "q", "f", "standard", 0).row(2)) == cols
+            assert sorted(servers[1].holder.fragment(
+                "q", "f", "standard", 0).row(2)) == cols
+            assert servers[0].hints.backlog_records() >= 1
+
+            servers[2] = _boot(tmp_path, hosts, 2)
+            _reconnect(servers[0], hosts[2])
+            assert servers[0].hints.wait_drained(30)
+            assert sorted(servers[2].holder.fragment(
+                "q", "f", "standard", 0).row(2)) == cols
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    def test_metrics_and_debug_vars_surface_hints(self, tmp_path):
+        ports = free_ports(3)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = [_boot(tmp_path, hosts, i) for i in range(3)]
+        try:
+            cli = InternalClient(hosts[0])
+            cli.create_index("q")
+            cli.create_frame("q", "f")
+            servers[2].close()
+            assert cli.execute_query(
+                None, "q", "SetBit(rowID=1, frame=f, columnID=3)", [],
+                remote=False) == [True]
+            body = urllib.request.urlopen(
+                f"http://{hosts[0]}/metrics", timeout=30).read().decode()
+            assert "pilosa_hints_queued_total" in body
+            assert "pilosa_hint_bytes" in body
+            assert 'pilosa_write_consistency_total{level="quorum"' in body
+            dv = json.loads(urllib.request.urlopen(
+                f"http://{hosts[0]}/debug/vars", timeout=30)
+                .read().decode())
+            assert dv["hints"]["backlog_records"] >= 1
+            assert dv["hints"]["targets"]
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
+# -- SIGKILL chaos: a replica dies mid-stream (subprocess, slow) --------------
+
+
+def _spawn_child(data_dir, host, hosts, replica_n=3):
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(data_dir), host, ",".join(hosts),
+         str(replica_n)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _wait_ready(proc, host, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            _, err = proc.communicate(timeout=10)
+            raise AssertionError(
+                f"child died during boot: {err.decode()[-2000:]}")
+        try:
+            urllib.request.urlopen(f"http://{host}/version",
+                                   timeout=2).read()
+            return
+        except Exception:  # noqa: BLE001 — still booting
+            time.sleep(0.2)
+    raise AssertionError("child never became ready")
+
+
+@pytest.mark.slow
+class TestReplicaKillChaos:
+    def test_sigkill_replica_zero_acked_loss_then_bit_identical(
+            self, tmp_path):
+        """3-node cluster at replica_n=3/quorum; SIGKILL one replica
+        mid-SetBit-stream. Every acked write must survive on a quorum
+        (no 5xx during the outage), and after restart + hint drain all
+        three replicas must be bit-identical at the block level."""
+        ports = free_ports(3)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = [_boot(tmp_path, hosts, i) for i in range(2)]
+        child = _spawn_child(tmp_path / "hnode2", hosts[2], hosts)
+        acked = []
+        try:
+            _wait_ready(child, hosts[2])
+            cli = InternalClient(hosts[0])
+            cli.create_index("c")
+            cli.create_frame("c", "f")
+            for col in range(120):
+                # every ack is a promise: it must survive the kill
+                assert cli.execute_query(
+                    None, "c",
+                    f"SetBit(rowID=1, frame=f, columnID={col})", [],
+                    remote=False) == [True], col
+                acked.append(col)
+                if len(acked) == 40:
+                    os.kill(child.pid, signal.SIGKILL)
+                    child.wait(timeout=30)
+            assert len(acked) == 120
+            assert servers[0].hints.backlog_records() > 0
+
+            # survivors already hold every acked bit
+            for s in servers:
+                assert sorted(s.holder.fragment(
+                    "c", "f", "standard", 0).row(1)) == acked
+
+            # restart the killed replica on the SAME data dir, then
+            # reconnect + drain the backlog
+            child = _spawn_child(tmp_path / "hnode2", hosts[2], hosts)
+            _wait_ready(child, hosts[2])
+            _reconnect(servers[0], hosts[2])
+            assert servers[0].hints.wait_drained(60)
+
+            # bit-level convergence across all three replicas
+            blocks = [InternalClient(h).fragment_blocks(
+                "c", "f", "standard", 0) for h in hosts]
+            assert blocks[0] and blocks[0] == blocks[1] == blocks[2]
+            res = InternalClient(hosts[2]).execute_query(
+                None, "c", "Bitmap(rowID=1, frame=f)", [0], remote=True)
+            assert sorted(res[0]) == acked
+        finally:
+            child.kill()
+            child.communicate(timeout=30)
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass

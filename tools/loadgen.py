@@ -448,6 +448,132 @@ def start_inprocess(spec: Dict[str, Any], log) -> tuple:
     return srv, srv.host
 
 
+def start_inprocess_cluster(spec: Dict[str, Any], nodes: int,
+                            replicas: int, log) -> tuple:
+    """Boot an N-node in-process cluster on loopback ports — the
+    target for the write-churn scenario (kill a replica mid-run,
+    restart it, gate on hint-drain convergence). Traffic goes to
+    node 0; the LAST node is the kill candidate so the coordinator
+    and its quorum partner survive. Returns (servers, configs,
+    hosts)."""
+    import socket as _socket
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    socks = [_socket.socket() for _ in range(nodes)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    hosts = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    base = tempfile.mkdtemp(prefix="pilosa-loadgen-cluster-")
+    servers, configs = [], []
+    for i, h in enumerate(hosts):
+        cfg = Config()
+        cfg.data_dir = os.path.join(base, f"node{i}")
+        cfg.host = h
+        cfg.cluster_hosts = list(hosts)
+        cfg.replica_n = replicas
+        cfg.use_device = os.environ.get("PILOSA_TPU_USE_DEVICE", "off")
+        cfg.sched_tenant_weights = {t: 1.0 for t in spec["tenants"]}
+        cfg.integrity_shadow_sample = 4
+        # anti-entropy off: convergence must come from hint replay,
+        # not the interval syncer papering over a broken drain path
+        cfg.anti_entropy_interval = 3600
+        cfg.polling_interval = 3600
+        for k in ("availability", "latency_target", "shed_rate_max"):
+            setattr(cfg, "slo_" + k, float(spec["objectives"][k]))
+        cfg.slo_p99_us = float(spec["objectives"]["p99_us"])
+        srv = Server(cfg)
+        srv.open()
+        servers.append(srv)
+        configs.append(cfg)
+    log(f"in-process cluster: {nodes} nodes, replica_n={replicas}, "
+        f"coordinator {hosts[0]} (data {base})")
+    return servers, configs, hosts
+
+
+def run_replica_churn(servers, configs, duration: float,
+                      kill_at: float, restart_at: float, log,
+                      state: Dict[str, Any]):
+    """Background churn: close the last replica at `kill_at`×duration,
+    restart it on the SAME data dir at `restart_at`×duration. Wall
+    clock (not schedule time) paces it — the write stream must keep
+    acking while the replica is actually gone."""
+    from pilosa_tpu.server import Server
+
+    victim = len(servers) - 1
+    time.sleep(max(0.0, kill_at * duration))
+    log(f"churn: stopping replica {configs[victim].host}")
+    servers[victim].close()
+    state["killed"] = True
+    if restart_at > kill_at:
+        time.sleep(max(0.0, (restart_at - kill_at) * duration))
+        log(f"churn: restarting replica {configs[victim].host}")
+        srv = Server(configs[victim])
+        srv.open()
+        servers[victim] = srv
+        state["restarted"] = True
+
+
+def _judge_write_churn(report: Dict[str, Any], servers, configs,
+                       churn_state: Dict[str, Any], args, log) -> None:
+    """Post-run verdict for cluster mode: reconnect the restarted
+    replica, give the hint drainer a bounded window, then gate on
+    (a) bounded residual backlog and (b) bit-level convergence of the
+    restarted replica (fragment block checksums vs the coordinator).
+    Folded into the report's overall verdict, so CI fails on a broken
+    drain path the same way it fails on a blown SLO."""
+    from pilosa_tpu.api import InternalClient
+
+    coord = servers[0]
+    victim_host = configs[-1].host
+    drained = True
+    if churn_state.get("restarted") and coord.hints is not None:
+        # the production reconnect path is breaker close -> mark_live
+        # -> hints.notify; force the close instead of waiting out the
+        # cooldown probe
+        coord.client.breakers.for_host(victim_host).record_success()
+        drained = coord.hints.wait_drained(
+            timeout=max(30.0, args.duration))
+    backlog = coord.hints.backlog_records() \
+        if coord.hints is not None else 0
+    hint_snap = coord.hints.snapshot() if coord.hints is not None else {}
+
+    converged = None
+    if churn_state.get("restarted"):
+        try:
+            blocks = [InternalClient(c.host).fragment_blocks(
+                args.index, args.frame, "standard", 0)
+                for c in (configs[0], configs[-1])]
+            converged = blocks[0] == blocks[1]
+        except Exception as e:  # noqa: BLE001 — judged, not crashed
+            log(f"churn: convergence probe failed: {e}")
+            converged = False
+
+    report["write_churn"] = {
+        "nodes": len(servers),
+        "replica_n": args.cluster_replicas,
+        "killed": bool(churn_state.get("killed")),
+        "restarted": bool(churn_state.get("restarted")),
+        "hint_backlog_after_drain": backlog,
+        "hints": hint_snap,
+        "replica_converged": converged,
+    }
+    ok = (drained and backlog <= args.hint_backlog_max
+          and converged is not False)
+    report["objectives"]["hint_backlog"] = {
+        "target": args.hint_backlog_max,
+        "measured": backlog,
+        "verdict": "OK" if ok else "VIOLATED",
+    }
+    if not ok:
+        report["verdict"] = "VIOLATED"
+    log(f"churn: backlog={backlog} converged={converged} "
+        f"-> {'OK' if ok else 'VIOLATED'}")
+
+
 def prepare_index(host: str, index: str, frame: str, log) -> None:
     """Create index + frame over HTTP, tolerating 409 replays."""
     for path, body in ((f"/index/{index}", b"{}"),
@@ -509,6 +635,20 @@ def make_parser() -> argparse.ArgumentParser:
                         "(in-process only)")
     p.add_argument("--fault-at", type=float, default=0.25,
                    help="arm --fault at this fraction of the run")
+    p.add_argument("--cluster-nodes", type=int, default=0,
+                   help="boot an N-node in-process cluster instead of "
+                        "a single node (implies --in-process)")
+    p.add_argument("--cluster-replicas", type=int, default=3,
+                   help="replica_n for --cluster-nodes")
+    p.add_argument("--kill-replica-at", type=float, default=-1.0,
+                   help="close one (non-coordinator) replica at this "
+                        "fraction of the run (cluster mode)")
+    p.add_argument("--restart-replica-at", type=float, default=-1.0,
+                   help="restart the killed replica at this fraction "
+                        "of the run, on the same data dir")
+    p.add_argument("--hint-backlog-max", type=int, default=0,
+                   help="max hint records allowed to remain after the "
+                        "post-run drain window (verdict-gated)")
     p.add_argument("--report", default="",
                    help="report path (default LOADGEN_<seed>.json)")
     p.add_argument("--print-schedule", action="store_true",
@@ -556,8 +696,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     srv = None
+    servers: list = []
+    configs: list = []
+    churn_state: Dict[str, Any] = {}
+    churn_thread = None
     host = args.host
-    if args.in_process:
+    if args.cluster_nodes > 0:
+        servers, configs, hosts = start_inprocess_cluster(
+            spec, args.cluster_nodes, args.cluster_replicas, log)
+        host = hosts[0]
+        if args.kill_replica_at >= 0:
+            churn_thread = threading.Thread(
+                target=run_replica_churn,
+                args=(servers, configs, args.duration,
+                      args.kill_replica_at, args.restart_replica_at,
+                      log, churn_state),
+                daemon=True)
+    elif args.in_process:
         srv, host = start_inprocess(spec, log)
     transport = HTTPTransport(host, index=args.index,
                               partial=args.partial,
@@ -581,7 +736,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = len(build_schedule(spec))
         log(f"running {n} requests over ~{args.duration:.0f}s "
             f"({args.mode}-loop, seed {args.seed})")
+        if churn_thread is not None:
+            churn_thread.start()
         report = run(dict(spec), transport, log=log, fault_cb=fault_cb)
+        if churn_thread is not None:
+            churn_thread.join(timeout=max(30.0, args.duration))
+        if servers:
+            _judge_write_churn(report, servers, configs, churn_state,
+                               args, log)
         mm1 = _mismatch_total(transport.get_text("/metrics"))
         growth = max(0.0, mm1 - mm0)
         report["mismatch_growth"] = growth
@@ -609,6 +771,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         if srv is not None:
             srv.close()
+        for s in servers:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — victim already closed
+                pass
 
     path = args.report or f"LOADGEN_{args.seed}.json"
     with open(path, "w") as f:
