@@ -151,12 +151,15 @@ def build_mixed_holder(tmp, num_slices, num_rows, seed=13):
 
 
 def build_sparse_holder(tmp, num_slices, density=0.03, seed=23):
-    """Two rows of ~density array containers across all 16 blocks."""
+    """Two rows of ~density containers across all 16 blocks. Containers
+    normalize at the 4096-value roaring break-even, so sweep densities
+    above ~6.25% build bitmap containers (which the device stager will
+    keep dense) while lower ones build sorted arrays."""
     from pilosa_tpu.core import Holder
     from pilosa_tpu.roaring.bitmap import Container
 
     rng = np.random.default_rng(seed)
-    h = Holder(os.path.join(tmp, f"sparse{num_slices}"))
+    h = Holder(os.path.join(tmp, f"sparse{num_slices}x{density}"))
     h.open()
     idx = h.create_index_if_not_exists("i")
     f = idx.create_frame_if_not_exists("general")
@@ -169,7 +172,7 @@ def build_sparse_holder(tmp, num_slices, density=0.03, seed=23):
                 vals = np.sort(rng.choice(65536, size=n, replace=False)
                                ).astype(np.uint32)
                 keys.append(r * 16 + b)
-                containers.append(Container(array=vals))
+                containers.append(Container(array=vals).normalize())
         frag = view.create_fragment_if_not_exists(s)
         _inject(frag, keys, containers)
     return h
@@ -1839,40 +1842,86 @@ def main():
             "host_baseline": "cxx-nary-fold, 1 thread, 3 reps"}
 
     with section("sparse_intersect"):
-        # -- extra: sparse array-container intersect (padded-pool worst case) ----
-        _progress("sparse intersect")
+        # -- extra: sparsity-adaptive container-format sweep ---------------------
+        # Three densities straddling the [mesh] sparse-density-threshold
+        # (5%) and the 4096-value array break-even: 0.3% and 3% stage as
+        # sorted-array containers and serve through the sparse kernels;
+        # 30% stays packed words on the dense path. Every row is
+        # checked bit-exact against the C++ host fold over the same
+        # containers. Rates go through mgr.count — the one entry that
+        # serves BOTH formats — so rows compare like for like.
+        _progress("sparse intersect: density sweep")
+        from pilosa_tpu.parallel.plan import _lower_tree as _lt
+
         sparse_slices = min(num_slices, 240)
-        hs = build_sparse_holder(tmp, sparse_slices)
-        es = _reg(Executor(hs, use_device=True))
-        first, calls_ = serve_count_call(
-            es, "i", "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))",
-            list(range(sparse_slices)))
-        dt = best_of(calls_, reps, iters)
-        # honest host baseline: sorted-array intersection counts (the
-        # reference's array-array kernel class), not dense popcount
-        want = 0
-        arrays = []
-        for s in range(sparse_slices):
-            fr = hs.fragment("i", "general", "standard", s)
-            for b in range(16):
-                ia = fr.storage._find_key(b)
-                ib = fr.storage._find_key(16 + b)
-                arrays.append((fr.storage.containers[ia].array,
-                               fr.storage.containers[ib].array))
-        for a, b in arrays:
-            want += native.intersection_count_sorted(a, b)
-        t0 = time.perf_counter()
-        for _ in range(3):
-            n = 0
-            for a, b in arrays:
-                n += native.intersection_count_sorted(a, b)
-        host_dt = (time.perf_counter() - t0) / 3
-        assert first == want, (first, want)
+        sweep = {}
+        for density in (0.003, 0.03, 0.3):
+            _progress(f"sparse intersect density={density:g}")
+            hs = build_sparse_holder(tmp, sparse_slices, density=density)
+            es = _reg(Executor(hs, use_device=True))
+            mgr = es.mesh_manager()
+            tree = parse_string(
+                "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+            ).calls[0].children[0]
+            leaves_ = []
+            shape_ = _lt(hs, "i", tree, leaves_)
+            assert shape_ is not None
+            slices_ = list(range(sparse_slices))
+            n_ = es._batch_num_slices("i", slices_)
+            first = mgr.count("i", shape_, leaves_, slices_, n_)
+            # honest host baseline over the same containers: sorted-array
+            # intersect for array pairs, AND+popcount for bitmap pairs
+            pairs = []
+            for s in range(sparse_slices):
+                fr = hs.fragment("i", "general", "standard", s)
+                for b in range(16):
+                    ia = fr.storage._find_key(b)
+                    ib = fr.storage._find_key(16 + b)
+                    pairs.append((fr.storage.containers[ia],
+                                  fr.storage.containers[ib]))
+
+            def host_once(pairs_=pairs):
+                total = 0
+                for ca, cb in pairs_:
+                    if ca.array is not None and cb.array is not None:
+                        total += native.intersection_count_sorted(
+                            ca.array, cb.array)
+                    else:
+                        total += native.popcnt_and_slice(
+                            ca.bitmap.reshape(-1),
+                            cb.bitmap.reshape(-1))
+                return total
+
+            want = host_once()
+            assert first == want, (density, first, want)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                host_once()
+            host_dt = (time.perf_counter() - t0) / 3
+            dt = best_of(
+                lambda m=mgr, sh=shape_, lv=leaves_, sl=slices_, nn=n_:
+                m.count("i", sh, lv, sl, nn), reps, iters)
+            sv_ = mgr._views.get(("i", "general", "standard"))
+            dm_ = mgr.device_memory()
+            sweep[f"{density:g}"] = {
+                "qps": 1.0 / dt, "mean_ms": dt * 1e3,
+                "host_cpu_qps": 1.0 / host_dt,
+                "vs_host": host_dt / dt,
+                "format": (Executor._resident_format(sv_)
+                           if sv_ is not None else "unstaged"),
+                "staged_sparse_bytes": int(dm_["sparse_bytes"]),
+                "staged_dense_bytes": int(dm_["padded_bytes"]
+                                          - dm_["sparse_bytes"]),
+                "residency_ratio": dm_["residency_ratio"],
+                "sparse_dispatches": int(
+                    mgr.stats.get("sparse_count", 0))}
+        d3 = sweep["0.03"]
         details["sparse_intersect"] = {
-            "qps": 1.0 / dt, "mean_ms": dt * 1e3, "density": 0.03,
+            "qps": d3["qps"], "mean_ms": d3["mean_ms"], "density": 0.03,
             "slices": sparse_slices,
-            "host_cpu_qps": 1.0 / host_dt, "vs_host": host_dt / dt,
-            "host_baseline": "cxx-sorted-array-intersect, 1 thread, 3 reps"}
+            "host_cpu_qps": d3["host_cpu_qps"], "vs_host": d3["vs_host"],
+            "host_baseline": "cxx-sorted-array-intersect, 1 thread, 3 reps",
+            "sweep": sweep}
 
     with section("materialize_intersect"):
         # -- extra: the bitmap-MATERIALIZING path (VERDICT r2 item 7) ------------
@@ -2414,8 +2463,13 @@ def main():
         try:
             # Probe one staged view's padded bytes on THIS mesh, then
             # starve: two views' worth for a four-view working set.
+            # sparse_density_threshold 0 pins BOTH thrash executors to
+            # packed words: this sub-benchmark prices the dense
+            # governor; the residency block below is where the
+            # sparsity-adaptive format gets measured.
             probe_ex = Executor(ev_holder, use_device=True,
-                                mesh_config={"hbm_budget_bytes": -1})
+                                mesh_config={"hbm_budget_bytes": -1,
+                                             "sparse_density_threshold": 0})
             all_executors.append(probe_ex)
             probe_ex.execute("ev", parse_string(
                 "Count(Bitmap(rowID=1, frame=f1))"))
@@ -2437,7 +2491,8 @@ def main():
             resident_dt = _spin(probe_ex, "resident")
             starved_ex = Executor(ev_holder, use_device=True,
                                   mesh_config={
-                                      "hbm_budget_bytes": 2 * view_b})
+                                      "hbm_budget_bytes": 2 * view_b,
+                                      "sparse_density_threshold": 0})
             all_executors.append(starved_ex)
             starved_dt = _spin(starved_ex, "starved")
             smgr = starved_ex.mesh_manager()
@@ -2456,6 +2511,51 @@ def main():
                 "host_fallbacks": int(
                     smgr.stats.get("fallback_hbm_infeasible", 0)
                     + smgr.stats.get("fallback_oom", 0))}
+
+            # -- residency: what the sparse format buys under the SAME
+            # starved budget. Four array-container frames whose dense
+            # images need ~4x the budget: the dense-forced run thrashes
+            # (budget evictions every cycle), the sparsity-adaptive run
+            # keeps the whole working set resident in a fraction of it.
+            sp_frames = ["s1", "s2", "s3", "s4"]
+            rng_sp = np.random.default_rng(43)
+            for fr_ in sp_frames:
+                fo_ = ev_idx.create_frame_if_not_exists(fr_)
+                for col_ in rng_sp.integers(0, SLICE_WIDTH, 2000):
+                    fo_.set_bit(1, int(col_))
+
+            def _spin_frames(ex_, tag_):
+                for i_ in range(n_ev):
+                    fr_ = sp_frames[i_ % len(sp_frames)]
+                    out_ = ex_.execute("ev", parse_string(
+                        f"Count(Bitmap(rowID={2 + i_}, frame={fr_}))"))
+                    assert out_ == [0], (tag_, fr_, out_)
+
+            dense_ex = Executor(ev_holder, use_device=True,
+                                mesh_config={
+                                    "hbm_budget_bytes": 2 * view_b,
+                                    "sparse_density_threshold": 0})
+            all_executors.append(dense_ex)
+            _spin_frames(dense_ex, "residency-dense")
+            sparse_ex = Executor(ev_holder, use_device=True,
+                                 mesh_config={
+                                     "hbm_budget_bytes": 2 * view_b})
+            all_executors.append(sparse_ex)
+            _spin_frames(sparse_ex, "residency-sparse")
+            dmgr = dense_ex.mesh_manager()
+            spmgr = sparse_ex.mesh_manager()
+            sdm = spmgr.device_memory()
+            # the whole sparse working set must sit resident
+            assert sdm["views"] == len(sp_frames), sdm
+            details["eviction_thrash"]["residency"] = {
+                "frames": len(sp_frames),
+                "budget_bytes": int(2 * view_b),
+                "dense_forced_evictions": int(
+                    dmgr.stats["evicted_budget"]),
+                "sparse_evictions": int(spmgr.stats["evicted_budget"]),
+                "sparse_views_resident": int(sdm["views"]),
+                "sparse_bytes": int(sdm["sparse_bytes"]),
+                "residency_ratio": sdm["residency_ratio"]}
         finally:
             if min_work_prev is None:
                 os.environ.pop("PILOSA_TPU_DEVICE_MIN_WORK", None)

@@ -745,6 +745,23 @@ class Handler:
                     "pilosa_hbm_staged_views", "gauge",
                     "Fragment views currently staged on-device.")
                     .add(dm["views"]))
+                fams.append(prom.MetricFamily(
+                    "pilosa_hbm_sparse_bytes", "gauge",
+                    "Staged pool bytes held as sorted-array (sparse) "
+                    "containers.")
+                    .add(dm["sparse_bytes"]))
+                rr = prom.MetricFamily(
+                    "pilosa_hbm_residency_ratio", "gauge",
+                    "Live container bytes over padded pool bytes — "
+                    "how much of the staged HBM footprint backs real "
+                    "data. Unlabeled series is the aggregate; one "
+                    "labeled series per device. 1.0 when nothing is "
+                    "staged.")
+                rr.add(dm["residency_ratio"])
+                for dev, r in sorted(
+                        dm["residency_per_device"].items()):
+                    rr.add(r, {"device": dev})
+                fams.append(rr)
             try:
                 budget = mgr._hbm_budget_bytes()
             except Exception:  # noqa: BLE001 — telemetry never fails scrape

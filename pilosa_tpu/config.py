@@ -97,6 +97,11 @@ TOML schema:
                                 # serves it meanwhile)
     quarantine-ttl = "60s"      # how long a quarantined plan signature
                                 # stays off the device path
+    sparse-density-threshold = 0.05  # mean container fill below which a
+                                # slice stages as sorted-array (roaring
+                                # array) containers on device; 0 = always
+                                # dense packed words. Env override:
+                                # PILOSA_TPU_SPARSE_DENSITY_THRESHOLD
     stage-chunk-mb = 64         # H2D staging chunk: shards larger than
                                 # this pipeline as chunked device_puts
                                 # with packing double-buffered against
@@ -335,6 +340,7 @@ class Config:
         self.mesh_hbm_headroom: float = 0.15
         self.mesh_quarantine_after: int = 2
         self.mesh_quarantine_ttl: float = 60.0
+        self.mesh_sparse_density_threshold: float = 0.05
         # Staging chunk size (mesh._stage_chunk_bytes) and the count
         # backend dispatch ("auto" = measured calibration). Both are
         # applied as process-env DEFAULTS at server boot — an explicit
@@ -471,6 +477,9 @@ class Config:
                                              c.mesh_quarantine_after))
         if "quarantine-ttl" in me:
             c.mesh_quarantine_ttl = parse_duration(me["quarantine-ttl"])
+        c.mesh_sparse_density_threshold = float(
+            me.get("sparse-density-threshold",
+                   c.mesh_sparse_density_threshold))
         c.mesh_stage_chunk_mb = int(me.get("stage-chunk-mb",
                                            c.mesh_stage_chunk_mb))
         c.mesh_count_backend = str(me.get("count-backend",
@@ -536,6 +545,8 @@ class Config:
             "hbm_headroom": self.mesh_hbm_headroom,
             "quarantine_after": self.mesh_quarantine_after,
             "quarantine_ttl": self.mesh_quarantine_ttl,
+            "sparse_density_threshold":
+                self.mesh_sparse_density_threshold,
             "stage_chunk_mb": self.mesh_stage_chunk_mb,
             "count_backend": self.mesh_count_backend,
         }
@@ -638,6 +649,8 @@ class Config:
             f"quarantine-after = {self.mesh_quarantine_after}\n"
             f'quarantine-ttl = '
             f'"{int(self.mesh_quarantine_ttl * 1000)}ms"\n'
+            f"sparse-density-threshold = "
+            f"{self.mesh_sparse_density_threshold}\n"
             f"stage-chunk-mb = {self.mesh_stage_chunk_mb}\n"
             f'count-backend = "{self.mesh_count_backend}"\n'
             + f"\n[storage]\n"

@@ -580,6 +580,12 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
         budget = cur.get(("pilosa_hbm_budget_bytes", ()), 0.0)
         if budget:
             line += f"   budget {_fmt_bytes(budget)}"
+        res = cur.get(("pilosa_hbm_residency_ratio", ()))
+        if res is not None:
+            line += f"   residency {res:.0%}"
+        sparse = cur.get(("pilosa_hbm_sparse_bytes", ()), 0.0)
+        if sparse:
+            line += f"   sparse {_fmt_bytes(sparse)}"
         ev = sum(v for (name, _labels), v in cur.items()
                  if name == "pilosa_hbm_evictions_total")
         if ev:

@@ -888,44 +888,130 @@ class Executor:
         info["plan_cache"] = {"checked": mgr is not None,
                               "hit": plan_hit,
                               "quarantined": quarantined}
+        if lowerable and mgr is not None:
+            info["device_format"] = self._explain_format(
+                index, leaves, shape, mgr)
         if lowerable:
             info["staging"] = self._explain_staging(index, leaves, slices)
         info["placement"] = self._explain_placement(index, slices)
         return info
 
+    @staticmethod
+    def _resident_format(sv) -> str:
+        """A StagedView's container format as the EXPLAIN label:
+        dense / sparse / mixed (per-slice split)."""
+        fmts = getattr(sv, "slice_formats", None)
+        if sv.sparse is None or fmts is None or not fmts.any():
+            return "dense"
+        if fmts.all() or not sv.keys_host.shape[1]:
+            return "sparse"
+        return "mixed"
+
+    def _explain_format(self, index: str, leaves, shape, mgr) -> dict:
+        """Which container format would serve this Count on-device:
+        per-leaf resident format plus whether the tree shape fits the
+        sparse slice-group dispatch (and which sparse kernel backend
+        is calibrated, if any). Peek only — unstaged leaves report
+        "unstaged"; the stager decides their format at dispatch."""
+        from .parallel.plan import _tree_signature
+
+        fmts = []
+        for frame, view, _r, _q in leaves:
+            sv = mgr._views.get((index, frame, view))
+            fmts.append("unstaged" if sv is None
+                        else self._resident_format(sv))
+        out: dict = {"leaves": fmts}
+        if any(f in ("sparse", "mixed") for f in fmts):
+            kind = mgr._sparse_shape_kind(_tree_signature(shape))
+            out["sparse_shape"] = kind or "unsupported"
+            # Peek the cached calibration pick; never trigger one.
+            out["sparse_backend"] = (mgr._sparse_backend_cached
+                                     or "unresolved")
+        return out
+
+    def _sparse_threshold_peek(self) -> float:
+        """The sparse-density threshold the stager would use, without
+        forcing manager construction: live manager if one exists, else
+        the same env-over-config resolution it would apply."""
+        mgr = self._mesh_mgr
+        if mgr is not None:
+            return mgr._sparse_threshold()
+        cfg = self.mesh_config.get("sparse_density_threshold")
+        base = float(cfg) if cfg is not None else 0.05
+        try:
+            return float(os.environ.get(
+                "PILOSA_TPU_SPARSE_DENSITY_THRESHOLD", base))
+        except ValueError:
+            return base
+
     def _explain_staging(self, index: str, leaves,
                          slices: Sequence[int]) -> dict:
         """Which of the Count's (frame, view) images are already
-        resident on-device, and a host-side byte estimate for the ones
-        a dispatch would have to stage. Loaded fragments estimate from
-        live container counts (exactly what build_sharded_index
-        uploads); lazily-opened ones fall back to storage file size —
-        EXPLAIN never forces a parse."""
+        resident on-device — and in which container format — plus a
+        host-side byte estimate for the ones a dispatch would have to
+        stage, priced at the format pick_slice_formats would make
+        today (dense slices at packed-word cost, sparse slices at
+        sorted-array cost). Loaded fragments estimate from live
+        container stats (exactly what the dual-pool builders upload);
+        lazily-opened ones fall back to storage file size and stay
+        format-unknown — EXPLAIN never forces a parse."""
+        import numpy as np
+
         from .ops.pool import CONTAINER_WORDS
+        from .parallel.mesh import pick_slice_formats
 
         mgr = self._mesh_mgr
+        threshold = self._sparse_threshold_peek()
         uniq = list(dict.fromkeys((f, v) for f, v, _r, _q in leaves))
         staged = unstaged = est = 0
+        views: list = []
         for frame, view in uniq:
-            if mgr is not None and (index, frame, view) in mgr._views:
+            sv = (mgr._views.get((index, frame, view))
+                  if mgr is not None else None)
+            if sv is not None:
                 staged += 1
+                views.append({"frame": frame, "view": view,
+                              "resident": True,
+                              "format": self._resident_format(sv)})
                 continue
             unstaged += 1
-            for s in slices:
+            stats = np.zeros((len(slices), 3), dtype=np.int64)
+            opaque = 0
+            for j, s in enumerate(slices):
                 frag = self.holder.fragment(index, frame, view, s)
                 if frag is None:
                     continue
                 with frag._mu:
-                    if not frag._pending_load:
-                        est += len(frag.storage.keys) * (
-                            CONTAINER_WORDS * 4 + 4)
-                    else:
+                    if frag._pending_load:
                         try:
-                            est += os.path.getsize(frag.path)
+                            opaque += os.path.getsize(frag.path)
                         except OSError:
                             pass
+                        continue
+                    nc = len(frag.storage.keys)
+                    if nc:
+                        ns = [c.n for c in frag.storage.containers]
+                        stats[j] = (nc, sum(ns), max(ns))
+            sp = pick_slice_formats(stats, threshold).astype(bool)
+            n_sparse = int(sp.sum())
+            n_live = int((stats[:, 0] > 0).sum())
+            # Dense slices upload packed words; sparse ones upload the
+            # value arrays plus their key/cardinality table entries.
+            vb = (int(stats[~sp, 0].sum()) * (CONTAINER_WORDS * 4 + 4)
+                  + int(stats[sp, 1].sum()) * 2
+                  + int(stats[sp, 0].sum()) * 8 + opaque)
+            est += vb
+            views.append({
+                "frame": frame, "view": view, "resident": False,
+                "format": ("mixed" if 0 < n_sparse < n_live
+                           else "sparse" if n_sparse else "dense"),
+                "sparse_slices": n_sparse,
+                "estimated_h2d_bytes": vb,
+            })
         return {"staged_views": staged, "unstaged_views": unstaged,
-                "estimated_h2d_bytes": est}
+                "estimated_h2d_bytes": est,
+                "sparse_density_threshold": threshold,
+                "views": views}
 
     def _explain_placement(self, index: str,
                            slices: Sequence[int]) -> dict:

@@ -37,6 +37,7 @@ from typing import Callable, Optional
 
 _MU = threading.Lock()
 _RESULT: Optional[dict] = None
+_SPARSE_RESULT: Optional[dict] = None
 
 # The headline Intersect+Count composition (plan._tree_signature form).
 _TREE = ["and", ["leaf", 0], ["leaf", 1]]
@@ -267,12 +268,154 @@ def resolve_backend(wait: bool = True) -> str:
 
 def calibration_snapshot() -> Optional[dict]:
     """The current record (None before first resolution) — /debug/vars
-    surface, satisfying "the measurement recorded in /debug/vars"."""
+    surface, satisfying "the measurement recorded in /debug/vars". The
+    sorted-array race result rides along under "sparse" once resolved."""
     rec = _RESULT
-    return dict(rec) if rec is not None else None
+    if rec is None:
+        return None
+    out = dict(rec)
+    if _SPARSE_RESULT is not None:
+        out["sparse"] = dict(_SPARSE_RESULT)
+    return out
+
+
+# -- sorted-array (sparse container) backend race -----------------------------
+#
+# The array×array intersect-count has the same two-backend shape as the
+# dense count path — an XLA binary-search gather ladder
+# (bitops.sparse_pair_intersect_counts) vs a Pallas broadcast-compare
+# kernel (kernels.pallas_sparse_pair_counts) — and the same "which wins
+# is hardware-dependent" problem: gathers are costly on TPU while VPU
+# compares are nearly free, but the compare kernel's work grows with
+# K^2. Same machinery, separate verdict: PILOSA_TPU_SPARSE_BACKEND pins
+# it, else one race per process on a representative container block.
+
+
+def _env_sparse_backend() -> str:
+    v = os.environ.get("PILOSA_TPU_SPARSE_BACKEND", "auto").lower()
+    return v if v in ("pallas", "xla", "auto") else "auto"
+
+
+def _measure_sparse(interpret: bool) -> dict:
+    """Time Pallas vs XLA on a representative sorted-array intersect:
+    a slab of half-full containers at the break-even K, cross-checked
+    before timing (a wrong backend must not win)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .bitops import sparse_pair_intersect_counts
+    from .kernels import pallas_sparse_pair_counts
+
+    n = 16 if interpret else 512
+    k = 128 if interpret else 512
+    rng = np.random.default_rng(0x9E3779B9)
+
+    def block():
+        vals = np.full((n, k), 0xFFFF, np.uint16)
+        lens = rng.integers(0, k + 1, size=n).astype(np.int32)
+        for i, ln in enumerate(lens):
+            vals[i, :ln] = np.sort(
+                rng.choice(1 << 16, size=ln, replace=False)).astype(np.uint16)
+        return jnp.asarray(vals), jnp.asarray(lens)
+
+    a, al = block()
+    b, bl = block()
+    xla_fn = jax.jit(sparse_pair_intersect_counts)
+    pallas_fn = lambda *args: pallas_sparse_pair_counts(  # noqa: E731
+        *args, interpret=interpret)
+
+    want = np.asarray(xla_fn(a, al, b, bl))
+    got = np.asarray(pallas_fn(a, al, b, bl))
+    if not np.array_equal(want, got):
+        raise AssertionError(
+            f"sparse calibration cross-check mismatch: xla={want[:4]}... "
+            f"pallas={got[:4]}...")
+
+    pallas_ms = _best_ms(pallas_fn, a, al, b, bl)
+    xla_ms = _best_ms(xla_fn, a, al, b, bl)
+    return {
+        "backend": "pallas" if pallas_ms <= xla_ms else "xla",
+        "source": "measured",
+        "pallas_ms": round(pallas_ms, 4),
+        "xla_ms": round(xla_ms, 4),
+        "shape": {"containers": n, "values": k},
+        "interpret": interpret,
+    }
+
+
+def calibrate_sparse_backend(force_measure: bool = False) -> dict:
+    """Resolve (measuring if needed) the auto sorted-array backend —
+    the sparse twin of calibrate_count_backend, with the same safety
+    ladder: instant "xla" off-TPU, probe canary, watchdogged daemon
+    measurement, any failure verdicts "xla"."""
+    global _SPARSE_RESULT
+    with _MU:
+        if _SPARSE_RESULT is not None:
+            return _SPARSE_RESULT
+        import jax
+
+        t0 = time.perf_counter()
+        key = f"{_device_key()}/sparse"
+        on_tpu = jax.default_backend() == "tpu"
+        forced = force_measure or (
+            os.environ.get("PILOSA_TPU_CALIBRATE", "").lower() == "force")
+        rec: Optional[dict] = None
+        if not on_tpu and not forced:
+            rec = {"backend": "xla", "source": "non-tpu"}
+        if rec is None:
+            rec = _cache_load(key)
+        if rec is None:
+            box: dict = {}
+            done = threading.Event()
+
+            def work():
+                try:
+                    from .kernels import pallas_probe_ok
+
+                    if on_tpu and not pallas_probe_ok():
+                        box["rec"] = {"backend": "xla",
+                                      "source": "probe-failed"}
+                    else:
+                        box["rec"] = _measure_sparse(interpret=not on_tpu)
+                except Exception as e:  # noqa: BLE001
+                    box["rec"] = {"backend": "xla", "source": "error",
+                                  "error": f"{type(e).__name__}: {e}"}
+                finally:
+                    done.set()
+
+            threading.Thread(target=work, daemon=True,
+                             name="sparse-calibrate").start()
+            if done.wait(_timeout_s()):
+                rec = box["rec"]
+            else:
+                rec = {"backend": "xla", "source": "timeout"}
+            if rec.get("source") == "measured":
+                _cache_store(key, rec)
+        rec["device"] = key
+        rec["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        _SPARSE_RESULT = rec
+        return rec
+
+
+def resolve_sparse_backend(wait: bool = True) -> str:
+    """Dispatch resolution for the sorted-array kernels: the
+    PILOSA_TPU_SPARSE_BACKEND pin when set, else the raced winner
+    (provisional "xla" while a calibration is in flight and
+    wait=False)."""
+    v = _env_sparse_backend()
+    if v != "auto":
+        return v
+    rec = _SPARSE_RESULT
+    if rec is not None:
+        return rec["backend"]
+    if not wait and _MU.locked():
+        return "xla"
+    return calibrate_sparse_backend()["backend"]
 
 
 def reset_for_tests() -> None:
-    global _RESULT
+    global _RESULT, _SPARSE_RESULT
     with _MU:
         _RESULT = None
+        _SPARSE_RESULT = None

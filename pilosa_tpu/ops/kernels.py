@@ -761,3 +761,107 @@ def tree_count_pallas(words, idx, hit, tree, *, interpret: bool = False):
                                      hit[:, main:], tree, num_leaves,
                                      interpret)
     return acc
+
+
+# -- sorted-array (sparse container) intersect-count ---------------------------
+#
+# Pallas variant of bitops.sparse_pair_intersect_counts — the device
+# array×array kernel class (reference roaring.go:1270-1351) for
+# containers staged as sorted value lists. The TPU has no per-lane
+# dynamic gather, so instead of the XLA path's binary-search ladder this
+# kernel brute-forces membership with lane-parallel broadcast compares:
+# each grid step loads a block of containers and, per 128-value a-slab,
+# tests all K b-values at once. That is O(K^2/lanes) VPU work vs the
+# gather ladder's O(K log K) HBM round-trips — which of the two wins is
+# hardware-dependent (gathers are expensive on TPU, compares are nearly
+# free), so ops/calibrate.py races them and the winner earns the
+# dispatch, same contract as the dense count backends.
+
+_SPARSE_BM = 8      # containers per grid step
+_SPARSE_AK = 128    # a-values per fori step: one full lane tile
+_SPARSE_BK = 1024   # b-lane slab per static inner step (VMEM bound)
+
+
+def _sparse_pair_kernel(bm, k, a_ref, al_ref, b_ref, bl_ref, o_ref):
+    b = b_ref[...]
+    valid_b = lax.broadcasted_iota(jnp.int32, (bm, k), 1) < bl_ref[...]
+    al = al_ref[...]
+    bk = min(k, _SPARSE_BK)
+
+    def body(c, acc):
+        a = a_ref[:, pl.ds(c * _SPARSE_AK, _SPARSE_AK)]
+        hit = jnp.zeros((bm, _SPARSE_AK), jnp.bool_)
+        # Static b-slab loop: container values are duplicate-free, so
+        # membership (any-match) equals match count and slabs OR.
+        for j in range(-(-k // bk)):
+            sl = slice(j * bk, min(k, (j + 1) * bk))
+            eq = (a[:, :, None] == b[:, None, sl]) & valid_b[:, None, sl]
+            hit = hit | eq.any(axis=-1)
+        a_pos = (lax.broadcasted_iota(jnp.int32, (bm, _SPARSE_AK), 1)
+                 + c * _SPARSE_AK)
+        hits = hit & (a_pos < al)
+        return acc + hits.sum(axis=-1, keepdims=True).astype(jnp.int32)
+
+    o_ref[...] = lax.fori_loop(0, k // _SPARSE_AK, body,
+                               jnp.zeros((bm, 1), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sparse_pair_counts(a_vals, a_len, b_vals, b_len, *,
+                              interpret: bool = False):
+    """Per-container |a ∩ b| over batched sorted-array containers —
+    same contract as bitops.sparse_pair_intersect_counts (values
+    padded with 0xFFFF, lens give real cardinality; exact for every
+    u16 value including 65535, because validity comes from the len
+    masks, never the pad value).
+
+    a_vals/b_vals: (..., K) integer values; a_len/b_len: (...,).
+    Returns (...,) int32."""
+    shape = a_vals.shape[:-1]
+    ka = a_vals.shape[-1]
+    kb = b_vals.shape[-1]  # operands may come from different pools
+    n = 1
+    for d in shape:
+        n *= d
+    a = a_vals.reshape(n, ka).astype(jnp.int32)
+    b = b_vals.reshape(n, kb).astype(jnp.int32)
+    al = a_len.reshape(n, 1).astype(jnp.int32)
+    bl = b_len.reshape(n, 1).astype(jnp.int32)
+    kp = max(_SPARSE_AK,
+             -(-max(ka, kb) // _SPARSE_AK) * _SPARSE_AK)
+    if kp != ka:
+        # Value padding is arbitrary (zeros): the len masks reject it.
+        a = jnp.pad(a, ((0, 0), (0, kp - ka)))
+    if kp != kb:
+        b = jnp.pad(b, ((0, 0), (0, kp - kb)))
+    n_p = -(-n // _SPARSE_BM) * _SPARSE_BM
+    if n_p != n:
+        a = jnp.pad(a, ((0, n_p - n), (0, 0)))
+        b = jnp.pad(b, ((0, n_p - n), (0, 0)))
+        al = jnp.pad(al, ((0, n_p - n), (0, 0)))
+        bl = jnp.pad(bl, ((0, n_p - n), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_sparse_pair_kernel, _SPARSE_BM, kp),
+        out_shape=jax.ShapeDtypeStruct((n_p, 1), jnp.int32),
+        grid=(n_p // _SPARSE_BM,),
+        in_specs=[
+            pl.BlockSpec((_SPARSE_BM, kp), lambda i: (i, 0)),
+            pl.BlockSpec((_SPARSE_BM, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_SPARSE_BM, kp), lambda i: (i, 0)),
+            pl.BlockSpec((_SPARSE_BM, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_SPARSE_BM, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(a, al, b, bl)
+    return out[:n, 0].reshape(shape)
+
+
+def use_sparse_pallas() -> bool:
+    """Dispatch switch for the sorted-array intersect kernel — the
+    sparse twin of use_pallas(): never on non-TPU backends, else the
+    PILOSA_TPU_SPARSE_BACKEND pin or the calibrated race winner."""
+    if jax.default_backend() != "tpu":
+        return False
+    from .calibrate import resolve_sparse_backend
+
+    return resolve_sparse_backend() == "pallas"

@@ -184,7 +184,8 @@ def _assemble_shard(pieces: List, offs: List[int], shard_shape, dev):
 def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
                         capacity: Optional[int] = None,
                         with_host_keys: bool = False,
-                        stats_out: Optional[dict] = None):
+                        stats_out: Optional[dict] = None,
+                        row_ids: Optional[np.ndarray] = None):
     """Stack per-slice host bitmaps into a ShardedIndex.
 
     bitmaps[s] is the slice-s roaring Bitmap (or None for an absent
@@ -221,14 +222,22 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     s = max(1, len(bitmaps))
     s_pad = -(-s // n_dev) * n_dev
 
-    # Global dense row table.
-    all_rows = [np.asarray(b.keys, dtype=np.uint64) >> np.uint64(4)
-                for b in bitmaps if b is not None and len(b.keys)]
-    row_ids = (np.unique(np.concatenate(all_rows)) if all_rows
-               else np.empty(0, dtype=np.uint64))
+    # Global dense row table — injectable (row_ids=) so a dual-format
+    # stager can number rows over ALL slices once and hand both the
+    # dense and the sparse pool the same table (a per-pool np.unique
+    # would give the two pools different dense indices for one row).
+    if row_ids is None:
+        all_rows = [np.asarray(b.keys, dtype=np.uint64) >> np.uint64(4)
+                    for b in bitmaps if b is not None and len(b.keys)]
+        row_ids = (np.unique(np.concatenate(all_rows)) if all_rows
+                   else np.empty(0, dtype=np.uint64))
 
     counts = [len(b.keys) if b is not None else 0 for b in bitmaps]
-    cap = capacity or max(1, max(counts, default=1))
+    # capacity=0 is an explicit "no dense containers anywhere" (a pure
+    # sparse-format view staging an empty dense pool so every consumer
+    # of sv.sharded keeps a real array to hold on to).
+    cap = capacity if capacity is not None else max(1, max(counts,
+                                                           default=1))
     # Round capacity up to a ROW_SPAN multiple: the coarse-gather
     # serving programs view the pool as (S, cap/16, 16*W) whole-row
     # runs, which needs 16 | cap. Cost: < 16 padded containers/slice.
@@ -344,6 +353,345 @@ def build_sharded_index(bitmaps: Sequence, mesh: Optional[Mesh] = None,
     if with_host_keys:
         return idx, row_ids, keys
     return idx, row_ids
+
+
+# -- sparsity-adaptive staging: sorted-array (roaring array) device pools -----
+#
+# The dense image bills 8 KB of HBM per container regardless of
+# cardinality; a 3%-density container carries ~2 K values = 4 KB live,
+# and a 0.3% one ~200 values = 400 B — 20-2000x padding waste. The
+# roaring container taxonomy (arXiv:1709.07821 §2.1: array below 4096
+# values, bitmap above) applied at STAGING time: slices whose mean
+# container fill sits under a density threshold stage as sorted u16
+# value arrays + a cardinality table, everything else keeps packed
+# words. One staged view can hold BOTH pools (mixed views), with a
+# per-slice format byte deciding which pool serves each slice.
+
+# A container with more than 4096 values is smaller as a bitmap
+# (4096 * 2 B = 8 KB = the packed-word size) — the reference's
+# ARRAY_MAX_SIZE break-even (roaring.go:951,1023).
+ARRAY_VALUE_CAP = 4096
+
+# Sparse eligibility floor: a slice whose TOTAL cardinality is under
+# this never stages as sorted arrays. Below it the whole slice is
+# Kbyte-scale either way, and the sparse path's extra host metadata
+# resolution + separate kernel dispatch cost more than the HBM it
+# saves. It also keeps tiny working sets (unit fixtures, cold frames)
+# on the one-format dense path the batch/coarse dispatchers are
+# specialized for.
+SPARSE_MIN_SLICE_CARD = 1024
+
+# Sparse value-capacity alignment: K pads to a lane multiple so the
+# Pallas broadcast-compare kernel and the (8, 128)-tiled gathers see
+# full tiles.
+_VALUE_ALIGN = 128
+
+
+class SparseShardedIndex(NamedTuple):
+    """One frame/view's SPARSE slices: sorted-array containers, stacked
+    and mesh-sharded. Same key packing as ShardedIndex (global dense
+    row * 16 + subkey, INVALID_KEY padded) so the host row-resolution
+    machinery (resolve_row_indices) works unchanged on either pool."""
+
+    keys: jax.Array    # (S, C) int32, INVALID_KEY padded
+    values: jax.Array  # (S, C, K) uint16, sorted, 0xFFFF padded
+    cards: jax.Array   # (S, C) int32 real cardinalities
+
+    @property
+    def num_slices(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[1]
+
+    @property
+    def value_cap(self) -> int:
+        return self.values.shape[2]
+
+
+def slice_format_stats(bitmaps: Sequence) -> np.ndarray:
+    """Per-slice container stats the format pick runs on: (S, 3) int64
+    [n_containers, total_cardinality, max_cardinality]. Uses the host
+    Container.n the stager already has — no container is materialized
+    to words to decide its format."""
+    out = np.zeros((len(bitmaps), 3), dtype=np.int64)
+    for si, b in enumerate(bitmaps):
+        if b is None or not len(b.keys):
+            continue
+        ns = [c.n for c in b.containers]
+        out[si] = (len(ns), sum(ns), max(ns))
+    return out
+
+
+def pick_slice_formats(stats: np.ndarray, threshold: float,
+                       prev: Optional[np.ndarray] = None,
+                       band: float = 1.25,
+                       value_cap: int = ARRAY_VALUE_CAP,
+                       min_card: int = SPARSE_MIN_SLICE_CARD) -> np.ndarray:
+    """Per-slice format decision: 1 = sorted-array, 0 = packed words.
+
+    A slice goes sparse when its mean container fill
+    (total_card / (n_containers * 65536)) is under `threshold`, its
+    total cardinality is at least `min_card` (below that the slice is
+    Kbyte-scale either way and the sparse dispatch overhead wins), AND
+    no container exceeds `value_cap` values (beyond 4096 the array
+    form is LARGER than the bitmap — the reference's ARRAY_MAX_SIZE
+    break-even). threshold <= 0 is the kill switch: everything dense.
+
+    Hysteresis: with `prev` (the view's formats before a restage), a
+    slice keeps its previous format inside the [threshold/band,
+    threshold*band) window, so a fragment sitting near the boundary
+    does not flip layout — and pay a full repack — on every
+    incremental refresh. Crossing the far edge of the band always
+    converts."""
+    s = stats.shape[0]
+    n = stats[:, 0].astype(np.float64)
+    total = stats[:, 1].astype(np.float64)
+    density = np.where(n > 0, total / np.maximum(n, 1) / 65536.0, 1.0)
+    eligible = ((stats[:, 0] > 0) & (stats[:, 2] <= value_cap)
+                & (stats[:, 1] >= min_card))
+    if threshold <= 0:
+        return np.zeros(s, dtype=np.uint8)
+    fmt = (eligible & (density < threshold)).astype(np.uint8)
+    if prev is not None and band > 1.0:
+        m = min(s, len(prev))
+        was_sparse = prev[:m].astype(bool)
+        keep_sparse = was_sparse & eligible[:m] & (
+            density[:m] < threshold * band)
+        go_sparse = ~was_sparse & eligible[:m] & (
+            density[:m] < threshold / band)
+        fmt[:m] = (keep_sparse | go_sparse).astype(np.uint8)
+    return fmt
+
+
+def split_bitmaps_by_format(bitmaps: Sequence, formats: np.ndarray):
+    """(dense_list, sparse_list): each the full-length slice list with
+    the other format's slices None — the shape the two builders eat."""
+    dense = [b if not formats[si] else None for si, b in enumerate(bitmaps)]
+    sparse = [b if formats[si] else None for si, b in enumerate(bitmaps)]
+    return dense, sparse
+
+
+def global_row_ids(bitmaps: Sequence) -> np.ndarray:
+    """The GLOBAL sorted uint64 row-id table over every slice — shared
+    by the dense and sparse pools of one view (see build_sharded_index
+    row_ids=)."""
+    all_rows = [np.asarray(b.keys, dtype=np.uint64) >> np.uint64(4)
+                for b in bitmaps if b is not None and len(b.keys)]
+    return (np.unique(np.concatenate(all_rows)) if all_rows
+            else np.empty(0, dtype=np.uint64))
+
+
+def sparse_pool_dims(bitmaps: Sequence) -> Tuple[int, int]:
+    """(container capacity C, value capacity K) of the sparse pool that
+    build_sparse_sharded_index would stage for these slices — shared
+    with the byte estimators so budget admission and actual staging
+    cannot disagree."""
+    counts = [len(b.keys) if b is not None else 0 for b in bitmaps]
+    cap = max(1, max(counts, default=1))
+    cap = -(-cap // ROW_SPAN) * ROW_SPAN
+    max_card = 1
+    for b in bitmaps:
+        if b is None or not len(b.keys):
+            continue
+        max_card = max(max_card, max(c.n for c in b.containers))
+    k = -(-max_card // _VALUE_ALIGN) * _VALUE_ALIGN
+    return cap, k
+
+
+def sparse_pool_bytes(num_slices: int, n_dev: int, cap: int,
+                      k: int) -> int:
+    """Padded HBM bytes of a (C=cap, K=k) sparse pool over num_slices
+    slices on an n_dev mesh axis: values u16 + keys i32 + cards i32."""
+    s_pad = -(-max(1, num_slices) // n_dev) * n_dev
+    return s_pad * cap * (k * 2 + 4 + 4)
+
+
+def build_sparse_sharded_index(bitmaps: Sequence,
+                               mesh: Optional[Mesh] = None,
+                               row_ids: Optional[np.ndarray] = None,
+                               stats_out: Optional[dict] = None):
+    """Stack the SPARSE slices' bitmaps into a SparseShardedIndex.
+
+    bitmaps[s] is the slice-s roaring Bitmap for sparse-format slices
+    and None elsewhere (dense or absent) — full-length, so slice
+    positions line up with the dense pool. Containers pack as sorted
+    u16 value arrays (Container.values(), already sorted) padded to
+    the pool-wide value capacity with 0xFFFF; keys pack exactly like
+    the dense builder so resolve_row_indices works on the host copy.
+
+    Returns (SparseShardedIndex, row_ids, keys_host, cards_host) —
+    the host keys/cards copies are always produced (they are the
+    serving metadata AND the live-byte accounting source; a sparse
+    pool is small enough that the copies are noise).
+
+    No chunk pipeline here: a sparse pool is 10-100x smaller than the
+    dense image of the same slices (the whole point), so a plain
+    sharded device_put is already under the pipelining break-even."""
+    import time as _time
+
+    n_dev = mesh.shape[SLICE_AXIS] if mesh is not None else 1
+    s = max(1, len(bitmaps))
+    s_pad = -(-s // n_dev) * n_dev
+
+    if row_ids is None:
+        row_ids = global_row_ids(bitmaps)
+    cap, k = sparse_pool_dims(bitmaps)
+
+    t0 = _time.monotonic()
+    keys = np.full((s_pad, cap), INVALID_KEY, dtype=np.int32)
+    values = np.full((s_pad, cap, k), 0xFFFF, dtype=np.uint16)
+    cards = np.zeros((s_pad, cap), dtype=np.int32)
+    for si, b in enumerate(bitmaps):
+        if b is None or not len(b.keys):
+            continue
+        real = np.asarray(b.keys, dtype=np.uint64)
+        dense = np.searchsorted(row_ids, real >> np.uint64(4))
+        kk = (dense * ROW_SPAN
+              + (real & np.uint64(15)).astype(np.int64)).astype(np.int32)
+        order = np.argsort(kk)
+        keys[si, : len(kk)] = kk[order]
+        for j, ci in enumerate(order):
+            vals = b.containers[ci].values()
+            cards[si, j] = len(vals)
+            values[si, j, : len(vals)] = vals.astype(np.uint16)
+
+    if mesh is None:
+        keys_arr = jnp.asarray(keys)
+        values_arr = jnp.asarray(values)
+        cards_arr = jnp.asarray(cards)
+    else:
+        sharding = NamedSharding(mesh, P(SLICE_AXIS))
+        keys_arr = jax.device_put(keys, sharding)
+        values_arr = jax.device_put(values, sharding)
+        cards_arr = jax.device_put(cards, sharding)
+    nbytes = values.nbytes + keys.nbytes + cards.nbytes
+    profile.add_bytes("bytes_staged", nbytes)
+    if stats_out is not None:
+        stats_out["sparse_h2d_bytes"] = nbytes
+        stats_out["sparse_h2d_dispatch_s"] = _time.monotonic() - t0
+        stats_out["sparse_value_cap"] = k
+    idx = SparseShardedIndex(keys=keys_arr, values=values_arr,
+                             cards=cards_arr)
+    return idx, row_ids, keys, cards
+
+
+def _gather_sparse_containers(vals, cards, idx_l, hit_l):
+    """One sparse leaf's row containers for the serving kernels:
+    (S_l*16, K) values and HIT-ZEROED (S_l*16,) cardinalities, flat-
+    gathered with host-resolved within-slice indices — the sorted-array
+    counterpart of _gather_leaf_blocks. Zeroed cardinalities make every
+    downstream kernel exact on absent containers (no valid a-positions,
+    no valid b-positions, so intersections and op counts are 0)."""
+    s_l, c, k = vals.shape
+    base = (jnp.arange(s_l, dtype=jnp.int32) * c)[:, None]
+    flat = (idx_l + base).reshape(-1)
+    v = vals.reshape(s_l * c, k)[flat]
+    n = cards.reshape(-1)[flat] * hit_l.reshape(-1).astype(jnp.int32)
+    return v, n
+
+
+def compile_serve_count_sparse_pair(mesh: Mesh, op: str, kind: str,
+                                    backend: str = "xla",
+                                    interpret: bool = False):
+    """Jit a masked two-leaf Count where at least one leaf serves from
+    a sorted-array pool — the device analog of the reference's
+    per-container-type kernel table (roaring.go:1270-1351), dispatched
+    per SLICE GROUP by the serving layer.
+
+    kind: "ss" (both sparse — array×array intersect kernel),
+          "sd" (leaf 0 sparse, leaf 1 dense — array×bitmap probe),
+          "ds" (leaf 0 dense, leaf 1 sparse — probe, operands swapped
+          back for the asymmetric ops).
+    op:   "and" | "or" | "andnot" (the plan lowering's full op set);
+          everything beyond intersection derives per container by
+          inclusion–exclusion from |a∩b| and the hit-masked operand
+          cardinalities (bitops.sparse_op_counts).
+    backend: for "ss", which intersect kernel serves — "xla" (binary-
+          search gather ladder) or "pallas" (broadcast-compare); the
+          calibrated race winner. Probe kinds are XLA-only (the TPU has
+          no per-lane dynamic gather to write a Pallas probe with).
+
+    Returns fn(pool_a, pool_b, idx_a, hit_a, idx_b, hit_b, mask)
+    -> (2,) [lo, hi] limbs (combine_count). A sparse pool argument is
+    the (values, cards) tuple, a dense one is (words,); idx/hit are the
+    REPLICATED host (S, 16) resolve_row_indices outputs against the
+    POOL THE LEAF SERVES FROM, mask the (S,) slice-group mask (1 only
+    on slices this format pair owns)."""
+    from ..ops.bitops import (sparse_op_counts,
+                              sparse_pair_intersect_counts,
+                              sparse_probe_intersect_counts)
+
+    assert kind in ("ss", "sd", "ds"), kind
+
+    def gather_dense(words, idx_l, hit_l):
+        blk = _gather_leaf_blocks((words,), (idx_l,), (hit_l,), 0)
+        return blk, lax.population_count(blk).astype(jnp.int32).sum(
+            axis=-1)
+
+    def per_shard(pool_a, pool_b, idx_a, hit_a, idx_b, hit_b, mask):
+        s_l = pool_a[0].shape[0]
+        off = lax.axis_index(SLICE_AXIS) * s_l
+        ia = lax.dynamic_slice_in_dim(idx_a, off, s_l, axis=0)
+        ha = lax.dynamic_slice_in_dim(hit_a, off, s_l, axis=0)
+        ib = lax.dynamic_slice_in_dim(idx_b, off, s_l, axis=0)
+        hb = lax.dynamic_slice_in_dim(hit_b, off, s_l, axis=0)
+        mask_l = lax.dynamic_slice_in_dim(mask, off, s_l, axis=0)
+
+        if kind == "ss":
+            va, na = _gather_sparse_containers(pool_a[0], pool_a[1],
+                                               ia, ha)
+            vb, nb = _gather_sparse_containers(pool_b[0], pool_b[1],
+                                               ib, hb)
+            if backend == "pallas":
+                from ..ops.kernels import pallas_sparse_pair_counts
+
+                inter = pallas_sparse_pair_counts(va, na, vb, nb,
+                                                  interpret=interpret)
+            else:
+                inter = sparse_pair_intersect_counts(va, na, vb, nb)
+        elif kind == "sd":
+            va, na = _gather_sparse_containers(pool_a[0], pool_a[1],
+                                               ia, ha)
+            blk, nb = gather_dense(pool_b[0], ib, hb)
+            inter = sparse_probe_intersect_counts(va, na, blk)
+        else:  # ds: probe the sparse side into the dense words;
+            # |a∩b| is symmetric, na/nb keep their leaf positions so
+            # andnot stays leaf0 - intersection.
+            blk, na = gather_dense(pool_a[0], ia, ha)
+            vb, nb = _gather_sparse_containers(pool_b[0], pool_b[1],
+                                               ib, hb)
+            inter = sparse_probe_intersect_counts(vb, nb, blk)
+
+        counts = sparse_op_counts(op, inter, na, nb)
+        per_slice = counts.reshape(s_l, ROW_SPAN).sum(
+            axis=1).astype(jnp.uint32)
+        per_slice = jnp.where(mask_l != 0, per_slice, jnp.uint32(0))
+        lo = lax.psum(
+            (per_slice & jnp.uint32(0xFFFF)).astype(jnp.int32).sum(),
+            SLICE_AXIS)
+        hi = lax.psum((per_slice >> 16).astype(jnp.int32).sum(),
+                      SLICE_AXIS)
+        return jnp.stack([lo, hi])
+
+    pool_spec_a = (P(SLICE_AXIS),) if kind == "ds" else (
+        P(SLICE_AXIS), P(SLICE_AXIS))
+    pool_spec_b = (P(SLICE_AXIS),) if kind == "sd" else (
+        P(SLICE_AXIS), P(SLICE_AXIS))
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(pool_spec_a, pool_spec_b, P(), P(), P(), P(), P()),
+        out_specs=P(),
+        check_vma=(backend == "xla"),
+    )
+
+    @jax.jit
+    def run(pool_a, pool_b, idx_a, hit_a, idx_b, hit_b, mask):
+        return fn(pool_a, pool_b, idx_a, hit_a, idx_b, hit_b, mask)
+
+    return run
 
 
 def _local_pools(keys, words):

@@ -54,6 +54,17 @@ def _tree_signature(node) -> object:
     return walk(node)
 
 
+def format_signature(sig: str, formats) -> str:
+    """Tag a plan signature with the device container format(s) the
+    launch serves from ("ss"/"sd"/"ds"/"dd" per slice group, or any
+    descriptive tag). Sparse-path launches strike/quarantine under the
+    TAGGED signature, so a broken sorted-array kernel quarantines only
+    itself — the dense program for the same tree shape keeps serving."""
+    if isinstance(formats, str):
+        formats = (formats,)
+    return sig + "|fmt=" + ",".join(formats)
+
+
 def eval_tree(tree, leaves):
     """Evaluate a nested op-shape list over leaf (pool, dense_idx) pairs,
     returning the combined (16, 2048) uint32 block. Shared by the

@@ -1,0 +1,441 @@
+"""Sparsity-adaptive device residency (sorted-array containers).
+
+Three layers, mirroring the dense suite's structure:
+
+1. Kernel differential — the host sorted-array reference, the XLA
+   gather ladder (bitops.sparse_pair_intersect_counts), and the Pallas
+   kernel in interpret mode (kernels.pallas_sparse_pair_counts) must
+   agree bit-exact on every container boundary the roaring format has:
+   empty, singleton, full 4096-value arrays, the 0/65535 edges, and the
+   0xFFFF padding collision.
+2. Format pick — pick_slice_formats unit behavior: threshold, the
+   ARRAY_VALUE_CAP and SPARSE_MIN_SLICE_CARD eligibility gates, and the
+   hysteresis band that keeps boundary slices from flapping layouts.
+3. Serving — end-to-end Executor counts on sparse and mixed views
+   (device vs host, per-slice fallback poisoned so only the mesh path
+   can answer), demote-to-dense for shapes the sparse kernels don't
+   serve, the residency gauge, and mixed-format eviction under a
+   sub-working-set HBM budget.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.pql import parse_string
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def q(executor, index, pql):
+    return executor.execute(index, parse_string(pql))
+
+
+def poison_per_slice(monkeypatch):
+    """Make the per-slice host fallback unusable so a passing query
+    proves the device path served it."""
+    from pilosa_tpu.parallel.plan import CountPlan
+
+    def boom(self, slice_):
+        raise AssertionError("per-slice path used; device path expected")
+
+    monkeypatch.setattr(CountPlan, "count_slice", boom)
+
+
+def seed_sparse(holder, frame, rows=(1, 2), per_slice=1500, slices=2,
+                seed=7, spread=3):
+    """Rows of ~per_slice values over `spread` containers per slice —
+    above the SPARSE_MIN_SLICE_CARD floor, under the 5% density
+    threshold and the 4096-value array cap, so the stager picks the
+    sorted-array format."""
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists(frame)
+    rng = np.random.default_rng(seed)
+    for row in rows:
+        for s in range(slices):
+            cols = rng.choice(spread * 65536, size=per_slice,
+                              replace=False) + s * SLICE_WIDTH
+            for c in cols:
+                f.set_bit(row, int(c))
+    return f
+
+
+def seed_dense(holder, frame, rows=(1, 2), slices=2, seed=11):
+    """Rows with an 8000-value container per slice: max_card over the
+    4096 array cap, so the stager keeps packed words."""
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists(frame)
+    rng = np.random.default_rng(seed)
+    for row in rows:
+        for s in range(slices):
+            cols = rng.choice(12000, size=8000,
+                              replace=False) + s * SLICE_WIDTH
+            for c in cols:
+                f.set_bit(row, int(c))
+    return f
+
+
+# -- 1. kernel differential ---------------------------------------------------
+
+
+def _pad_pool(arrays, k=None):
+    """(C, K) int32 pool + (C,) lens from a list of sorted value
+    arrays, 0xFFFF padded — the exact layout the stager builds."""
+    if k is None:
+        k = max((len(a) for a in arrays), default=1)
+        k = max(8, -(-k // 8) * 8)
+    vals = np.full((len(arrays), k), 0xFFFF, dtype=np.int32)
+    lens = np.zeros(len(arrays), dtype=np.int32)
+    for i, a in enumerate(arrays):
+        a = np.asarray(sorted(a), dtype=np.int32)
+        vals[i, : len(a)] = a
+        lens[i] = len(a)
+    return vals, lens
+
+
+BOUNDARY_CONTAINERS = [
+    [],                                   # empty
+    [0],                                  # singleton at the low edge
+    [65535],                              # singleton at the pad value
+    [7],                                  # singleton, interior
+    list(range(4096)),                    # full array container
+    list(range(0, 65536, 16)),            # spread 4096-value container
+    list(range(61440, 65536)),            # full container at high edge
+    [0, 1, 2, 3, 65532, 65533, 65534, 65535],  # both edges
+    list(range(100, 200)),                # small interior run
+]
+
+
+class TestSparseKernelDifferential:
+    def _pairs(self):
+        """Every boundary container against every other (including
+        itself) plus random duplicates-free draws."""
+        rng = np.random.default_rng(3)
+        cs = list(BOUNDARY_CONTAINERS)
+        for n in (1, 100, 2048, 4096):
+            cs.append(sorted(rng.choice(65536, size=n, replace=False)))
+        a_list, b_list = [], []
+        for a in cs:
+            for b in cs:
+                a_list.append(a)
+                b_list.append(b)
+        return a_list, b_list
+
+    def test_pair_xla_vs_host_vs_pallas_interpret(self):
+        from pilosa_tpu.ops.bitops import (sparse_pair_count_host,
+                                           sparse_pair_intersect_counts)
+        from pilosa_tpu.ops.kernels import pallas_sparse_pair_counts
+
+        a_list, b_list = self._pairs()
+        a_vals, a_len = _pad_pool(a_list)
+        b_vals, b_len = _pad_pool(b_list)
+        want = np.array([sparse_pair_count_host(a, b)
+                         for a, b in zip(a_list, b_list)], dtype=np.int32)
+        got_xla = np.asarray(
+            sparse_pair_intersect_counts(a_vals, a_len, b_vals, b_len))
+        np.testing.assert_array_equal(got_xla, want)
+        got_pl = np.asarray(pallas_sparse_pair_counts(
+            a_vals, a_len, b_vals, b_len, interpret=True))
+        np.testing.assert_array_equal(got_pl, want)
+
+    def test_pair_asymmetric_value_caps(self):
+        """Operands from pools with different K paddings (a mixed
+        sd-vs-ss staging) must still agree."""
+        from pilosa_tpu.ops.bitops import (sparse_pair_count_host,
+                                           sparse_pair_intersect_counts)
+        from pilosa_tpu.ops.kernels import pallas_sparse_pair_counts
+
+        rng = np.random.default_rng(5)
+        a_list = [sorted(rng.choice(65536, size=n, replace=False))
+                  for n in (0, 1, 60, 64)]
+        b_list = [sorted(rng.choice(65536, size=n, replace=False))
+                  for n in (4096, 3000, 1, 0)]
+        a_vals, a_len = _pad_pool(a_list, k=64)
+        b_vals, b_len = _pad_pool(b_list, k=4096)
+        want = np.array([sparse_pair_count_host(a, b)
+                         for a, b in zip(a_list, b_list)], dtype=np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(sparse_pair_intersect_counts(
+                a_vals, a_len, b_vals, b_len)), want)
+        np.testing.assert_array_equal(
+            np.asarray(pallas_sparse_pair_counts(
+                a_vals, a_len, b_vals, b_len, interpret=True)), want)
+
+    def test_probe_array_x_bitmap(self):
+        """The mixed array×bitmap probe vs a host membership check."""
+        from pilosa_tpu.ops.bitops import sparse_probe_intersect_counts
+        from pilosa_tpu.ops.pool import CONTAINER_WORDS
+
+        rng = np.random.default_rng(9)
+        a_list = list(BOUNDARY_CONTAINERS)
+        a_vals, a_len = _pad_pool(a_list)
+        words = np.zeros((len(a_list), CONTAINER_WORDS), dtype=np.uint32)
+        for i in range(len(a_list)):
+            bits = rng.choice(65536, size=rng.integers(0, 20000),
+                              replace=False)
+            np.bitwise_or.at(words[i], bits >> 5,
+                             np.uint32(1) << (bits & 31).astype(np.uint32))
+        want = []
+        for i, a in enumerate(a_list):
+            a = np.asarray(a, dtype=np.int64)
+            if not a.size:
+                want.append(0)
+                continue
+            hit = (words[i][a >> 5] >> (a & 31).astype(np.uint32)) & 1
+            want.append(int(hit.sum()))
+        got = np.asarray(sparse_probe_intersect_counts(
+            a_vals, a_len, words))
+        np.testing.assert_array_equal(got, np.array(want, dtype=np.int32))
+
+    def test_op_identities_match_set_ops(self):
+        """The inclusion–exclusion identities the serving path uses
+        must reproduce real set-op cardinalities."""
+        from pilosa_tpu.ops.bitops import (sparse_pair_count_host,
+                                           sparse_op_counts)
+
+        rng = np.random.default_rng(13)
+        for _ in range(20):
+            a = set(map(int, rng.choice(65536, size=rng.integers(0, 3000))))
+            b = set(map(int, rng.choice(65536, size=rng.integers(0, 3000))))
+            inter = sparse_pair_count_host(sorted(a), sorted(b))
+            assert sparse_op_counts("and", inter, len(a), len(b)) \
+                == len(a & b)
+            assert sparse_op_counts("or", inter, len(a), len(b)) \
+                == len(a | b)
+            assert sparse_op_counts("andnot", inter, len(a), len(b)) \
+                == len(a - b)
+            assert sparse_op_counts("xor", inter, len(a), len(b)) \
+                == len(a ^ b)
+
+
+# -- 2. format pick -----------------------------------------------------------
+
+
+class TestFormatPick:
+    def _stats(self, rows):
+        return np.array(rows, dtype=np.int64)
+
+    def test_threshold_and_eligibility(self):
+        from pilosa_tpu.parallel.mesh import pick_slice_formats
+
+        stats = self._stats([
+            (16, 2000, 200),     # 0.19% fill -> sparse
+            (16, 60000, 5000),   # a container over the 4096 cap -> dense
+            (1, 60000, 60000),   # can't happen (cap 4096) but: dense
+            (0, 0, 0),           # empty slice -> dense
+            (16, 500, 40),       # under the min-card floor -> dense
+            (2, 130000, 4096),   # ~99% fill -> dense
+        ])
+        fmt = pick_slice_formats(stats, 0.05)
+        np.testing.assert_array_equal(fmt, [1, 0, 0, 0, 0, 0])
+
+    def test_kill_switch(self):
+        from pilosa_tpu.parallel.mesh import pick_slice_formats
+
+        stats = self._stats([(16, 2000, 200)])
+        np.testing.assert_array_equal(pick_slice_formats(stats, 0.0), [0])
+        np.testing.assert_array_equal(pick_slice_formats(stats, -1), [0])
+
+    def test_hysteresis_keeps_boundary_slice(self):
+        from pilosa_tpu.parallel.mesh import pick_slice_formats
+
+        # density = total / (n * 65536); threshold 5%, band 1.25:
+        # keep-sparse window is [5%, 6.25%), go-sparse needs < 4%.
+        n = 16
+        d_in_band = int(n * 65536 * 0.055)   # 5.5%: inside the band
+        stats = self._stats([(n, d_in_band, 4000)])
+        # fresh pick at 5.5%: dense
+        np.testing.assert_array_equal(pick_slice_formats(stats, 0.05), [0])
+        # was sparse: the band keeps it sparse
+        np.testing.assert_array_equal(
+            pick_slice_formats(stats, 0.05,
+                               prev=np.array([1], dtype=np.uint8)), [1])
+        # was dense: 4.5% is under the threshold but NOT under
+        # threshold/band — stays dense
+        d_under = int(n * 65536 * 0.045)
+        stats2 = self._stats([(n, d_under, 4000)])
+        np.testing.assert_array_equal(
+            pick_slice_formats(stats2, 0.05,
+                               prev=np.array([0], dtype=np.uint8)), [0])
+        # was dense, 3%: crosses threshold/band -> converts to sparse
+        d_deep = int(n * 65536 * 0.03)
+        stats3 = self._stats([(n, d_deep, 4000)])
+        np.testing.assert_array_equal(
+            pick_slice_formats(stats3, 0.05,
+                               prev=np.array([0], dtype=np.uint8)), [1])
+        # crossing the far band edge always converts to dense
+        d_out = int(n * 65536 * 0.07)
+        stats4 = self._stats([(n, d_out, 4000)])
+        np.testing.assert_array_equal(
+            pick_slice_formats(stats4, 0.05,
+                               prev=np.array([1], dtype=np.uint8)), [0])
+
+
+# -- 3. serving ---------------------------------------------------------------
+
+
+class TestSparseServe:
+    OPS = ("Intersect", "Union", "Difference")
+
+    def test_sparse_and_mixed_counts_match_host(self, holder, monkeypatch):
+        seed_sparse(holder, "sp")
+        seed_dense(holder, "dn")
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        queries = ["Count(Bitmap(rowID=1, frame=sp))",
+                   "Count(Bitmap(rowID=999, frame=sp))"]
+        for op in self.OPS:
+            queries.append(
+                f"Count({op}(Bitmap(rowID=1, frame=sp), "
+                "Bitmap(rowID=2, frame=sp)))")
+            queries.append(
+                f"Count({op}(Bitmap(rowID=1, frame=sp), "
+                "Bitmap(rowID=2, frame=dn)))")
+            queries.append(
+                f"Count({op}(Bitmap(rowID=1, frame=dn), "
+                "Bitmap(rowID=2, frame=sp)))")
+        for pql in queries:
+            assert q(e, "i", pql) == q(host, "i", pql), pql
+        mgr = e.mesh_manager()
+        assert mgr.stats["sparse_count"] > 0
+        assert mgr.stats["stage_sparse_slices"] > 0
+        sv = mgr._views.get(("i", "sp", "standard"))
+        assert sv is not None and sv.sparse is not None
+        assert sv.slice_formats.any()
+
+    def test_incremental_write_restages_exactly(self, holder, monkeypatch):
+        f = seed_sparse(holder, "sp")
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Bitmap(rowID=1, frame=sp))"
+        assert q(e, "i", pql) == q(host, "i", pql)
+        f.set_bit(1, 123456)
+        assert q(e, "i", pql) == q(host, "i", pql)
+        assert e.mesh_manager().stats.get("refresh_pick_restage", 0) >= 1
+
+    def test_demote_on_nary_tree_stays_on_device(self, holder,
+                                                 monkeypatch):
+        seed_sparse(holder, "sp", rows=(1, 2, 3))
+        poison_per_slice(monkeypatch)
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pair = ("Count(Intersect(Bitmap(rowID=1, frame=sp), "
+                "Bitmap(rowID=2, frame=sp)))")
+        assert q(e, "i", pair) == q(host, "i", pair)
+        mgr = e.mesh_manager()
+        assert mgr._views[("i", "sp", "standard")].sparse is not None
+        # 3-leaf union: only the packed-word fold serves it — the view
+        # demotes to dense and the DEVICE answers (host is poisoned)
+        tri = ("Count(Union(Bitmap(rowID=1, frame=sp), "
+               "Bitmap(rowID=2, frame=sp), Bitmap(rowID=3, frame=sp)))")
+        assert q(e, "i", tri) == q(host, "i", tri)
+        assert mgr.stats["sparse_demote"] >= 1
+        sv = mgr._views[("i", "sp", "standard")]
+        assert sv.sparse is None
+        # pin is sticky: a pair query keeps serving dense, no flap back
+        assert q(e, "i", pair) == q(host, "i", pair)
+        assert mgr._views[("i", "sp", "standard")].sparse is None
+        # invalidate clears the pin: the view may stage sparse again
+        # (ask a fresh pair so no memo can answer without staging)
+        mgr.invalidate()
+        pair23 = ("Count(Intersect(Bitmap(rowID=2, frame=sp), "
+                  "Bitmap(rowID=3, frame=sp)))")
+        assert q(e, "i", pair23) == q(host, "i", pair23)
+        assert mgr._views[("i", "sp", "standard")].sparse is not None
+
+    def test_threshold_env_kill_switch(self, holder, monkeypatch):
+        seed_sparse(holder, "sp")
+        monkeypatch.setenv("PILOSA_TPU_SPARSE_DENSITY_THRESHOLD", "0")
+        e = Executor(holder, use_device=True)
+        host = Executor(holder, use_device=False)
+        pql = "Count(Bitmap(rowID=1, frame=sp))"
+        assert q(e, "i", pql) == q(host, "i", pql)
+        mgr = e.mesh_manager()
+        sv = mgr._views[("i", "sp", "standard")]
+        assert sv.sparse is None
+        assert mgr._sparse_views == 0
+
+    def test_residency_gauge(self, holder):
+        seed_sparse(holder, "sp")
+        e = Executor(holder, use_device=True)
+        q(e, "i", "Count(Bitmap(rowID=1, frame=sp))")
+        dm = e.mesh_manager().device_memory()
+        assert dm["sparse_bytes"] > 0
+        assert 0 < dm["residency_ratio"] <= 1.0
+        assert dm["per_device"]
+        assert set(dm["residency_per_device"]) == set(dm["per_device"])
+        for r in dm["residency_per_device"].values():
+            assert 0 <= r <= 1.0
+
+    def test_explain_reports_format(self, holder):
+        seed_sparse(holder, "sp")
+        e = Executor(holder, use_device=True)
+        pql = ("Count(Intersect(Bitmap(rowID=1, frame=sp), "
+               "Bitmap(rowID=2, frame=sp)))")
+        plan = e.explain("i", parse_string(pql))
+        call = plan["calls"][0]
+        # pre-stage: the staging estimate prices the sparse pick
+        view = call["staging"]["views"][0]
+        assert view["format"] == "sparse"
+        assert call["staging"]["estimated_h2d_bytes"] > 0
+        q(e, "i", pql)
+        call2 = e.explain("i", parse_string(pql))["calls"][0]
+        assert call2["staging"]["views"][0]["resident"] is True
+        assert call2["staging"]["views"][0]["format"] == "sparse"
+        assert call2["device_format"]["leaves"] == ["sparse", "sparse"]
+        assert call2["device_format"]["sparse_shape"] == "and"
+
+
+class TestMixedEviction:
+    def test_mixed_format_eviction_under_budget(self, tmp_path,
+                                                monkeypatch):
+        """Round-robin over sparse + dense frames under a budget that
+        can't hold the whole working set: answers stay exact, the
+        governor's byte ledger tracks ACTUAL (sparse) bytes, and the
+        staged total respects the budget."""
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        try:
+            frames = ["sp1", "sp2", "dn1", "dn2"]
+            seed_sparse(h, "sp1", slices=1, seed=3)
+            seed_sparse(h, "sp2", slices=1, seed=4)
+            seed_dense(h, "dn1", slices=1, seed=5)
+            seed_dense(h, "dn2", slices=1, seed=6)
+            probe = Executor(h, use_device=True,
+                             mesh_config={"hbm_budget_bytes": -1})
+            host = Executor(h, use_device=False)
+            for fr in frames:
+                assert q(probe, "i", f"Count(Bitmap(rowID=1, frame={fr}))") \
+                    == q(host, "i", f"Count(Bitmap(rowID=1, frame={fr}))")
+            mgr = probe.mesh_manager()
+            per_view = {k[1]: mgr._view_bytes(v)
+                        for k, v in mgr._views.items()}
+            # the ledger charges sparse pools their actual (small) bytes
+            assert per_view["sp1"] < per_view["dn1"]
+            total = sum(per_view.values())
+            budget = int(total - per_view["dn1"] // 2)  # can't hold all
+            e = Executor(h, use_device=True,
+                         mesh_config={"hbm_budget_bytes": budget})
+            for i in range(12):
+                fr = frames[i % len(frames)]
+                pql = f"Count(Bitmap(rowID=1, frame={fr}))"
+                assert q(e, "i", pql) == q(host, "i", pql), pql
+            smgr = e.mesh_manager()
+            assert smgr.stats["evicted_budget"] > 0
+            assert smgr.stats["staged_bytes"] <= budget
+            # a sparse view survived or restaged — and the gauge is live
+            dm = smgr.device_memory()
+            assert dm["padded_bytes"] <= budget
+            assert 0 < dm["residency_ratio"] <= 1.0
+        finally:
+            h.close()

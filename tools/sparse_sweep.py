@@ -1,0 +1,161 @@
+"""Sparsity-adaptive format density sweep — the CI artifact half of
+the bench.py `sparse_intersect` section.
+
+Builds a pair-intersect workload at each density (defaults straddle
+the 5% [mesh] sparse-density-threshold and the 4096-value roaring
+array break-even: 0.3% and 3% stage as sorted-array containers, 30%
+stays packed words), serves it through `MeshManager.count` — the one
+entry point that dispatches BOTH container formats — and gates every
+density on bit-exact agreement with the C++ host fold over the same
+containers. Emits SPARSE_SWEEP.json with per-density qps, the resident
+format actually picked, staged bytes split by format, and the HBM
+residency ratio. Exits non-zero on any device-vs-host mismatch or on a
+format pick that contradicts the density (a 3% workload staging dense
+means the adaptive stager is broken, not slow).
+
+CPU-scale by design: the `vs_host` column on a CPU mesh is a sandbag
+(the XLA CPU backend pays dispatch overhead the C++ kernel doesn't);
+the gate here is correctness + format selection, the TPU speedup
+number comes from bench.py.
+
+Run: python tools/sparse_sweep.py [--slices 8] [--iters 5]
+     [--densities 0.003,0.03,0.3] [--out SPARSE_SWEEP.json]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slices", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--densities", default="0.003,0.03,0.3")
+    ap.add_argument("--out", default="SPARSE_SWEEP.json")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PILOSA_TPU_DEVICE_MIN_WORK", "0")
+
+    from bench import best_of, build_sparse_holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops import native
+    from pilosa_tpu.parallel.mesh import ARRAY_VALUE_CAP
+    from pilosa_tpu.parallel.plan import _lower_tree
+    from pilosa_tpu.pql import parse_string
+
+    densities = [float(d) for d in args.densities.split(",") if d]
+    tmp = tempfile.mkdtemp(prefix="sparse_sweep_")
+    sweep: dict = {}
+    failures = []
+    holders = []
+    try:
+        for density in densities:
+            hs = build_sparse_holder(tmp, args.slices, density=density)
+            es = Executor(hs, use_device=True)
+            holders.append((hs, es))
+            mgr = es.mesh_manager()
+            tree = parse_string(
+                "Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))"
+            ).calls[0].children[0]
+            leaves = []
+            shape = _lower_tree(hs, "i", tree, leaves)
+            slices = list(range(args.slices))
+            n = es._batch_num_slices("i", slices)
+            got = mgr.count("i", shape, leaves, slices, n)
+
+            pairs = []
+            for s in slices:
+                fr = hs.fragment("i", "general", "standard", s)
+                for b in range(16):
+                    ia = fr.storage._find_key(b)
+                    ib = fr.storage._find_key(16 + b)
+                    pairs.append((fr.storage.containers[ia],
+                                  fr.storage.containers[ib]))
+
+            def host_once(pairs_=pairs):
+                total = 0
+                for ca, cb in pairs_:
+                    if ca.array is not None and cb.array is not None:
+                        total += native.intersection_count_sorted(
+                            ca.array, cb.array)
+                    else:
+                        total += native.popcnt_and_slice(
+                            ca.bitmap.reshape(-1), cb.bitmap.reshape(-1))
+                return total
+
+            want = host_once()
+            t0 = time.perf_counter()
+            for _ in range(3):
+                host_once()
+            host_dt = (time.perf_counter() - t0) / 3
+            dt = best_of(
+                lambda m=mgr, sh=shape, lv=leaves, sl=slices, nn=n:
+                m.count("i", sh, lv, sl, nn), 1, args.iters)
+            sv = mgr._views.get(("i", "general", "standard"))
+            fmt = (Executor._resident_format(sv)
+                   if sv is not None else "unstaged")
+            dm = mgr.device_memory()
+            row = {
+                "qps": round(1.0 / dt, 2),
+                "mean_ms": round(dt * 1e3, 4),
+                "host_cpu_qps": round(1.0 / host_dt, 2),
+                "vs_host": round(host_dt / dt, 4),
+                "format": fmt,
+                "staged_sparse_bytes": int(dm["sparse_bytes"]),
+                "staged_dense_bytes": int(dm["padded_bytes"]
+                                          - dm["sparse_bytes"]),
+                "residency_ratio": round(dm["residency_ratio"], 4),
+                "device_vs_host_exact": bool(got == want),
+            }
+            sweep[f"{density:g}"] = row
+            if got != want:
+                failures.append(
+                    f"density {density:g}: device {got} != host {want}")
+            # 4096-value break-even: an array-container workload must
+            # have staged sparse; a bitmap-container one, dense.
+            per_container = int(65536 * density)
+            expect = ("sparse" if per_container <= ARRAY_VALUE_CAP
+                      else "dense")
+            if fmt != expect:
+                failures.append(
+                    f"density {density:g}: staged {fmt}, expected {expect}")
+            print(f"density {density:g}: {fmt:6s} "
+                  f"qps={row['qps']:>9} vs_host={row['vs_host']} "
+                  f"exact={row['device_vs_host_exact']}")
+    finally:
+        for hs, _ in holders:
+            try:
+                hs.close()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    report = {
+        "slices": args.slices,
+        "iters": args.iters,
+        "sweep": sweep,
+        "failures": failures,
+        "ok": not failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}; ok={report['ok']}")
+    if failures:
+        for msg in failures:
+            print("FAIL:", msg, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
