@@ -1841,6 +1841,96 @@ def main():
             "routed_stale_vs_host": host_dt / routed_stale_dt,
             "host_baseline": "cxx-nary-fold, 1 thread, 3 reps"}
 
+    with section("bsi_aggregate"):
+        # -- BSI analytics: Sum / Min / Max / Range over a 2M-column
+        # integer field (bit-plane rows in the bsi.val view), device
+        # aggregation vs the exact host roaring fold. Planes inject as
+        # packed words (SetValue-per-column would take hours at this
+        # scale); a numpy model of the same values is the ground truth
+        # both paths must match bit-exactly, negatives included.
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.bsi import FieldSchema
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.roaring.bitmap import Container
+
+        bsi_slices = 2  # 2 x 2^20 = 2M columns (>= 1M acceptance bar)
+        rngb = np.random.default_rng(41)
+        schema_b = FieldSchema("val", min=-32768, max=32767)
+        vals = rngb.integers(-32768, 32768,
+                             size=bsi_slices * SLICE_WIDTH).astype(np.int64)
+        exists = rngb.random(bsi_slices * SLICE_WIDTH) < 0.5
+        vals[~exists] = 0
+        hb = Holder(os.path.join(tmp, "bsi"))
+        hb.open()
+        idxb = hb.create_index_if_not_exists("i")
+        fb = idxb.create_frame_if_not_exists("general")
+        fb.create_field_if_not_exists(schema_b)
+        vw = fb.create_view_if_not_exists(schema_b.view)
+        mags = np.where(vals < 0, -vals, vals).astype(np.uint64)
+        planes = [exists, vals < 0] + [
+            ((mags >> np.uint64(k)) & np.uint64(1)).astype(bool)
+            for k in range(schema_b.bit_depth)]
+        for s in range(bsi_slices):
+            fragb = vw.create_fragment_if_not_exists(s)
+            keys_b, conts_b = [], []
+            lo = s * SLICE_WIDTH
+            for r, bits in enumerate(planes):
+                words = np.packbits(bits[lo:lo + SLICE_WIDTH],
+                                    bitorder="little").view(np.uint64)
+                for c in range(16):
+                    keys_b.append(r * 16 + c)
+                    conts_b.append(Container(
+                        bitmap=words[c * 1024:(c + 1) * 1024].copy()))
+            _inject(fragb, keys_b, conts_b)
+        want_sum = int(vals[exists].sum())
+        want_cnt = int(exists.sum())
+        want_min = int(vals[exists].min())
+        want_max = int(vals[exists].max())
+        want_ge0 = int((exists & (vals >= 0)).sum())
+
+        ed = _reg(Executor(hb, use_device=True, device_min_work=0))
+        eh = Executor(hb, use_device=False)
+        q_sum = parse_string('Sum(frame=general, field="val")')
+        q_rng = parse_string('Count(Range(frame=general, val >= 0))')
+        got_d = ed.execute("i", q_sum)[0]
+        got_h = eh.execute("i", q_sum)[0]
+        assert got_d == got_h == {"value": want_sum, "count": want_cnt}, \
+            (got_d, got_h, want_sum, want_cnt)
+        assert ed.execute("i", parse_string(
+            'Min(frame=general, field="val")'))[0]["value"] == want_min
+        assert ed.execute("i", parse_string(
+            'Max(frame=general, field="val")'))[0]["value"] == want_max
+        assert ed.execute("i", q_rng)[0] == \
+            eh.execute("i", q_rng)[0] == want_ge0
+        n_r = 20 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(n_r):
+            ed.execute("i", q_sum)
+        dev_dt = (time.perf_counter() - t0) / n_r
+        t0 = time.perf_counter()
+        for _ in range(n_r):
+            eh.execute("i", q_sum)
+        host_dt = (time.perf_counter() - t0) / n_r
+        t0 = time.perf_counter()
+        for _ in range(n_r):
+            ed.execute("i", q_rng)
+        dev_rng_dt = (time.perf_counter() - t0) / n_r
+        t0 = time.perf_counter()
+        for _ in range(n_r):
+            eh.execute("i", q_rng)
+        host_rng_dt = (time.perf_counter() - t0) / n_r
+        details["bsi_aggregate"] = {
+            "columns": bsi_slices * SLICE_WIDTH,
+            "bit_depth": schema_b.bit_depth,
+            "sum_device_ms": dev_dt * 1e3,
+            "sum_host_ms": host_dt * 1e3,
+            "sum_device_vs_host": host_dt / dev_dt,
+            "range_device_ms": dev_rng_dt * 1e3,
+            "range_host_ms": host_rng_dt * 1e3,
+            "range_device_vs_host": host_rng_dt / dev_rng_dt,
+            "routes": dict(ed.route_stats.copy()),
+            "host_baseline": "host roaring fold (bsi/host.py), 1 thread"}
+
     with section("sparse_intersect"):
         # -- extra: sparsity-adaptive container-format sweep ---------------------
         # Three densities straddling the [mesh] sparse-density-threshold

@@ -58,6 +58,7 @@ import time
 from datetime import datetime, timezone
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
+from ..bsi import FieldNotFoundError, FieldValueError
 from ..core.attr import diff_blocks
 from ..core.row import Row
 from ..core.timequantum import parse_time_quantum
@@ -174,6 +175,12 @@ TopN(frame=f, n=N [, threshold=T] [, ids=[..]] [, field=.., filters=[..]]
      [, tanimotoThreshold=P]) [&lt;src bitmap&gt;]
 Range(frame=f, rowID=R, start="...", end="...")   time-quantum views
 SetRowAttrs(frame=f, rowID=R, k=v, ...)   SetColumnAttrs(columnID=C, k=v, ...)
+
+Integer fields (BSI; declare via POST frame options {"fields":[{"name":..,"min":..,"max":..}]}):
+SetValue(frame=f, columnID=C, price=42)   write one column's value
+Range(frame=f, price &gt;= 100)              value comparison: &lt; &lt;= &gt; &gt;= == != &gt;&lt; [lo,hi]
+Sum(frame=f, field="price")               {value, count}; optional bitmap filter child
+Min(frame=f, field="price")  Max(...)     device binary search over bit planes
 </pre>
 <h2>HTTP API</h2>
 <pre>
@@ -372,10 +379,15 @@ def _error_status(err: Exception) -> int:
     if isinstance(err, (WriteBackpressureError, WriteConsistencyError)):
         return 503
     if isinstance(err, (IndexNotFoundError, FrameNotFoundError,
-                        FragmentNotFoundError)):
+                        FragmentNotFoundError, FieldNotFoundError)):
         return 404
     if isinstance(err, (IndexExistsError, FrameExistsError)):
         return 409
+    # Before the generic ValueError → 400: FieldValueError is a
+    # ValueError, but an in-range-typed, out-of-declared-range value is
+    # a semantic (422) rejection, not a malformed request.
+    if isinstance(err, FieldValueError):
+        return 422
     if isinstance(err, (QueryError, ParseError, ValueError, KeyError)):
         return 400
     return 500
@@ -1661,7 +1673,7 @@ class Handler:
         opts = _decode_options(body, {
             "rowLabel": "row_label", "inverseEnabled": "inverse_enabled",
             "cacheType": "cache_type", "cacheSize": "cache_size",
-            "timeQuantum": "time_quantum"})
+            "timeQuantum": "time_quantum", "fields": "fields"})
         idx = self.holder.index(pv["index"])
         if idx is None:
             raise IndexNotFoundError()
@@ -1672,7 +1684,11 @@ class Handler:
                     row_label=f.row_label,
                     inverse_enabled=f.inverse_enabled,
                     cache_type=f.cache_type, cache_size=f.cache_size,
-                    time_quantum=str(f.time_quantum))))
+                    time_quantum=str(f.time_quantum),
+                    fields_json=json.dumps(
+                        [s.to_dict()
+                         for _, s in sorted(f.fields.items())])
+                    if f.fields else "")))
         return _json_resp({})
 
     def _delete_frame(self, pv, params, headers, body) -> Response:
@@ -1977,7 +1993,15 @@ class Handler:
                                    "retry_after_s": retry}, 503)
             resp.headers["Retry-After"] = str(retry)
             return resp
-        status = 504 if isinstance(e, DeadlineExceededError) else 400
+        if isinstance(e, DeadlineExceededError):
+            status = 504
+        elif isinstance(e, (FieldValueError, FieldNotFoundError)):
+            # BSI field errors keep their schema-aware statuses (422 /
+            # 404) through the query surface — a SetValue outside the
+            # declared range is not a malformed request.
+            status = _error_status(e)
+        else:
+            status = 400
         if self._accepts_proto(headers):
             return _proto_resp(pb.QueryResponse(err=str(e)), status)
         return _json_resp({"error": str(e)}, status)

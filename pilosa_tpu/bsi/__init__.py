@@ -1,0 +1,42 @@
+"""Bit-sliced indexing: integer fields over bitmap plane rows.
+
+`field` defines the schema and row layout of a ``bsi.<field>`` view;
+`lower` compiles value comparisons into the plane-ladder boolean trees
+both execution paths share; `host` is the exact roaring fold — the
+differential oracle for the device aggregation path.
+"""
+
+from .field import (
+    BSI_VIEW_PREFIX,
+    DEFAULT_MAX,
+    DEFAULT_MIN,
+    MAX_BIT_DEPTH,
+    ROW_EXISTS,
+    ROW_PLANE0,
+    ROW_SIGN,
+    FieldNotFoundError,
+    FieldSchema,
+    FieldValueError,
+    is_bsi_view,
+    view_name,
+)
+from .lower import cond_tree, lower_cond, to_shape, tree_leaf_count
+
+__all__ = [
+    "BSI_VIEW_PREFIX",
+    "DEFAULT_MAX",
+    "DEFAULT_MIN",
+    "MAX_BIT_DEPTH",
+    "ROW_EXISTS",
+    "ROW_PLANE0",
+    "ROW_SIGN",
+    "FieldNotFoundError",
+    "FieldSchema",
+    "FieldValueError",
+    "is_bsi_view",
+    "view_name",
+    "cond_tree",
+    "lower_cond",
+    "to_shape",
+    "tree_leaf_count",
+]

@@ -1,0 +1,198 @@
+"""Compile BSI value comparisons into plane-wise boolean ladders.
+
+One tree language serves both execution paths: nodes are tuples —
+``("leaf", row_id)``, ``("and"|"or"|"andnot", *children)``, or the
+``EMPTY`` sentinel — over rows of a field's ``bsi.<field>`` view. The
+device path converts a tree to the fused-plan (shape, leaves) form via
+`to_shape`; the host oracle folds the SAME tree over roaring Rows via
+`bsi.host.eval_rows`. Bit-exactness between the two is then a property
+of the kernels, not of two hand-maintained ladder implementations.
+
+The ladders are the classic O'Neil bit-sliced forms, built LSB→MSB so
+each comparison is one linear nesting the fused tree-count kernels
+consume directly:
+
+    x > c   :  R_k = x_k AND R_{k-1}           when bit k of c is 1
+               R_k = x_k OR  R_{k-1}           when bit k of c is 0
+               seeded R = EMPTY (>) or base (>=)
+    x < c   :  R_k = (base ANDNOT x_k) OR R    when bit k of c is 1
+               R_k = R ANDNOT x_k              when bit k of c is 0
+               seeded R = EMPTY (<) or base (<=)
+    x == c  :  fold of AND x_k / ANDNOT x_k over all planes, from base
+
+Signed composition splits on the sign plane: with pos = ex ANDNOT sign
+and neg = ex AND sign, e.g. ``x > c`` for negative c is
+``pos OR (neg AND |x| < |c|)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .field import ROW_EXISTS, ROW_PLANE0, ROW_SIGN, FieldSchema
+
+EMPTY = ("empty",)
+
+
+def leaf(row_id: int) -> tuple:
+    return ("leaf", row_id)
+
+
+def t_and(a: tuple, b: tuple) -> tuple:
+    if a == EMPTY or b == EMPTY:
+        return EMPTY
+    return ("and", a, b)
+
+
+def t_or(a: tuple, b: tuple) -> tuple:
+    if a == EMPTY:
+        return b
+    if b == EMPTY:
+        return a
+    return ("or", a, b)
+
+
+def t_andnot(a: tuple, b: tuple) -> tuple:
+    if a == EMPTY:
+        return EMPTY
+    if b == EMPTY:
+        return a
+    if a == b:
+        return EMPTY
+    return ("andnot", a, b)
+
+
+_EX = leaf(ROW_EXISTS)
+_SIGN = leaf(ROW_SIGN)
+_POS = t_andnot(_EX, _SIGN)
+_NEG = t_and(_EX, _SIGN)
+
+# Public names for the sign-split bases — the executor's Min/Max plane
+# search seeds its candidate trees from these.
+POS = _POS
+NEG = _NEG
+
+
+def _mag_cmp(schema: FieldSchema, op: str, c: int, base: tuple) -> tuple:
+    """Unsigned magnitude comparison |x| <op> c restricted to `base`
+    (a set of existing columns on one side of the sign split). c must
+    be >= 0; op in {">", ">=", "<", "<="}."""
+    d = schema.bit_depth
+    if c >= (1 << d):
+        return base if op in ("<", "<=") else EMPTY
+    if c < 0:  # defensive; callers split on sign first
+        return base if op in (">", ">=") else EMPTY
+    strict = op in (">", "<")
+    r = EMPTY if strict else base
+    if op in (">", ">="):
+        for k in range(d):
+            p = leaf(ROW_PLANE0 + k)
+            r = t_and(p, r) if (c >> k) & 1 else t_or(p, r)
+        # OR terms escape the candidate set; clamp back to base.
+        return t_and(r, base)
+    for k in range(d):
+        p = leaf(ROW_PLANE0 + k)
+        if (c >> k) & 1:
+            r = t_or(t_andnot(base, p), r)
+        else:
+            r = t_andnot(r, p)
+    return r
+
+
+def _mag_eq(schema: FieldSchema, c: int, base: tuple) -> tuple:
+    """|x| == c restricted to `base`."""
+    if c < 0 or c >= (1 << schema.bit_depth):
+        return EMPTY
+    r = base
+    for k in range(schema.bit_depth):
+        p = leaf(ROW_PLANE0 + k)
+        r = t_and(r, p) if (c >> k) & 1 else t_andnot(r, p)
+    return r
+
+
+def cond_tree(schema: FieldSchema, op: str, value) -> tuple:
+    """Full signed comparison tree for ``field <op> value`` over the
+    field's bsi view. `value` is an int, or (low, high) for ``><``
+    (between, inclusive)."""
+    if op == "><":
+        low, high = value
+        return t_and(cond_tree(schema, ">=", low),
+                     cond_tree(schema, "<=", high))
+    c = value
+    if op == ">":
+        if c >= 0:
+            return t_and(_POS, _mag_cmp(schema, ">", c, _POS))
+        return t_or(_POS, t_and(_NEG, _mag_cmp(schema, "<", -c, _NEG)))
+    if op == ">=":
+        if c > 0:
+            return t_and(_POS, _mag_cmp(schema, ">=", c, _POS))
+        if c == 0:
+            return _POS
+        return t_or(_POS, t_and(_NEG, _mag_cmp(schema, "<=", -c, _NEG)))
+    if op == "<":
+        if c <= 0:
+            return t_and(_NEG, _mag_cmp(schema, ">", -c, _NEG))
+        return t_or(_NEG, t_and(_POS, _mag_cmp(schema, "<", c, _POS)))
+    if op == "<=":
+        if c < 0:
+            return t_and(_NEG, _mag_cmp(schema, ">=", -c, _NEG))
+        return t_or(_NEG, t_and(_POS, _mag_cmp(schema, "<=", c, _POS)))
+    if op == "==":
+        base = _NEG if c < 0 else _POS
+        return _mag_eq(schema, abs(c), base)
+    if op == "!=":
+        return t_andnot(_EX, cond_tree(schema, "==", c))
+    raise ValueError(f"unknown comparison operator {op!r}")
+
+
+def tree_leaf_count(tree: tuple) -> int:
+    """Number of plane leaves in a tree — the explain() plane count."""
+    if tree == EMPTY:
+        return 0
+    if tree[0] == "leaf":
+        return 1
+    return sum(tree_leaf_count(t) for t in tree[1:])
+
+
+def to_shape(tree: tuple, frame: str, view: str,
+             leaves: List[tuple]) -> Optional[list]:
+    """Convert a cond tree to the fused-plan nested shape, appending
+    (frame, view, row_id, required=False) leaf tuples depth-first —
+    the exact format parallel.plan's _lower_tree produces. Absent bsi
+    fragments mean "no values on this slice", so every leaf is
+    optional. EMPTY lowers as ex ANDNOT ex: a two-leaf always-empty
+    tree, keeping the plan machinery's invariant that a shape always
+    has leaves."""
+    if tree == EMPTY:
+        tree = ("andnot", _EX, _EX)
+    if tree[0] == "leaf":
+        leaves.append((frame, view, tree[1], False))
+        return ["leaf"]
+    return [tree[0]] + [to_shape(t, frame, view, leaves)
+                        for t in tree[1:]]
+
+
+def lower_cond(holder, index: str, c, leaves: List[tuple]):
+    """plan._lower_tree hook: lower Range(frame=f, field <op> N) to a
+    fused shape over the field's bsi view. Returns None (host path)
+    when the frame/field is unknown or the call is not a BSI range."""
+    from ..pql.ast import Cond
+
+    found = [(k, v) for k, v in c.args.items() if isinstance(v, Cond)]
+    if len(found) != 1:
+        return None
+    fname, cond = found[0]
+    from ..executor import DEFAULT_FRAME
+
+    idx = holder.index(index)
+    if idx is None:
+        return None
+    frame = c.args.get("frame") or DEFAULT_FRAME
+    f = idx.frame(frame)
+    if f is None:
+        return None
+    schema = f.bsi_field(fname)
+    if schema is None:
+        return None
+    tree = cond_tree(schema, cond.op, cond.value)
+    return to_shape(tree, frame, schema.view, leaves)

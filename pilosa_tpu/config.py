@@ -143,6 +143,22 @@ TOML schema:
                                 # results through the host roaring fold
                                 # and compare; 0 = off
 
+    # -- declarative schema (optional) --
+    # Indexes/frames/integer fields created at server open (idempotent:
+    # existing objects are kept, missing BSI fields are added to
+    # existing frames). Bad declarations fail boot loudly — a typo'd
+    # schema must never half-apply.
+    # [[schema.indexes]]
+    # name = "i"
+    # column-label = "columnID"
+    # [[schema.indexes.frames]]
+    # name = "f"
+    # row-label = "rowID"
+    # [[schema.indexes.frames.fields]]
+    # name = "val"
+    # min = -1000
+    # max = 1000
+
     [slo]
     enabled = true              # SLO observatory (obs/slo.py):
                                 # per-tenant outcome accounting, error
@@ -234,6 +250,39 @@ def parse_use_device(value: str):
     if v in ("auto", ""):
         return None
     raise ValueError(f"use-device must be auto/on/off, got {value!r}")
+
+
+def _parse_schema(sh: dict) -> List[dict]:
+    """Normalize [[schema.indexes]] into plain dicts, validating shape
+    and every field definition eagerly (FieldSchema's constructor
+    raises on bad names/ranges) — a typo'd declarative schema should
+    fail at config load, not halfway through server open."""
+    from .bsi.field import FieldSchema
+
+    out = []
+    for ix in sh.get("indexes", []):
+        name = str(ix.get("name", "")).strip()
+        if not name:
+            raise ValueError("[[schema.indexes]] entry missing name")
+        frames = []
+        for fr in ix.get("frames", []):
+            fname = str(fr.get("name", "")).strip()
+            if not fname:
+                raise ValueError(
+                    f"schema index {name!r}: frame entry missing name")
+            fields = []
+            for fd in fr.get("fields", []):
+                # Round-trip through FieldSchema for validation; keep
+                # the plain dict (to_dict adds derived bitDepth, which
+                # from_dict ignores — harmless either way).
+                fields.append(FieldSchema.from_dict(dict(fd)).to_dict())
+            frames.append({"name": fname,
+                           "row-label": str(fr.get("row-label", "")),
+                           "fields": fields})
+        out.append({"name": name,
+                    "column-label": str(ix.get("column-label", "")),
+                    "frames": frames})
+    return out
 
 
 class Config:
@@ -371,6 +420,11 @@ class Config:
         self.slo_p99_us: float = 50_000.0
         self.slo_latency_target: float = 99.0
         self.slo_shed_rate_max: float = 0.05
+        # [[schema.indexes]] — declarative schema applied at server
+        # open (module docstring). Normalized dicts: {"name", optional
+        # "column-label", "frames": [{"name", optional "row-label",
+        # "fields": [{"name", "min", "max"}, ...]}, ...]}.
+        self.schema_indexes: List[dict] = []
 
     @classmethod
     def from_toml(cls, path_or_text: str, is_text: bool = False) -> "Config":
@@ -513,6 +567,7 @@ class Config:
                                             c.slo_latency_target))
         c.slo_shed_rate_max = float(sl.get("shed-rate-max",
                                            c.slo_shed_rate_max))
+        c.schema_indexes = _parse_schema(data.get("schema", {}))
         return c
 
     def expanded_data_dir(self) -> str:
@@ -672,7 +727,28 @@ class Config:
             f"p99-us = {int(self.slo_p99_us)}\n"
             f"latency-target = {self.slo_latency_target}\n"
             f"shed-rate-max = {self.slo_shed_rate_max}\n"
+            + self._schema_toml()
         )
+
+    def _schema_toml(self) -> str:
+        """[[schema.indexes]] tables for to_toml; empty schema emits
+        nothing (the section is optional and has no defaults)."""
+        parts = []
+        for ix in self.schema_indexes:
+            parts.append(f'\n[[schema.indexes]]\nname = "{ix["name"]}"\n')
+            if ix.get("column-label"):
+                parts.append(f'column-label = "{ix["column-label"]}"\n')
+            for fr in ix.get("frames", []):
+                parts.append(f'\n[[schema.indexes.frames]]\n'
+                             f'name = "{fr["name"]}"\n')
+                if fr.get("row-label"):
+                    parts.append(f'row-label = "{fr["row-label"]}"\n')
+                for fd in fr.get("fields", []):
+                    parts.append(f'\n[[schema.indexes.frames.fields]]\n'
+                                 f'name = "{fd["name"]}"\n'
+                                 f'min = {fd["min"]}\n'
+                                 f'max = {fd["max"]}\n')
+        return "".join(parts)
 
 
 # -- roofline peak table (obs/profile.py) ---------------------------------

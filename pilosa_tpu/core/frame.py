@@ -17,10 +17,12 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from ..bsi.field import FieldNotFoundError, FieldSchema, FieldValueError
 from ..utils import validate_label, validate_name
 from .attr import AttrStore
 from .cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
 from .fragment import MUTATION_EPOCH
+from .row import Row
 from .timequantum import TimeQuantum, views_by_time
 from .view import VIEW_INVERSE, VIEW_STANDARD, View
 
@@ -34,6 +36,7 @@ class Frame:
                  cache_type: str = CACHE_TYPE_RANKED,
                  cache_size: int = DEFAULT_CACHE_SIZE,
                  time_quantum: str = "",
+                 fields: Optional[Sequence] = None,
                  stats=None, broadcaster=None, wal=None,
                  integrity=None):
         validate_name(name)
@@ -50,8 +53,21 @@ class Frame:
         self.wal = wal
         self.integrity = integrity
         self.views: Dict[str, View] = {}
+        self.fields: Dict[str, FieldSchema] = self._coerce_fields(fields)
         self._create_mu = threading.RLock()
         self.row_attr_store = AttrStore(os.path.join(path, "attrs.db"))
+
+    @staticmethod
+    def _coerce_fields(fields) -> Dict[str, FieldSchema]:
+        out: Dict[str, FieldSchema] = {}
+        for f in fields or ():
+            schema = f if isinstance(f, FieldSchema) \
+                else FieldSchema.from_dict(f)
+            if schema.name in out:
+                raise FieldValueError(
+                    f"duplicate field {schema.name!r}")
+            out[schema.name] = schema
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -89,6 +105,9 @@ class Frame:
         self.cache_type = meta.get("cacheType", self.cache_type)
         self.cache_size = meta.get("cacheSize", self.cache_size)
         self.time_quantum = TimeQuantum(meta.get("timeQuantum", str(self.time_quantum)))
+        if meta.get("fields"):
+            # Disk wins over ctor options, same as every other meta key.
+            self.fields = self._coerce_fields(meta["fields"])
 
     def _save_meta(self):
         os.makedirs(self.path, exist_ok=True)
@@ -99,6 +118,7 @@ class Frame:
                 "cacheType": self.cache_type,
                 "cacheSize": self.cache_size,
                 "timeQuantum": str(self.time_quantum),
+                "fields": [s.to_dict() for _, s in sorted(self.fields.items())],
             }, f)
 
     def set_time_quantum(self, q: TimeQuantum):
@@ -110,6 +130,72 @@ class Frame:
         self.row_label = validate_label(label)
         MUTATION_EPOCH.bump_structural()  # changes how Bitmap args lower
         self._save_meta()
+
+    # -- BSI fields ----------------------------------------------------------
+
+    def bsi_field(self, name: str) -> Optional[FieldSchema]:
+        return self.fields.get(name)
+
+    def create_field_if_not_exists(self, schema: FieldSchema) -> FieldSchema:
+        with self._create_mu:
+            cur = self.fields.get(schema.name)
+            if cur is not None:
+                if cur != schema:
+                    raise FieldValueError(
+                        f"field {schema.name!r} already exists with a "
+                        f"different range")
+                return cur
+            # Copy-on-write like views: readers never take the lock.
+            self.fields = {**self.fields, schema.name: schema}
+            MUTATION_EPOCH.bump_structural()  # changes how conds lower
+            self._save_meta()
+            return schema
+
+    def set_value(self, field: str, column_id: int, value: int,
+                  deadline: Optional[float] = None) -> bool:
+        """Write one integer value: set/clear every plane row of the
+        field's bsi view for this column. Overwrites need no
+        read-modify-write because encode() covers all rows explicitly.
+        Raises FieldNotFoundError / FieldValueError (HTTP 404/422)."""
+        schema = self.fields.get(field)
+        if schema is None:
+            raise FieldNotFoundError(self.name, field)
+        set_rows, clear_rows = schema.encode(value)
+        view = self.create_view_if_not_exists(schema.view)
+        changed = False
+        for row_id in set_rows:
+            if view.set_bit(row_id, column_id, deadline=deadline):
+                changed = True
+        for row_id in clear_rows:
+            if view.clear_bit(row_id, column_id, deadline=deadline):
+                changed = True
+        return changed
+
+    def field_value(self, field: str, column_id: int) -> Optional[int]:
+        """Read one column's value back from the plane rows (host-only
+        point read; None when the column has no value)."""
+        from ..bsi.field import ROW_EXISTS, ROW_PLANE0, ROW_SIGN
+        from .. import SLICE_WIDTH
+
+        schema = self.fields.get(field)
+        if schema is None:
+            raise FieldNotFoundError(self.name, field)
+        view = self.views.get(schema.view)
+        frag = view.fragment(column_id // SLICE_WIDTH) if view else None
+        if frag is None:
+            return None
+        probe = Row([column_id])
+
+        def has(row_id: int) -> bool:
+            return frag.row(row_id).intersection_count(probe) > 0
+
+        if not has(ROW_EXISTS):
+            return None
+        mag = 0
+        for k in range(schema.bit_depth):
+            if has(ROW_PLANE0 + k):
+                mag |= 1 << k
+        return -mag if has(ROW_SIGN) else mag
 
     # -- views -------------------------------------------------------------
 
@@ -230,6 +316,8 @@ class Frame:
                 "cacheType": self.cache_type,
                 "cacheSize": self.cache_size,
                 "timeQuantum": str(self.time_quantum),
+                "fields": [s.to_dict()
+                           for _, s in sorted(self.fields.items())],
             },
             "views": sorted(self.views),
         }

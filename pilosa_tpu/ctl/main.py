@@ -462,6 +462,27 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
     lines.append(f"uptime {up:.0f}s   queries {int(qtot)}   "
                  f"qps {qps:.1f}")
 
+    # Route panel (pilosa_query_route_total{backend}): per-backend QPS
+    # over the scrape interval, with the BSI aggregation path (bsi-mesh
+    # device / bsi-host fold) summed into one "aggregate qps" figure.
+    routes = [(dict(labels).get("backend", ""), v)
+              for (name, labels), v in sorted(cur.items())
+              if name == "pilosa_query_route_total"]
+    if routes:
+        def _route_rate(backend: str, v: float) -> float:
+            pv = prev.get(("pilosa_query_route_total",
+                           (("backend", backend),)), 0.0) if prev else 0.0
+            return (v - pv) / dt if prev and dt > 0 else 0.0
+        lines.append("routes: " + "  ".join(
+            f"{b}={int(v)} ({_route_rate(b, v):.1f}/s)"
+            for b, v in routes))
+        agg = [(b, v) for b, v in routes if b.startswith("bsi-")]
+        if agg:
+            lines.append(
+                f"aggregates: qps "
+                f"{sum(_route_rate(b, v) for b, v in agg):.1f}   "
+                + "  ".join(f"{b}={int(v)}" for b, v in agg))
+
     # Per-phase measured percentiles (pilosa_query_phase_us{phase,
     # backend}) — only present once something has been profiled.
     pairs = sorted({(dict(labels).get("phase", ""),

@@ -60,7 +60,11 @@ MIN_THRESHOLD = 1
 # PQL timestamp format (reference TimeFormat "2006-01-02T15:04").
 TIME_FORMAT = "%Y-%m-%dT%H:%M"
 
-_WRITE_CALLS = ("ClearBit", "SetBit", "SetRowAttrs", "SetColumnAttrs")
+_WRITE_CALLS = ("ClearBit", "SetBit", "SetValue", "SetRowAttrs",
+                "SetColumnAttrs")
+
+# BSI aggregates over integer fields (bsi.<field> views).
+_BSI_AGGREGATES = ("Sum", "Min", "Max")
 
 # Shadow-verification counters, keyed "checks:<backend>" /
 # "mismatch:<backend>" — exported as pilosa_shadow_checks_total /
@@ -337,6 +341,10 @@ class Executor:
             return self._execute_count(index, c, slices, opt)
         if c.name == "SetBit":
             return self._execute_set_bit(index, c, opt)
+        if c.name == "SetValue":
+            return self._execute_set_value(index, c, opt)
+        if c.name in _BSI_AGGREGATES:
+            return self._execute_bsi_aggregate(index, c, slices, opt)
         if c.name == "SetRowAttrs":
             return self._execute_set_row_attrs(index, c, opt)
         if c.name == "SetColumnAttrs":
@@ -483,6 +491,24 @@ class Executor:
         f = self.holder.frame(index, frame)
         if f is None:
             raise FrameNotFoundError()
+
+        # Value comparison over an integer field: Range(frame=f, v >= 3)
+        # — one O'Neil plane ladder over the field's bsi view. This is
+        # the per-slice host form; lowerable trees never get here (the
+        # fused materialize/count paths lower the same ladder).
+        fname_cond = self._bsi_cond(c)
+        if fname_cond is not None:
+            from .bsi import host as bsi_host
+
+            fname, cond = fname_cond
+            schema = f.bsi_field(fname)
+            if schema is None:
+                from .bsi import FieldNotFoundError
+
+                raise FieldNotFoundError(frame, fname)
+            frag = self.holder.fragment(index, frame, schema.view, slice_)
+            return bsi_host.range_row(frag, schema, cond.op, cond.value)
+
         row_id, _ = c.uint_arg(f.row_label)
 
         start = c.args.get("start")
@@ -686,6 +712,303 @@ class Executor:
                     pairs.append((frag, frag.generation))
         return tuple(pairs)
 
+    # -- BSI aggregates ------------------------------------------------------
+
+    @staticmethod
+    def _bsi_cond(c: Call):
+        """The call's single field comparison as (field, Cond), None
+        when it has none; raises QueryError on more than one."""
+        from .pql.ast import Cond
+
+        found = [(k, v) for k, v in c.args.items() if isinstance(v, Cond)]
+        if not found:
+            return None
+        if len(found) > 1:
+            raise QueryError(
+                f"{c.name}() accepts one field comparison, got "
+                f"{len(found)}")
+        return found[0]
+
+    def _bsi_call_schema(self, index: str, c: Call):
+        """Resolve (frame name, Frame, FieldSchema) for a BSI aggregate
+        call; raises the NotFound errors the handler maps to 404."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        field = c.args.get("field")
+        if not isinstance(field, str) or not field:
+            raise QueryError(f"{c.name}() field required")
+        schema = f.bsi_field(field)
+        if schema is None:
+            from .bsi import FieldNotFoundError
+
+            raise FieldNotFoundError(frame, field)
+        return frame, f, schema
+
+    @staticmethod
+    def _valcount_pair(v):
+        """Normalize a per-leg aggregate result — local (value, count)
+        tuple or a remote leg's decoded {"value", "count"} dict — to a
+        tuple; None stays None (an empty Min/Max leg)."""
+        if v is None:
+            return None
+        if isinstance(v, dict):
+            return int(v.get("value", 0)), int(v.get("count", 0))
+        return v
+
+    def _execute_bsi_aggregate(self, index: str, c: Call,
+                               slices: Sequence[int], opt: ExecOptions):
+        """Sum / Min / Max over an integer field, with an optional
+        bitmap filter child.
+
+        Device path (single-host mesh): Sum is one fused per-row-count
+        collective over the whole bsi view — every magnitude plane, the
+        existence row, and the sign row counted in a single masked
+        popcount + segment-sum — plus a second sign-side pass that is
+        SKIPPED when no negative values exist (the sign count is
+        visible in the first pass); the 2^k weighting folds host-side
+        in unbounded Python ints. Min/Max binary-search the magnitude
+        planes MSB-down, each probe one fused tree-count collective.
+        Both shadow-verify sampled batches against the host roaring
+        fold and serve the HOST value on mismatch.
+
+        Host path (fallback, cost-routed small queries, SPMD, remote
+        legs' per-slice work): exact roaring folds in bsi.host."""
+        frame, _f, schema = self._bsi_call_schema(index, c)
+        if len(c.children) > 1:
+            raise QueryError(
+                f"{c.name}() only accepts a single bitmap input")
+        child = c.children[0] if c.children else None
+        t0 = time.monotonic()
+
+        # Lower the filter child once; a non-lowerable filter pins the
+        # whole aggregate to the host path (its per-slice evaluation
+        # needs host state anyway).
+        filter_lowered = None
+        device_ok = self._device_backend_on() and self._spmd is None
+        if device_ok and child is not None:
+            from .parallel.plan import _lower_tree
+
+            fleaves: list = []
+            fshape = _lower_tree(self.holder, index, child, fleaves)
+            if fshape is None or not fleaves:
+                device_ok = False
+            else:
+                filter_lowered = (fshape, fleaves)
+        if device_ok and self._route_to_host(
+                len(slices), schema.row_count, index=index):
+            device_ok = False
+
+        view = schema.view
+        from .bsi import host as bsi_host
+
+        def map_fn(slice_):
+            frag = self.holder.fragment(index, frame, view, slice_)
+            filter_row = (self.execute_bitmap_call_slice(index, child,
+                                                         slice_)
+                          if child is not None else None)
+            if c.name == "Sum":
+                return bsi_host.sum_slice(frag, schema, filter_row)
+            if c.name == "Max":
+                return bsi_host.max_slice(frag, schema, filter_row)
+            return bsi_host.min_slice(frag, schema, filter_row)
+
+        if c.name == "Sum":
+            def reduce_fn(prev, v):
+                v = self._valcount_pair(v)
+                if v is None:
+                    return prev
+                if prev is None:
+                    return v
+                return prev[0] + v[0], prev[1] + v[1]
+        else:
+            maximize = c.name == "Max"
+
+            def reduce_fn(prev, v):
+                return bsi_host.reduce_extremes(
+                    [prev, self._valcount_pair(v)], maximize)
+
+        batch_fn = None
+        if device_ok:
+            inner = (self._bsi_sum_batch(index, frame, schema,
+                                         filter_lowered)
+                     if c.name == "Sum" else
+                     self._bsi_extremum_batch(index, frame, schema,
+                                              filter_lowered,
+                                              c.name == "Max"))
+            if inner is not None:
+                def batch_fn(batch_slices):
+                    v = inner(batch_slices)
+                    if v is not None and self._shadow_sampled():
+                        v = self._shadow_check_bsi(
+                            c.name, index, batch_slices, v, map_fn,
+                            reduce_fn)
+                    return v
+            else:
+                device_ok = False
+
+        out = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
+                               batch_fn=batch_fn)
+        self._record_route("bsi-mesh" if device_ok else "bsi-host", t0)
+        if c.name == "Sum":
+            s, n = out if out is not None else (0, 0)
+            return {"value": int(s), "count": int(n)}
+        if out is None:
+            return None
+        return {"value": int(out[0]), "count": int(out[1])}
+
+    def _bsi_sum_batch(self, index: str, frame: str, schema,
+                       filter_lowered):
+        """batch_fn computing (sum, count) for a slice batch from the
+        fused per-row-count collectives, or None when no manager."""
+        mgr = self.mesh_manager()
+        if mgr is None:
+            return None
+        from .bsi.field import ROW_EXISTS, ROW_PLANE0, ROW_SIGN
+        from .ops.bsi import sum_from_counts
+
+        view = schema.view
+
+        def batch_fn(batch_slices):
+            num = self._batch_num_slices(index, batch_slices)
+            try:
+                counts = mgr.bsi_plane_counts(
+                    index, frame, view, batch_slices, num,
+                    src=filter_lowered)
+                if counts is None:
+                    return None
+                neg: dict = {}
+                if counts.get(ROW_SIGN, 0):
+                    # Negative values present: second pass restricted
+                    # to the sign row (AND the filter, when given).
+                    sshape: list = ["leaf"]
+                    sleaves = [(frame, view, ROW_SIGN, False)]
+                    if filter_lowered is not None:
+                        fshape, fleaves = filter_lowered
+                        sshape = ["and", fshape, ["leaf"]]
+                        sleaves = list(fleaves) + sleaves
+                    neg = mgr.bsi_plane_counts(
+                        index, frame, view, batch_slices, num,
+                        src=(sshape, sleaves))
+                    if neg is None:
+                        return None
+            except Exception:  # noqa: BLE001 — device failure → host
+                return None
+            d = schema.bit_depth
+            total = sum_from_counts(
+                [counts.get(ROW_PLANE0 + k, 0) for k in range(d)],
+                [neg.get(ROW_PLANE0 + k, 0) for k in range(d)])
+            return total, counts.get(ROW_EXISTS, 0)
+
+        return batch_fn
+
+    def _bsi_extremum_batch(self, index: str, frame: str, schema,
+                            filter_lowered, maximize: bool):
+        """batch_fn binary-searching the magnitude planes MSB-down for
+        a slice batch — ~bit_depth fused tree-count collectives over
+        growing candidate trees. Returns (value, count) or None (empty
+        batch falls through to the host fold, which agrees)."""
+        mgr = self.mesh_manager()
+        if mgr is None:
+            return None
+        from .bsi import lower as L
+        from .bsi.field import ROW_PLANE0
+
+        view = schema.view
+
+        def batch_fn(batch_slices):
+            num = self._batch_num_slices(index, batch_slices)
+
+            def count_tree(tree):
+                leaves: list = []
+                shape = L.to_shape(tree, frame, view, leaves)
+                if filter_lowered is not None:
+                    fshape, fleaves = filter_lowered
+                    shape = ["and", shape, fshape]
+                    leaves = leaves + list(fleaves)
+                try:
+                    n = mgr.count(index, shape, leaves, batch_slices,
+                                  num)
+                except Exception:  # noqa: BLE001 — device → host
+                    return None
+                return None if n is None else int(n)
+
+            def search(cand, big_mag: bool):
+                mag = 0
+                for k in range(schema.bit_depth - 1, -1, -1):
+                    p = L.leaf(ROW_PLANE0 + k)
+                    inter = L.t_and(cand, p)
+                    if big_mag:
+                        n = count_tree(inter)
+                        if n is None:
+                            return None
+                        if n:
+                            cand, mag = inter, mag | (1 << k)
+                    else:
+                        rest = L.t_andnot(cand, p)
+                        n = count_tree(rest)
+                        if n is None:
+                            return None
+                        if n:
+                            cand = rest
+                        else:
+                            cand, mag = inter, mag | (1 << k)
+                n = count_tree(cand)
+                if n is None:
+                    return None
+                return mag, n
+
+            n_pos = count_tree(L.POS)
+            if n_pos is None:
+                return None
+            n_neg = count_tree(L.NEG)
+            if n_neg is None:
+                return None
+            first, second = ((n_pos, L.POS, 1), (n_neg, L.NEG, -1))
+            if not maximize:
+                first, second = second, first
+            for n_side, base, sign in (first, second):
+                if not n_side:
+                    continue
+                # max: positives hold the LARGEST magnitude, negatives
+                # the smallest; min mirrors.
+                big = (sign > 0) == maximize
+                out = search(base, big_mag=big)
+                if out is None:
+                    return None
+                return sign * out[0], out[1]
+            return None  # no values in batch; host fold agrees
+
+        return batch_fn
+
+    def _shadow_check_bsi(self, name: str, index: str, batch_slices,
+                          device_v, map_fn, reduce_fn):
+        """Recompute a sampled device aggregate through the host
+        roaring fold and compare. On mismatch: count it, log, and
+        serve the HOST value — BSI collectives are keyed per staged
+        view rather than one plan signature, so the counter and log
+        line are the alarm (as with TopN)."""
+        SHADOW_STATS.inc("checks:bsi")
+        host_v = None
+        for s in batch_slices:
+            host_v = reduce_fn(host_v, map_fn(s))
+        if name == "Sum" and host_v is None:
+            host_v = (0, 0)
+        if host_v == self._valcount_pair(device_v):
+            return device_v
+        SHADOW_STATS.inc("mismatch:bsi")
+        cur = obs.current_span()
+        trace = getattr(getattr(cur, "trace", None), "trace_id", "-")
+        obs.get_logger("executor").error(
+            "shadow verification MISMATCH (bsi %s): device=%s host=%s "
+            "index=%s slices=%d trace=%s — serving host fold",
+            name, device_v, host_v, index, len(batch_slices), trace)
+        return host_v
+
     def mesh_manager(self):
         """The mesh serving layer, or None when the device backend is
         off or its construction failed (no devices, import error)."""
@@ -828,9 +1151,25 @@ class Executor:
                 "hinted_handoff": self.hints is not None,
             }
             return info
+        if c.name in _BSI_AGGREGATES:
+            return self._explain_bsi_aggregate(index, c, slices, info)
         if c.name != "Count" or len(c.children) != 1:
             # Non-Count reads run the per-slice roaring map-reduce.
             info["route"] = "roaring"
+            cond = self._find_cond(c)
+            if cond is not None:
+                # Range(field <op> N): report the plane ladder the
+                # comparison compiles to, and what it would stage.
+                from .parallel.plan import _lower_tree
+
+                leaves: list = []
+                shape = _lower_tree(self.holder, index, c, leaves)
+                if shape is not None and leaves:
+                    info["bsi"] = {"field": cond[0],
+                                   "cond": str(cond[1]),
+                                   "planes": len(leaves)}
+                    info["staging"] = self._explain_staging(
+                        index, leaves, slices)
             info["placement"] = self._explain_placement(index, slices)
             return info
 
@@ -841,6 +1180,10 @@ class Executor:
         leaves: list = []
         shape = _lower_tree(self.holder, index, child, leaves)
         lowerable = shape is not None and bool(leaves)
+        cond = self._find_cond(child)
+        if cond is not None and lowerable:
+            info["bsi"] = {"field": cond[0], "cond": str(cond[1]),
+                           "planes": len(leaves)}
 
         # Memo peek mirrors _execute_count's single-node gate.
         memo_hit = False
@@ -893,6 +1236,65 @@ class Executor:
                 index, leaves, shape, mgr)
         if lowerable:
             info["staging"] = self._explain_staging(index, leaves, slices)
+        info["placement"] = self._explain_placement(index, slices)
+        return info
+
+    @classmethod
+    def _find_cond(cls, c: Call):
+        """First (field, Cond) pair anywhere in a call tree — the
+        explain() marker that a query compiles plane ladders."""
+        from .pql.ast import Cond
+
+        for k, v in c.args.items():
+            if isinstance(v, Cond):
+                return k, v
+        for child in c.children:
+            found = cls._find_cond(child)
+            if found is not None:
+                return found
+        return None
+
+    def _explain_bsi_aggregate(self, index: str, c: Call,
+                               slices: Sequence[int],
+                               info: dict) -> dict:
+        """Planned execution of Sum/Min/Max: which engine serves it,
+        the plane count behind the field, and what a device dispatch
+        would stage (every row of the bsi view)."""
+        from .bsi import FieldNotFoundError
+
+        try:
+            frame, _f, schema = self._bsi_call_schema(index, c)
+        except (IndexNotFoundError, FrameNotFoundError,
+                FieldNotFoundError, QueryError) as err:
+            # explain() never dispatches: a bad call reports its error
+            # instead of raising, so the rest of the plan still renders.
+            info["route"] = "error"
+            info["error"] = str(err) or type(err).__name__
+            return info
+        backend_on = self._device_backend_on()
+        route_reason = None
+        if backend_on and self._spmd is None:
+            route_reason = self._would_route_to_host(
+                len(slices), schema.row_count, index=index)
+            route = "bsi-host" if route_reason else "bsi-mesh"
+        else:
+            route = "bsi-host"
+        info["route"] = route
+        if route_reason:
+            info["route_reason"] = route_reason
+        info["bsi"] = {"field": c.args.get("field"),
+                       "planes": schema.bit_depth,
+                       "rows": schema.row_count}
+        info["cost_model"] = {
+            "backend_on": backend_on,
+            "leaves": schema.row_count,
+            "work_units": len(slices) * schema.row_count,
+            "min_work": self._min_work(),
+            "cpu_native_routes": self._cpu_native_routes(),
+        }
+        leaves = [(frame, schema.view, r, False)
+                  for r in range(schema.row_count)]
+        info["staging"] = self._explain_staging(index, leaves, slices)
         info["placement"] = self._explain_placement(index, slices)
         return info
 
@@ -1553,6 +1955,56 @@ class Executor:
             index, c, opt, col_id,
             lambda: f.set_bit(row_id, col_id, timestamp,
                               deadline=opt.deadline))
+
+    def _execute_set_value(self, index: str, c: Call,
+                           opt: ExecOptions) -> bool:
+        """SetValue(frame=f, col=N, field=V): overwrite a column's
+        integer field value. The encode covers EVERY row of the bsi
+        view (set + clear lists), so overwrite needs no
+        read-modify-write; replication rides the same quorum fan-out
+        as SetBit — the call re-parses verbatim on replicas and hints."""
+        self._check_writable("SetValue()", opt)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        frame = c.args.get("frame")
+        if not isinstance(frame, str):
+            raise QueryError("SetValue() frame required")
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise QueryError(
+                f"SetValue() column field '{idx.column_label}' required")
+
+        fields = [(k, v) for k, v in c.args.items()
+                  if k not in ("frame", idx.column_label)]
+        if len(fields) != 1:
+            raise QueryError(
+                "SetValue() requires exactly one field=value pair")
+        fname, value = fields[0]
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise QueryError(f"SetValue() field '{fname}' must be an int")
+        schema = f.bsi_field(fname)
+        if schema is None:
+            from .bsi import FieldNotFoundError
+
+            raise FieldNotFoundError(frame, fname)
+        # Validate BEFORE any replica sees the write: an out-of-range
+        # value is a clean 422 with no state mutated anywhere.
+        schema.validate(value)
+
+        if self._spmd is not None and not opt.remote:
+            # The SPMD write descriptor encodes (row, col, clear) bit
+            # flips only; multi-valued field writes don't fit it yet.
+            raise QueryError(
+                "SetValue() is not supported under SPMD serving")
+
+        return self._execute_mutate_view(
+            index, c, opt, col_id,
+            lambda: f.set_value(fname, col_id, value,
+                                deadline=opt.deadline))
 
     def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
         self._check_writable("ClearBit()", opt)

@@ -26,6 +26,12 @@ _LAZY = {
     "popcount_words": "bitops",
     "fused_pair_count": "kernels",
     "use_pallas": "kernels",
+    "dense_rows_from_values": "bsi",
+    "plane_counts": "bsi",
+    "sum_dense": "bsi",
+    "sum_from_counts": "bsi",
+    "tree_count_dense": "bsi",
+    "extremum_dense": "bsi",
 }
 
 
@@ -50,4 +56,10 @@ __all__ = [
     "popcount_words",
     "fused_pair_count",
     "use_pallas",
+    "dense_rows_from_values",
+    "plane_counts",
+    "sum_dense",
+    "sum_from_counts",
+    "tree_count_dense",
+    "extremum_dense",
 ]

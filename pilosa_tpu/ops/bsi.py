@@ -1,0 +1,222 @@
+"""BSI aggregation kernels: weighted plane popcounts over bit-sliced
+integer fields.
+
+Device primitives underlying the executor's Sum/Min/Max aggregates over
+``bsi.<field>`` views, in two variants that must agree bit-exact:
+
+- fused XLA: one ``population_count(planes & filter).sum`` dataflow per
+  launch — every magnitude plane counted in a single fused reduction;
+- Pallas/CSA: per-plane `fused_pair_count` / `csa_popcount_sum` calls
+  reusing the carry-save ladder from kernels.py (interpret mode is the
+  CPU test vehicle).
+
+Per-plane counts come back as device int32 scalars (a plane holds at
+most 2^20 bits per slice); the 2^k weighting and cross-slice totals are
+combined host-side in unbounded Python ints (`sum_from_counts`), so the
+device epilogue can never overflow no matter the bit depth.
+
+Dense blocks here are ``(..., words)`` uint32 arrays in the same packed
+layout as the container pools (bit i of word w = column 32*w + i).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..bsi.field import ROW_EXISTS, ROW_PLANE0, ROW_SIGN, FieldSchema
+from .bitops import fold_tree
+from .kernels import csa_popcount_sum, fused_pair_count
+
+
+# -- dense plane construction (tests / bench) --------------------------------
+
+def dense_rows_from_values(columns: Sequence[int], values: Sequence[int],
+                           schema: FieldSchema, n_words: int) -> np.ndarray:
+    """Encode (column, value) pairs as the field's dense row matrix:
+    ``(row_count, n_words)`` uint32, rows laid out exactly like the
+    ``bsi.<field>`` view (existence, sign, magnitude planes)."""
+    rows = np.zeros((schema.row_count, n_words), dtype=np.uint32)
+    for col, val in zip(columns, values):
+        schema.validate(val)
+        w, bit = divmod(int(col), 32)
+        mask = np.uint32(1 << bit)
+        rows[ROW_EXISTS, w] |= mask
+        if val < 0:
+            rows[ROW_SIGN, w] |= mask
+        mag = abs(int(val))
+        for k in range(schema.bit_depth):
+            if (mag >> k) & 1:
+                rows[ROW_PLANE0 + k, w] |= mask
+    return rows
+
+
+# -- per-plane popcounts ------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("masked",))
+def _plane_counts_xla(planes, src, masked: bool):
+    x = planes & src[None, :] if masked else planes
+    return jax.lax.population_count(x).astype(jnp.int32).sum(axis=1)
+
+
+def plane_counts(planes, src=None, *, backend: str = "xla",
+                 interpret: bool = False) -> np.ndarray:
+    """Popcount of each plane row, optionally ANDed with a filter block:
+    ``counts[p] = |planes[p] & src|``. `planes` is (P, words) uint32,
+    `src` (words,) uint32 or None.
+
+    backend "xla" is the fused single-launch path; "pallas" routes each
+    plane through the CSA kernels (force-compiled, `interpret` for CPU
+    differential runs). Returns a host int64 vector of length P."""
+    planes = jnp.asarray(planes)
+    if backend == "xla":
+        src_in = (jnp.asarray(src) if src is not None
+                  else jnp.zeros((planes.shape[1],), planes.dtype))
+        out = _plane_counts_xla(planes, src_in, src is not None)
+        return np.asarray(jax.device_get(out), dtype=np.int64)
+    counts = []
+    src_p = _pad_words(jnp.asarray(src)) if src is not None else None
+    for p in range(planes.shape[0]):
+        row = _pad_words(planes[p])
+        if src_p is None:
+            counts.append(int(csa_popcount_sum(
+                _pad_rows(row), force=not interpret)))
+        else:
+            counts.append(int(fused_pair_count(
+                row, src_p, "and",
+                force_pallas=True, interpret=interpret)))
+    return np.asarray(counts, dtype=np.int64)
+
+
+def _pad_words(row):
+    """Pad the flattened word axis to whole 2048-word containers —
+    the block shape the Pallas pair kernels are specialized for."""
+    from .pool import CONTAINER_WORDS
+
+    row = row.reshape(-1)
+    n = row.shape[0]
+    rem = n % CONTAINER_WORDS
+    if rem:
+        row = jnp.concatenate(
+            [row, jnp.zeros((CONTAINER_WORDS - rem,), row.dtype)])
+    return row.reshape(1, -1)
+
+
+def _pad_rows(row):
+    """csa_popcount_sum wants rows % 8 == 0; pad with zero rows."""
+    m = row.shape[0]
+    if m % 8:
+        row = jnp.concatenate(
+            [row, jnp.zeros((8 - m % 8, row.shape[1]), row.dtype)])
+    return row
+
+
+# -- exact host epilogues -----------------------------------------------------
+
+def sum_from_counts(all_counts: Sequence[int],
+                    neg_counts: Sequence[int]) -> int:
+    """Combine per-plane counts into the signed sum, in unbounded
+    Python ints: sum = sum_k 2^k * (|p_k ∩ F| - 2·|p_k ∩ F ∩ neg|).
+    `all_counts[k]` counts plane k against the filter, `neg_counts[k]`
+    against the filter restricted to negative columns."""
+    total = 0
+    for k, (a, n) in enumerate(zip(all_counts, neg_counts)):
+        total += (1 << k) * (int(a) - 2 * int(n))
+    return total
+
+
+def sum_dense(planes, schema: FieldSchema, src=None, *,
+              backend: str = "xla",
+              interpret: bool = False) -> Tuple[int, int]:
+    """-> (sum, count) of a field over one dense row matrix — the
+    kernel-level differential twin of `bsi.host.sum_slice`."""
+    planes = jnp.asarray(planes)
+    ex, sg = planes[ROW_EXISTS], planes[ROW_SIGN]
+    if src is not None:
+        ex = ex & jnp.asarray(src)
+    neg = ex & sg
+    mags = planes[ROW_PLANE0:ROW_PLANE0 + schema.bit_depth]
+    all_c = plane_counts(mags, ex, backend=backend, interpret=interpret)
+    neg_c = plane_counts(mags, neg, backend=backend, interpret=interpret)
+    count = int(plane_counts(ex.reshape(1, -1),
+                             backend=backend, interpret=interpret)[0])
+    return sum_from_counts(all_c, neg_c), count
+
+
+# -- tree-count + extremum search over dense blocks ---------------------------
+
+def tree_count_dense(tree, planes, *, backend: str = "xla",
+                     interpret: bool = False) -> int:
+    """Fused count of a bsi.lower cond tree over a dense row matrix:
+    the device analog of counting `bsi.host.eval_rows(tree, frag)`.
+    Leaves index rows of `planes` by row id."""
+    from ..bsi.lower import EMPTY
+
+    if tree == EMPTY:
+        return 0
+    planes = jnp.asarray(planes)
+    blk = fold_tree(tree, lambda row_id: planes[row_id])
+    if backend == "pallas":
+        return int(csa_popcount_sum(_pad_rows(blk.reshape(1, -1)),
+                                    force=not interpret))
+    return int(jax.device_get(
+        jax.lax.population_count(blk).astype(jnp.int32).sum()))
+
+
+def extremum_dense(planes, schema: FieldSchema, maximize: bool,
+                   src=None, *, backend: str = "xla",
+                   interpret: bool = False) -> Optional[Tuple[int, int]]:
+    """-> (value, count) extremum over one dense row matrix, or None
+    when empty — MSB-down binary search issuing one fused popcount per
+    plane, mirroring `bsi.host.max_slice`/`min_slice` semantics
+    (positives win for max, negatives for min)."""
+    planes = jnp.asarray(planes)
+    ex, sg = planes[ROW_EXISTS], planes[ROW_SIGN]
+    if src is not None:
+        ex = ex & jnp.asarray(src)
+    pos, neg = ex & ~sg, ex & sg
+
+    def count(blk) -> int:
+        if backend == "pallas":
+            return int(csa_popcount_sum(_pad_rows(blk.reshape(1, -1)),
+                                        force=not interpret))
+        return int(jax.device_get(
+            jax.lax.population_count(blk).astype(jnp.int32).sum()))
+
+    def search(cand, big_mag: bool) -> Tuple[int, int]:
+        mag = 0
+        for k in range(schema.bit_depth - 1, -1, -1):
+            p = planes[ROW_PLANE0 + k]
+            inter = cand & p
+            n = count(inter)
+            if big_mag:
+                if n:
+                    cand, mag = inter, mag | (1 << k)
+            else:
+                rest = cand & ~p
+                if count(rest):
+                    cand = rest
+                else:
+                    cand, mag = inter, mag | (1 << k)
+        return mag, count(cand)
+
+    if maximize:
+        if count(pos):
+            mag, n = search(pos, big_mag=True)
+            return mag, n
+        if count(neg):
+            mag, n = search(neg, big_mag=False)
+            return -mag, n
+        return None
+    if count(neg):
+        mag, n = search(neg, big_mag=True)
+        return -mag, n
+    if count(pos):
+        mag, n = search(pos, big_mag=False)
+        return mag, n
+    return None

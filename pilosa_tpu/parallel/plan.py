@@ -792,6 +792,12 @@ def _lower_tree(holder, index: str, c, leaves: List[tuple]):
             return ["leaf"]
         return None  # both/neither/disabled-inverse → host path
     if c.name == "Range":
+        from ..pql.ast import Cond
+
+        if any(isinstance(v, Cond) for v in c.args.values()):
+            from ..bsi.lower import lower_cond
+
+            return lower_cond(holder, index, c, leaves)
         return _lower_range(holder, index, c, leaves)
     op = _TREE_OPS.get(c.name)
     if op is None or not c.children:

@@ -3390,6 +3390,26 @@ class MeshManager:
         self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
         return row_ids, counts
 
+    def bsi_plane_counts(self, index: str, frame: str, view: str,
+                         slices: Sequence[int], num_slices: int,
+                         src=None):
+        """Per-row counts over a ``bsi.<field>`` view as a dict
+        {row_id: count} — the executor's Sum aggregate reads every
+        plane, the existence row, and the sign row from ONE fused
+        collective (the same masked popcount + segment-sum the TopN
+        paths use; a bsi view is just another row space). With `src` =
+        (shape, leaves) the counts are |row ∩ src| — the filtered-Sum
+        form. Returns None on any fallback (not staged, OOM, sparse)."""
+        out = (self.row_counts_src(index, frame, view, src[0],
+                                   src[1], slices, num_slices)
+               if src is not None else
+               self.row_counts(index, frame, view, slices, num_slices))
+        if out is None:
+            return None
+        row_ids, counts = out
+        self.stats.inc("bsi_aggregate")
+        return {int(r): int(n) for r, n in zip(row_ids, counts)}
+
     def top_n(self, index: str, frame: str, view: str,
               slices: Sequence[int], num_slices: int, n: int,
               row_ids: Sequence[int], min_threshold: int,

@@ -6,12 +6,13 @@ values are idents (true/false/null), quoted strings, integers, floats, or
 what travels to remote nodes (reference executor.go:1000-1083).
 """
 
-from .ast import Call, Query
+from .ast import Call, Cond, Query
 from .parser import ParseError, Parser, parse_string, parse_string_cached
 from .scanner import Scanner, Token
 
 __all__ = [
     "Call",
+    "Cond",
     "Query",
     "ParseError",
     "Parser",

@@ -11,6 +11,31 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+# Comparison operators a Cond may carry, in canonical spelling.
+COND_OPS = (">", ">=", "<", "<=", "==", "!=", "><")
+
+
+@dataclass(frozen=True)
+class Cond:
+    """A value comparison attached to an argument key — the parse of
+    `field >= 10` inside Range(frame=f, field >= 10). `op` is one of
+    COND_OPS; `value` is an int (or a (low, high) tuple for `><`,
+    between, inclusive on both ends). Hashable so Call.cache_key and
+    the parse cache keep working."""
+
+    op: str
+    value: Any
+
+    def __post_init__(self):
+        if self.op not in COND_OPS:
+            raise ValueError(f"invalid condition operator {self.op!r}")
+        if isinstance(self.value, list):
+            object.__setattr__(self, "value", tuple(self.value))
+
+    def __str__(self) -> str:
+        return f"{self.op} {_fmt_value(self.value)}"
+
+
 def _fmt_value(v: Any) -> str:
     if isinstance(v, str):
         return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
@@ -74,6 +99,8 @@ class Call:
         type-blind key would let one serve the other from a cache."""
         if isinstance(v, (list, tuple)):
             return tuple(Call._typed(x) for x in v)
+        if isinstance(v, Cond):
+            return ("Cond", v.op, Call._typed(v.value))
         return (type(v).__name__, v)
 
     def _cache_key_uncached(self):
@@ -136,9 +163,27 @@ class Call:
             return False
         return (not row_ok) and col_ok
 
+    def cond_arg(self):
+        """The (key, Cond) pair if exactly one argument carries a value
+        comparison, else (None, None). More than one comparison in a
+        single call is a query error surfaced at execution time."""
+        found = [(k, v) for k, v in self.args.items()
+                 if isinstance(v, Cond)]
+        if len(found) == 1:
+            return found[0]
+        if len(found) > 1:
+            raise ValueError(
+                f"{self.name}() supports one field comparison, "
+                f"got {len(found)}")
+        return None, None
+
     def __str__(self) -> str:
         parts = [str(c) for c in self.children]
-        parts += [f"{k}={_fmt_value(self.args[k])}" for k in self.keys()]
+        # Cond-valued args serialize as `key >= 10`, everything else as
+        # `key=value` — both re-parse on remote nodes.
+        parts += [f"{k} {self.args[k]}" if isinstance(self.args[k], Cond)
+                  else f"{k}={_fmt_value(self.args[k])}"
+                  for k in self.keys()]
         return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
 
 
@@ -147,11 +192,12 @@ class Query:
     calls: list = field(default_factory=list)
 
     def write_call_n(self) -> int:
-        """Number of write calls (SetBit/ClearBit/Set*Attrs)."""
+        """Number of write calls (SetBit/ClearBit/SetValue/Set*Attrs)."""
         return sum(
             1
             for c in self.calls
-            if c.name in ("SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs")
+            if c.name in ("SetBit", "ClearBit", "SetValue",
+                          "SetRowAttrs", "SetColumnAttrs")
         )
 
     def __str__(self) -> str:

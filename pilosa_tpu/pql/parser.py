@@ -10,8 +10,20 @@ import threading
 from collections import OrderedDict
 from typing import Optional
 
-from .ast import Call, Query
+from .ast import Call, Cond, Query
 from .scanner import Pos, Scanner, Token
+
+# Comparison tokens accepted between an argument key and its value,
+# mapped to their canonical Cond.op spelling.
+_COND_TOKENS = {
+    Token.GT: ">",
+    Token.GTE: ">=",
+    Token.LT: "<",
+    Token.LTE: "<=",
+    Token.EQEQ: "==",
+    Token.NEQ: "!=",
+    Token.BETWEEN: "><",
+}
 
 
 class ParseError(Exception):
@@ -105,10 +117,25 @@ class Parser:
             key = lit
 
             tok, pos, lit = self._next()
-            if tok is not Token.EQ:
+            if tok in _COND_TOKENS:
+                op = _COND_TOKENS[tok]
+                value = self._parse_value()
+                if op == "><":
+                    if (not isinstance(value, list) or len(value) != 2
+                            or any(isinstance(x, bool)
+                                   or not isinstance(x, int)
+                                   for x in value)):
+                        raise ParseError(
+                            "between (><) requires [low, high] integers",
+                            pos)
+                elif isinstance(value, bool) or not isinstance(value, int):
+                    raise ParseError(
+                        f"comparison {op} requires an integer value", pos)
+                value = Cond(op, value)
+            elif tok is Token.EQ:
+                value = self._parse_value()
+            else:
                 raise ParseError(f"expected equals sign, found {lit!r}", pos)
-
-            value = self._parse_value()
             if key in args:
                 raise ParseError(f"argument key already used: {key}", pos)
             args[key] = value

@@ -28,6 +28,14 @@ class Token(enum.Enum):
     RPAREN = ")"
     LBRACK = "["
     RBRACK = "]"
+    # BSI field comparisons (Range(frame=f, field >= 10) etc).
+    GT = ">"
+    GTE = ">="
+    LT = "<"
+    LTE = "<="
+    EQEQ = "=="
+    NEQ = "!="
+    BETWEEN = "><"
 
 
 class Pos(NamedTuple):
@@ -97,6 +105,29 @@ class Scanner:
         if ch in "\"'":
             return self._scan_string(pos)
         self._read()
+        # Two-character comparison operators first: '=' / '>' / '<' / '!'
+        # all fuse with a following '=' (and '>' with '<' for between).
+        if ch == "=" and self._peek() == "=":
+            self._read()
+            return Token.EQEQ, pos, "=="
+        if ch == ">":
+            if self._peek() == "=":
+                self._read()
+                return Token.GTE, pos, ">="
+            if self._peek() == "<":
+                self._read()
+                return Token.BETWEEN, pos, "><"
+            return Token.GT, pos, ">"
+        if ch == "<":
+            if self._peek() == "=":
+                self._read()
+                return Token.LTE, pos, "<="
+            return Token.LT, pos, "<"
+        if ch == "!":
+            if self._peek() == "=":
+                self._read()
+                return Token.NEQ, pos, "!="
+            return Token.ILLEGAL, pos, ch
         single = {
             "=": Token.EQ,
             ",": Token.COMMA,

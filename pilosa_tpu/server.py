@@ -17,6 +17,7 @@ daemons:
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -349,6 +350,7 @@ class Server:
     def open(self, port: Optional[int] = None):
         """Open holder + listener + daemons (server.go:89-154)."""
         self.holder.open()
+        self._apply_config_schema()
         bind_host, _, bind_port = self.host.partition(":")
         if port is None:
             port = int(bind_port or 10101)
@@ -600,18 +602,60 @@ class Server:
             idx = self.holder.index(msg.index)
             if idx is None:
                 raise ValueError(f"local index not found: {msg.index}")
-            idx.create_frame_if_not_exists(
+            f = idx.create_frame_if_not_exists(
                 msg.frame, row_label=msg.meta.row_label or "rowID",
                 inverse_enabled=msg.meta.inverse_enabled,
                 cache_type=msg.meta.cache_type or "ranked",
                 cache_size=msg.meta.cache_size or 50000,
                 time_quantum=msg.meta.time_quantum)
+            self._merge_fields(f, msg.meta.fields_json)
         elif isinstance(msg, pb.DeleteFrameMessage):
             idx = self.holder.index(msg.index)
             if idx is not None:
                 idx.delete_frame(msg.frame)
         else:
             raise ValueError(f"unknown message: {type(msg).__name__}")
+
+    @staticmethod
+    def _merge_fields(frame, fields_json: str):
+        """Converge a frame's integer-field definitions from a peer's
+        broadcast/status meta. Idempotent: an existing identical field
+        is a no-op; a CONFLICTING redefinition logs and skips rather
+        than poisoning schema sync (the peers disagree — an operator
+        problem, not one anti-entropy should escalate)."""
+        if not fields_json:
+            return
+        from .bsi.field import FieldSchema, FieldValueError
+
+        for d in json.loads(fields_json):
+            try:
+                frame.create_field_if_not_exists(FieldSchema.from_dict(d))
+            except FieldValueError as e:
+                logging.getLogger("pilosa.server").warning(
+                    "field sync skipped for frame %r: %s", frame.name, e)
+
+    def _apply_config_schema(self):
+        """Declarative [[schema.indexes]] from the TOML config: create
+        the declared indexes/frames/BSI fields at open. Idempotent —
+        existing objects are kept and missing fields are added to
+        existing frames; definitions were already validated at config
+        load (config._parse_schema), so a conflicting redefinition of
+        an on-disk field is the only error left, and it raises: a node
+        must not serve a schema that contradicts its config."""
+        from .bsi.field import FieldSchema
+
+        for ix in self.config.schema_indexes:
+            opts = {}
+            if ix.get("column-label"):
+                opts["column_label"] = ix["column-label"]
+            idx = self.holder.create_index_if_not_exists(ix["name"], **opts)
+            for fr in ix.get("frames", []):
+                fopts = {}
+                if fr.get("row-label"):
+                    fopts["row_label"] = fr["row-label"]
+                f = idx.create_frame_if_not_exists(fr["name"], **fopts)
+                for fd in fr.get("fields", []):
+                    f.create_field_if_not_exists(FieldSchema.from_dict(fd))
 
     # -- StatusHandler (server.go:306-387) -----------------------------------
 
@@ -634,6 +678,10 @@ class Server:
                 fr.meta.cache_type = f.cache_type
                 fr.meta.cache_size = f.cache_size
                 fr.meta.time_quantum = str(f.time_quantum)
+                if f.fields:
+                    fr.meta.fields_json = json.dumps(
+                        [s.to_dict()
+                         for _, s in sorted(f.fields.items())])
         return ns
 
     def cluster_status(self) -> pb.ClusterStatus:
@@ -663,9 +711,10 @@ class Server:
             idx.set_remote_max_slice(ii.max_slice)
             idx.set_remote_max_inverse_slice(ii.max_inverse_slice)
             for fr in ii.frames:
-                idx.create_frame_if_not_exists(
+                f = idx.create_frame_if_not_exists(
                     fr.name, row_label=fr.meta.row_label or "rowID",
                     inverse_enabled=fr.meta.inverse_enabled,
                     cache_type=fr.meta.cache_type or "ranked",
                     cache_size=fr.meta.cache_size or 50000,
                     time_quantum=fr.meta.time_quantum)
+                self._merge_fields(f, fr.meta.fields_json)

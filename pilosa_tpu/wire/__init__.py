@@ -99,6 +99,11 @@ def result_to_proto(result) -> pb.QueryResult:
     elif isinstance(result, list):
         qr.kind = pb.QueryResult.PAIRS
         qr.pairs.extend(pb.Pair(key=int(k), count=int(n)) for k, n in result)
+    elif isinstance(result, dict) and "value" in result:
+        # BSI aggregate (Sum/Min/Max): {"value": v, "count": n}.
+        qr.kind = pb.QueryResult.VALCOUNT
+        qr.value = int(result["value"])
+        qr.val_count = int(result.get("count", 0))
     elif result is None:
         qr.kind = pb.QueryResult.NONE
     else:
@@ -120,6 +125,8 @@ def result_from_proto(qr: pb.QueryResult):
         return [(int(p.key), int(p.count)) for p in qr.pairs]
     if qr.kind == pb.QueryResult.CHANGED:
         return bool(qr.changed)
+    if qr.kind == pb.QueryResult.VALCOUNT:
+        return {"value": int(qr.value), "count": int(qr.val_count)}
     if qr.kind == pb.QueryResult.NONE:
         return None
     return int(qr.n)

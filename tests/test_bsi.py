@@ -1,0 +1,613 @@
+"""BSI integer fields (ISSUE 15): schema, O'Neil plane ladders, host
+roaring folds, device kernels, and the executor surface — every layer
+checked differentially against a brute-force python oracle over a
+seeded value matrix that includes negatives, zero, plane-boundary
+values (2^k ± 1), sparse existence, and multiple slices.
+
+The subprocess test at the bottom kill -9s a real server mid
+SetValue-stream and asserts WAL replay restores every acknowledged
+value (slow, excluded from tier-1).
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.bsi import (
+    MAX_BIT_DEPTH,
+    ROW_EXISTS,
+    ROW_PLANE0,
+    ROW_SIGN,
+    FieldNotFoundError,
+    FieldSchema,
+    FieldValueError,
+    cond_tree,
+    is_bsi_view,
+    view_name,
+)
+from pilosa_tpu.bsi import host as bsi_host
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import SHADOW_STATS, Executor
+from pilosa_tpu.ops import bsi as ops_bsi
+from pilosa_tpu.pql import parse_string
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "crash_child.py")
+
+ALL_OPS = (">", ">=", "<", "<=", "==", "!=")
+
+
+# -- oracles ------------------------------------------------------------------
+
+
+def brute_cond(vals: dict, op: str, c) -> set:
+    """Columns whose value satisfies the comparison — the brute-force
+    twin of the plane ladders."""
+    if op == "><":
+        lo, hi = c
+        return {k for k, v in vals.items() if lo <= v <= hi}
+    import operator
+
+    f = {">": operator.gt, ">=": operator.ge, "<": operator.lt,
+         "<=": operator.le, "==": operator.eq, "!=": operator.ne}[op]
+    return {k for k, v in vals.items() if f(v, c)}
+
+
+def boundary_values(schema: FieldSchema) -> list:
+    """Plane-boundary magnitudes (2^k ± 1, 2^k) both signs, plus the
+    declared extremes and zero."""
+    out = [0, schema.min, schema.max]
+    for k in range(schema.bit_depth):
+        for mag in (2 ** k - 1, 2 ** k, 2 ** k + 1):
+            for v in (mag, -mag):
+                if schema.min <= v <= schema.max:
+                    out.append(v)
+    return out
+
+
+def seeded_values(schema: FieldSchema, n_slices: int, per_slice: int,
+                  seed: int = 5) -> dict:
+    """{column: value} over `n_slices` slices: sparse random existence,
+    boundary values first, random in-range values after."""
+    rng = random.Random(seed)
+    bnd = boundary_values(schema)
+    vals = {}
+    for s in range(n_slices):
+        cols = sorted(rng.sample(range(SLICE_WIDTH), per_slice))
+        for i, c in enumerate(cols):
+            v = bnd[i] if i < len(bnd) else rng.randint(schema.min,
+                                                        schema.max)
+            vals[s * SLICE_WIDTH + c] = v
+    return vals
+
+
+def build_holder(tmp, schema: FieldSchema, vals: dict,
+                 frame: str = "f") -> Holder:
+    h = Holder(str(tmp))
+    h.open()
+    idx = h.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists(frame)
+    f.create_field_if_not_exists(schema)
+    for col, v in vals.items():
+        f.set_value(schema.name, col, v)
+    return h
+
+
+# -- schema -------------------------------------------------------------------
+
+
+class TestFieldSchema:
+    def test_bit_depth_from_range(self):
+        assert FieldSchema("v", 0, 100).bit_depth == 7
+        assert FieldSchema("v", -100, 50).bit_depth == 7
+        assert FieldSchema("v", 0, 0).bit_depth == 1
+        assert FieldSchema("v").bit_depth == 32  # int32 default span
+
+    def test_view_naming(self):
+        s = FieldSchema("val", 0, 10)
+        assert s.view == "bsi.val" == view_name("val")
+        assert is_bsi_view(s.view) and not is_bsi_view("standard")
+
+    def test_bad_definitions_raise(self):
+        with pytest.raises(FieldValueError):
+            FieldSchema("", 0, 1)
+        with pytest.raises(FieldValueError):
+            FieldSchema("v", 10, 5)
+        with pytest.raises(FieldValueError):
+            FieldSchema("v", 0, 1 << (MAX_BIT_DEPTH + 1))
+        with pytest.raises(FieldValueError):
+            FieldSchema("v", True, 5)
+
+    def test_validate_range(self):
+        s = FieldSchema("v", -10, 10)
+        assert s.validate(-10) == -10 and s.validate(10) == 10
+        for bad in (11, -11, 1.5, "3", True, None):
+            with pytest.raises(FieldValueError):
+                s.validate(bad)
+
+    def test_encode_covers_every_row(self):
+        s = FieldSchema("v", -100, 100)
+        for v in (-100, -1, 0, 1, 7, 64, 100):
+            set_rows, clear_rows = s.encode(v)
+            assert sorted(set_rows + clear_rows) == list(range(s.row_count))
+            assert ROW_EXISTS in set_rows
+            assert (ROW_SIGN in set_rows) == (v < 0)
+            mag = abs(v)
+            for k in range(s.bit_depth):
+                in_set = (ROW_PLANE0 + k) in set_rows
+                assert in_set == bool((mag >> k) & 1)
+
+    def test_dict_round_trip(self):
+        s = FieldSchema("v", -5, 250)
+        d = s.to_dict()
+        assert d["bitDepth"] == s.bit_depth
+        assert FieldSchema.from_dict(d) == s
+
+
+# -- PQL surface --------------------------------------------------------------
+
+
+class TestPQL:
+    def test_parse_round_trip(self):
+        for pql in ('SetValue(frame="f", columnID=3, val=-7)',
+                    'Sum(frame="f", field="val")',
+                    'Min(frame="f", field="val")',
+                    'Max(Bitmap(frame="f", rowID=1), frame="f", '
+                    'field="val")'):
+            q = parse_string(pql)
+            q2 = parse_string(str(q))
+            assert [c.cache_key() for c in q2.calls] == \
+                [c.cache_key() for c in q.calls]
+
+    def test_parse_conds(self):
+        for op in ALL_OPS:
+            q = parse_string(f'Range(frame="f", val {op} -12)')
+            (_, cond), = [(k, v) for k, v in q.calls[0].args.items()
+                          if k == "val"]
+            assert cond.op == op and cond.value == -12
+            assert parse_string(str(q)).calls[0].cache_key() == \
+                q.calls[0].cache_key()
+
+    def test_parse_between(self):
+        q = parse_string('Range(frame="f", val >< [2, 9])')
+        cond = q.calls[0].args["val"]
+        assert cond.op == "><" and cond.value == (2, 9)
+        assert parse_string(str(q)).calls[0].cache_key() == \
+            q.calls[0].cache_key()
+
+
+# -- plane ladders vs brute force --------------------------------------------
+
+
+class TestLadders:
+    """Every comparison op, every threshold around every stored value:
+    cond_tree folded over a real fragment must match the brute force."""
+
+    def test_differential_small_domain(self, tmp_path):
+        schema = FieldSchema("val", -20, 20)
+        vals = {c: v for c, v in enumerate(range(-20, 21))}
+        h = build_holder(tmp_path, schema, vals)
+        try:
+            frag = h.fragment("i", "f", schema.view, 0)
+            for c in range(-23, 24):
+                for op in ALL_OPS:
+                    got = set(bsi_host.range_row(
+                        frag, schema, op, c).columns())
+                    assert got == brute_cond(vals, op, c), (op, c)
+            for lo, hi in ((-25, 25), (-3, 3), (0, 0), (5, -5),
+                           (-21, -19), (19, 23)):
+                got = set(bsi_host.range_row(
+                    frag, schema, "><", (lo, hi)).columns())
+                assert got == brute_cond(vals, "><", (lo, hi)), (lo, hi)
+        finally:
+            h.close()
+
+    def test_differential_boundaries(self, tmp_path):
+        schema = FieldSchema("val", -300, 300)
+        vals = {i: v for i, v in enumerate(boundary_values(schema))}
+        h = build_holder(tmp_path, schema, vals)
+        try:
+            frag = h.fragment("i", "f", schema.view, 0)
+            thresholds = sorted({t for v in set(vals.values())
+                                 for t in (v - 1, v, v + 1)})
+            for c in thresholds:
+                for op in ALL_OPS:
+                    got = set(bsi_host.range_row(
+                        frag, schema, op, c).columns())
+                    assert got == brute_cond(vals, op, c), (op, c)
+        finally:
+            h.close()
+
+
+# -- host folds ---------------------------------------------------------------
+
+
+class TestHostFolds:
+    def test_sum_min_max_multi_slice(self, tmp_path):
+        schema = FieldSchema("val", -5000, 5000)
+        vals = seeded_values(schema, n_slices=3, per_slice=80)
+        h = build_holder(tmp_path, schema, vals)
+        try:
+            parts_max, parts_min = [], []
+            total = count = 0
+            for s in range(3):
+                frag = h.fragment("i", "f", schema.view, s)
+                sv, cv = bsi_host.sum_slice(frag, schema)
+                total += sv
+                count += cv
+                parts_max.append(bsi_host.max_slice(frag, schema))
+                parts_min.append(bsi_host.min_slice(frag, schema))
+            assert total == sum(vals.values())
+            assert count == len(vals)
+            mx = bsi_host.reduce_extremes(parts_max, maximize=True)
+            mn = bsi_host.reduce_extremes(parts_min, maximize=False)
+            want_mx, want_mn = max(vals.values()), min(vals.values())
+            assert mx == (want_mx,
+                          sum(1 for v in vals.values() if v == want_mx))
+            assert mn == (want_mn,
+                          sum(1 for v in vals.values() if v == want_mn))
+        finally:
+            h.close()
+
+    def test_empty_and_missing_fragment(self):
+        schema = FieldSchema("val", -10, 10)
+        assert bsi_host.sum_slice(None, schema) == (0, 0)
+        assert bsi_host.max_slice(None, schema) is None
+        assert bsi_host.min_slice(None, schema) is None
+        assert bsi_host.reduce_extremes([None, None], True) is None
+
+
+# -- device kernels: XLA vs Pallas-interpret vs numpy oracle ------------------
+
+
+class TestKernelDifferential:
+    """ops.bsi over dense packed blocks: the fused XLA path and the
+    Pallas/CSA path must both match exact integer math."""
+
+    N_WORDS = 2048  # one container: 65536 columns
+
+    def _dense(self, schema, vals):
+        cols, vv = zip(*sorted(vals.items()))
+        return ops_bsi.dense_rows_from_values(cols, vv, schema,
+                                              self.N_WORDS)
+
+    def _vals(self, schema, n=200, seed=9):
+        rng = random.Random(seed)
+        bnd = boundary_values(schema)
+        cols = sorted(rng.sample(range(self.N_WORDS * 32), n))
+        return {c: (bnd[i] if i < len(bnd)
+                    else rng.randint(schema.min, schema.max))
+                for i, c in enumerate(cols)}
+
+    @pytest.mark.parametrize("backend,interpret",
+                             [("xla", False), ("pallas", True)])
+    def test_sum_dense(self, backend, interpret):
+        schema = FieldSchema("val", -(2 ** 12), 2 ** 12)
+        vals = self._vals(schema)
+        planes = self._dense(schema, vals)
+        got = ops_bsi.sum_dense(planes, schema, backend=backend,
+                                interpret=interpret)
+        assert got == (sum(vals.values()), len(vals))
+
+    @pytest.mark.parametrize("backend,interpret",
+                             [("xla", False), ("pallas", True)])
+    def test_sum_dense_filtered(self, backend, interpret):
+        schema = FieldSchema("val", -999, 999)
+        vals = self._vals(schema)
+        planes = self._dense(schema, vals)
+        src = np.zeros(self.N_WORDS, dtype=np.uint32)
+        keep = {c for i, c in enumerate(sorted(vals)) if i % 3 == 0}
+        for c in keep:
+            src[c // 32] |= np.uint32(1 << (c % 32))
+        got = ops_bsi.sum_dense(planes, schema, src=src,
+                                backend=backend, interpret=interpret)
+        assert got == (sum(vals[c] for c in keep), len(keep))
+
+    @pytest.mark.parametrize("backend,interpret",
+                             [("xla", False), ("pallas", True)])
+    @pytest.mark.parametrize("maximize", [True, False])
+    def test_extremum_dense(self, backend, interpret, maximize):
+        schema = FieldSchema("val", -(2 ** 10), 2 ** 10)
+        for seed, sign in ((9, 0), (10, -1), (11, 1)):
+            vals = self._vals(schema, n=60, seed=seed)
+            if sign:  # single-signed populations exercise both branches
+                vals = {c: sign * abs(v) for c, v in vals.items()}
+            planes = self._dense(schema, vals)
+            got = ops_bsi.extremum_dense(planes, schema, maximize,
+                                         backend=backend,
+                                         interpret=interpret)
+            want_v = max(vals.values()) if maximize else min(vals.values())
+            want_n = sum(1 for v in vals.values() if v == want_v)
+            assert got == (want_v, want_n), (seed, sign, maximize)
+
+    @pytest.mark.parametrize("backend,interpret",
+                             [("xla", False), ("pallas", True)])
+    def test_extremum_dense_empty(self, backend, interpret):
+        schema = FieldSchema("val", -10, 10)
+        planes = np.zeros((schema.row_count, self.N_WORDS),
+                          dtype=np.uint32)
+        assert ops_bsi.extremum_dense(planes, schema, True,
+                                      backend=backend,
+                                      interpret=interpret) is None
+
+    @pytest.mark.parametrize("backend,interpret",
+                             [("xla", False), ("pallas", True)])
+    def test_tree_count_dense(self, backend, interpret):
+        schema = FieldSchema("val", -500, 500)
+        vals = self._vals(schema, n=150, seed=13)
+        planes = self._dense(schema, vals)
+        for op, c in ((">", 0), (">=", -17), ("<", 129), ("<=", -128),
+                      ("==", 0), ("!=", 5), ("><", (-100, 100))):
+            tree = cond_tree(schema, op, c)
+            got = ops_bsi.tree_count_dense(tree, planes, backend=backend,
+                                           interpret=interpret)
+            assert got == len(brute_cond(vals, op, c)), (op, c)
+
+
+# -- executor end to end ------------------------------------------------------
+
+
+def _q(ex, pql):
+    return ex.execute("i", parse_string(pql))[0]
+
+
+class TestExecutor:
+    """Host route and forced device mesh route (shadow-verified) must
+    both reproduce the python oracle over the seeded matrix."""
+
+    SCHEMA = FieldSchema("val", -4000, 4000)
+
+    @pytest.fixture()
+    def setup(self, tmp_path):
+        vals = seeded_values(self.SCHEMA, n_slices=2, per_slice=60)
+        h = build_holder(tmp_path, self.SCHEMA, vals)
+        host = Executor(h, use_device=False)
+        dev = Executor(h, use_device=True, device_min_work=0)
+        dev.shadow_sample = 1  # shadow-verify every device aggregate
+        try:
+            yield h, vals, host, dev
+        finally:
+            h.close()
+
+    def test_sum_min_max_both_routes(self, setup):
+        h, vals, host, dev = setup
+        mm0 = SHADOW_STATS.copy().get("mismatch:bsi", 0)
+        want_sum = {"value": sum(vals.values()), "count": len(vals)}
+        for ex in (host, dev):
+            assert _q(ex, 'Sum(frame="f", field="val")') == want_sum
+            for name, fn in (("Min", min), ("Max", max)):
+                want_v = fn(vals.values())
+                got = _q(ex, f'{name}(frame="f", field="val")')
+                assert got == {
+                    "value": want_v,
+                    "count": sum(1 for v in vals.values() if v == want_v)}
+        stats = SHADOW_STATS.copy()
+        assert stats.get("mismatch:bsi", 0) == mm0
+        assert stats.get("checks:bsi", 0) > 0
+        assert dev.route_stats.copy().get("count_bsi-mesh", 0) > 0
+
+    def test_range_all_ops_both_routes(self, setup):
+        h, vals, host, dev = setup
+        for op, c in ((">", 0), (">=", -1), ("<", 100), ("<=", 0),
+                      ("==", 0), ("!=", 0), ("><", (-64, 63))):
+            want = len(brute_cond(vals, op, c))
+            arg = f"[{c[0]}, {c[1]}]" if op == "><" else str(c)
+            pql = f'Count(Range(frame="f", val {op} {arg}))'
+            assert _q(host, pql) == want, (op, c)
+            assert _q(dev, pql) == want, (op, c)
+
+    def test_range_bits_match_oracle(self, setup):
+        h, vals, host, dev = setup
+        want = brute_cond(vals, ">=", 2048)  # top plane only
+        got = _q(host, 'Range(frame="f", val >= 2048)')
+        assert set(got.columns()) == want
+
+    def test_filtered_sum(self, setup):
+        h, vals, host, dev = setup
+        f = h.index("i").frame("f")
+        keep = {c for i, c in enumerate(sorted(vals)) if i % 2 == 0}
+        for c in keep:
+            f.set_bit(7, c)
+        pql = ('Sum(Bitmap(frame="f", rowID=7), '
+               'frame="f", field="val")')
+        want = {"value": sum(vals[c] for c in keep), "count": len(keep)}
+        assert _q(host, pql) == want
+        assert _q(dev, pql) == want
+
+    def test_set_value_overwrite(self, setup):
+        h, vals, host, dev = setup
+        col = sorted(vals)[0]
+        for new in (999, -999, 0):
+            assert _q(host, f'SetValue(frame="f", columnID={col}, '
+                            f'val={new})') is True  # value changed
+            want = sum(vals.values()) - vals[col] + new
+            assert _q(dev, 'Sum(frame="f", field="val")')["value"] == want
+
+    def test_empty_field_extremes_none(self, tmp_path):
+        h = build_holder(tmp_path, self.SCHEMA, {})
+        try:
+            for ex in (Executor(h, use_device=False),
+                       Executor(h, use_device=True, device_min_work=0)):
+                assert _q(ex, 'Min(frame="f", field="val")') is None
+                assert _q(ex, 'Max(frame="f", field="val")') is None
+                assert _q(ex, 'Sum(frame="f", field="val")') == \
+                    {"value": 0, "count": 0}
+        finally:
+            h.close()
+
+    def test_out_of_range_set_value_raises(self, setup):
+        h, vals, host, dev = setup
+        with pytest.raises(FieldValueError):
+            _q(host, 'SetValue(frame="f", columnID=1, val=4001)')
+
+    def test_unknown_field_raises(self, setup):
+        h, vals, host, dev = setup
+        with pytest.raises(FieldNotFoundError):
+            _q(host, 'Sum(frame="f", field="nope")')
+
+
+# -- declarative TOML schema --------------------------------------------------
+
+
+class TestTomlSchema:
+    TOML = '''
+    [[schema.indexes]]
+    name = "i"
+
+    [[schema.indexes.frames]]
+    name = "f"
+
+    [[schema.indexes.frames.fields]]
+    name = "val"
+    min = -50
+    max = 50
+    '''
+
+    def test_parse_and_round_trip(self):
+        from pilosa_tpu.config import Config
+
+        cfg = Config.from_toml(self.TOML, is_text=True)
+        fr = cfg.schema_indexes[0]["frames"][0]
+        assert fr["fields"][0]["min"] == -50
+        cfg2 = Config.from_toml(cfg.to_toml(), is_text=True)
+        assert cfg2.schema_indexes == cfg.schema_indexes
+
+    def test_bad_schema_fails_at_load(self):
+        from pilosa_tpu.config import Config
+
+        for bad in ("[[schema.indexes]]\nfoo = 1\n",
+                    self.TOML.replace("max = 50", "max = -60")):
+            with pytest.raises(ValueError):
+                Config.from_toml(bad, is_text=True)
+
+    def test_server_open_applies_schema(self, tmp_path):
+        from pilosa_tpu.config import Config
+        from pilosa_tpu.server import Server
+
+        cfg = Config.from_toml(
+            f'data-dir = "{tmp_path}"\nhost = "127.0.0.1:0"\n'
+            + self.TOML, is_text=True)
+        cfg.sched_enabled = False
+        s = Server(cfg)
+        s.open(port=0)
+        try:
+            f = s.holder.index("i").frame("f")
+            assert f.fields["val"] == FieldSchema("val", -50, 50)
+            st, _, body = s.handler.handle(
+                "POST", "/index/i/query", {}, {},
+                b'SetValue(frame=f, columnID=1, val=-3)')
+            assert st == 200, body
+            st, _, body = s.handler.handle(
+                "POST", "/index/i/query", {}, {},
+                b'SetValue(frame=f, columnID=2, val=99)')
+            assert st == 422, body
+        finally:
+            s.close()
+
+
+# -- WAL durability: kill -9 mid SetValue-stream (subprocess, slow) -----------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port, path, body=b"", timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode() or "{}")
+
+
+def _wait_ready(proc, port, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            _, err = proc.communicate(timeout=10)
+            raise AssertionError(
+                f"child died during boot: {err.decode()[-2000:]}")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/version", timeout=2).read()
+            return
+        except Exception:  # noqa: BLE001 — still booting
+            time.sleep(0.2)
+    raise AssertionError("child never became ready")
+
+
+@pytest.mark.slow
+class TestKillMinusNineSetValue:
+    def test_no_acked_value_lost(self, tmp_path):
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, CHILD, str(tmp_path), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        acked = {}
+        try:
+            _wait_ready(proc, port)
+            _post(port, "/index/i")
+            _post(port, "/index/i/frame/f", json.dumps({"options": {
+                "fields": [{"name": "val",
+                            "min": -100000, "max": 100000}]}}).encode())
+            # distinct per-column values so replay verification can pin
+            # each acked write exactly; SIGKILL arrives mid-stream
+            for col in range(120):
+                val = 1000 + 7 * col
+                st, out = _post(
+                    port, "/index/i/query",
+                    f"SetValue(frame=f, columnID={col}, "
+                    f"val={val})".encode())
+                if st == 200 and out.get("results") is not None:
+                    acked[col] = val
+                if len(acked) == 80:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            proc.wait(timeout=30)
+            assert len(acked) == 80
+            # restart on the SAME data dir: WAL replay must restore
+            # every acknowledged value, planes and all
+            port2 = _free_port()
+            proc2 = subprocess.Popen(
+                [sys.executable, CHILD, str(tmp_path), str(port2)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            try:
+                _wait_ready(proc2, port2)
+                st, out = _post(port2, "/index/i/query",
+                                b"Range(frame=f, val >= 1000)")
+                assert st == 200
+                have = set(out["results"][0]["bits"])
+                lost = [c for c in acked if c not in have]
+                assert not lost, f"acked SetValues lost: {lost}"
+                for col, val in sorted(acked.items())[::8]:
+                    st, out = _post(
+                        port2, "/index/i/query",
+                        f"Range(frame=f, val == {val})".encode())
+                    assert st == 200
+                    assert col in set(out["results"][0]["bits"]), \
+                        (col, val)
+                # the recovered field must accept new writes
+                st, _ = _post(port2, "/index/i/query",
+                              b"SetValue(frame=f, columnID=500, val=1)")
+                assert st == 200
+            finally:
+                proc2.kill()
+                proc2.communicate(timeout=30)
+        finally:
+            proc.kill()
+            proc.communicate(timeout=30)

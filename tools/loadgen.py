@@ -59,6 +59,15 @@ DEFAULT_MIX = "read=0.65,write=0.20,topn=0.15,range=0.0"
 RANGE_START = "2016-01-01T00:00"
 RANGE_END = "2026-01-01T00:00"
 
+# BSI analytics ops (bsi_sum / bsi_range in the mix) target one integer
+# field with a fixed declared range; prepare_index creates it and seeds
+# deterministic SetValues so aggregates have data to chew on.
+BSI_FIELD = "val"
+BSI_MIN = -1024
+BSI_MAX = 1024
+BSI_SEED_COLUMNS = 256
+_BSI_RANGE_OPS = (">=", ">", "<", "<=", "==")
+
 
 # -- deterministic schedule generation ------------------------------------
 
@@ -73,7 +82,8 @@ def parse_mix(text: str) -> List[tuple]:
             continue
         name, _, w = item.partition("=")
         name = name.strip()
-        if name not in ("read", "write", "topn", "range"):
+        if name not in ("read", "write", "topn", "range",
+                        "bsi_sum", "bsi_range"):
             raise ValueError(f"unknown op {name!r} in mix")
         total += float(w)
         ops.append((name, total))
@@ -144,6 +154,14 @@ def build_schedule(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
             pql = f"SetBit(rowID={row}, frame={frame}, columnID={col})"
         elif op == "topn":
             pql = f"TopN(frame={frame}, n=10)"
+        elif op == "bsi_sum":
+            pql = f'Sum(frame={frame}, field="{BSI_FIELD}")'
+        elif op == "bsi_range":
+            cmp_op = _BSI_RANGE_OPS[
+                rng.randrange(len(_BSI_RANGE_OPS))]
+            thresh = rng.randrange(BSI_MIN, BSI_MAX + 1)
+            pql = (f"Count(Range(frame={frame}, "
+                   f"{BSI_FIELD} {cmp_op} {thresh}))")
         else:
             pql = (f'Range(rowID={row}, frame={frame}, '
                    f'start="{RANGE_START}", end="{RANGE_END}")')
@@ -574,11 +592,22 @@ def _judge_write_churn(report: Dict[str, Any], servers, configs,
         f"-> {'OK' if ok else 'VIOLATED'}")
 
 
-def prepare_index(host: str, index: str, frame: str, log) -> None:
-    """Create index + frame over HTTP, tolerating 409 replays."""
+def prepare_index(host: str, index: str, frame: str, log,
+                  mix: str = "", columns: int = 1 << 16,
+                  seed: int = 1) -> None:
+    """Create index + frame over HTTP, tolerating 409 replays. When the
+    mix includes bsi ops, the frame is created with the integer field
+    and seeded with deterministic SetValues so Sum/Range aggregates run
+    against real data rather than empty planes."""
+    bsi = any(op.startswith("bsi_") for op, _ in parse_mix(mix)) \
+        if mix else False
+    frame_opts: Dict[str, Any] = {"timeQuantum": "YMD"}
+    if bsi:
+        frame_opts["fields"] = [
+            {"name": BSI_FIELD, "min": BSI_MIN, "max": BSI_MAX}]
     for path, body in ((f"/index/{index}", b"{}"),
                        (f"/index/{index}/frame/{frame}",
-                        b'{"options": {"timeQuantum": "YMD"}}')):
+                        json.dumps({"options": frame_opts}).encode())):
         req = urllib.request.Request("http://" + host + path, data=body,
                                      method="POST")
         try:
@@ -587,6 +616,24 @@ def prepare_index(host: str, index: str, frame: str, log) -> None:
             e.read()
             if e.code != 409:
                 log(f"setup {path}: HTTP {e.code}")
+    if not bsi:
+        return
+    # Seed values over a deterministic column subset (same seed, same
+    # data); chunked multi-call PQL bodies keep setup round-trips low.
+    rng = random.Random(seed)
+    n = min(BSI_SEED_COLUMNS, columns)
+    calls = [f"SetValue(frame={frame}, columnID={c}, "
+             f"{BSI_FIELD}={rng.randrange(BSI_MIN, BSI_MAX + 1)})"
+             for c in sorted(rng.sample(range(columns), n))]
+    for k in range(0, len(calls), 64):
+        req = urllib.request.Request(
+            "http://" + host + f"/index/{index}/query",
+            data="".join(calls[k:k + 64]).encode(), method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30).read()
+        except urllib.error.HTTPError as e:
+            e.read()
+            log(f"bsi seed: HTTP {e.code}")
 
 
 # -- CLI -------------------------------------------------------------------
@@ -731,7 +778,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 fault_rules.extend(_fault.load_spec(args.fault))
 
     try:
-        prepare_index(host, args.index, args.frame, log)
+        prepare_index(host, args.index, args.frame, log,
+                      mix=args.mix, columns=args.columns,
+                      seed=args.seed)
         mm0 = _mismatch_total(transport.get_text("/metrics"))
         n = len(build_schedule(spec))
         log(f"running {n} requests over ~{args.duration:.0f}s "
