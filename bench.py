@@ -2222,6 +2222,44 @@ def main():
             if node1 is not None:
                 node1.close()
 
+    with section("multichip_scaling"):
+        # Pod-scale execution headline (ISSUE 16): Intersect+Count and
+        # BSI-Sum collective QPS on the full mesh vs a mesh restricted
+        # to ONE device, same holder, with device-vs-host bit-exact
+        # asserts and the tier-ledger check (every collective records
+        # tier="ici", nothing leaks to tier="http"). Runs in a child
+        # process so the device topology (real accelerators, or 8
+        # forced CPU host devices) is picked fresh by the tool; the
+        # >=4x acceptance is enforced only where parallel capacity
+        # physically exists (see tools/multichip_bench.py).
+        _progress("multichip scaling: 8-device vs 1-device child run")
+        import subprocess
+
+        mc_out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "MULTICHIP_r06.json")
+        mc_env = (dict(os.environ) if on_tpu
+                  and len(jax.devices()) >= 8 else _cpu_reexec_env())
+        mc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "multichip_bench.py"),
+             "--out", mc_out],
+            env=mc_env, capture_output=True, text=True, timeout=900)
+        assert mc.returncode == 0, (mc.returncode, mc.stdout[-2000:],
+                                    mc.stderr[-2000:])
+        with open(mc_out) as mfp:
+            mc_report = json.load(mfp)
+        assert mc_report["ok"], mc_report["failures"]
+        details["multichip_scaling"] = {
+            "n_devices": mc_report["n_devices"],
+            "backend": mc_report["backend"],
+            "scaling": mc_report["scaling"],
+            "speedup": mc_report["speedup"],
+            "efficiency": mc_report["efficiency"],
+            "accept_4x": mc_report["accept_4x"],
+            "tiers": mc_report["tiers"],
+            "artifact": "MULTICHIP_r06.json"}
+
     with section("write_availability"):
         # Write-path replication resilience (ISSUE 13): acked-write
         # latency and shed rate through a replica kill + restart on a

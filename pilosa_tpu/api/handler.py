@@ -814,10 +814,27 @@ class Handler:
         if rs is not None:
             routes = prom.MetricFamily(
                 "pilosa_query_route_total", "counter",
-                "Count queries by serving backend.")
+                "Count queries by serving backend and locality tier "
+                "(local = this chip, ici = pod interconnect collective, "
+                "http = cross-node ring).")
+            ts = getattr(ex, "tier_stats", None)
+            tiers = dict(ts.copy()) if ts is not None else {}
+            by_route: dict = {}
+            for k, v in tiers.items():
+                route, _, tier = k.partition("|")
+                by_route.setdefault(route, {})[tier or "local"] = v
             for k, v in sorted(dict(rs.copy()).items()):
-                if k.startswith("count_"):
-                    routes.add(v, {"backend": k[len("count_"):]})
+                if not k.startswith("count_"):
+                    continue
+                backend = k[len("count_"):]
+                split = by_route.get(backend)
+                if split:
+                    for tier, tv in sorted(split.items()):
+                        routes.add(tv, {"backend": backend, "tier": tier})
+                else:
+                    # Backend counted before tier tracking (or seeded
+                    # directly in tests): everything was single-chip.
+                    routes.add(v, {"backend": backend, "tier": "local"})
             fams.append(routes)
         hists = getattr(ex, "route_latency_hists", None)
         if hists:

@@ -29,6 +29,11 @@ TOML schema:
                                 # instead of the ring-order primary
                                 # (keeps QPS flat across a resize when
                                 # replica sets overlap)
+    ici-hosts = []              # peers on THIS node's pod interconnect
+                                # whose data dirs are replicated here:
+                                # their slices fold into the local mesh
+                                # dispatch (tier="ici") instead of an
+                                # HTTP hop
     # -- write consistency + hinted handoff (README section) --
     write-consistency = "quorum"  # one | quorum | all: replica acks
                                 # (local apply included) required
@@ -325,6 +330,13 @@ class Config:
         # read-heavy single-coordinator deployments so a resize with
         # overlapping replica sets keeps QPS flat.
         self.prefer_local_reads: bool = False
+        # [cluster] ici-hosts: hosts whose accelerators share THIS
+        # node's pod interconnect and whose data dirs are replicated
+        # here (the SPMD deployment shape). The executor serves their
+        # ring-assigned slices from the local mesh dispatch — one psum
+        # over ICI instead of an HTTP leg (`tier="ici"` on
+        # pilosa_query_route_total). Empty = no ICI peers.
+        self.cluster_ici_hosts: List[str] = []
         # [cluster] write consistency + hinted handoff: replica acks
         # required before a write is acked (one|quorum|all), the
         # per-target hint log byte bound, and the drainer pacing.
@@ -468,6 +480,8 @@ class Config:
             c.breaker_cooldown = parse_duration(cl["breaker-cooldown"])
         c.prefer_local_reads = bool(cl.get("prefer-local-reads",
                                            c.prefer_local_reads))
+        c.cluster_ici_hosts = list(cl.get("ici-hosts",
+                                          c.cluster_ici_hosts))
         c.write_consistency = parse_write_consistency(
             cl.get("write-consistency", c.write_consistency))
         c.hint_max_bytes = int(cl.get("hint-max-bytes", c.hint_max_bytes))
@@ -663,6 +677,9 @@ class Config:
             f'breaker-cooldown = "{int(self.breaker_cooldown * 1000)}ms"\n'
             f"prefer-local-reads = "
             f"{'true' if self.prefer_local_reads else 'false'}\n"
+            f"ici-hosts = ["
+            + ", ".join(f'"{h}"' for h in self.cluster_ici_hosts)
+            + "]\n"
             f'write-consistency = "{self.write_consistency}"\n'
             f"hint-max-bytes = {self.hint_max_bytes}\n"
             f'hint-drain-interval = '

@@ -462,17 +462,35 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
     lines.append(f"uptime {up:.0f}s   queries {int(qtot)}   "
                  f"qps {qps:.1f}")
 
-    # Route panel (pilosa_query_route_total{backend}): per-backend QPS
-    # over the scrape interval, with the BSI aggregation path (bsi-mesh
-    # device / bsi-host fold) summed into one "aggregate qps" figure.
-    routes = [(dict(labels).get("backend", ""), v)
-              for (name, labels), v in sorted(cur.items())
-              if name == "pilosa_query_route_total"]
-    if routes:
+    # Route panel (pilosa_query_route_total{backend,tier}): per-backend
+    # QPS over the scrape interval, with the BSI aggregation path
+    # (bsi-mesh device / bsi-host fold) summed into one "aggregate qps"
+    # figure, plus the locality-tier split (local chip / pod ICI
+    # collective / cross-node HTTP).
+    by_backend: dict = {}
+    by_tier: dict = {}
+    for (name, labels), v in sorted(cur.items()):
+        if name != "pilosa_query_route_total":
+            continue
+        d = dict(labels)
+        b = d.get("backend", "")
+        by_backend[b] = by_backend.get(b, 0.0) + v
+        t = d.get("tier", "local")
+        by_tier[t] = by_tier.get(t, 0.0) + v
+    if by_backend:
+        def _route_prev(backend: str) -> float:
+            if not prev:
+                return 0.0
+            # Sum across tier series (and tolerate pre-tier scrapes
+            # whose series carry only the backend label).
+            return sum(v for (name, labels), v in prev.items()
+                       if name == "pilosa_query_route_total"
+                       and dict(labels).get("backend", "") == backend)
+
         def _route_rate(backend: str, v: float) -> float:
-            pv = prev.get(("pilosa_query_route_total",
-                           (("backend", backend),)), 0.0) if prev else 0.0
+            pv = _route_prev(backend)
             return (v - pv) / dt if prev and dt > 0 else 0.0
+        routes = sorted(by_backend.items())
         lines.append("routes: " + "  ".join(
             f"{b}={int(v)} ({_route_rate(b, v):.1f}/s)"
             for b, v in routes))
@@ -482,6 +500,10 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
                 f"aggregates: qps "
                 f"{sum(_route_rate(b, v) for b, v in agg):.1f}   "
                 + "  ".join(f"{b}={int(v)}" for b, v in agg))
+        if by_tier:
+            lines.append("tiers:  " + "  ".join(
+                f"{t}={int(by_tier.get(t, 0.0))}"
+                for t in ("local", "ici", "http") if t in by_tier))
 
     # Per-phase measured percentiles (pilosa_query_phase_us{phase,
     # backend}) — only present once something has been profiled.

@@ -112,6 +112,14 @@ class ExecOptions:
         # Slices this query could not serve (partial mode only); the
         # handler surfaces them as {partial: true, missing_slices}.
         self.missing_slices: List[int] = []
+        # Locality-tier footprints, set while the query executes:
+        # used_http when any slice group was actually submitted over
+        # the HTTP ring (_mapper's remote leg), used_ici when slices
+        # owned by a same-pod ICI peer were folded into the local mesh
+        # dispatch (_slices_by_node). _record_route derives the
+        # query's `tier` label (http > ici > local) from these.
+        self.used_http = False
+        self.used_ici = False
 
     def deadline_left(self) -> Optional[float]:
         """Remaining budget in seconds (negative when expired), or
@@ -176,7 +184,8 @@ class Executor:
                  use_device: Optional[bool] = None, max_workers: int = 8,
                  device_min_work: Optional[int] = None,
                  prefer_local_reads: bool = False,
-                 mesh_config: Optional[dict] = None):
+                 mesh_config: Optional[dict] = None,
+                 ici_hosts: Optional[Sequence[str]] = None):
         self.holder = holder
         # [mesh] knobs (config.Config.mesh_config()) handed to the
         # MeshManager on construction: HBM budget, headroom, plan
@@ -191,6 +200,14 @@ class Executor:
         # reference routes each slice to ring order, spreading load
         # across replicas, which is right when clients hit every node.
         self.prefer_local_reads = prefer_local_reads
+        # Same-pod ICI peers ([cluster] ici-hosts): hosts whose chips
+        # share this node's interconnect AND whose data dirs are
+        # replicated here (the SPMD deployment shape). Slices the ring
+        # assigns to an ICI peer are served from the LOCAL mesh — the
+        # collective already spans the pod's devices — so the query
+        # pays one psum over the fabric instead of an HTTP leg
+        # (_slices_by_node). The local host being listed is harmless.
+        self.ici_hosts = frozenset(ici_hosts or ())
         # Write-path replication (ISSUE 13): replica acks required
         # before a mutation acks ("one" | "quorum" | "all"), and the
         # hinted-handoff manager that journals missed replica ops.
@@ -243,6 +260,11 @@ class Executor:
         # host-fold / mesh / roaring) and the end-to-end latency per
         # engine — the backend-labeled latency histogram at /metrics.
         self.route_stats = obs.StatMap()
+        # Locality-tier split of the same routes, keyed "route|tier"
+        # (tier ∈ local|ici|http): which interconnect the query's
+        # slice fan-out actually crossed. Separate map so count_*
+        # consumers keep exact keys.
+        self.tier_stats = obs.StatMap()
         self._route_hists: dict = {}
         # [integrity] shadow-sample-1-in: every Nth device Count/TopN
         # result is recomputed through the host roaring fold and
@@ -685,7 +707,8 @@ class Executor:
                 # them, so the entry can never validate — stale results
                 # invalidate, they don't serve.
                 self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
-        self._record_route(route, t0)
+        self._record_route(route, t0,
+                           tier=self._query_tier(opt, route == "mesh"))
         return n
 
     # Above this fan-out, gathering (fragment, generation) pairs for
@@ -776,8 +799,12 @@ class Executor:
         Both shadow-verify sampled batches against the host roaring
         fold and serve the HOST value on mismatch.
 
-        Host path (fallback, cost-routed small queries, SPMD, remote
-        legs' per-slice work): exact roaring folds in bsi.host."""
+        SPMD deployments route the same collectives through the BSISUM
+        / COUNT descriptors (parallel/spmd.py) so every rank enters
+        them together — the pod-scale form of the same plan.
+
+        Host path (fallback, cost-routed small queries, remote legs'
+        per-slice work): exact roaring folds in bsi.host."""
         frame, _f, schema = self._bsi_call_schema(index, c)
         if len(c.children) > 1:
             raise QueryError(
@@ -789,7 +816,7 @@ class Executor:
         # whole aggregate to the host path (its per-slice evaluation
         # needs host state anyway).
         filter_lowered = None
-        device_ok = self._device_backend_on() and self._spmd is None
+        device_ok = self._device_backend_on()
         if device_ok and child is not None:
             from .parallel.plan import _lower_tree
 
@@ -853,7 +880,8 @@ class Executor:
 
         out = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
                                batch_fn=batch_fn)
-        self._record_route("bsi-mesh" if device_ok else "bsi-host", t0)
+        self._record_route("bsi-mesh" if device_ok else "bsi-host", t0,
+                           tier=self._query_tier(opt, device_ok))
         if c.name == "Sum":
             s, n = out if out is not None else (0, 0)
             return {"value": int(s), "count": int(n)}
@@ -864,21 +892,29 @@ class Executor:
     def _bsi_sum_batch(self, index: str, frame: str, schema,
                        filter_lowered):
         """batch_fn computing (sum, count) for a slice batch from the
-        fused per-row-count collectives, or None when no manager."""
+        fused per-row-count collectives, or None when no manager. With
+        the SPMD plane wired, the collectives ride BSISUM descriptors
+        (every rank must enter the psum together); the host-side 2^k
+        weighting below is identical either way."""
         mgr = self.mesh_manager()
         if mgr is None:
             return None
-        from .bsi.field import ROW_EXISTS, ROW_PLANE0, ROW_SIGN
-        from .ops.bsi import sum_from_counts
+        from .bsi.field import ROW_SIGN
+        from .ops.bsi import sum_from_plane_dicts
 
         view = schema.view
+
+        def plane_counts(batch_slices, num, src):
+            if self._spmd is not None:
+                return self._spmd.bsi_sum(index, frame, view,
+                                          batch_slices, num, src=src)
+            return mgr.bsi_plane_counts(index, frame, view,
+                                        batch_slices, num, src=src)
 
         def batch_fn(batch_slices):
             num = self._batch_num_slices(index, batch_slices)
             try:
-                counts = mgr.bsi_plane_counts(
-                    index, frame, view, batch_slices, num,
-                    src=filter_lowered)
+                counts = plane_counts(batch_slices, num, filter_lowered)
                 if counts is None:
                     return None
                 neg: dict = {}
@@ -891,18 +927,13 @@ class Executor:
                         fshape, fleaves = filter_lowered
                         sshape = ["and", fshape, ["leaf"]]
                         sleaves = list(fleaves) + sleaves
-                    neg = mgr.bsi_plane_counts(
-                        index, frame, view, batch_slices, num,
-                        src=(sshape, sleaves))
+                    neg = plane_counts(batch_slices, num,
+                                       (sshape, sleaves))
                     if neg is None:
                         return None
             except Exception:  # noqa: BLE001 — device failure → host
                 return None
-            d = schema.bit_depth
-            total = sum_from_counts(
-                [counts.get(ROW_PLANE0 + k, 0) for k in range(d)],
-                [neg.get(ROW_PLANE0 + k, 0) for k in range(d)])
-            return total, counts.get(ROW_EXISTS, 0)
+            return sum_from_plane_dicts(counts, neg, schema.bit_depth)
 
         return batch_fn
 
@@ -931,8 +962,13 @@ class Executor:
                     shape = ["and", shape, fshape]
                     leaves = leaves + list(fleaves)
                 try:
-                    n = mgr.count(index, shape, leaves, batch_slices,
-                                  num)
+                    # SPMD: each probe is one COUNT descriptor so all
+                    # ranks enter the collective together.
+                    n = (self._spmd.count(index, shape, leaves,
+                                          batch_slices, num)
+                         if self._spmd is not None else
+                         mgr.count(index, shape, leaves, batch_slices,
+                                   num))
                 except Exception:  # noqa: BLE001 — device → host
                     return None
                 return None if n is None else int(n)
@@ -1048,8 +1084,41 @@ class Executor:
         """Routed-host-path cache counters for /debug/vars."""
         return self._host_cache.stats
 
-    def _record_route(self, route: str, t0: float):
+    def _query_tier(self, opt: Optional["ExecOptions"],
+                    collective: bool) -> str:
+        """Locality tier a served query actually paid, worst-first:
+        `http` when any slice group went over the HTTP ring, `ici`
+        when a multi-device collective ran (slices reduced over the
+        interconnect — including ICI-peer slices folded into the local
+        dispatch), else `local` (one chip, or pure host fold)."""
+        if opt is not None and opt.used_http:
+            return "http"
+        if opt is not None and opt.used_ici:
+            return "ici"
+        if collective and self._multi_device():
+            return "ici"
+        return "local"
+
+    def _multi_device(self) -> bool:
+        """True when the serving mesh spans more than one device (its
+        reductions cross the interconnect)."""
+        if self._spmd is not None:
+            return True
+        mgr = self._mesh_mgr
+        try:
+            return bool(mgr is not None
+                        and mgr.mesh.devices.size > 1)
+        except Exception:  # noqa: BLE001 — no mesh constructed
+            return False
+
+    def _record_route(self, route: str, t0: float,
+                      tier: Optional[str] = None):
         self.route_stats.inc(f"count_{route}")
+        # Tier split rides a parallel StatMap (route|tier) so the
+        # legacy count_* keys — bench dumps, tests, dashboards — keep
+        # their meaning; /metrics joins both into
+        # pilosa_query_route_total{backend, tier}.
+        self.tier_stats.inc(f"{route}|{tier or 'local'}")
         h = self._route_hists.get(route)
         if h is None:
             # setdefault: two first-observers race benignly to one.
@@ -1273,7 +1342,7 @@ class Executor:
             return info
         backend_on = self._device_backend_on()
         route_reason = None
-        if backend_on and self._spmd is None:
+        if backend_on:
             route_reason = self._would_route_to_host(
                 len(slices), schema.row_count, index=index)
             route = "bsi-host" if route_reason else "bsi-mesh"
@@ -1419,14 +1488,23 @@ class Executor:
                            slices: Sequence[int]) -> dict:
         """slice→owner picks as _slices_by_node would make them —
         breaker/liveness-aware — plus each host's current breaker
-        state. Slice lists are sampled (first 16) so a 960-slice
-        explain stays readable."""
+        state, the locality tier of each pick (same-chip → same-pod-
+        ICI → cross-node-HTTP), and the per-device group sizes one
+        local mesh dispatch would shard the local+ici slices into.
+        Slice lists are sampled (first 16) so a 960-slice explain
+        stays readable."""
+        from .parallel.cluster import owner_tier
+
         if self.cluster is None or not self.cluster.nodes:
-            return {"mode": "local", "slices": len(slices)}
+            out = {"mode": "local", "slices": len(slices),
+                   "tier": "ici" if self._multi_device() else "local"}
+            self._explain_device_groups(out, slices, len(slices))
+            return out
         state = self._breaker_callable()
         nodes = list(self.cluster.nodes)
         per_host: dict = {}
         unowned: list = []
+        tiers = {"local": 0, "ici": 0, "http": 0}
         for slice_ in slices:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
                       if o in nodes]
@@ -1435,13 +1513,23 @@ class Executor:
                 continue
             pick = preferred_owner(
                 owners, state,
-                prefer=self.host if self.prefer_local_reads else None)
+                prefer=self.host if self.prefer_local_reads else None,
+                ici_hosts=self.ici_hosts or None)
+            tier = owner_tier(pick.host, self.host, self.ici_hosts)
+            tiers[tier] += 1
             ent = per_host.setdefault(pick.host,
-                                      {"slices": 0, "sample": []})
+                                      {"slices": 0, "sample": [],
+                                       "tier": tier})
             ent["slices"] += 1
             if len(ent["sample"]) < 16:
                 ent["sample"].append(slice_)
-        out = {"mode": "cluster", "nodes": per_host}
+        out = {"mode": "cluster", "nodes": per_host, "tiers": tiers,
+               "tier": ("http" if tiers["http"]
+                        else "ici" if tiers["ici"] or (
+                            tiers["local"] and self._multi_device())
+                        else "local")}
+        self._explain_device_groups(out, slices,
+                                    tiers["local"] + tiers["ici"])
         if unowned:
             out["unowned_count"] = len(unowned)
             out["unowned_sample"] = unowned[:16]
@@ -1450,6 +1538,29 @@ class Executor:
         if callable(snap):
             out["breakers"] = snap()
         return out
+
+    def _explain_device_groups(self, out: dict, slices, eligible) -> None:
+        """Attach the per-device slice-group sizes one local mesh
+        dispatch would shard the locally-served (local + ici tier)
+        slices into. Peek only: the resident manager's mesh when one
+        exists, else the process device count — never forces manager
+        construction."""
+        if not eligible or not slices or not self._device_backend_on():
+            return
+        try:
+            if self._mesh_mgr is not None:
+                n_dev = int(self._mesh_mgr.mesh.devices.size)
+            else:
+                import jax
+
+                n_dev = len(jax.devices())
+            from .parallel.plan import device_slice_groups
+
+            out["device_groups"] = device_slice_groups(
+                slices, max(slices) + 1, n_dev)
+            out["devices"] = n_dev
+        except Exception:  # noqa: BLE001 — explain never raises for this
+            pass
 
     def _batch_num_slices(self, index: str, batch_slices) -> int:
         idx = self.holder.index(index)
@@ -2289,7 +2400,20 @@ class Executor:
     def _slices_by_node(self, nodes, index: str, slices: Sequence[int],
                         opt: Optional[ExecOptions] = None):
         """node -> slices owned, restricted to `nodes`
-        (executor.go:1087-1101)."""
+        (executor.go:1087-1101).
+
+        Locality hierarchy (same-chip → same-pod-ICI → cross-node
+        HTTP): a slice whose picked owner is a configured ICI peer
+        (`[cluster] ici-hosts`) is folded into the LOCAL node's group —
+        its shard is already addressable through this node's mesh, and
+        the collective reduces over the interconnect — so only slices
+        owned by hosts OUTSIDE the pod pay the HTTP ring."""
+        local_node = (self.cluster.node_by_host(self.host)
+                      if self.ici_hosts else None)
+        if local_node is not None and local_node not in nodes:
+            # e.g. a re-split that excluded this node: don't route an
+            # ICI peer's slices back into the excluded local group.
+            local_node = None
         m = {}
         for slice_ in slices:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
@@ -2314,7 +2438,15 @@ class Executor:
             # executor.go:1140-1151).
             pick = preferred_owner(
                 owners, self._breaker_callable(),
-                prefer=self.host if self.prefer_local_reads else None)
+                prefer=self.host if self.prefer_local_reads else None,
+                ici_hosts=self.ici_hosts or None)
+            if (local_node is not None and pick.host != self.host
+                    and pick.host in self.ici_hosts):
+                # ICI-tier slice: serve it from the local mesh dispatch
+                # (one psum over the pod fabric beats an HTTP leg).
+                if opt is not None:
+                    opt.used_ici = True
+                pick = local_node
             m.setdefault(pick, []).append(slice_)
         return m
 
@@ -2371,6 +2503,9 @@ class Executor:
                     obs.wrap_ctx(self._mapper_local), node_slices,
                     map_fn, reduce_fn, batch_fn, opt.deadline)
             elif not opt.remote:
+                # This group actually pays a cross-node HTTP leg — the
+                # query's tier is `http` no matter what else served.
+                opt.used_http = True
                 fut = self._pool.submit(
                     obs.wrap_ctx(self._exec_remote_one), node, index, c,
                     node_slices, opt)

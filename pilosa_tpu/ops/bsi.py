@@ -130,6 +130,21 @@ def sum_from_counts(all_counts: Sequence[int],
     return total
 
 
+def sum_from_plane_dicts(counts: dict, neg: dict,
+                         bit_depth: int) -> Tuple[int, int]:
+    """-> (sum, count) from the {row_id: count} dicts a per-plane-row
+    collective returns (MeshManager.bsi_plane_counts on one host, the
+    SPMD BSISUM descriptor at pod scale): `counts` over the full
+    filter, `neg` over the filter restricted to the sign row. Absent
+    rows count zero — a plane no column ever set simply never entered
+    the row table. The ONE epilogue both serving paths share, so the
+    2^k weighting and sign handling cannot drift between them."""
+    total = sum_from_counts(
+        [counts.get(ROW_PLANE0 + k, 0) for k in range(bit_depth)],
+        [neg.get(ROW_PLANE0 + k, 0) for k in range(bit_depth)])
+    return total, counts.get(ROW_EXISTS, 0)
+
+
 def sum_dense(planes, schema: FieldSchema, src=None, *,
               backend: str = "xla",
               interpret: bool = False) -> Tuple[int, int]:

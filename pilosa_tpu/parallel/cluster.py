@@ -329,8 +329,25 @@ class Cluster:
                           for n in self.nodes]}
 
 
+def owner_tier(host: str, local_host: str,
+               ici_hosts=None) -> str:
+    """Locality tier of serving a slice owned by `host` from the node
+    at `local_host`: `local` (same chip / same process), `ici` (a
+    same-pod peer — its shard is one psum over the interconnect away),
+    or `http` (cross-node RPC is the only road). The executor's
+    placement (`_slices_by_node`) and `?explain=true` both classify
+    through this one function so the route metric's `tier` label and
+    the explain output can never disagree."""
+    if host == local_host:
+        return "local"
+    if ici_hosts and host in ici_hosts:
+        return "ici"
+    return "http"
+
+
 def preferred_owner(owners: List[Node], breaker_state=None,
-                    prefer: Optional[str] = None) -> Node:
+                    prefer: Optional[str] = None,
+                    ici_hosts=None) -> Node:
     """Routing preference among a slice's replica owners: ACTIVE nodes
     whose circuit breaker is closed, then any ACTIVE node, then LEAVING
     nodes (still serving until cutover), then anyone — liveness,
@@ -341,12 +358,19 @@ def preferred_owner(owners: List[Node], breaker_state=None,
     tier, `prefer` (the coordinating node's own host) breaks the tie —
     a locally-held replica serves locally instead of paying an HTTP
     hop, which is what keeps query QPS flat across a resize when the
-    replica sets overlap."""
+    replica sets overlap. `ici_hosts` is the second rung of the same
+    ladder: when no locally-held replica wins, a same-pod-ICI owner
+    beats a cross-pod one (the executor folds its slices into the
+    local mesh dispatch instead of an HTTP leg)."""
 
     def pick(cands: List[Node]) -> Node:
         if prefer is not None:
             for o in cands:
                 if o.host == prefer:
+                    return o
+        if ici_hosts:
+            for o in cands:
+                if o.host in ici_hosts:
                     return o
         return cands[0]
 

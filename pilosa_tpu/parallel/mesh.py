@@ -61,6 +61,23 @@ from .plan import _tree_signature, eval_tree
 SLICE_AXIS = "slices"
 
 
+def slice_device(slice_: int, num_slices: int, n_devices: int) -> int:
+    """Which mesh device serves a slice under the P(SLICE_AXIS)
+    sharding every staged pool uses: the slice axis pads to a multiple
+    of the device count (build_sharded_index / build_sparse_sharded)
+    and NamedSharding splits it into contiguous chunks — a CONSISTENT
+    placement across every view of an index at a given slice count.
+    Because a slice holds every row of its view — all BSI magnitude
+    planes, the existence row, the sign row — any per-row/ per-plane
+    combination is device-local by construction; only count partials
+    ever cross the interconnect (psum). Placement moves ONLY when the
+    padded slice count changes (index growth past a pad boundary or a
+    mesh resize), which forces a restage anyway."""
+    n_dev = max(1, int(n_devices))
+    s_pad = -(-max(1, int(num_slices)) // n_dev) * n_dev
+    return int(slice_) // (s_pad // n_dev)
+
+
 class ShardedIndex(NamedTuple):
     """One frame/view's fragments, stacked and mesh-sharded."""
 

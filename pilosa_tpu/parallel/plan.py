@@ -54,6 +54,27 @@ def _tree_signature(node) -> object:
     return walk(node)
 
 
+def device_slice_groups(slices, num_slices: int, n_devices: int):
+    """Per-device slice-group sizes under the mesh's contiguous
+    slice-axis sharding (build_sharded_index pads the slice axis to a
+    multiple of the device count and NamedSharding(P(SLICE_AXIS))
+    splits it into contiguous chunks). Device d therefore serves
+    slices [d*chunk, (d+1)*chunk) — and since a slice carries EVERY
+    row of its view (all BSI planes, the existence row, the sign row),
+    any per-row combination stays device-local; only the final count
+    partials cross the interconnect (psum). Returns a list of group
+    sizes for the queried `slices`, devices with no queried slice
+    omitted — the `?explain=true` device-group view of one mesh
+    dispatch."""
+    from .mesh import slice_device
+
+    groups: Dict[int, int] = {}
+    for s in slices:
+        d = slice_device(s, num_slices, n_devices)
+        groups[d] = groups.get(d, 0) + 1
+    return [groups[d] for d in sorted(groups)]
+
+
 def format_signature(sig: str, formats) -> str:
     """Tag a plan signature with the device container format(s) the
     launch serves from ("ss"/"sd"/"ds"/"dd" per slice group, or any

@@ -28,6 +28,7 @@ Counts are returned as Python ints combined from (lo, hi) int32 limbs
 
 from __future__ import annotations
 
+import contextlib
 import json
 import queue
 import threading
@@ -101,6 +102,14 @@ def _num_env(name: str, default, cast=int):
         return default
 
 
+class DispatchGenMoved(Exception):
+    """Raised inside the launch gate when a view's dispatch generation
+    moved between resolve and launch — another dispatch (batch thread,
+    racing querier, post-eviction restage) launched against the same
+    staged image first. Pure control flow: the caller falls back to a
+    coalescing path; never a plan failure, never a strike."""
+
+
 class StagedView:
     """One (index, frame, view)'s staged device image + bookkeeping."""
 
@@ -108,7 +117,8 @@ class StagedView:
                  "num_slices", "idx_cache", "host_idx_cache", "last_used",
                  "last_stage_s", "inc_spend_s", "inc_ewma_s", "inc_count",
                  "validated_epoch", "pins", "sparse", "sparse_keys_host",
-                 "sparse_cards_host", "slice_formats", "sparse_idx_cache")
+                 "sparse_cards_host", "slice_formats", "sparse_idx_cache",
+                 "dispatch_gen")
 
     def __init__(self, sharded, row_ids, keys_host, slice_gens, num_slices,
                  sparse=None, sparse_keys_host=None, sparse_cards_host=None,
@@ -177,6 +187,15 @@ class StagedView:
         # for its whole unlocked execution window (the use-epoch stamp
         # below only protects the resolution currently holding _mu).
         self.pins = 0
+        # Per-view dispatch generation: bumped (under the launch gate)
+        # every time a device execution launches against this image.
+        # The lone fused path captures the generations of its resolved
+        # views and re-validates them at launch: if another dispatch
+        # (a racing querier's batch, an eviction-churn restage's first
+        # query) moved them in between, the lone launch aborts to the
+        # coalescing batch path instead of stacking a second concurrent
+        # multi-device execution.
+        self.dispatch_gen = 0
         # MUTATION_EPOCH.read() pair captured BEFORE the last staleness
         # walk that found (or made) this view current. refresh()'s O(1)
         # fast path: while the process-wide pair hasn't moved, no
@@ -289,11 +308,15 @@ class _CountRequest:
     every leaf of every request in a group is eligible."""
 
     __slots__ = ("args", "coarse_t", "leaf_keys", "done", "result",
-                 "error")
+                 "error", "views")
 
     def __init__(self, sig, words_t, idx_t, hit_t, coarse_t, dev_mask):
         self.args = (sig, words_t, idx_t, hit_t, dev_mask)
         self.coarse_t = coarse_t
+        # StagedViews this request resolved against — stamped with a
+        # dispatch generation when the group launches (see
+        # _launch_gate), so lone-path snapshots observe batch launches.
+        self.views = ()
         # Logical (frame, view, row_id) per leaf, set by count() — the
         # shared-batch planner canonicalizes on THIS (stable across
         # restages/evictions, unlike array ids).
@@ -474,6 +497,16 @@ class MeshManager:
         # (a multi-second compile must not stall staging), and nothing
         # under _compile_mu ever takes _mu — no ordering cycle.
         self._compile_mu = threading.Lock()
+        # Device-launch gate (see _launch_gate): serializes program
+        # launches on a >1-device CPU mesh — where XLA executes every
+        # per-device program inline on the CALLING threads, so two
+        # concurrent multi-device launches can cross-pair their
+        # per-device programs into a collective-rendezvous spin — and
+        # stamps each launched view's dispatch_gen. Real accelerators
+        # queue launches on the device stream, so the lock is skipped
+        # there (resolved lazily; None = not yet probed).
+        self._dispatch_mu = threading.Lock()
+        self._serialize_dispatch: Optional[bool] = None
         # Completed-result memo for TopN-family limb vectors — the
         # device analog of the reference's rank cache (cache.go:126-275,
         # VERDICT r2 #4): a repeat TopN on an unchanged image re-enters
@@ -2067,8 +2100,53 @@ class MeshManager:
                 self._plan_failures.pop(sig, None)
         return self._fused_plans.clear_quarantine(sig)
 
+    def _dispatch_serialized(self) -> bool:
+        """True when device program launches must serialize through
+        _dispatch_mu: on a >1-device CPU mesh (forced host platform
+        device count — CI, the MULTICHIP dryrun) XLA executes the
+        per-device programs of a collective inline on the calling
+        threads, and two concurrent multi-device launches can
+        interleave their per-device programs into a cross-paired
+        collective rendezvous that spins forever. Real accelerators
+        queue launches on the device stream, so they skip the lock."""
+        v = self._serialize_dispatch
+        if v is None:
+            try:
+                import jax
+
+                v = bool(self.mesh.devices.size > 1
+                         and jax.default_backend() == "cpu")
+            except Exception:  # noqa: BLE001 — no mesh: nothing launches
+                v = False
+            self._serialize_dispatch = v
+        return v
+
+    @contextlib.contextmanager
+    def _launch_gate(self, views=(), expect_gens=None):
+        """The per-view dispatch-generation gate every device launch
+        passes through. Under the gate (serialized on CPU multi-device
+        meshes, see _dispatch_serialized): first re-validate
+        `expect_gens` — (view, generation) pairs captured at resolve
+        time — raising DispatchGenMoved when any view has been
+        launched against since (the caller falls back to a coalescing
+        path instead of stacking a second in-flight execution); then
+        stamp every participating view's dispatch_gen."""
+        lock = self._dispatch_mu if self._dispatch_serialized() else None
+        if lock is not None:
+            lock.acquire()
+        try:
+            if expect_gens is not None and any(
+                    sv.dispatch_gen != gen for sv, gen in expect_gens):
+                raise DispatchGenMoved()
+            for sv in views:
+                sv.dispatch_gen += 1
+            yield
+        finally:
+            if lock is not None:
+                lock.release()
+
     def _guarded_exec(self, sig: str, launch, kind: str = "count",
-                      note: bool = True):
+                      note: bool = True, views=(), expect_gens=None):
         """Run one device program launch through the recovery ladder:
 
           quarantined sig  -> DeviceResourceError("quarantined") now,
@@ -2083,11 +2161,18 @@ class MeshManager:
         stat bumps for launches whose failure another path will retry
         and re-count (e.g. _lone_count falling through to the chained
         path) — otherwise one transient fault would double-strike
-        straight into quarantine and double-count the fallback."""
+        straight into quarantine and double-count the fallback.
+
+        `views` / `expect_gens` thread through to _launch_gate: views
+        get their dispatch generation stamped per launch; expect_gens
+        aborts the launch (DispatchGenMoved, propagated without a
+        strike — it is not a plan failure) when another dispatch beat
+        this one to those views."""
 
         def attempt():
             fault.point("device.exec", sig=sig, kind=kind)
-            return launch()
+            with self._launch_gate(views, expect_gens):
+                return launch()
 
         if self.plan_quarantined(sig):
             if note:
@@ -2096,6 +2181,8 @@ class MeshManager:
                 f"plan quarantined: {sig[:80]}", reason="quarantined")
         try:
             return attempt()
+        except DispatchGenMoved:
+            raise  # control flow, not a plan failure: no strike
         except Exception as e:  # noqa: BLE001 — classify then rethrow
             if not _is_resource_exhausted(e):
                 if note:
@@ -2265,6 +2352,11 @@ class MeshManager:
                 uniq[key] = r
         group = list(uniq.values())
         self.stats.inc("deduped", len(dups))
+        # Union of staged views this group launches against — each
+        # launch below stamps their dispatch generations under the
+        # launch gate.
+        gviews = tuple({id(sv): sv for r in group
+                        for sv in r.views}.values())
 
         def _propagate():
             for r, key in dups:
@@ -2296,7 +2388,7 @@ class MeshManager:
                                              uniform=True)
                         return fn(words_t, du, dev_mask)
 
-                    limbs = self._guarded_exec(sig, launch)
+                    limbs = self._guarded_exec(sig, launch, views=gviews)
                     self.stats.inc("coarse_uniform")
                 else:
                     def launch():
@@ -2304,14 +2396,14 @@ class MeshManager:
                         return fn(words_t, tuple(c[0] for c in ct),
                                   tuple(c[1] for c in ct), dev_mask)
 
-                    limbs = self._guarded_exec(sig, launch)
+                    limbs = self._guarded_exec(sig, launch, views=gviews)
                 self.stats.inc("coarse")
             else:
                 def launch():
                     fn = self._count_fn(sig, len(idx_t))
                     return fn(words_t, idx_t, hit_t, dev_mask)
 
-                limbs = self._guarded_exec(sig, launch)
+                limbs = self._guarded_exec(sig, launch, views=gviews)
         else:
             sig, words_t, _, _, dev_mask = group[0].args
             num_leaves = len(group[0].args[2])
@@ -2360,7 +2452,7 @@ class MeshManager:
                                 tuple(u[1] for u in uniques),
                                 tuple(u[2] for u in uniques), dev_mask)
 
-                    limbs = self._guarded_exec(sig, launch)
+                    limbs = self._guarded_exec(sig, launch, views=gviews)
                     # shared output columns follow the CANONICAL group
                     # order; distribute results in that order (exact
                     # width, no padding)
@@ -2377,7 +2469,7 @@ class MeshManager:
                                                  uniform=True)
                             return fn(words_t, du, dev_mask)
 
-                        limbs = self._guarded_exec(sig, launch)
+                        limbs = self._guarded_exec(sig, launch, views=gviews)
                         self.stats.inc("coarse_uniform", b)
                     else:
                         start_flat = tuple(
@@ -2392,7 +2484,7 @@ class MeshManager:
                             return fn(words_t, start_flat, valid_flat,
                                       dev_mask)
 
-                        limbs = self._guarded_exec(sig, launch)
+                        limbs = self._guarded_exec(sig, launch, views=gviews)
                 self.stats.inc("coarse", b)
             else:
                 idx_flat = tuple(r.args[2][i] for r in padded
@@ -2410,7 +2502,7 @@ class MeshManager:
                     with jax_scope("pilosa:count_batch"):
                         return fn(words_t, idx_flat, hit_flat, dev_mask)
 
-                limbs = self._guarded_exec(sig, launch)
+                limbs = self._guarded_exec(sig, launch, views=gviews)
             self.stats.inc("batched", b)
 
         # Every branch above launched exactly ONE compiled program.
@@ -2562,6 +2654,7 @@ class MeshManager:
                 return None
             req = _CountRequest(*prepared)
             req.leaf_keys = tuple((f, v, int(r)) for f, v, r, _ in leaves)
+            req.views = tuple(pins)
             self._ensure_batch_thread()
             self._batch_q.put(req)
             prof = profile.current()
@@ -2627,6 +2720,14 @@ class MeshManager:
                 mask = self._mask_for(first, slices)
                 if mask is None:
                     return None
+            # Dispatch-generation snapshot of the resolved views: if
+            # any other launch lands on them between here and the
+            # launch gate (a racing querier's batch on the batch
+            # thread — the PR-13 CPU-mesh rendezvous hazard), the gate
+            # raises DispatchGenMoved and this query falls through to
+            # the coalescing chained path instead of stacking a second
+            # concurrent multi-device execution.
+            gens = tuple((sv, sv.dispatch_gen) for sv in pins)
             sig = json.dumps(_tree_signature(shape))
             key = CompiledPlanCache.key(sig, words_t)
             fn = self._fused_plans.get_or_build(
@@ -2641,7 +2742,8 @@ class MeshManager:
                     with jax_scope("pilosa:count_fused"):
                         return fn(words_t, idx_all, hit_all, mask)
 
-                limbs = self._guarded_exec(sig, launch, note=False)
+                limbs = self._guarded_exec(sig, launch, note=False,
+                                           views=pins, expect_gens=gens)
             else:
                 # Profiled: bracket the dispatch with block_until_ready
                 # so device_exec is the kernel's wall time and
@@ -2655,7 +2757,9 @@ class MeshManager:
                         return out_l
 
                 with prof.phase("device_exec"):
-                    limbs = self._guarded_exec(sig, launch, note=False)
+                    limbs = self._guarded_exec(sig, launch, note=False,
+                                               views=pins,
+                                               expect_gens=gens)
                 # Each leaf gathers ROW_SPAN containers per slice.
                 prof.add_bytes("bytes_touched_hbm",
                                len(leaves) * len(slices)
@@ -3135,12 +3239,18 @@ class MeshManager:
             # Pseudo-signature per padded width: row_counts has no
             # lowered tree, but the quarantine/recovery ladder still
             # wants a stable identity for the program family.
-            def launch():
-                return self._single_flight(
-                    key, lambda: fn(sharded, dev_mask))
+            # Single-flight wraps the guarded launch, never the
+            # reverse: the launch gate can hold the CPU-mesh dispatch
+            # lock for the whole execution, and an identical
+            # concurrent caller must join the leader at the in-flight
+            # table instead of queueing on that lock for a duplicate
+            # run.
+            def compute():
+                return self._guarded_exec(
+                    f"__row_counts__:{padded}",
+                    lambda: fn(sharded, dev_mask), kind="row_counts")
 
-            out = self._guarded_exec(f"__row_counts__:{padded}", launch,
-                                     kind="row_counts")
+            out = self._single_flight(key, compute)
             self._memo_put(key, out, (sharded.words, dev_mask), epoch)
             return out
 
@@ -3344,12 +3454,16 @@ class MeshManager:
                tuple(id(w) for w in words_t), tuple(id(a) for a in idx_t))
         out = self._memo_get(key)
         if out is None:
-            def launch():
-                return self._single_flight(
-                    key, lambda: fn(sharded.keys, sharded.words,
-                                    words_t, idx_t, hit_t, dev_mask))
+            # Single-flight outside the guarded launch (see
+            # _row_counts_call): waiters must not queue on the
+            # CPU-mesh dispatch lock behind the leader.
+            def compute():
+                return self._guarded_exec(
+                    sig, lambda: fn(sharded.keys, sharded.words,
+                                    words_t, idx_t, hit_t, dev_mask),
+                    kind=kind)
 
-            out = self._guarded_exec(sig, launch, kind=kind)
+            out = self._single_flight(key, compute)
             self._memo_put(key, out,
                            (sharded.words, dev_mask) + tuple(words_t)
                            + tuple(idx_t), epoch)
@@ -3389,6 +3503,27 @@ class MeshManager:
         self.stats.inc("topn")
         self.stats.inc("query_us", int((time.monotonic() - t0) * 1e6))
         return row_ids, counts
+
+    def staged_format_blob(self, index: str, frames_views) -> bytes:
+        """Deterministic bytes describing the PER-SHARD sparse/dense
+        format picks of the given (frame, view) pairs — one
+        slice_formats byte vector per view, sorted, `|`-joined, with a
+        distinct marker for a not-staged view. The SPMD descriptor
+        plane folds this into its program-agreement fingerprint: the
+        per-device-shard format pick (PR 14) is a per-rank staging
+        decision, and two ranks that picked different layouts for the
+        same shard must skip the collective together rather than enter
+        it with mismatched programs."""
+        parts = []
+        with self._mu:
+            for frame, view in sorted(frames_views):
+                sv = self._views.get((index, frame, view))
+                if sv is None:
+                    parts.append(b"\xff")  # not staged here (yet)
+                else:
+                    parts.append(np.ascontiguousarray(
+                        sv.slice_formats).tobytes())
+        return b"|".join(parts)
 
     def bsi_plane_counts(self, index: str, frame: str, view: str,
                          slices: Sequence[int], num_slices: int,

@@ -20,6 +20,12 @@ the global mesh.
 Descriptor ops:
     COUNT      Count over a lowered bitmap-op tree (psum collective)
     ROWCOUNTS  per-row totals for TopN (psum collective)
+    BSISUM     per-plane-row popcount partials for BSI Sum/Min/Max —
+               the weighted-popcount halves are reduced with the same
+               psum collectives as ROWCOUNTS/RCSRC (plane rows and
+               their existence/sign rows live in ONE view, so slice
+               sharding keeps them co-located per device) and the
+               2^k weighting folds on the host
     WRITE      SetBit/ClearBit — every rank applies to ITS holder; the
                staged device image then folds the bits in as an
                incremental scatter at the next query's refresh (a
@@ -73,6 +79,7 @@ _OP_SCHEMA = 5
 _OP_PQL = 6
 _OP_IMPORT = 7
 _OP_RCSRC = 8  # src / tanimoto row-count collectives (kind field)
+_OP_BSISUM = 9  # BSI plane-row count partials (psum collective)
 
 
 def _encode(obj: dict) -> np.ndarray:
@@ -279,6 +286,34 @@ class SpmdServer:
             self._broadcast(desc)
             return self._run(desc)
 
+    def bsi_sum(self, index: str, frame: str, view: str,
+                slices: Sequence[int], num_slices: int, src=None):
+        """Broadcast + execute one BSISUM collective: per-plane-row
+        popcount partials psum-reduced over the global mesh — the
+        device half of a sharded BSI Sum/Min/Max (executor folds the
+        2^k plane weights and the sign split on the host, exactly as
+        the single-host path does via bsi_plane_counts). With `src` a
+        lowered (shape, leaves) filter tree, counts are restricted to
+        the filter — the RCSRC program. Returns {row_id: count} or
+        None. Rank 0 only."""
+        assert self.rank == 0
+        desc = {
+            "op": _OP_BSISUM,
+            "index": index,
+            "frame": frame,
+            "view": view,
+            "slices": list(map(int, slices)),
+            "num_slices": int(num_slices),
+        }
+        if src is not None:
+            src_shape, src_leaves = src
+            desc["kind"] = "rcs"
+            desc["shape"] = src_shape
+            desc["leaves"] = [list(leaf) for leaf in src_leaves]
+        with self._mu:
+            self._broadcast(desc)
+            return self._run(desc)
+
     def write(self, index: str, frame: str, row_id: int, col_id: int,
               timestamp: Optional[str], clear: bool) -> bool:
         """Broadcast one bit mutation; EVERY rank (this one included)
@@ -427,6 +462,8 @@ class SpmdServer:
             return self._execute_rowcounts(desc)
         if op == _OP_RCSRC:
             return self._execute_rcsrc(desc)
+        if op == _OP_BSISUM:
+            return self._execute_bsisum(desc)
         if op == _OP_WRITE:
             return self._execute_write(desc)
         if op == _OP_SCHEMA:
@@ -475,7 +512,13 @@ class SpmdServer:
         Resolution can fail — or succeed with a DIFFERENT program — on
         one rank alone (replicated data dirs momentarily out of sync: a
         lagging replica stages a different pool capacity), hence the
-        fingerprint gate."""
+        fingerprint gate. The fingerprint also covers the PER-SHARD
+        sparse/dense format picks of every touched view
+        (staged_format_blob): two ranks whose stagers disagreed on a
+        shard's layout must skip together rather than enter a
+        collective with mismatched programs."""
+        import zlib
+
         from .mesh import combine_count
 
         leaves = [tuple(leaf) for leaf in desc["leaves"]]
@@ -506,7 +549,10 @@ class SpmdServer:
                     compiled = fn.lower(words_t, idx_t, hit_t,
                                         mask).compile()
                     self._compiled[ckey] = compiled
-                blob = json.dumps(["count", sig, list(shapes)]).encode()
+                fmt = self.manager.staged_format_blob(
+                    desc["index"], {(lf[0], lf[1]) for lf in leaves})
+                blob = json.dumps(["count", sig, list(shapes),
+                                   int(zlib.crc32(fmt))]).encode()
         except Exception:  # noqa: BLE001 — counted as not-ready below
             compiled = None
         if not self._gate(blob if compiled is not None else None):
@@ -549,9 +595,12 @@ class SpmdServer:
                             self.manager.mesh, padded))
                     compiled = fn.lower(sharded, dev_mask).compile()
                     self._compiled[ckey] = compiled
+                fmt = self.manager.staged_format_blob(
+                    desc["index"], {(desc["frame"], desc["view"])})
                 blob = json.dumps(
                     ["rc", padded, list(sharded.words.shape),
-                     int(zlib.crc32(np.ascontiguousarray(row_ids)))]
+                     int(zlib.crc32(np.ascontiguousarray(row_ids))),
+                     int(zlib.crc32(fmt))]
                 ).encode()
         except Exception:  # noqa: BLE001 — not-ready below
             compiled = None
@@ -622,9 +671,12 @@ class SpmdServer:
                                         words_t, idx_t, hit_t,
                                         dev_mask).compile()
                     self._compiled[ckey] = compiled
+                fmt = self.manager.staged_format_blob(
+                    desc["index"], {(desc["frame"], desc["view"])})
                 blob = json.dumps(
                     [kind, sig, padded, repr(shapes),
-                     int(zlib.crc32(np.ascontiguousarray(sv.row_ids)))]
+                     int(zlib.crc32(np.ascontiguousarray(sv.row_ids))),
+                     int(zlib.crc32(fmt))]
                 ).encode()
         except Exception:  # noqa: BLE001 — counted as not-ready below
             compiled = None
@@ -634,6 +686,34 @@ class SpmdServer:
                                     idx_t, hit_t, dev_mask))
         self.manager.stats["topn"] += 1
         return sv.row_ids, padded, limbs
+
+    def _execute_bsisum(self, desc: dict):
+        """BSISUM: the per-plane-row count partials a sharded BSI
+        aggregate needs, as a {row_id: count} dict (the
+        MeshManager.bsi_plane_counts contract). The collective halves
+        ARE the ROWCOUNTS / RCSRC programs — a BSI view's plane,
+        existence and sign rows are ordinary rows of one staged view,
+        so the same psum-of-popcounts serves them and the gate
+        fingerprints (shapes + row table + per-shard formats) carry
+        over unchanged."""
+        if "shape" in desc:
+            out = self._execute_rcsrc(desc)
+            if out is None:
+                return None
+            row_ids, _padded, limbs = out
+            if limbs is None:
+                counts = np.zeros(0, dtype=np.int64)
+            else:
+                from .serve import combine_limbs
+
+                counts = combine_limbs(limbs, len(row_ids))
+        else:
+            out = self._execute_rowcounts(desc)
+            if out is None:
+                return None
+            row_ids, counts = out
+        self.manager.stats.inc("bsi_aggregate")
+        return {int(r): int(c) for r, c in zip(row_ids, counts)}
 
     def _execute_write(self, desc: dict) -> bool:
         """WRITE: apply the bit to THIS rank's holder (host-side; the

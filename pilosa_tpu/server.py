@@ -216,6 +216,7 @@ class Server:
             self.holder, host=self.host, cluster=self.cluster,
             client=self.client, use_device=use_device,
             prefer_local_reads=self.config.prefer_local_reads,
+            ici_hosts=self.config.cluster_ici_hosts,
             mesh_config=self.config.mesh_config())
         if self.spmd is not None:
             def _apply_query(index, query):

@@ -1,0 +1,388 @@
+"""Pod-scale locality tiers (ISSUE 16): the same-chip → same-pod-ICI →
+cross-node-HTTP hierarchy end to end — owner classification
+(cluster.owner_tier / preferred_owner's ICI rung), the executor folding
+ICI peers' slices into the local mesh dispatch with zero HTTP legs, the
+slice→device placement helpers behind one mesh dispatch, the `tier`
+label on pilosa_query_route_total (handler join of route_stats ×
+tier_stats), `?explain=true` tier/device-group output, the pilosa-tpu
+top tier split, the [cluster] ici-hosts config knob, and the
+MeshManager launch gate (per-view dispatch generations) that makes
+concurrent SPMD dispatch safe under eviction churn.
+"""
+
+import re
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.parallel import Cluster, ModHasher, Node
+from pilosa_tpu.parallel.cluster import owner_tier, preferred_owner
+from pilosa_tpu.pql import parse_string
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def seed(holder, index="i", frame="general", bits=()):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    for row, col in bits:
+        f.set_bit(row, col)
+    return f
+
+
+def two_node_cluster(replica_n=1):
+    return Cluster(nodes=[Node("host0"), Node("host1")],
+                   hasher=ModHasher(), partition_n=4,
+                   replica_n=replica_n)
+
+
+# -- owner classification -----------------------------------------------------
+
+
+class TestOwnerTier:
+    def test_ladder(self):
+        assert owner_tier("h0", "h0") == "local"
+        assert owner_tier("h0", "h0", {"h1"}) == "local"  # local wins
+        assert owner_tier("h1", "h0", {"h1"}) == "ici"
+        assert owner_tier("h2", "h0", {"h1"}) == "http"
+        assert owner_tier("h1", "h0") == "http"  # no pod peers
+        assert owner_tier("h1", "h0", frozenset()) == "http"
+
+    def test_preferred_owner_ici_rung(self):
+        a, b, c = Node("hA"), Node("hB"), Node("hC")
+        # No locality info: ring order wins.
+        assert preferred_owner([a, b, c]) is a
+        # An ICI peer beats a cross-pod owner...
+        assert preferred_owner([a, b, c], ici_hosts={"hB"}) is b
+        # ...but a locally-held replica (prefer) still beats the peer.
+        assert preferred_owner([a, b, c], prefer="hC",
+                               ici_hosts={"hB"}) is c
+        # The rung only reorders WITHIN the health tier: a DOWN ICI
+        # peer never outranks an UP cross-pod owner.
+        b.mark_unreachable()
+        assert preferred_owner([a, b, c], ici_hosts={"hB"}) is a
+
+
+# -- slice → device placement -------------------------------------------------
+
+
+class TestSlicePlacement:
+    def test_slice_device_contiguous_chunks(self):
+        from pilosa_tpu.parallel.mesh import slice_device
+
+        # 10 slices on 4 devices: padded to 12, chunk = 3.
+        assert [slice_device(s, 10, 4) for s in range(10)] \
+            == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3]
+        # One device: everything lands on it.
+        assert {slice_device(s, 7, 1) for s in range(7)} == {0}
+        # Placement is a pure function of (slice, padded count): the
+        # BSI planes + existence + sign rows of a slice ride the same
+        # first-axis shard, so co-location needs no extra bookkeeping.
+        assert slice_device(5, 10, 4) == slice_device(5, 12, 4)
+
+    def test_device_slice_groups(self):
+        from pilosa_tpu.parallel.plan import device_slice_groups
+
+        assert device_slice_groups(range(10), 10, 4) == [3, 3, 3, 1]
+        # Devices with no queried slice are omitted.
+        assert device_slice_groups([0, 1, 9], 10, 4) == [2, 1]
+        assert device_slice_groups([], 0, 4) == []
+
+
+# -- executor: ICI peers fold into the local dispatch -------------------------
+
+
+class TestIciGrouping:
+    def test_ici_peer_slices_served_locally_zero_http(self, holder):
+        """With host1 declared an ICI peer, every slice the ring
+        assigns to it folds into host0's local group: the query never
+        touches the HTTP client, and its tier records as `ici`."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+
+        class ExplodingClient:
+            def execute_query(self, *a, **kw):
+                raise AssertionError("HTTP leg must not fire: the "
+                                     "peer is one psum away")
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=ExplodingClient(), use_device=False,
+                     ici_hosts=["host1"])
+        opt = ExecOptions()
+        n = e.execute("i", parse_string("Count(Bitmap(rowID=10))"),
+                      None, opt)[0]
+        assert n == 4
+        assert opt.used_ici is True and opt.used_http is False
+        tiers = e.tier_stats.copy()
+        assert any(k.endswith("|ici") for k in tiers), tiers
+        assert not any(k.endswith("|http") for k in tiers), tiers
+
+    def test_without_ici_hosts_http_tier_recorded(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        calls = []
+
+        class MockClient:
+            def execute_query(self, node, index, query, slices, remote):
+                calls.append(node.host)
+                return [len(slices)]
+
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=MockClient(), use_device=False)
+        opt = ExecOptions()
+        n = e.execute("i", parse_string("Count(Bitmap(rowID=10))"),
+                      None, opt)[0]
+        assert n == 4
+        assert calls  # the remote leg actually fired
+        assert opt.used_http is True and opt.used_ici is False
+        tiers = e.tier_stats.copy()
+        assert any(k.endswith("|http") for k in tiers), tiers
+
+    def test_single_node_tier_local(self, holder):
+        seed(holder, bits=[(10, 0), (10, SLICE_WIDTH + 1)])
+        e = Executor(holder, use_device=False)
+        assert e.execute("i",
+                         parse_string("Count(Bitmap(rowID=10))"))[0] == 2
+        tiers = e.tier_stats.copy()
+        assert tiers and all(k.endswith("|local") for k in tiers), tiers
+
+    def test_ici_redirect_skips_failed_resplit(self, holder):
+        """A re-split that excluded this node (its own leg failed) must
+        not route an ICI peer's slices back into the excluded local
+        group — the guard keeps the failure path identical to the
+        pre-tier behavior."""
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=None, use_device=False,
+                     ici_hosts=["host1"])
+        nodes = [n for n in cluster.nodes if n.host == "host1"]
+        theirs = [s for s in range(4)
+                  if cluster.fragment_nodes("i", s)[0].host == "host1"]
+        m = e._slices_by_node(nodes, "i", theirs)
+        assert set(m) == {nodes[0]}, m  # nothing folded back to host0
+
+
+# -- explain: tier + device groups --------------------------------------------
+
+
+class TestExplainTiers:
+    def test_cluster_explain_reports_tiers(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=None, use_device=False,
+                     ici_hosts=["host1"])
+        out = e.explain("i", parse_string("Count(Bitmap(rowID=10))"))
+        pl = out["calls"][0]["placement"]
+        assert pl["mode"] == "cluster"
+        assert pl["tier"] == "ici"
+        assert pl["tiers"]["http"] == 0
+        assert pl["tiers"]["local"] + pl["tiers"]["ici"] == 4
+        for ent in pl["nodes"].values():
+            assert ent["tier"] in ("local", "ici")
+
+    def test_http_tier_without_pod_peers(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        cluster = two_node_cluster()
+        e = Executor(holder, host="host0", cluster=cluster,
+                     client=None, use_device=False)
+        pl = e.explain("i", parse_string("Count(Bitmap(rowID=10))")
+                       )["calls"][0]["placement"]
+        assert pl["tier"] == "http"
+        assert pl["tiers"]["http"] > 0
+
+    def test_local_mode_device_groups(self, holder):
+        seed(holder, bits=[(10, s * SLICE_WIDTH) for s in range(4)])
+        e = Executor(holder, use_device=True, device_min_work=0)
+        pl = e.explain("i", parse_string("Count(Bitmap(rowID=10))")
+                       )["calls"][0]["placement"]
+        assert pl["mode"] == "local"
+        assert pl["tier"] in ("local", "ici")
+        # Peek-only sharding report: group sizes cover every slice.
+        if "device_groups" in pl:
+            assert sum(pl["device_groups"]) == 4
+            assert pl["devices"] >= 1
+
+
+# -- metrics: the tier label --------------------------------------------------
+
+
+class TestTierMetric:
+    def test_route_total_carries_tier_label(self, holder):
+        from pilosa_tpu.api import Handler
+        from pilosa_tpu.parallel import new_test_cluster
+
+        from tests.test_metrics import parse_exposition
+
+        cluster = new_test_cluster(1)
+        ex = Executor(holder, host=cluster.nodes[0].host,
+                      cluster=cluster, use_device=False)
+        h = Handler(holder, ex, cluster=cluster,
+                    host=cluster.nodes[0].host)
+        assert h.handle("POST", "/index/i").status == 200
+        assert h.handle("POST", "/index/i/frame/f").status == 200
+        assert h.handle(
+            "POST", "/index/i/query",
+            body=b"SetBit(rowID=1, frame=f, columnID=5)").status == 200
+        assert h.handle(
+            "POST", "/index/i/query",
+            body=b"Count(Bitmap(rowID=1, frame=f))").status == 200
+        text = h.handle("GET", "/metrics").body.decode()
+        samples, _, _ = parse_exposition(text)
+        route = [(l, v) for n, l, v in samples
+                 if n == "pilosa_query_route_total"]
+        assert route
+        # Every series carries BOTH labels, and a single-chip serving
+        # path is all tier="local".
+        for labels, _v in route:
+            assert set(labels) == {"backend", "tier"}, labels
+            assert labels["tier"] == "local", labels
+
+    def test_tier_split_emitted_when_present(self, holder):
+        from pilosa_tpu.api import Handler
+        from pilosa_tpu.parallel import new_test_cluster
+
+        from tests.test_metrics import parse_exposition
+
+        cluster = new_test_cluster(1)
+        ex = Executor(holder, host=cluster.nodes[0].host,
+                      cluster=cluster, use_device=False)
+        # Seed a mixed tier history the way _record_route would.
+        ex.route_stats.inc("count_host")
+        ex.route_stats.inc("count_host")
+        ex.tier_stats.inc("host|local")
+        ex.tier_stats.inc("host|ici")
+        h = Handler(holder, ex, cluster=cluster,
+                    host=cluster.nodes[0].host)
+        text = h.handle("GET", "/metrics").body.decode()
+        samples, _, _ = parse_exposition(text)
+        got = {(l["backend"], l["tier"]): v for n, l, v in samples
+               if n == "pilosa_query_route_total"}
+        assert got.get(("host", "local")) == "1"
+        assert got.get(("host", "ici")) == "1"
+
+
+class TestRenderTopTiers:
+    SCRAPE = (
+        'pilosa_query_route_total{backend="mesh",tier="local"} 5\n'
+        'pilosa_query_route_total{backend="mesh",tier="ici"} 3\n'
+        'pilosa_query_route_total{backend="host",tier="local"} 2\n'
+        'pilosa_query_route_total{backend="bsi-mesh",tier="ici"} 4\n')
+
+    def test_backend_aggregation_and_tier_line(self):
+        from pilosa_tpu.ctl.main import _parse_prom, render_top
+
+        cur = _parse_prom(self.SCRAPE)
+        out = render_top("h:1", cur, {}, 0.0)
+        # Backends aggregate ACROSS tier series...
+        assert "mesh=8" in out and "host=2" in out
+        assert "bsi-mesh=4" in out
+        # ...and the tier split renders on its own line.
+        m = re.search(r"tiers:\s+(.*)", out)
+        assert m, out
+        assert "local=7" in m.group(1) and "ici=7" in m.group(1)
+        assert "http" not in m.group(1)  # absent tiers are omitted
+
+    def test_rate_tolerates_pre_tier_prev_scrape(self):
+        from pilosa_tpu.ctl.main import _parse_prom, render_top
+
+        cur = _parse_prom(self.SCRAPE)
+        prev = _parse_prom(
+            'pilosa_query_route_total{backend="mesh"} 4\n')
+        out = render_top("h:1", cur, prev, 2.0)
+        # (5+3) - 4 = 4 over 2 s.
+        assert "mesh=8 (2.0/s)" in out
+
+
+# -- config knob --------------------------------------------------------------
+
+
+class TestIciHostsConfig:
+    def test_from_dict_and_toml_roundtrip(self):
+        from pilosa_tpu.config import Config
+
+        c = Config.from_dict(
+            {"cluster": {"ici-hosts": ["10.0.0.2:10101",
+                                       "10.0.0.3:10101"]}})
+        assert c.cluster_ici_hosts == ["10.0.0.2:10101",
+                                       "10.0.0.3:10101"]
+        toml = c.to_toml()
+        assert 'ici-hosts = ["10.0.0.2:10101", "10.0.0.3:10101"]' \
+            in toml
+        # Default: no pod peers.
+        assert Config().cluster_ici_hosts == []
+        assert "ici-hosts = []" in Config().to_toml()
+
+
+# -- launch gate: dispatch generations ----------------------------------------
+
+
+class TestLaunchGate:
+    def _staged(self, holder):
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.parallel.serve import MeshManager
+
+        seed(holder, bits=[(1, 3), (1, SLICE_WIDTH + 3)])
+        mgr = MeshManager(holder)
+        tree = parse_string("Count(Bitmap(rowID=1))") \
+            .calls[0].children[0]
+        leaves: list = []
+        shape = _lower_tree(holder, "i", tree, leaves)
+        assert mgr.count("i", shape, leaves, [0, 1], 2) == 2
+        sv = mgr._views[("i", "general", "standard")]
+        return mgr, sv
+
+    def test_generation_stamps_and_moved_abort(self, holder):
+        from pilosa_tpu.parallel.serve import DispatchGenMoved
+
+        mgr, sv = self._staged(holder)
+        g0 = sv.dispatch_gen
+        with mgr._launch_gate(views=(sv,)):
+            pass
+        assert sv.dispatch_gen == g0 + 1
+        # A stale expectation (another dispatch touched the view
+        # between resolve and launch) aborts BEFORE bumping again.
+        stale = ((sv, sv.dispatch_gen - 1),)
+        with pytest.raises(DispatchGenMoved):
+            with mgr._launch_gate(views=(sv,), expect_gens=stale):
+                raise AssertionError("body must not run")
+        assert sv.dispatch_gen == g0 + 1
+        # A current expectation proceeds and bumps.
+        fresh = ((sv, sv.dispatch_gen),)
+        with mgr._launch_gate(views=(sv,), expect_gens=fresh):
+            pass
+        assert sv.dispatch_gen == g0 + 2
+
+    def test_guarded_exec_moved_is_not_a_strike(self, holder):
+        """DispatchGenMoved is control flow (retry via the coalescing
+        batch path), never a plan failure: no quarantine strike, and
+        the same signature still launches afterwards."""
+        from pilosa_tpu.parallel.serve import DispatchGenMoved
+
+        mgr, sv = self._staged(holder)
+        q0 = mgr.stats.copy().get("plan_quarantined", 0)
+        stale = ((sv, sv.dispatch_gen - 1),)
+        with pytest.raises(DispatchGenMoved):
+            mgr._guarded_exec("sig-x", lambda: 1, views=(sv,),
+                              expect_gens=stale)
+        assert mgr.stats.copy().get("plan_quarantined", 0) == q0
+        assert mgr._guarded_exec(
+            "sig-x", lambda: 1, views=(sv,),
+            expect_gens=((sv, sv.dispatch_gen),)) == 1
+
+    def test_serialization_cpu_multi_device_only(self, holder):
+        mgr, _sv = self._staged(holder)
+        import jax
+
+        want = bool(mgr.mesh.devices.size > 1
+                    and jax.default_backend() == "cpu")
+        assert mgr._dispatch_serialized() is want

@@ -372,3 +372,174 @@ class TestDescriptorFaults:
             mhu.process_allgather = real
         # re-agreement: the collective serves again
         assert srv.count("i", shape, leaves, [0, 1], 2) == 2
+
+    def test_format_disagreement_skips_and_recovers(self, tmp_path):
+        """Per-shard format agreement (ISSUE 16): the gate fingerprint
+        covers each staged view's sparse/dense per-slice picks, so a
+        rank whose PR-14 format choice diverged (sparse where another
+        rank went dense) changes the fingerprint — mismatched ranks
+        skip the collective together, the executor serves the host
+        fold, and re-agreement recovers the device path."""
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.parallel.plan import _lower_tree
+        from pilosa_tpu.parallel.spmd import SpmdServer
+        from pilosa_tpu.pql import parse_string
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        f = h.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("g")
+        for s in range(2):
+            f.set_bit(1, s * SLICE_WIDTH + 3)
+        srv = SpmdServer(h)
+        ex = Executor(h, use_device=True, device_min_work=0)
+        ex.set_spmd(srv)
+        q = parse_string("Count(Bitmap(frame=g, rowID=1))")
+        tree = q.calls[0].children[0]
+        leaves: list = []
+        shape = _lower_tree(h, "i", tree, leaves)
+
+        # Baseline: the collective serves and stages the view.
+        assert srv.count("i", shape, leaves, [0, 1], 2) == 2
+        sv = srv.manager._views[("i", "g", "standard")]
+
+        # The per-shard format vector is part of the fingerprint: a
+        # sparse<->dense flip on one shard changes the gated blob, so
+        # real ranks with diverged picks would land on different crcs.
+        blob0 = srv.manager.staged_format_blob("i", {("g", "standard")})
+        sv.slice_formats[0] ^= 1
+        blob1 = srv.manager.staged_format_blob("i", {("g", "standard")})
+        sv.slice_formats[0] ^= 1
+        assert blob0 != blob1
+
+        # Simulate that divergence at the gate (world size 1 can't
+        # disagree with itself): capture the fingerprint and force the
+        # skip verdict a mismatch produces. The collective must skip
+        # CLEANLY — no dispatch, None back to the caller — and the
+        # executor seam turns that into a host-path answer.
+        real_gate = srv._gate
+        seen: list = []
+
+        def veto_gate(blob):
+            seen.append(blob)
+            return False
+
+        try:
+            srv._gate = veto_gate
+            assert srv.count("i", shape, leaves, [0, 1], 2) is None
+            assert seen  # the count reached the gate, then skipped
+            assert ex.execute("i", q)[0] == 2  # host fallback serves
+        finally:
+            srv._gate = real_gate
+        # re-agreement: the device collective serves again, bit-exact
+        assert srv.count("i", shape, leaves, [0, 1], 2) == 2
+        h.close()
+
+
+class TestBsiSumDescriptor:
+    """BSISUM descriptor differential (ISSUE 16): BSI aggregates served
+    through the SPMD descriptor plane — world size 1 on CPU collapses
+    broadcast/allgather to identity, so the full broadcast + gate +
+    psum machinery runs in-process — must be bit-exact against the host
+    roaring fold AND the python oracle over the same holder: negatives,
+    multi-slice, plane boundaries, filtered forms."""
+
+    def _setup(self, tmp_path):
+        import random
+
+        from pilosa_tpu.bsi import FieldSchema
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.parallel.spmd import SpmdServer
+
+        schema = FieldSchema("val", -4000, 4000)
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        f = h.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        f.create_field_if_not_exists(schema)
+        rng = random.Random(7)
+        vals = {}
+        # plane boundaries both signs, zero, extremes — then random
+        bnd = [0, -4000, 4000, 1, -1, 2047, -2048, 255, -256, 1024]
+        for s in range(2):  # multi-slice: partials cross slices
+            cols = sorted(rng.sample(range(SLICE_WIDTH), 40))
+            for i, c in enumerate(cols):
+                v = bnd[i] if i < len(bnd) else rng.randint(-4000, 4000)
+                vals[s * SLICE_WIDTH + c] = v
+                f.set_value("val", s * SLICE_WIDTH + c, v)
+        srv = SpmdServer(h)
+        dev = Executor(h, use_device=True, device_min_work=0)
+        dev.set_spmd(srv)
+        host = Executor(h, use_device=False)
+        return h, vals, host, dev, srv
+
+    def test_sum_min_max_vs_host_and_oracle(self, tmp_path):
+        from pilosa_tpu.pql import parse_string
+
+        h, vals, host, dev, srv = self._setup(tmp_path)
+        try:
+            agg0 = srv.manager.stats.copy().get("bsi_aggregate", 0)
+            for pql in ('Sum(frame="f", field="val")',
+                        'Min(frame="f", field="val")',
+                        'Max(frame="f", field="val")'):
+                want = host.execute("i", parse_string(pql))[0]
+                got = dev.execute("i", parse_string(pql))[0]
+                assert got == want, pql
+            got = dev.execute(
+                "i", parse_string('Sum(frame="f", field="val")'))[0]
+            assert got == {"value": sum(vals.values()),
+                           "count": len(vals)}
+            for name, fn in (("Min", min), ("Max", max)):
+                want_v = fn(vals.values())
+                got = dev.execute(
+                    "i", parse_string(f'{name}(frame="f", '
+                                      f'field="val")'))[0]
+                assert got == {
+                    "value": want_v,
+                    "count": sum(1 for v in vals.values()
+                                 if v == want_v)}
+            # Sum rode the BSISUM descriptor (negatives present → two
+            # passes), and the device route served it.
+            assert srv.manager.stats.copy() \
+                .get("bsi_aggregate", 0) > agg0
+            assert dev.route_stats.copy() \
+                .get("count_bsi-mesh", 0) >= 3
+        finally:
+            h.close()
+
+    def test_filtered_sum_rides_rcsrc_descriptor(self, tmp_path):
+        from pilosa_tpu.pql import parse_string
+
+        h, vals, host, dev, srv = self._setup(tmp_path)
+        try:
+            f = h.index("i").frame("f")
+            keep = {c for i, c in enumerate(sorted(vals)) if i % 2 == 0}
+            for c in keep:
+                f.set_bit(7, c)
+            pql = ('Sum(Bitmap(frame="f", rowID=7), '
+                   'frame="f", field="val")')
+            want = {"value": sum(vals[c] for c in keep),
+                    "count": len(keep)}
+            assert host.execute("i", parse_string(pql))[0] == want
+            assert dev.execute("i", parse_string(pql))[0] == want
+        finally:
+            h.close()
+
+    def test_descriptor_matches_manager_collective(self, tmp_path):
+        """srv.bsi_sum must return exactly what the single-host
+        MeshManager collective returns for the same view — the SPMD
+        plane adds broadcast+gate around the SAME program, never a
+        different reduction."""
+        h, vals, host, dev, srv = self._setup(tmp_path)
+        try:
+            view = "bsi.val"
+            got = srv.bsi_sum("i", "f", view, [0, 1], 2)
+            want = srv.manager.bsi_plane_counts("i", "f", view,
+                                                [0, 1], 2)
+            assert got is not None and want is not None
+            assert got == want
+        finally:
+            h.close()
