@@ -1513,6 +1513,98 @@ def main():
             f"scheduler idle fast-path overhead {overhead:.1%} " \
             f"exceeds the 2% guard"
 
+    with section("fleet_overhead"):
+        # Fleet-plane guards, three halves. (1) A /debug/fleet build
+        # over an 8-member ring — eight full /metrics + /debug/vars
+        # scrapes plus the exact cumulative merge — must finish under
+        # 250 ms, the budget that keeps the coordinator panel cheap to
+        # poll at the default 5 s interval. In-process fetch closures
+        # over a live handler, so the number prices scrape + parse +
+        # merge, not sockets. (2) The query-shape flight recorder's
+        # record() — one lock hold and a handful of dict increments per
+        # served query — must add under 1% to the lone-query fast path.
+        # (3) Exemplar sampling is free when off: a histogram that
+        # never sees a trace id allocates no exemplar storage, and the
+        # off path is a single `is None` check per observe.
+        _progress("fleet scrape+merge / flight recorder / exemplar "
+                  "off-path")
+        from pilosa_tpu.api import Handler as _FHandler
+        from pilosa_tpu.obs import Histogram as _FHist
+        from pilosa_tpu.obs import fleet as _fleet
+        from pilosa_tpu.obs import flight as _flight
+
+        _fh = _FHandler(e.holder, e)
+        assert _fh.handle("GET", "/metrics").status == 200  # warm walk
+        _fmembers = {"10.9.0.%d:10101" % i: "UP" for i in range(8)}
+
+        def _ffetch(host, path, timeout_s):
+            resp = _fh.handle("GET", path)
+            assert resp.status == 200, (host, path, resp.status)
+            return resp.body.decode()
+
+        _agg = _fleet.FleetAggregator(members=lambda: _fmembers,
+                                      fetch=_ffetch)
+        _agg.snapshot(force=True)  # warm: first full round
+        fleet_best = float("inf")
+        fdoc = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fdoc = _agg.snapshot(force=True)
+            fleet_best = min(fleet_best, time.perf_counter() - t0)
+        assert fdoc["scraped"] == 8 and fdoc["healthy"] == 8, \
+            (fdoc["scraped"], fdoc["healthy"])
+
+        _fr = _flight.FlightRecorder()
+        _fsig = "bench:lone-intersect-count"
+
+        def flight_dt(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                MUTATION_EPOCH.bump_structural()
+                _cold_rows()
+                q_t0 = time.monotonic()
+                e.execute("i", q1)
+                dt_us = (time.monotonic() - q_t0) * 1e6
+                _fr.record(_fsig, "mesh", "local", dt_us)
+            return (time.perf_counter() - t0) / n
+
+        base_best = flight_best = float("inf")
+        for _ in range(7):
+            base_best = min(base_best, fresh_dt(n_lone))
+            flight_best = min(flight_best, flight_dt(n_lone))
+        fr_overhead = flight_best / base_best - 1.0
+
+        # Off-path exemplar cost: per-observe time with no trace id,
+        # plus proof the histogram allocated nothing for exemplars.
+        n_obs = 100_000
+        _h_off = _FHist()
+        t0 = time.perf_counter()
+        for v in range(n_obs):
+            _h_off.observe(v & 1023)
+        off_ns = (time.perf_counter() - t0) / n_obs * 1e9
+        assert _h_off._exemplars is None, \
+            "exemplar storage allocated on the no-exemplar path"
+        _h_on = _FHist()
+        t0 = time.perf_counter()
+        for v in range(n_obs):
+            _h_on.observe(v & 1023, exemplar="t0")
+        on_ns = (time.perf_counter() - t0) / n_obs * 1e9
+
+        details["fleet_overhead"] = {
+            "fleet8_scrape_merge_ms": fleet_best * 1e3,
+            "fleet_merged_series": len(fdoc["merged"]),
+            "plain_ms": base_best * 1e3,
+            "flight_ms": flight_best * 1e3,
+            "flight_overhead_frac": fr_overhead,
+            "observe_ns": off_ns,
+            "observe_exemplar_ns": on_ns}
+        assert fleet_best < 0.250, \
+            f"8-member fleet scrape+merge {fleet_best * 1e3:.0f} ms " \
+            f"exceeds the 250 ms guard"
+        assert fr_overhead < 0.01, \
+            f"flight-recorder overhead {fr_overhead:.1%} exceeds " \
+            f"the 1% guard"
+
     with section("serving_concurrent16_qps"):
         # concurrent clients: 16 threads, every query a DISTINCT 3-leaf
         # Intersect (each query text appears exactly once across

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..errors import DeadlineExceededError, PilosaError
 from ..obs import StatMap, current_span
 from ..obs import profile as obs_profile
+from ..obs.metrics import TIER_BYTES
 from .. import fault
 from ..wire import pb, result_from_proto, PROTOBUF_CT
 
@@ -284,6 +285,7 @@ class InternalClient:
                     data = resp.read()
                     if self.breaker is not None:
                         self.breaker.record_success()
+                    TIER_BYTES.inc("http", len(body or b"") + len(data))
                     return resp.status, data
             except urllib.error.HTTPError as e:
                 data = e.read()

@@ -495,6 +495,17 @@ class Handler:
         # server replaces this default with a config-driven recorder;
         # set to None to disable accounting entirely.
         self.slo = obs.slo.SLORecorder()
+        # Federated fleet view (obs.fleet.FleetAggregator) behind
+        # GET /debug/fleet. Built lazily on first request — embedded
+        # handlers without a cluster pay nothing and answer 404.
+        # Interval/deadline from [obs] fleet-scrape-interval (server
+        # wiring); peer scrapes ride client_factory transports, the
+        # local node short-circuits through handle() directly.
+        self.fleet_scrape_interval = 5.0
+        self.fleet_scrape_deadline = 2.0
+        self._fleet_agg = None
+        self._fleet_mu = threading.Lock()
+        self._fleet_clients: Dict[str, object] = {}
         self._prom = obs.prom.Registry()
         self._register_collectors()
         self._routes: List[Route] = []
@@ -536,6 +547,8 @@ class Handler:
         r("GET", r"/metrics", self._get_metrics)
         r("GET", r"/debug/vars", self._get_expvar)
         r("GET", r"/debug/slo", self._get_debug_slo)
+        r("GET", r"/debug/fleet", self._get_debug_fleet)
+        r("GET", r"/debug/queryshapes", self._get_debug_queryshapes)
         r("GET", r"/debug/queries", self._get_debug_queries)
         r("GET", r"/debug/traces/(?P<tid>[^/]+)", self._get_debug_trace)
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
@@ -620,8 +633,12 @@ class Handler:
         """Prometheus text exposition over every stat store: the
         ExpvarStats bridge, mesh/compile/device-memory telemetry,
         cache + dispatch + breaker counters, backend-labeled query
-        latency histograms, build info. All bridged at scrape time."""
-        text = self._prom.render()
+        latency histograms, build info. All bridged at scrape time.
+        ?exemplars=true upgrades the output to OpenMetrics exemplar
+        syntax — latency buckets carry sampled trace ids resolvable at
+        /debug/traces/<id>; default scrapes stay plain 0.0.4."""
+        text = self._prom.render(
+            exemplars=params.get("exemplars") == "true")
         return Response(
             200,
             {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
@@ -642,6 +659,7 @@ class Handler:
         reg.register_collector(self._collect_integrity)
         reg.register_collector(self._collect_hints)
         reg.register_collector(self._collect_slo)
+        reg.register_collector(self._collect_spmd)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
@@ -651,6 +669,69 @@ class Handler:
             return []
         return self.slo.families()
 
+    def _collect_spmd(self) -> list:
+        """Descriptor-plane + locality-tier telemetry: per-op dispatch
+        counts and wall time, rank-gate vetoes by reason, bytes moved
+        per tier, and the flight recorder's ring accounting."""
+        from ..parallel import spmd as spmd_mod
+
+        prom = obs.prom
+        fams: list = []
+        tb = obs.metrics.TIER_BYTES.copy()
+        tier = prom.MetricFamily(
+            "pilosa_tier_bytes_total", "counter",
+            "Bytes moved across locality tiers: ici = descriptor-plane "
+            "broadcasts over the device fabric, http = node-to-node "
+            "request+response bodies.")
+        for t in ("ici", "http"):
+            tier.add(tb.get(t, 0), {"tier": t})
+        fams.append(tier)
+        stats = spmd_mod.SPMD_STATS.copy()
+        disp = prom.MetricFamily(
+            "pilosa_spmd_dispatch_total", "counter",
+            "SPMD descriptors executed by this rank, by op.")
+        veto = prom.MetricFamily(
+            "pilosa_spmd_gate_veto_total", "counter",
+            "Collective launches vetoed by the program-agreement gate: "
+            "not_ready = a rank had no compiled program, "
+            "format_disagreement = ranks resolved different programs "
+            "or staged formats.")
+        for k, v in sorted(stats.items()):
+            kind, _, rest = k.partition(":")
+            if kind == "dispatch":
+                disp.add(v, {"op": rest})
+            elif kind == "veto":
+                veto.add(v, {"reason": rest})
+        if disp.samples:
+            fams.append(disp)
+        if veto.samples:
+            fams.append(veto)
+        hists = spmd_mod.op_hist_snapshot()
+        if hists:
+            lat = prom.MetricFamily(
+                "pilosa_spmd_dispatch_us", "histogram",
+                "SPMD descriptor wall time by op (resolve + gate + "
+                "collective; log2 buckets, µs).")
+            for op, h in sorted(hists.items()):
+                lat.add_histogram(h, {"op": op})
+            fams.append(lat)
+        fr = getattr(self.executor, "flight", None)
+        if fr is not None:
+            st = fr.stats()
+            fams.append(prom.MetricFamily(
+                "pilosa_queryshape_tracked", "gauge",
+                "Query shapes currently held by the flight recorder "
+                "ring.").add(st["shapes"]))
+            fams.append(prom.MetricFamily(
+                "pilosa_queryshape_ring", "gauge",
+                "Flight recorder ring capacity ([obs] "
+                "queryshape-ring).").add(st["ring"]))
+            fams.append(prom.MetricFamily(
+                "pilosa_queryshape_evicted_total", "counter",
+                "Query shapes evicted from the flight recorder ring "
+                "(LRU).").add(st["evicted"]))
+        return fams
+
     def _get_debug_slo(self, pv, params, headers, body):
         """SLO observatory snapshot: per-window SLIs, burn rates, and
         error budgets — the same numbers the pilosa_slo_* families
@@ -658,6 +739,79 @@ class Handler:
         if self.slo is None:
             return _json_resp({"error": "slo accounting disabled"}, 404)
         return _json_resp(self.slo.status())
+
+    # -- /debug/fleet + /debug/queryshapes -----------------------------------
+
+    def _fleet(self):
+        """Lazily-built FleetAggregator; None without a cluster."""
+        if self.cluster is None:
+            return None
+        with self._fleet_mu:
+            if self._fleet_agg is None:
+                self._fleet_agg = obs.fleet.FleetAggregator(
+                    members=self.cluster.node_states,
+                    fetch=self._fleet_fetch,
+                    interval=self.fleet_scrape_interval,
+                    deadline=self.fleet_scrape_deadline,
+                    breaker_state=self._fleet_breaker_state)
+            return self._fleet_agg
+
+    def _fleet_breaker_state(self, host: str) -> str:
+        breakers = getattr(getattr(self.executor, "client", None),
+                           "breakers", None)
+        state = getattr(breakers, "state", None)
+        if callable(state):
+            try:
+                return state(host)
+            except Exception:  # noqa: BLE001 — unknown peer: no skip
+                return ""
+        return ""
+
+    def _fleet_fetch(self, host: str, path: str,
+                     timeout_s: float) -> str:
+        """Fleet scrape transport: the local node answers through its
+        own handler (no self-scrape over HTTP — always fresh, never
+        breaker-gated); peers go through the internal client, which
+        brings retries, deadlines, and breaker accounting."""
+        if host == self.host or self.client_factory is None:
+            resp = self.handle("GET", path)
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"local {path}: status={resp.status}")
+            return resp.body.decode()
+        client = self._fleet_clients.get(host)
+        if client is None:
+            client = self._fleet_clients[host] = self.client_factory(
+                host)
+        status, data = client._do(
+            "GET", path, deadline=time.monotonic() + timeout_s)
+        if status != 200:
+            raise RuntimeError(f"{host}{path}: status={status}")
+        return data.decode()
+
+    def _get_debug_fleet(self, pv, params, headers, body):
+        """Federated fleet pane: every ring member's /metrics +
+        /debug/vars scraped (bounded concurrency, per-node deadline,
+        breaker-aware, stale-tolerant) and the cumulative families
+        merged exactly. ?force=true bypasses the snapshot cache."""
+        agg = self._fleet()
+        if agg is None:
+            return _json_resp(
+                {"error": "fleet view requires a cluster"}, 404)
+        return _json_resp(
+            agg.snapshot(force=params.get("force") == "true"))
+
+    def _get_debug_queryshapes(self, pv, params, headers, body):
+        """Query-shape flight recorder: per plan-signature traffic,
+        latency, route/tier mix, staged bytes, and shadow-check
+        outcomes. ?sort=cost|p99|routed_host|count, ?limit=N."""
+        fr = getattr(self.executor, "flight", None)
+        if fr is None:
+            return _json_resp(
+                {"error": "flight recorder unavailable"}, 404)
+        return _json_resp(fr.snapshot(
+            sort=params.get("sort", "cost"),
+            limit=int(params.get("limit", "50"))))
 
     def _collect_runtime(self) -> list:
         prom = obs.prom
@@ -717,6 +871,12 @@ class Handler:
                 "Plan signatures quarantined off the device path "
                 "after repeated failures.")
                 .add(stats.get("plan_quarantined", 0)))
+            fams.append(prom.MetricFamily(
+                "pilosa_dispatch_gen_moved_total", "counter",
+                "Launches aborted because another dispatch advanced a "
+                "participating view's generation first (retried via "
+                "the coalescing path, not a failure).")
+                .add(stats.get("dispatch_gen_moved", 0)))
         mgr = getattr(ex, "_mesh_mgr", None)
         cs = getattr(mgr, "compile_stats", None)
         if cs is not None:
@@ -827,14 +987,12 @@ class Handler:
                 if not k.startswith("count_"):
                     continue
                 backend = k[len("count_"):]
-                split = by_route.get(backend)
-                if split:
-                    for tier, tv in sorted(split.items()):
-                        routes.add(tv, {"backend": backend, "tier": tier})
-                else:
-                    # Backend counted before tier tracking (or seeded
-                    # directly in tests): everything was single-chip.
-                    routes.add(v, {"backend": backend, "tier": "local"})
+                # Every _record_route call site threads a real tier, so
+                # the tier split is authoritative — no single-chip
+                # fallback guessing.
+                for tier, tv in sorted(by_route.get(backend,
+                                                    {}).items()):
+                    routes.add(tv, {"backend": backend, "tier": tier})
             fams.append(routes)
         hists = getattr(ex, "route_latency_hists", None)
         if hists:
@@ -1778,7 +1936,8 @@ class Handler:
             latency_us = (time.monotonic() - t0) * 1e6
         self.slo.record(obs.slo.outcome_for_status(resp.status, partial),
                         tenant=info.get("tenant", "default"),
-                        latency_us=latency_us)
+                        latency_us=latency_us,
+                        trace_id=info.get("trace_id"))
         return resp
 
     def _post_query_inner(self, pv, params, headers, body,
@@ -1868,6 +2027,7 @@ class Handler:
                 "query", trace_id=th.partition(":")[0] or None,
                 index=index, query=query[:256], remote=bool(remote),
                 node=self.host)
+            info["trace_id"] = trace.trace_id
             try:
                 with trace.root:
                     resp = self._run_query(index, query, slices,

@@ -374,6 +374,14 @@ class Config:
         # ?profile=true; N profiles every Nth query (block_until_ready
         # bracketing and all), feeding pilosa_query_phase_us.
         self.profile_sample_rate: int = 0
+        # Federated fleet view (GET /debug/fleet): coordinator-side
+        # scrape-round cache TTL — a dashboard polling faster than this
+        # reuses the last merged snapshot instead of re-scraping the
+        # whole ring.
+        self.fleet_scrape_interval: float = 5.0
+        # Query-shape flight recorder ring (GET /debug/queryshapes):
+        # distinct plan signatures retained (LRU beyond that).
+        self.queryshape_ring: int = 256
         # [log] — structured logging (obs/log.py). `log_format` "json"
         # injects the active trace/span id into every record so log
         # lines join against /debug/traces. `log_file` empty falls back
@@ -519,6 +527,11 @@ class Config:
                 ob["metrics-sample-interval"])
         c.profile_sample_rate = int(ob.get("profile-sample-rate",
                                            c.profile_sample_rate))
+        if "fleet-scrape-interval" in ob:
+            c.fleet_scrape_interval = parse_duration(
+                ob["fleet-scrape-interval"])
+        c.queryshape_ring = int(ob.get("queryshape-ring",
+                                       c.queryshape_ring))
         lg = data.get("log", {})
         c.log_level = str(lg.get("level", c.log_level))
         c.log_format = str(lg.get("format", c.log_format))
@@ -701,6 +714,9 @@ class Config:
             f'metrics-sample-interval = '
             f'"{int(self.metrics_sample_interval)}s"\n'
             f"profile-sample-rate = {self.profile_sample_rate}\n"
+            f'fleet-scrape-interval = '
+            f'"{int(self.fleet_scrape_interval)}s"\n'
+            f"queryshape-ring = {self.queryshape_ring}\n"
             f"\n[log]\n"
             f'level = "{self.log_level}"\n'
             f'format = "{self.log_format}"\n'

@@ -371,65 +371,24 @@ def cmd_config(args) -> int:
 
 def _parse_prom(text: str) -> dict:
     """Prometheus 0.0.4 text -> {(name, ((label, value), ...)): float}.
-    Labels come back sorted so lookups are order-independent. Comment
-    and malformed lines are skipped (an operator tool must survive a
-    partially-garbled scrape)."""
-    import re as _re
+    Delegates to the canonical parser in obs.fleet — the operator CLI
+    and the coordinator's fleet merge must agree on what a scrape
+    means. Notably, duplicate cumulative samples (the same `le` bucket
+    appearing once per (tenant, tier, backend) label slice) SUM rather
+    than overwrite, so percentile merges over a mixed-label scrape
+    don't silently drop all but the last series."""
+    from ..obs import fleet
 
-    label_re = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)='
-                           r'"((?:[^"\\]|\\.)*)"')
-    out = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$",
-                      line)
-        if m is None:
-            continue
-        name, rawlabels, value = m.groups()
-        try:
-            v = float(value)
-        except ValueError:
-            continue
-        labels = tuple(sorted(
-            (k, lv.replace('\\"', '"').replace("\\\\", "\\")
-                  .replace("\\n", "\n"))
-            for k, lv in label_re.findall(rawlabels or "")))
-        out[(name, labels)] = v
-    return out
+    return fleet.parse_text(text)
 
 
 def _hist_percentiles(metrics: dict, name: str, fixed: dict):
     """(p50, p95, p99, count) from `name`_bucket cumulative-le samples
-    whose labels include `fixed`. Percentile = the smallest le whose
-    cumulative count covers the quantile (exact for the log2 exporter,
-    an upper bound in general)."""
-    by_le: dict = {}
-    for (mname, labels), v in metrics.items():
-        if mname != name + "_bucket":
-            continue
-        d = dict(labels)
-        if any(d.get(k) != str(val) for k, val in fixed.items()):
-            continue
-        le = d.get("le", "")
-        le = float("inf") if le == "+Inf" else float(le)
-        # Sum across any series the fixed labels don't pin down (e.g.
-        # per-tenant phase histograms viewed by (phase, backend)) —
-        # cumulative counts stay cumulative under per-le addition.
-        by_le[le] = by_le.get(le, 0.0) + v
-    if not by_le:
-        return None
-    buckets = sorted(by_le.items())
-    total = buckets[-1][1]
-    if total <= 0:
-        return (0.0, 0.0, 0.0, 0)
-    out = []
-    for q in (0.50, 0.95, 0.99):
-        thresh = q * total
-        out.append(next((le for le, cum in buckets if cum >= thresh),
-                        buckets[-1][0]))
-    return (*out, int(total))
+    whose labels include `fixed`. Delegates to obs.fleet (see
+    _parse_prom)."""
+    from ..obs import fleet
+
+    return fleet.hist_percentiles(metrics, name, fixed)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -686,6 +645,94 @@ def render_top(host: str, cur: dict, prev: dict, dt: float) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fleet(host: str, doc: dict, prev: Optional[dict] = None,
+                 dt: float = 0.0) -> str:
+    """One screenful from a /debug/fleet document. Pure — tests feed
+    it canned snapshots. `prev`/`dt` (the previous snapshot and the
+    seconds between polls) turn the merged request counter into a
+    fleet-wide QPS figure."""
+    lines = [f"pilosa-tpu fleet — via {host}   "
+             f"members {doc.get('members', 0)}   "
+             f"scraped {doc.get('scraped', 0)}   "
+             f"healthy {doc.get('healthy', 0)}"]
+    req = doc.get("requests_total", 0)
+    line = f"fleet requests {int(req)}"
+    if prev is not None and dt > 0:
+        qps = max(0.0, (req - prev.get("requests_total", 0)) / dt)
+        line += f"   qps {qps:.1f}"
+    lines.append(line)
+
+    phases = doc.get("phase_percentiles") or {}
+    for ph, row in sorted(phases.items()):
+        lines.append(
+            f"phase {ph:<14} p50 {_fmt_us(row['p50_us'])}   "
+            f"p95 {_fmt_us(row['p95_us'])}   "
+            f"p99 {_fmt_us(row['p99_us'])}   n={row['count']}")
+
+    lines.append("")
+    for node, row in sorted((doc.get("nodes") or {}).items()):
+        state = row.get("state", "?")
+        if row.get("tiers") is None and row.get("error"):
+            lines.append(f"{node:<24} {state:<8} "
+                         f"UNSCRAPED ({row['error']})")
+            continue
+        tiers = row.get("tiers") or {}
+        tier_mix = "/".join(
+            f"{t}:{int(tiers.get(t, 0))}"
+            for t in ("local", "ici", "http")) or "-"
+        hints = row.get("hints") or {}
+        hbm = row.get("hbm") or {}
+        line = (f"{node:<24} {state:<8} "
+                f"req {int(row.get('requests_total', 0)):<8} "
+                f"tiers {tier_mix:<24} "
+                f"hints backlog {int(hints.get('backlog', 0)):<6} "
+                f"hbm {_fmt_bytes(hbm.get('resident_bytes', 0))}")
+        budget = hbm.get("budget_bytes", 0)
+        if budget:
+            line += f"/{_fmt_bytes(budget)}"
+        ratio = hbm.get("residency_ratio")
+        if ratio is not None:
+            line += f" ({ratio:.0%})"
+        age = row.get("scrape_age_s")
+        if age is not None and age > doc.get("scrape_interval_s", 5.0):
+            line += f"   STALE {age:.0f}s"
+        if row.get("error"):
+            line += f"   error: {row['error']}"
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def cmd_fleet(args) -> int:
+    """Scrape /debug/fleet on an interval and render the federated
+    pane: per-node health / tier mix / hint backlog / HBM residency
+    plus fleet-wide QPS and phase percentiles."""
+    import json as _json
+    import urllib.request
+
+    url = f"http://{args.host}/debug/fleet"
+    prev: Optional[dict] = None
+    t_prev = 0.0
+    n = 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                doc = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"scrape {url}: {e}", file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        out = render_fleet(args.host, doc, prev, now - t_prev)
+        if sys.stdout.isatty() and args.n != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        prev, t_prev = doc, now
+        n += 1
+        if args.n and n >= args.n:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_loadgen(args) -> int:
     """`pilosa-tpu loadgen` — delegate to tools/loadgen.py (its parser
     owns every flag; exit code is the SLO verdict)."""
@@ -830,6 +877,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", type=int, default=0,
                    help="number of scrapes, 0 = until interrupted")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("fleet",
+                       help="federated /debug/fleet panel for the ring")
+    _add_host(p)
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="seconds between polls (default 5)")
+    p.add_argument("-n", type=int, default=0,
+                   help="number of polls, 0 = until interrupted")
+    p.set_defaults(fn=cmd_fleet)
 
     # Placeholder row for --help only: main() routes "loadgen" before
     # argparse runs, because tools/loadgen.py's parser owns its flags

@@ -83,6 +83,19 @@ SHADOW_STATS = obs.StatMap()
 CONSISTENCY_STATS = obs.StatMap()
 
 
+def _call_shape(c) -> str:
+    """Structural fingerprint of a Call tree — names + frame args,
+    row/column ids elided: `Count(Intersect(Bitmap[f],Bitmap[f]))`.
+    The flight recorder's shape key (human-readable, bounded
+    cardinality — one entry per query SHAPE, not per query)."""
+    frame = c.args.get("frame")
+    label = f"{c.name}[{frame}]" if isinstance(frame, str) else c.name
+    if c.children:
+        return (label + "("
+                + ",".join(_call_shape(k) for k in c.children) + ")")
+    return label
+
+
 def required_acks(level: str, owners: int) -> int:
     """Replica acks (local apply included) a write needs before it is
     acked to the client."""
@@ -266,6 +279,10 @@ class Executor:
         # consumers keep exact keys.
         self.tier_stats = obs.StatMap()
         self._route_hists: dict = {}
+        # Query-shape flight recorder (/debug/queryshapes): per
+        # plan-signature route/tier/latency aggregation in a bounded
+        # ring. The server resizes it from [obs] queryshape-ring.
+        self.flight = obs.flight.FlightRecorder()
         # [integrity] shadow-sample-1-in: every Nth device Count/TopN
         # result is recomputed through the host roaring fold and
         # compared (0 = off). itertools.count() next() is atomic under
@@ -567,6 +584,7 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
         t0 = time.monotonic()
+        h2d0 = self._h2d_bytes()
 
         # Whole-query memo (the Range/nary routed-path answer to the
         # reference's rank cache): a repeated read-only Count on an
@@ -597,7 +615,13 @@ class Executor:
                 if hit is not None:
                     psp.tag(route="memo").finish()
                     pph.stop()
-                    self._record_route("memo", t0)
+                    # A memo hit never leaves this process: tier from
+                    # the options anyway (a remote leg's hit still
+                    # belongs to the tier the query paid), never the
+                    # bare legacy default.
+                    self._record_route("memo", t0,
+                                       tier=self._query_tier(opt, False),
+                                       call=c)
                     return hit
 
         # Lower the tree ONCE; every count engine shares it. The
@@ -708,7 +732,9 @@ class Executor:
                 # invalidate, they don't serve.
                 self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
         self._record_route(route, t0,
-                           tier=self._query_tier(opt, route == "mesh"))
+                           tier=self._query_tier(opt, route == "mesh"),
+                           call=c,
+                           staged_bytes=max(0, self._h2d_bytes() - h2d0))
         return n
 
     # Above this fan-out, gathering (fragment, generation) pairs for
@@ -811,6 +837,7 @@ class Executor:
                 f"{c.name}() only accepts a single bitmap input")
         child = c.children[0] if c.children else None
         t0 = time.monotonic()
+        h2d0 = self._h2d_bytes()
 
         # Lower the filter child once; a non-lowerable filter pins the
         # whole aggregate to the host path (its per-slice evaluation
@@ -860,6 +887,7 @@ class Executor:
                     [prev, self._valcount_pair(v)], maximize)
 
         batch_fn = None
+        shadow_out: list = []  # per-check mismatch flags (flight rec)
         if device_ok:
             inner = (self._bsi_sum_batch(index, frame, schema,
                                          filter_lowered)
@@ -873,7 +901,7 @@ class Executor:
                     if v is not None and self._shadow_sampled():
                         v = self._shadow_check_bsi(
                             c.name, index, batch_slices, v, map_fn,
-                            reduce_fn)
+                            reduce_fn, outcome=shadow_out)
                     return v
             else:
                 device_ok = False
@@ -881,7 +909,11 @@ class Executor:
         out = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
                                batch_fn=batch_fn)
         self._record_route("bsi-mesh" if device_ok else "bsi-host", t0,
-                           tier=self._query_tier(opt, device_ok))
+                           tier=self._query_tier(opt, device_ok),
+                           call=c,
+                           staged_bytes=max(0, self._h2d_bytes() - h2d0),
+                           shadow_checked=bool(shadow_out),
+                           shadow_mismatch=any(shadow_out))
         if c.name == "Sum":
             s, n = out if out is not None else (0, 0)
             return {"value": int(s), "count": int(n)}
@@ -1022,7 +1054,7 @@ class Executor:
         return batch_fn
 
     def _shadow_check_bsi(self, name: str, index: str, batch_slices,
-                          device_v, map_fn, reduce_fn):
+                          device_v, map_fn, reduce_fn, outcome=None):
         """Recompute a sampled device aggregate through the host
         roaring fold and compare. On mismatch: count it, log, and
         serve the HOST value — BSI collectives are keyed per staged
@@ -1035,8 +1067,12 @@ class Executor:
         if name == "Sum" and host_v is None:
             host_v = (0, 0)
         if host_v == self._valcount_pair(device_v):
+            if outcome is not None:
+                outcome.append(False)
             return device_v
         SHADOW_STATS.inc("mismatch:bsi")
+        if outcome is not None:
+            outcome.append(True)
         cur = obs.current_span()
         trace = getattr(getattr(cur, "trace", None), "trace_id", "-")
         obs.get_logger("executor").error(
@@ -1111,8 +1147,34 @@ class Executor:
         except Exception:  # noqa: BLE001 — no mesh constructed
             return False
 
+    @staticmethod
+    def _shape_sig(c) -> str:
+        """Structural plan signature for the flight recorder: call
+        names plus frame arguments, with row/column ids elided — two
+        queries differing only in ids aggregate as one shape. Memoized
+        on the Call (immutable after parse, like cache_key)."""
+        sig = c.__dict__.get("_shape_sig")
+        if sig is None:
+            try:
+                sig = _call_shape(c)
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                sig = c.name
+            c.__dict__["_shape_sig"] = sig
+        return sig
+
+    def _h2d_bytes(self) -> int:
+        """Cumulative mesh H2D staging bytes (0 without a manager) —
+        deltas attribute staging cost to the query that triggered it
+        (approximate under concurrency; it is an attribution
+        instrument, not an invoice)."""
+        stats = self.device_stats
+        return int(stats.get("h2d_bytes", 0)) if stats is not None else 0
+
     def _record_route(self, route: str, t0: float,
-                      tier: Optional[str] = None):
+                      tier: Optional[str] = None, call=None,
+                      staged_bytes: int = 0,
+                      shadow_checked: bool = False,
+                      shadow_mismatch: bool = False):
         self.route_stats.inc(f"count_{route}")
         # Tier split rides a parallel StatMap (route|tier) so the
         # legacy count_* keys — bench dumps, tests, dashboards — keep
@@ -1123,7 +1185,21 @@ class Executor:
         if h is None:
             # setdefault: two first-observers race benignly to one.
             h = self._route_hists.setdefault(route, obs.Histogram())
-        h.observe((time.monotonic() - t0) * 1e6)
+        lat_us = (time.monotonic() - t0) * 1e6
+        # Exemplar: with a trace active, its id rides into the latency
+        # bucket this observation lands in, so /metrics?exemplars=true
+        # links a burning p99 straight to /debug/traces/<id>. No trace
+        # = None = zero extra work in the histogram.
+        cur = obs.current_span()
+        trace = getattr(cur, "trace", None)
+        h.observe(lat_us, exemplar=getattr(trace, "trace_id", None))
+        if call is not None:
+            self.flight.record(self._shape_sig(call), route,
+                               tier or "local", lat_us,
+                               staged_bytes=staged_bytes,
+                               shadow_checked=shadow_checked,
+                               shadow_mismatch=shadow_mismatch,
+                               example=lambda: str(call))
 
     @property
     def route_latency_hists(self) -> dict:

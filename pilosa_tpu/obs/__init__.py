@@ -10,6 +10,8 @@ trace is a single ContextVar read returning a shared no-op object, and
 """
 
 from .metrics import Histogram, StatMap
+from . import fleet
+from . import flight
 from . import log
 from . import profile
 from . import prom
@@ -34,6 +36,8 @@ __all__ = [
     "Trace",
     "Tracer",
     "current_span",
+    "fleet",
+    "flight",
     "get_logger",
     "jax_scope",
     "log",

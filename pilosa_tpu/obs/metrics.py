@@ -11,7 +11,8 @@ lock held for nanoseconds, and percentiles are derived on snapshot
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterable, List, Optional
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 _NBUCKETS = 64
 
@@ -24,9 +25,16 @@ class Histogram:
     [2^(b-1), 2^b). Percentiles interpolate linearly inside the
     bucket and clamp to the observed min/max, so a histogram fed a
     single repeated value reports that exact value at every quantile.
+
+    An observation may carry an exemplar (a trace id): the histogram
+    keeps the latest exemplar per bucket — (trace_id, value, wall ts) —
+    so exporters can link a percentile bucket to a concrete trace.
+    Exemplar storage is lazy: histograms that never see one pay a
+    single `is None` check per observe.
     """
 
-    __slots__ = ("_mu", "counts", "total", "sum", "min", "max")
+    __slots__ = ("_mu", "counts", "total", "sum", "min", "max",
+                 "_exemplars")
 
     def __init__(self):
         self._mu = threading.Lock()
@@ -35,8 +43,10 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._exemplars: Optional[
+            Dict[int, Tuple[str, float, float]]] = None
 
-    def observe(self, value) -> None:
+    def observe(self, value, exemplar: Optional[str] = None) -> None:
         v = float(value)
         if v < 0:
             v = 0.0
@@ -51,6 +61,16 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[b] = (exemplar, v, time.time())
+
+    def exemplar_snapshot(self) -> Dict[int, Tuple[str, float, float]]:
+        """bucket -> (trace_id, value, wall ts) under the lock; empty
+        when no observation ever carried an exemplar."""
+        with self._mu:
+            return dict(self._exemplars) if self._exemplars else {}
 
     def percentile(self, q: float) -> float:
         """Value at quantile q in [0, 1], linearly interpolated within
@@ -146,3 +166,12 @@ class StatMap(dict):
     def copy(self) -> dict:
         with self._mu:
             return dict(self)
+
+
+# Process-wide bytes moved across locality tiers, keyed by tier name
+# ("ici" for descriptor-plane broadcasts over the device fabric, "http"
+# for node-to-node HTTP bodies). Lives here — the lowest obs layer — so
+# both parallel/spmd.py and api/client.py can increment it without an
+# import cycle; the handler exports it as
+# pilosa_tier_bytes_total{tier=...}.
+TIER_BYTES = StatMap()

@@ -90,7 +90,12 @@ def format_labels(labels: Iterable[Tuple[str, str]]) -> str:
 class MetricFamily:
     """One family: name + type + help + samples. Samples carry an
     optional name suffix so histogram expansions (`_bucket`, `_sum`,
-    `_count`) stay inside their family block, as the format requires."""
+    `_count`) stay inside their family block, as the format requires.
+
+    A sample may additionally carry an exemplar — (trace_id, value,
+    wall ts) — as a fourth tuple slot; exemplars are only emitted when
+    rendering with ``exemplars=True`` (OpenMetrics syntax), so default
+    scrapes stay plain text-format 0.0.4."""
 
     __slots__ = ("name", "mtype", "help", "samples")
 
@@ -98,8 +103,8 @@ class MetricFamily:
         self.name = sanitize_name(name)
         self.mtype = mtype  # "counter" | "gauge" | "histogram" | "untyped"
         self.help = help_text
-        # (suffix, ((label, value), ...), numeric)
-        self.samples: List[Tuple[str, tuple, float]] = []
+        # (suffix, ((label, value), ...), numeric[, exemplar])
+        self.samples: List[tuple] = []
 
     def add(self, value, labels: Optional[dict] = None,
             suffix: str = "") -> "MetricFamily":
@@ -110,8 +115,10 @@ class MetricFamily:
     def add_histogram(self, hist: Histogram,
                       labels: Optional[dict] = None) -> "MetricFamily":
         """Expand one log₂ Histogram into cumulative `le` buckets plus
-        `_sum`/`_count` under the given labels."""
+        `_sum`/`_count` under the given labels. Bucket exemplars (when
+        the histogram holds any) ride along on their bucket's line."""
         counts, total, total_sum = hist.bucket_snapshot()
+        exemplars = hist.exemplar_snapshot()
         base = tuple((labels or {}).items())
         top = 0
         for b, n in enumerate(counts):
@@ -120,28 +127,37 @@ class MetricFamily:
         cum = 0
         for b in range(top + 1):
             cum += counts[b]
-            self.samples.append(
-                ("_bucket", base + (("le", format_value(1 << b)),), cum))
+            key = ("_bucket", base + (("le", format_value(1 << b)),), cum)
+            ex = exemplars.get(b)
+            self.samples.append(key + (ex,) if ex is not None else key)
         self.samples.append(("_bucket", base + (("le", "+Inf"),), total))
         self.samples.append(("_sum", base, total_sum))
         self.samples.append(("_count", base, total))
         return self
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         lines = []
         if self.help:
             lines.append(f"# HELP {self.name} {escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} {self.mtype}")
-        for suffix, labels, value in self.samples:
-            lines.append(f"{self.name}{suffix}{format_labels(labels)} "
-                         f"{format_value(value)}")
+        for sample in self.samples:
+            suffix, labels, value = sample[:3]
+            line = (f"{self.name}{suffix}{format_labels(labels)} "
+                    f"{format_value(value)}")
+            if exemplars and len(sample) > 3 and sample[3] is not None:
+                tid, ev, ets = sample[3]
+                line += (f' # {{trace_id="{escape_label_value(tid)}"}} '
+                         f"{format_value(ev)} {ets:.3f}")
+            lines.append(line)
         return "\n".join(lines)
 
 
-def render(families: Iterable[MetricFamily]) -> str:
+def render(families: Iterable[MetricFamily],
+           exemplars: bool = False) -> str:
     """Full exposition text. Trailing newline per the spec; families
     render in the order given (stable output diffs cleanly)."""
-    return "\n".join(f.render() for f in families if f.samples) + "\n"
+    return "\n".join(f.render(exemplars=exemplars)
+                     for f in families if f.samples) + "\n"
 
 
 class _Series:
@@ -163,13 +179,13 @@ class _Series:
         with inst._mu:
             inst._series[self._key] = value
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         inst = self._inst
         with inst._mu:
             h = inst._series.get(self._key)
             if h is None:
                 h = inst._series[self._key] = Histogram()
-        h.observe(value)
+        h.observe(value, exemplar=exemplar)
 
 
 class _Instrument:
@@ -194,8 +210,8 @@ class _Instrument:
     def set(self, value):
         self.labels().set(value)
 
-    def observe(self, value):
-        self.labels().observe(value)
+    def observe(self, value, exemplar=None):
+        self.labels().observe(value, exemplar=exemplar)
 
     def collect(self) -> MetricFamily:
         fam = MetricFamily(self.name, self.kind, self.help)
@@ -257,8 +273,8 @@ class Registry:
                 continue
         return fams
 
-    def render(self) -> str:
-        return render(self.collect())
+    def render(self, exemplars: bool = False) -> str:
+        return render(self.collect(), exemplars=exemplars)
 
 
 def _tag_labels(tags: Iterable[str]) -> dict:
@@ -285,6 +301,8 @@ def expvar_families(stats, prefix: str = "pilosa_") -> List[MetricFamily]:
         return []
     values, sets, hists, kinds = structured()
 
+    help_text = ("Auto-exported from an ExpvarStats call site "
+                 "(also at /debug/vars).")
     fams: Dict[str, MetricFamily] = {}
     for (name, tags), v in sorted(values.items()):
         kind = kinds.get(name, "gauge")
@@ -293,13 +311,14 @@ def expvar_families(stats, prefix: str = "pilosa_") -> List[MetricFamily]:
             mname += "_total"
         fam = fams.get(mname)
         if fam is None:
-            fam = fams[mname] = MetricFamily(mname, kind)
+            fam = fams[mname] = MetricFamily(mname, kind, help_text)
         fam.add(v, _tag_labels(tags))
     for (name, tags), h in sorted(hists.items()):
         mname = prefix + sanitize_name(name)
         fam = fams.get(mname)
         if fam is None:
-            fam = fams[mname] = MetricFamily(mname, "histogram")
+            fam = fams[mname] = MetricFamily(mname, "histogram",
+                                             help_text)
         fam.add_histogram(h, _tag_labels(tags))
     # String sets export as info-style gauges: value 1, the string a
     # label — the only faithful mapping onto a numeric format.
@@ -307,7 +326,7 @@ def expvar_families(stats, prefix: str = "pilosa_") -> List[MetricFamily]:
         mname = prefix + sanitize_name(name) + "_info"
         fam = fams.get(mname)
         if fam is None:
-            fam = fams[mname] = MetricFamily(mname, "gauge")
+            fam = fams[mname] = MetricFamily(mname, "gauge", help_text)
         labels = _tag_labels(tags)
         labels["value"] = s
         fam.add(1, labels)
@@ -320,6 +339,9 @@ def statmap_families(stats: dict, prefix: str,
     per key. StatMaps mix counters and gauges; untyped-as-gauge keeps
     every scraper happy without guessing."""
     copy = stats.copy() if hasattr(stats, "copy") else dict(stats)
+    if not help_text:
+        help_text = (f"Auto-exported stat key from the "
+                     f"{prefix.rstrip('_')} store.")
     fams = []
     for k, v in sorted(copy.items()):
         if not isinstance(v, (int, float)):

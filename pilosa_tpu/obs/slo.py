@@ -291,6 +291,12 @@ class SLORecorder:
         # pilosa_query_outcome_total{outcome,tenant} family.
         self.outcome_totals: Dict[Tuple[str, str], int] = {}
         self._lat_threshold = float(self.objectives["p99_us"])
+        # Latest latency exemplar per (route, tenant) — (trace_id,
+        # latency_us, wall ts). Surfaced as the `exemplar` field on
+        # /debug/slo latency SLIs, so a p99 burn links straight to a
+        # resolvable /debug/traces/<id>.
+        self._lat_exemplars: Dict[Tuple[str, str],
+                                  Tuple[str, float, float]] = {}
 
     # -- hot path --------------------------------------------------------
 
@@ -301,9 +307,11 @@ class SLORecorder:
 
     def record(self, outcome: str, tenant: str = "default",
                latency_us: Optional[float] = None,
-               route: str = "query") -> None:
+               route: str = "query",
+               trace_id: Optional[str] = None) -> None:
         """One request outcome. `latency_us` only for served requests
-        (sheds and errors have no meaningful service latency)."""
+        (sheds and errors have no meaningful service latency);
+        `trace_id` rides along as the latency exemplar."""
         t = self.tenant_label(tenant)
         key = (route, t, outcome)
         lkey = (route, t)
@@ -313,6 +321,9 @@ class SLORecorder:
             under = latency_us <= self._lat_threshold
         with self._mu:
             self.outcome_totals[key] = self.outcome_totals.get(key, 0) + 1
+            if latency_us is not None and trace_id is not None:
+                self._lat_exemplars[lkey] = (trace_id, float(latency_us),
+                                             time.time())
             for _, ring in self._rings:
                 b = ring.current(now)
                 b.counts[key] = b.counts.get(key, 0) + 1
@@ -360,6 +371,7 @@ class SLORecorder:
         with self._mu:
             aggs = [(n, _aggregate(r.live(now))) for n, r in self._rings]
             totals = dict(self.outcome_totals)
+            exemplars = dict(self._lat_exemplars)
         windows = {}
         for name, agg in aggs:
             ev = evaluate(agg, self.objectives)
@@ -379,6 +391,13 @@ class SLORecorder:
                 if seen:
                     row["p50_us"] = log2_percentile(merged, 0.50)
                     row["p99_us"] = log2_percentile(merged, 0.99)
+                    best = None
+                    for (_, lt), ex in exemplars.items():
+                        if lt == t and (best is None or ex[2] > best[2]):
+                            best = ex
+                    if best is not None:
+                        row["exemplar"] = {"trace_id": best[0],
+                                           "latency_us": best[1]}
             windows[name] = {"requests": agg["total"],
                              "shed": agg["shed"],
                              "mismatch_growth": agg["mismatch_growth"],

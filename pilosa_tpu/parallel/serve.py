@@ -2182,7 +2182,10 @@ class MeshManager:
         try:
             return attempt()
         except DispatchGenMoved:
-            raise  # control flow, not a plan failure: no strike
+            # Control flow, not a plan failure: no strike. Counted so
+            # the retry-into-coalescing rate is visible at /metrics.
+            self.stats.inc("dispatch_gen_moved")
+            raise
         except Exception as e:  # noqa: BLE001 — classify then rethrow
             if not _is_resource_exhausted(e):
                 if note:

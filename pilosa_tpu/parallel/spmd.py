@@ -57,10 +57,14 @@ wiring the reference does at startup in server/server.go:107-192).
 from __future__ import annotations
 
 import json
+import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import Histogram, StatMap
+from ..obs.metrics import TIER_BYTES
 from .broadcast import Broadcaster
 
 # Fixed descriptor size: broadcast payloads must be identical shapes on
@@ -81,9 +85,47 @@ _OP_IMPORT = 7
 _OP_RCSRC = 8  # src / tanimoto row-count collectives (kind field)
 _OP_BSISUM = 9  # BSI plane-row count partials (psum collective)
 
+_OP_NAMES = {
+    _OP_COUNT: "count",
+    _OP_STOP: "stop",
+    _OP_ROWCOUNTS: "rowcounts",
+    _OP_WRITE: "write",
+    _OP_SCHEMA: "schema",
+    _OP_PQL: "pql",
+    _OP_IMPORT: "import",
+    _OP_RCSRC: "rcsrc",
+    _OP_BSISUM: "bsisum",
+}
+
+# Descriptor-plane telemetry, process-wide (one SpmdServer per process,
+# but module scope keeps the /metrics collector free of server plumbing):
+#   dispatch:<op>              descriptors executed, by op name
+#   veto:not_ready             gate vetoes — this rank had no program
+#   veto:format_disagreement   gate vetoes — ranks resolved different
+#                              programs / staged formats
+SPMD_STATS = StatMap()
+
+# Per-op descriptor wall time (resolve + gate + collective), µs.
+_OP_HISTS: dict = {}
+_OP_HISTS_MU = threading.Lock()
+
+
+def op_hist(op: str) -> Histogram:
+    h = _OP_HISTS.get(op)
+    if h is None:
+        with _OP_HISTS_MU:
+            h = _OP_HISTS.setdefault(op, Histogram())
+    return h
+
+
+def op_hist_snapshot() -> dict:
+    with _OP_HISTS_MU:
+        return dict(_OP_HISTS)
+
 
 def _encode(obj: dict) -> np.ndarray:
     raw = json.dumps(obj).encode()
+    TIER_BYTES.inc("ici", len(raw))
     if len(raw) > _DESC_BYTES:
         raise ValueError(f"descriptor too large: {len(raw)} bytes")
     buf = np.zeros(_DESC_BYTES, dtype=np.uint8)
@@ -176,11 +218,15 @@ class SpmdServer:
 
     def _run(self, desc: dict):
         """Execute one descriptor with the re-entrancy flag set."""
+        op = _OP_NAMES.get(desc.get("op"), "unknown")
+        SPMD_STATS.inc(f"dispatch:{op}")
+        t0 = time.monotonic()
         self._local.in_exec = True
         try:
             return self._dispatch(desc)
         finally:
             self._local.in_exec = False
+            op_hist(op).observe((time.monotonic() - t0) * 1e6)
 
     # -- rank 0 --------------------------------------------------------------
 
@@ -504,7 +550,18 @@ class SpmdServer:
         # older jax returns a 0-d array for a scalar single-process
         # allgather — normalize before indexing
         fps = np.atleast_1d(multihost_utils.process_allgather(fp))
-        return int(fp) != 0 and bool(np.all(fps == fps[0]))
+        # Veto accounting distinguishes the two skip causes: this rank
+        # (or a peer — every rank that gathered a 0 reports not_ready)
+        # had no program vs all ranks resolved programs that DISAGREE.
+        # The allgather above always runs regardless — the gate itself
+        # is a collective, and vetoing without it would desync ranks.
+        if int(fp) == 0 or not np.all(fps != 0):
+            SPMD_STATS.inc("veto:not_ready")
+            return False
+        if not np.all(fps == fps[0]):
+            SPMD_STATS.inc("veto:format_disagreement")
+            return False
+        return True
 
     def _execute_count(self, desc: dict) -> Optional[int]:
         """Resolve, AGREE on the program, then execute.

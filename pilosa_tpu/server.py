@@ -280,6 +280,12 @@ class Server:
         # Continuous-profiling cadence ([obs] profile-sample-rate;
         # 0 = only on explicit ?profile=true).
         self.handler.profile_sample_rate = self.config.profile_sample_rate
+        # Fleet pane scrape-round TTL ([obs] fleet-scrape-interval) and
+        # flight-recorder ring capacity ([obs] queryshape-ring).
+        self.handler.fleet_scrape_interval = (
+            self.config.fleet_scrape_interval)
+        self.executor.flight.ring = max(1, int(
+            self.config.queryshape_ring))
         # Adaptive query scheduler ([sched]): deadline-aware admission
         # (429 + Retry-After), adaptive batching window whose cohort
         # releases hint the mesh batch loop (executor.burst_hint), and

@@ -161,6 +161,124 @@ class TestLiveNode:
         res = json.loads(capsys.readouterr().out)
         assert res["op"] == "topn" and res["ops_per_sec"] > 0
 
+    def test_fleet_panel_live(self, node, capsys):
+        from pilosa_tpu.api import InternalClient
+
+        cli = InternalClient(node)
+        cli.create_index("i")
+        cli.create_frame("i", "f")
+        cli.execute_query(None, "i", "SetBit(rowID=1, frame=f, "
+                          "columnID=3)", [], remote=False)
+        cli.execute_query(None, "i", "Count(Bitmap(rowID=1, "
+                          "frame=f))", [], remote=False)
+        assert main(["fleet", "--host", node, "-n", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "pilosa-tpu fleet" in out
+        assert "members 1" in out and "healthy 1" in out
+        assert node in out and "tiers local:" in out
+
+
+class TestTopPercentileMerge:
+    """`pilosa-tpu top` percentile regression: a scrape whose histogram
+    family fans out over several label products (tenant, backend) must
+    SUM duplicate `le` buckets, not keep whichever series parsed last —
+    the pre-fix parser keyed on (name, labels) but the percentile fold
+    overwrote per-le instead of summing."""
+
+    SCRAPE = (
+        "# TYPE pilosa_query_phase_us histogram\n"
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="a",le="64"} 0\n'
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="a",le="256"} 10\n'
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="a",le="+Inf"} 10\n'
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="b",le="64"} 90\n'
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="b",le="256"} 90\n'
+        'pilosa_query_phase_us_bucket{phase="gather",tenant="b",le="+Inf"} 90\n'
+        'pilosa_query_phase_us_bucket{phase="plan",tenant="a",le="64"} 4\n'
+        'pilosa_query_phase_us_bucket{phase="plan",tenant="a",le="+Inf"} 4\n'
+    )
+
+    def test_mixed_label_percentiles_sum_per_le(self):
+        from pilosa_tpu.ctl.main import _hist_percentiles, _parse_prom
+
+        m = _parse_prom(self.SCRAPE)
+        p50, p95, p99, n = _hist_percentiles(
+            m, "pilosa_query_phase_us", {"phase": "gather"})
+        # 100 observations in all: 90 sit at le=64, 10 more by le=256.
+        assert n == 100
+        assert p50 == 64.0
+        assert p95 == 256.0
+        assert p99 == 256.0
+        # The phase filter still pins series: plan is its own family.
+        assert _hist_percentiles(
+            m, "pilosa_query_phase_us", {"phase": "plan"})[3] == 4
+
+    def test_duplicate_cumulative_lines_sum_in_parse(self):
+        from pilosa_tpu.ctl.main import _parse_prom
+
+        m = _parse_prom('x_total{t="1"} 2\nx_total{t="1"} 3\n'
+                        "a_gauge 5\na_gauge 7\n")
+        assert m[("x_total", (("t", "1"),))] == 5.0
+        assert m[("a_gauge", ())] == 7.0  # gauges: last wins
+
+
+class TestRenderFleet:
+    DOC = {
+        "members": 2, "scraped": 1, "healthy": 1,
+        "scrape_interval_s": 5.0, "requests_total": 120,
+        "phase_percentiles": {
+            "gather": {"p50_us": 64.0, "p95_us": 256.0,
+                       "p99_us": 256.0, "count": 100}},
+        "nodes": {
+            "10.0.0.1:10101": {
+                "state": "UP", "requests_total": 120,
+                "tiers": {"local": 100, "ici": 15, "http": 5},
+                "hints": {"backlog": 2},
+                "hbm": {"resident_bytes": 2 << 30,
+                        "budget_bytes": 4 << 30,
+                        "residency_ratio": 0.5},
+                "scrape_age_s": 12.0, "error": None},
+            "10.0.0.2:10101": {
+                "state": "DOWN", "tiers": None,
+                "scrape_age_s": None,
+                "error": "ConnectionError: down"},
+        },
+    }
+
+    def test_panel_rows(self):
+        from pilosa_tpu.ctl.main import render_fleet
+
+        out = render_fleet("10.0.0.1:10101", self.DOC)
+        assert "members 2   scraped 1   healthy 1" in out
+        assert "fleet requests 120" in out
+        assert "phase gather" in out and "n=100" in out
+        assert "tiers local:100/ici:15/http:5" in out
+        assert "hints backlog 2" in out
+        assert "2.0GiB/4.0GiB (50%)" in out
+        # 12 s old against a 5 s interval: flagged stale.
+        assert "STALE 12s" in out
+        assert "UNSCRAPED (ConnectionError: down)" in out
+
+    def test_fleet_qps_from_previous_snapshot(self):
+        from pilosa_tpu.ctl.main import render_fleet
+
+        prev = dict(self.DOC, requests_total=100)
+        out = render_fleet("h", self.DOC, prev=prev, dt=2.0)
+        assert "qps 10.0" in out
+
+
+def test_fleet_subcommand_parses():
+    from pilosa_tpu.ctl.main import cmd_fleet
+
+    ap = make_parser()
+    for cmd in ("fleet", "top"):
+        with pytest.raises(SystemExit) as e:
+            ap.parse_args([cmd, "--help"])
+        assert e.value.code == 0
+    args = ap.parse_args(["fleet", "--host", "h:1", "-n", "3",
+                          "--interval", "0.5"])
+    assert args.fn is cmd_fleet
+    assert args.n == 3 and args.interval == 0.5
+
 
 def test_server_command_full_binary(tmp_path):
     """Boot the real `server` subcommand as a child process, query it

@@ -1,0 +1,239 @@
+"""pilosa-tpu metrics lint — conventions gate for the /metrics surface.
+
+Builds an in-process node (Holder + Executor + Handler over a test
+cluster), drives representative traffic through every serving path the
+registry bridges, scrapes /metrics live, and asserts the exposition
+keeps its contract:
+
+  1. every family has HELP text — a metric nobody can read the meaning
+     of is a metric nobody can alert on;
+  2. conventional suffixes: counters end in `_total`, histograms carry
+     a unit (`_us` / `_microseconds` / `_seconds` / `_bytes`), gauges
+     never impersonate counters with a `_total` suffix, and nobody
+     sneaks in a nonstandard unit (`_ms`, `_msec`, `_millis`);
+  3. no unbounded label keys: every label key must come from the known
+     bounded vocabulary below — a new key (say, a query string or a
+     trace id used as a label) is a cardinality leak and fails the
+     lint until it is consciously added here;
+  4. per-family series-count ceiling (--max-series) as a tripwire for
+     label products that exploded.
+
+Run by CI against the live scrape (tier-1 workflow); also usable
+against a running node with --url, or a saved exposition with --file.
+Exit code 0 = clean, 1 = violations (listed one per line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Label keys with *bounded* cardinality by construction. Keys bounded
+# by config or membership (host, target, device, index, frame, tenant)
+# are included: their growth tracks operator action, not request
+# content. Anything outside this set fails the lint.
+ALLOWED_LABEL_KEYS = frozenset((
+    "le",            # histogram buckets (fixed log2 ladder)
+    "backend",       # serving routes (fixed set)
+    "tier",          # local | ici | http
+    "tenant",        # [sched] tenant-weights + default + other
+    "outcome",       # SLO outcome vocabulary
+    "route",         # SLO route vocabulary
+    "phase",         # profiler phase names (code-defined)
+    "mode",          # dispatch modes (code-defined)
+    "reason",        # fallback/veto/eviction reasons (code-defined)
+    "event",         # cache event names (code-defined)
+    "entry",         # compile entry points (code-defined)
+    "device",        # device ids (hardware-bounded)
+    "objective",     # SLO objectives (code-defined)
+    "window",        # SLO windows (code-defined)
+    "state",         # breaker/membership states (code-defined)
+    "level",         # write-consistency levels (code-defined)
+    "op",            # descriptor ops (code-defined)
+    "version",       # build info
+    "path",          # scheduler admission paths (code-defined)
+    "index",         # schema-bounded
+    "frame",         # schema-bounded
+    "view",          # schema-bounded (standard | bsi.<field>)
+    "slice",         # per-fragment expvar bridge (data-bounded)
+    "host",          # ring-membership-bounded
+    "target",        # hint targets (ring-membership-bounded)
+    "kind",          # stat kinds (code-defined)
+    "tag",           # expvar bare-tag bridge
+    "value",         # expvar string-set info bridge
+))
+
+# Suffixes that carry a recognized unit for histogram families.
+# `_size` is the dimensionless-count ladder (e.g. writes per WAL group
+# commit) — a real unit would be wrong there.
+HIST_UNIT_SUFFIXES = ("_us", "_microseconds", "_seconds", "_bytes",
+                      "_size")
+
+# Nonstandard unit suffixes nobody should introduce (the repo
+# standardized on µs for latency and raw bytes for sizes).
+BANNED_SUFFIXES = ("_ms", "_msec", "_millis", "_milliseconds",
+                   "_kb", "_mb", "_gb")
+
+
+def parse_exposition(text: str):
+    """(families, series) from Prometheus 0.0.4 text. `families` maps
+    name -> {"type": ..., "help": ...}; `series` maps family name ->
+    list of (sample name, label dict)."""
+    families: Dict[str, Dict[str, Optional[str]]] = {}
+    series: Dict[str, List[Tuple[str, dict]]] = {}
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    sample_re = re.compile(
+        r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+#.*)?$")
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            families.setdefault(name, {"type": None, "help": None})
+            families[name]["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            families.setdefault(name, {"type": None, "help": None})
+            families[name]["type"] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            continue
+        sname, rawlabels, _ = m.groups()
+        # Histogram expansions belong to their base family.
+        fname = sname
+        for suf in ("_bucket", "_sum", "_count"):
+            if sname.endswith(suf) and sname[: -len(suf)] in families:
+                fname = sname[: -len(suf)]
+                break
+        labels = dict(label_re.findall(rawlabels or ""))
+        series.setdefault(fname, []).append((sname, labels))
+    return families, series
+
+
+def lint(text: str, max_series: int = 500) -> List[str]:
+    """All convention violations in one exposition, one per entry."""
+    problems: List[str] = []
+    families, series = parse_exposition(text)
+    for name, meta in sorted(families.items()):
+        mtype = meta["type"]
+        if not meta["help"]:
+            problems.append(f"{name}: missing HELP text")
+        if mtype is None:
+            problems.append(f"{name}: missing TYPE line")
+        if mtype == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{name}: counter families must end in _total")
+        if mtype == "gauge" and name.endswith("_total"):
+            problems.append(
+                f"{name}: gauge with a counter's _total suffix")
+        if mtype == "histogram" and not name.endswith(
+                HIST_UNIT_SUFFIXES):
+            problems.append(
+                f"{name}: histogram lacks a unit suffix "
+                f"({'/'.join(HIST_UNIT_SUFFIXES)})")
+        for banned in BANNED_SUFFIXES:
+            if name.endswith(banned):
+                problems.append(
+                    f"{name}: nonstandard unit suffix {banned} "
+                    f"(standardize on _us / _seconds / _bytes)")
+        rows = series.get(name, [])
+        if len(rows) > max_series:
+            problems.append(
+                f"{name}: {len(rows)} series exceeds the "
+                f"--max-series ceiling of {max_series}")
+        seen_keys = set()
+        for _, labels in rows:
+            seen_keys.update(labels)
+        for key in sorted(seen_keys - ALLOWED_LABEL_KEYS):
+            problems.append(
+                f"{name}: label key {key!r} not in the bounded "
+                f"vocabulary (tools/metrics_lint.py "
+                f"ALLOWED_LABEL_KEYS)")
+    return problems
+
+
+def live_scrape() -> str:
+    """Build an in-process node, drive every bridged path once, and
+    return its /metrics text (exemplars on — the lint must hold for
+    the OpenMetrics variant too)."""
+    from pilosa_tpu.api import Handler
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel import new_test_cluster
+
+    with tempfile.TemporaryDirectory() as td:
+        holder = Holder(os.path.join(td, "data"))
+        holder.open()
+        try:
+            cluster = new_test_cluster(1)
+            ex = Executor(holder, host=cluster.nodes[0].host,
+                          cluster=cluster, use_device=False)
+            h = Handler(holder, ex, cluster=cluster,
+                        host=cluster.nodes[0].host)
+            assert h.handle("POST", "/index/i").status == 200
+            assert h.handle("POST", "/index/i/frame/f").status == 200
+            assert h.handle(
+                "POST", "/index/i/query",
+                body=b"SetBit(rowID=1, frame=f, columnID=5)",
+            ).status == 200
+            for _ in range(3):
+                assert h.handle(
+                    "POST", "/index/i/query",
+                    body=b"Count(Bitmap(rowID=1, frame=f))",
+                ).status == 200
+            assert h.handle("POST", "/index/i/query",
+                            body=b"TopN(frame=f, n=2)").status == 200
+            resp = h.handle("GET", "/metrics",
+                            params={"exemplars": "true"})
+            assert resp.status == 200
+            return resp.body.decode()
+        finally:
+            holder.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="metrics_lint",
+        description="lint a /metrics exposition for convention drift")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--url", help="scrape a running node's /metrics")
+    src.add_argument("--file", help="lint a saved exposition file")
+    ap.add_argument("--max-series", type=int, default=500,
+                    help="per-family series ceiling (default 500)")
+    args = ap.parse_args(argv)
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url, timeout=10) as resp:
+            text = resp.read().decode()
+    elif args.file:
+        with open(args.file) as f:
+            text = f.read()
+    else:
+        text = live_scrape()
+    problems = lint(text, max_series=args.max_series)
+    for p in problems:
+        print(p)
+    nfam = len(parse_exposition(text)[0])
+    if problems:
+        print(f"metrics lint: {len(problems)} violation(s) across "
+              f"{nfam} families", file=sys.stderr)
+        return 1
+    print(f"metrics lint: {nfam} families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
