@@ -61,6 +61,7 @@ All configs (written to BENCH_DETAILS.json), each with a host column:
 import itertools
 import json
 import os
+import random
 import threading
 import time
 
@@ -2488,6 +2489,215 @@ def main():
                 try:
                     s_.close()
                 except Exception:  # noqa: BLE001 — victim mid-restart
+                    pass
+
+    with section("follower_reads"):
+        # Read-path scale-out (ISSUE 18): bounded-staleness follower
+        # reads + the epoch-keyed result cache on a 3-node cluster at
+        # replica_n=3. Three headline rows: (1) read QPS of bounded
+        # reads spread over all three coordinators vs strict reads
+        # through one — the ≥2x scale-out claim; (2) zipf-stream
+        # result-cache hit rate vs its theoretical ceiling (−10pt
+        # margin); (3) the kill window — bounded reads stay 100%
+        # fully-available while strict reads degrade to partial until
+        # the breaker reroutes.
+        _progress("follower reads: 3-node bounded-staleness scale-out")
+        import tempfile as _tf4
+        import urllib.request as _ur4
+
+        from pilosa_tpu import SLICE_WIDTH as _FRSW
+        from pilosa_tpu.config import Config as _FRCfg
+        from pilosa_tpu.server import Server as _FRSrv
+
+        def _frfreeport():
+            import socket as _sk4
+            s_ = _sk4.socket()
+            s_.bind(("127.0.0.1", 0))
+            p_ = s_.getsockname()[1]
+            s_.close()
+            return p_
+
+        frhosts = [f"127.0.0.1:{_frfreeport()}" for _ in range(3)]
+        frcfgs = []
+        for i_, h_ in enumerate(frhosts):
+            c_ = _FRCfg()
+            c_.data_dir = _tf4.mkdtemp(prefix=f"bench_frd{i_}_")
+            c_.host = h_
+            c_.cluster_hosts = list(frhosts)
+            c_.replica_n = 3
+            c_.anti_entropy_interval = 3600
+            c_.polling_interval = 3600
+            c_.sched_enabled = False
+            frcfgs.append(c_)
+        frsrvs = [_FRSrv(c_) for c_ in frcfgs]
+        for s_ in frsrvs:
+            s_.open()
+        try:
+            def _frpost(host_, pql_, staleness_=False, partial_=False):
+                """-> (status, partial flag); transport failure = 599."""
+                path_ = "/index/fr/query" + (
+                    "?partial=true" if partial_ else "")
+                hdrs_ = ({"X-Pilosa-Staleness": "200ms"}
+                         if staleness_ else {})
+                req = _ur4.Request(f"http://{host_}{path_}",
+                                   data=pql_.encode(), headers=hdrs_,
+                                   method="POST")
+                try:
+                    with _ur4.urlopen(req, timeout=10) as r_:
+                        return r_.status, b'"partial": true' in r_.read()
+                except Exception:  # noqa: BLE001 — a 5xx outcome
+                    return 599, False
+
+            _ur4.urlopen(_ur4.Request(
+                f"http://{frhosts[0]}/index/fr", data=b"",
+                method="POST"), timeout=10).read()
+            _ur4.urlopen(_ur4.Request(
+                f"http://{frhosts[0]}/index/fr/frame/f", data=b"",
+                method="POST"), timeout=10).read()
+            # 16 rows across 3 slices, so every Count fans over three
+            # fragments — strict reads from one coordinator pay HTTP
+            # legs for the slices whose ring primary lives elsewhere.
+            n_rows_ = 16
+            seed_calls = []
+            for r_ in range(n_rows_):
+                for sl_ in range(3):
+                    seed_calls.append(
+                        f"SetBit(rowID={r_}, frame=f, "
+                        f"columnID={sl_ * _FRSW + r_})")
+            for k_ in range(0, len(seed_calls), 16):
+                st_, _pf = _frpost(frhosts[0],
+                                   "".join(seed_calls[k_:k_ + 16]))
+                assert st_ == 200
+
+            def _read_qps(seconds_, n_threads, pick_host, staleness_):
+                """Closed-loop reader herd; returns (ok/s, n_5xx).
+                Row ids rotate so consecutive requests differ."""
+                ok_ = [0] * n_threads
+                bad_ = [0] * n_threads
+                stop_ = time.perf_counter() + seconds_
+
+                def _rdr(ti_):
+                    j_ = ti_
+                    while time.perf_counter() < stop_:
+                        pql_ = (f"Count(Bitmap(rowID={j_ % n_rows_},"
+                                f" frame=f))")
+                        st2_, _p2 = _frpost(pick_host(j_), pql_,
+                                            staleness_=staleness_)
+                        if st2_ == 200:
+                            ok_[ti_] += 1
+                        else:
+                            bad_[ti_] += 1
+                        j_ += n_threads
+                    return None
+
+                ths_ = [threading.Thread(target=_rdr, args=(t_,))
+                        for t_ in range(n_threads)]
+                t0_ = time.perf_counter()
+                for th_ in ths_:
+                    th_.start()
+                for th_ in ths_:
+                    th_.join()
+                wall_ = time.perf_counter() - t0_
+                return sum(ok_) / wall_, sum(bad_)
+
+            # (1) strict through one coordinator vs bounded spread
+            # over all three (each node serves every slice locally
+            # under a staleness budget — no fan-out legs).
+            strict_qps, strict_bad = _read_qps(
+                2.0, 8, lambda j_: frhosts[0], False)
+            bounded_qps, bounded_bad = _read_qps(
+                2.0, 8, lambda j_: frhosts[j_ % 3], True)
+            assert strict_bad == 0 and bounded_bad == 0
+            speedup_ = bounded_qps / max(strict_qps, 1e-9)
+
+            # (2) zipf stream -> cache hit rate vs ceiling. Perfect-
+            # cache ceiling over the same deterministic stream: no
+            # writes interleave, so ceiling = 1 - distinct/total.
+            rc_ = frsrvs[0].executor.result_cache
+            hits0_ = rc_.stats.copy()
+            zrng_ = random.Random(18)
+            zn_ = 400
+            zrows_ = []
+            for _ in range(zn_):
+                # zipf-ish over 16 rows: P(r) ∝ 1/(r+1)^1.1
+                w_ = [1.0 / ((r_ + 1) ** 1.1) for r_ in range(n_rows_)]
+                tot_ = sum(w_)
+                x_ = zrng_.random() * tot_
+                acc_ = 0.0
+                for r_, wr_ in enumerate(w_):
+                    acc_ += wr_
+                    if x_ <= acc_:
+                        zrows_.append(r_)
+                        break
+                else:
+                    zrows_.append(n_rows_ - 1)
+            for r_ in zrows_:
+                st3_, _p3 = _frpost(
+                    frhosts[0],
+                    f"Count(Bitmap(rowID={r_}, frame=f))",
+                    staleness_=True)
+                assert st3_ == 200
+            hits1_ = rc_.stats.copy()
+            d_hit_ = hits1_.get("hit", 0) - hits0_.get("hit", 0)
+            d_miss_ = hits1_.get("miss", 0) - hits0_.get("miss", 0)
+            zhit_rate_ = d_hit_ / max(1, d_hit_ + d_miss_)
+            zceiling_ = 1.0 - len(set(zrows_)) / zn_
+            assert zhit_rate_ >= zceiling_ - 0.10, (
+                f"zipf cache hit rate {zhit_rate_:.3f} under ceiling "
+                f"{zceiling_:.3f} - 10pt")
+
+            # (3) the kill window: bounded reads never notice (every
+            # coordinator serves locally); strict reads degrade to
+            # partial until the breaker reroutes the dead legs.
+            frsrvs[2].close()
+            kw_bounded_full = kw_bounded_bad = 0
+            for j_ in range(100):
+                st4_, p4_ = _frpost(
+                    frhosts[0],
+                    f"Count(Bitmap(rowID={j_ % n_rows_}, frame=f))",
+                    staleness_=True, partial_=True)
+                if st4_ == 200 and not p4_:
+                    kw_bounded_full += 1
+                elif st4_ >= 500:
+                    kw_bounded_bad += 1
+            kw_strict_partial = kw_strict_bad = 0
+            for j_ in range(100):
+                st5_, p5_ = _frpost(
+                    frhosts[0],
+                    f"Count(Bitmap(rowID={j_ % n_rows_}, frame=f))",
+                    staleness_=False, partial_=True)
+                if st5_ == 200 and p5_:
+                    kw_strict_partial += 1
+                elif st5_ >= 500:
+                    kw_strict_bad += 1
+            # Bounded availability through the outage is total: every
+            # read full (not even partial), zero 5xx.
+            assert kw_bounded_full == 100 and kw_bounded_bad == 0, (
+                f"bounded reads through the kill window: "
+                f"{kw_bounded_full}/100 full, {kw_bounded_bad} 5xx")
+
+            assert speedup_ >= 2.0, (
+                f"bounded 3-coordinator read QPS {bounded_qps:.0f} "
+                f"is {speedup_:.2f}x strict {strict_qps:.0f} "
+                f"(< 2x scale-out bar)")
+            details["follower_reads"] = {
+                "nodes": 3, "replica_n": 3, "staleness_ms": 200,
+                "strict_1coord_qps": strict_qps,
+                "bounded_3coord_qps": bounded_qps,
+                "read_qps_speedup": speedup_,
+                "zipf_reads": zn_,
+                "zipf_hit_rate": zhit_rate_,
+                "zipf_hit_ceiling": zceiling_,
+                "kill_window_bounded_full": kw_bounded_full,
+                "kill_window_bounded_5xx": kw_bounded_bad,
+                "kill_window_strict_partial": kw_strict_partial,
+                "kill_window_strict_5xx": kw_strict_bad,
+                "result_cache": rc_.snapshot()}
+        finally:
+            for s_ in frsrvs:
+                try:
+                    s_.close()
+                except Exception:  # noqa: BLE001 — victim already closed
                     pass
 
     with section("sustained_ingest"):

@@ -478,6 +478,34 @@ class InternalClient:
                                 content_type="application/octet-stream")
         self._check(status, resp, "internal/message")
 
+    def epoch_digest(self) -> dict:
+        """GET /internal/epochs — the peer's replication-epoch digest:
+        {"host", "epochs": {fragment key -> epoch}, "queue_depth"}.
+        Raises ClientError on transport failure; an older peer without
+        the endpoint surfaces as a 404 ClientError the status-poll
+        caller tolerates."""
+        status, data = self._do("GET", "/internal/epochs")
+        self._check(status, data, "internal/epochs")
+        return json.loads(data.decode())
+
+    def advance_epochs(self, epochs: dict,
+                       deadline: Optional[float] = None) -> int:
+        """POST /internal/epochs/advance — floor-raise the peer's
+        fragment epochs to reconciled values (after hint replay /
+        anti-entropy convergence). Returns the number of fragments the
+        peer actually raised."""
+        body = json.dumps({"epochs": {str(k): int(v)
+                                      for k, v in epochs.items()}})
+        status, data = self._do("POST", "/internal/epochs/advance",
+                                body=body.encode(),
+                                content_type="application/json",
+                                deadline=deadline)
+        self._check(status, data, "internal/epochs/advance")
+        try:
+            return int(json.loads(data.decode()).get("applied", 0))
+        except ValueError:
+            return 0
+
     # -- anti-entropy plane --------------------------------------------------
 
     def fragment_blocks(self, index: str, frame: str, view: str,

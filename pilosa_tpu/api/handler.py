@@ -91,6 +91,28 @@ from ..wire import (
 
 VERSION = "0.1.0"
 
+
+def _parse_staleness(raw: str) -> float:
+    """X-Pilosa-Staleness / ?staleness= value in seconds: a bare
+    number is MILLISECONDS (the loadgen/client convention), anything
+    suffixed parses as a Go duration ("500ms", "2s"). Unparseable
+    values mean strict (0) — a read must never get LESS freshness
+    than it asked for because of a typo'd header."""
+    raw = raw.strip()
+    if not raw:
+        return 0.0
+    try:
+        return max(0.0, float(raw) / 1e3)
+    except ValueError:
+        pass
+    try:
+        from ..config import parse_duration
+
+        return max(0.0, parse_duration(raw))
+    except (ValueError, KeyError):
+        return 0.0
+
+
 _WEBUI_PAGE = """<!doctype html>
 <html><head><title>pilosa-tpu</title><style>
 body{font-family:monospace;margin:0;background:#fff;color:#222}
@@ -487,6 +509,15 @@ class Handler:
         # handlers, single-node).
         self.hints = None
         self.write_consistency = "quorum"
+        # Default bounded-staleness read budget in seconds ([cluster]
+        # default-read-staleness, server wiring): applied to
+        # coordinator queries that carry no X-Pilosa-Staleness header.
+        # 0 (the default) = strict owner-only reads everywhere.
+        self.default_read_staleness = 0.0
+        # Scheduler queue depth for the /internal/epochs digest (the
+        # p2c load signal peers spread reads by); server wiring points
+        # it at the query scheduler. None = report 0.
+        self.queue_depth_fn = None
         # SLO observatory (obs.slo.SLORecorder; [slo] config). Every
         # coordinator query outcome — success, partial, shed 429,
         # deadline 504, backpressure 503, other errors — is recorded
@@ -563,6 +594,9 @@ class Handler:
         r("GET", r"/debug/pprof/?", self._get_pprof)
         r("POST", r"/internal/message", self._post_internal_message)
         r("GET", r"/internal/status", self._get_internal_status)
+        r("GET", r"/internal/epochs", self._get_internal_epochs)
+        r("POST", r"/internal/epochs/advance",
+          self._post_internal_epochs_advance)
 
     def _add_route(self, method: str, pattern: str, fn: Callable):
         self._routes.append(Route(method, re.compile("^" + pattern + "$"), fn))
@@ -660,6 +694,7 @@ class Handler:
         reg.register_collector(self._collect_hints)
         reg.register_collector(self._collect_slo)
         reg.register_collector(self._collect_spmd)
+        reg.register_collector(self._collect_read_path)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
@@ -730,6 +765,48 @@ class Handler:
                 "pilosa_queryshape_evicted_total", "counter",
                 "Query shapes evicted from the flight recorder ring "
                 "(LRU).").add(st["evicted"]))
+        return fams
+
+    def _collect_read_path(self) -> list:
+        """Follower-read + result-cache telemetry (ISSUE 18): which
+        replica class served each slice pick, what the epoch-keyed
+        result cache did, and how many entries it holds."""
+        prom = obs.prom
+        fams: list = []
+        picks = getattr(self.executor, "read_stats", None)
+        if picks is not None:
+            snap = picks.copy()
+            if snap:
+                fam = prom.MetricFamily(
+                    "pilosa_read_replica_total", "counter",
+                    "Read-path slice placements by replica class "
+                    "(owner = the strict ring pick, follower = spread "
+                    "to an in-sync replica, fallback_owner = a "
+                    "bounded read with no eligible follower) and "
+                    "staleness class (strict = X-Pilosa-Staleness "
+                    "absent/0, bounded = a positive budget).")
+                for k, v in sorted(snap.items()):
+                    pick, _, sclass = k.partition("|")
+                    fam.add(v, {"replica": pick,
+                                "staleness": sclass or "strict"})
+                fams.append(fam)
+        rc = getattr(self.executor, "result_cache", None)
+        if rc is not None:
+            events = rc.stats.copy()
+            if events:
+                fam = prom.MetricFamily(
+                    "pilosa_result_cache_events_total", "counter",
+                    "Epoch-keyed result cache events: hit / miss / "
+                    "invalidate (an entry keyed to a superseded "
+                    "epoch) / evict (LRU) / bypass (strict or "
+                    "uncacheable query).")
+                for k, v in sorted(events.items()):
+                    fam.add(v, {"event": k})
+                fams.append(fam)
+            fams.append(prom.MetricFamily(
+                "pilosa_result_cache_entries", "gauge",
+                "Entries currently held by the epoch-keyed result "
+                "cache.").add(len(rc)))
         return fams
 
     def _get_debug_slo(self, pv, params, headers, body):
@@ -1399,6 +1476,21 @@ class Handler:
         # pilosa_hint_bytes grows (README runbook).
         if self.hints is not None:
             snap = dict(snap, hints=self.hints.snapshot())
+        # Read-path resilience state: what the epoch tracker knows
+        # about each peer's write progress, and the result cache's
+        # size + hit/miss/invalidation tallies.
+        tracker = getattr(self.executor, "epochs", None)
+        if tracker is not None:
+            try:
+                snap = dict(snap, epochs=tracker.snapshot())
+            except Exception:  # noqa: BLE001 — debug never 500s
+                pass
+        rc = getattr(self.executor, "result_cache", None)
+        if rc is not None:
+            try:
+                snap = dict(snap, result_cache=rc.snapshot())
+            except Exception:  # noqa: BLE001 — debug never 500s
+                pass
         return _json_resp(snap)
 
     def _get_debug_queries(self, pv, params, headers, body) -> Response:
@@ -2076,7 +2168,12 @@ class Handler:
         upstream coordinator hop) or the ?deadline= param (Go duration,
         e.g. "50ms"), falling back to the configured default for
         coordinator-side queries; ?partial=true opts into graceful
-        degradation (missing slices reported, not fatal)."""
+        degradation (missing slices reported, not fatal); read
+        staleness from X-Pilosa-Staleness / ?staleness= (bare number =
+        milliseconds, or a Go duration like "500ms"), falling back to
+        [cluster] default-read-staleness — 0 keeps strict owner-only
+        reads. Remote legs never re-apply a staleness spread: the
+        coordinator already picked their replica."""
         deadline = None
         hdr = headers.get("x-pilosa-deadline-us", "")
         if hdr:
@@ -2087,8 +2184,17 @@ class Handler:
             deadline = time.monotonic() + parse_duration(params["deadline"])
         elif not remote and self.default_deadline > 0:
             deadline = time.monotonic() + self.default_deadline
+        staleness = 0.0
+        if not remote:
+            raw = (headers.get("x-pilosa-staleness", "")
+                   or params.get("staleness", ""))
+            if raw:
+                staleness = _parse_staleness(raw)
+            else:
+                staleness = self.default_read_staleness
         return ExecOptions(remote=remote, deadline=deadline,
-                           partial=params.get("partial") == "true")
+                           partial=params.get("partial") == "true",
+                           staleness=staleness)
 
     def _run_query(self, index, query, slices, column_attrs, remote,
                    headers, opt=None, profile_section=False) -> Response:
@@ -2347,9 +2453,27 @@ class Handler:
                 except Exception as e:  # noqa: BLE001 — collected
                     failures.append((n.host, e))
 
+        # Post-apply epochs of the imported fragments: fed to the
+        # coordinator's tracker immediately (an import is a mutation
+        # seam that bypasses the executor write path) and carried on
+        # every hint so replay floor-raises the recovered replica.
+        epochs = {}
+        f = self.holder.frame(req.index, req.frame)
+        if f is not None:
+            tracker = getattr(self.executor, "epochs", None)
+            for vname, view in list(f.views.items()):
+                frag = view.fragments.get(req.slice)
+                if frag is not None and not frag._pending_load:
+                    key = (f"{req.index}/{req.frame}/{vname}"
+                           f"/{req.slice}")
+                    epochs[key] = frag.epoch
+                    if tracker is not None:
+                        tracker.observe_local(key, frag.epoch)
+
         for host in [n.host for n in down] + [h for h, _ in failures]:
             self.hints.enqueue_import(host, req.index, req.frame,
-                                      req.slice, rows, cols, ts)
+                                      req.slice, rows, cols, ts,
+                                      epochs=epochs)
         acked = 1 + len(live) - len(failures)
         if acked >= required:
             CONSISTENCY_STATS.inc(
@@ -2538,6 +2662,61 @@ class Handler:
             return _json_resp({"error": "status not supported"}, 501)
         status = self.status_handler.local_status()
         return _proto_resp(status)
+
+    def _get_internal_epochs(self, pv, params, headers, body) -> Response:
+        """Replication-epoch digest (ISSUE 18): this node's
+        (fragment -> epoch) map plus its scheduler queue depth. A JSON
+        side-channel on the status poll — the NodeStatus protobuf's
+        descriptor is baked, so the digest rides next to it rather
+        than inside it. Peers feed the answer to their EpochTracker
+        (observe_digest) to judge read-replica staleness in
+        writes-behind."""
+        depth = 0
+        if callable(self.queue_depth_fn):
+            try:
+                depth = int(self.queue_depth_fn())
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                depth = 0
+        return _json_resp({
+            "host": self.host,
+            "epochs": self.holder.fragment_epochs(),
+            "queue_depth": depth,
+        })
+
+    def _post_internal_epochs_advance(self, pv, params, headers,
+                                      body) -> Response:
+        """Floor-raise local fragment epochs to reconciled values
+        (hint-replay and anti-entropy push these after convergence so
+        a replica that applied writes out of band reports an epoch
+        comparable to its peers'). Raising is the ONLY direction:
+        advance_epoch is monotonic, and unknown fragments are skipped
+        — a floor push never creates state."""
+        try:
+            req = json.loads(body or b"{}")
+            epochs = req.get("epochs") or {}
+        except (ValueError, AttributeError):
+            return _json_resp({"error": "bad epoch advance body"}, 400)
+        applied = 0
+        for key, epoch in epochs.items():
+            parts = str(key).split("/")
+            if len(parts) != 4:
+                continue
+            try:
+                slice_ = int(parts[3])
+                epoch = int(epoch)
+            except ValueError:
+                continue
+            frag = self.holder.fragment(parts[0], parts[1], parts[2],
+                                        slice_)
+            if frag is None:
+                continue
+            try:
+                before = frag.epoch
+                if frag.advance_epoch(epoch) > before:
+                    applied += 1
+            except Exception:  # noqa: BLE001 — one bad fragment
+                continue       # must not fail the whole push
+        return _json_resp({"applied": applied})
 
 
 # ---- JSON encoding of results ----------------------------------------------

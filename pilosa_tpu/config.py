@@ -47,6 +47,16 @@ TOML schema:
     hint-drain-interval = "1s"  # drainer pacing; recovering targets
                                 # also wake it immediately via gossip/
                                 # status-poll/breaker-close notify
+    # -- read-path resilience (README "Read-path scale-out") --
+    default-read-staleness = "0ms"  # staleness bound for queries with
+                                # no X-Pilosa-Staleness header. 0 =
+                                # strict owner-only reads (reference
+                                # semantics); >0 lets eligible reads
+                                # spread over in-sync replicas and
+                                # enables the epoch-keyed result cache
+    result-cache-size = 4096    # coordinator result-cache entries,
+                                # keyed (plan signature, max fragment
+                                # epoch over touched slices)
 
     [anti-entropy]
     interval = "10m"
@@ -147,6 +157,10 @@ TOML schema:
     shadow-sample-1-in = 0      # recompute 1-in-N device Count/TopN
                                 # results through the host roaring fold
                                 # and compare; 0 = off
+    result-cache-verify-1-in = 16  # withhold + recompute every Nth
+                                # result-cache HIT; a divergence counts
+                                # a shadow mismatch and invalidates the
+                                # entry. 0 = off
 
     # -- declarative schema (optional) --
     # Indexes/frames/integer fields created at server open (idempotent:
@@ -343,6 +357,13 @@ class Config:
         self.write_consistency: str = "quorum"
         self.hint_max_bytes: int = 64 << 20
         self.hint_drain_interval: float = 1.0
+        # [cluster] read-path resilience: default staleness bound for
+        # queries without an X-Pilosa-Staleness header (0 = strict,
+        # owner-only reads — the reference semantics) and the
+        # epoch-keyed result-cache capacity (entries; 0/negative
+        # clamps to 1 at wiring).
+        self.default_read_staleness: float = 0.0
+        self.result_cache_size: int = 4096
         self.polling_interval: float = DEFAULT_POLLING_INTERVAL
         self.anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
         # [anti-entropy] — jitter spreads pass starts across nodes
@@ -431,6 +452,10 @@ class Config:
         self.integrity_scrub_interval: float = 600.0
         self.integrity_rate_limit: int = 16 << 20
         self.integrity_shadow_sample: int = 0
+        # Every Nth result-cache HIT is withheld and recomputed
+        # through the normal path; a divergence increments the shadow
+        # mismatch counter and invalidates the entry. 0 disables.
+        self.result_cache_verify_1_in: int = 16
         # [slo] — declared service objectives (obs/slo.py). The
         # availability/latency targets are percentages; shed-rate-max
         # is a fraction; correctness (zero shadow-mismatch growth) has
@@ -498,6 +523,11 @@ class Config:
                 cl["hint-drain-interval"])
         if "polling-interval" in cl:
             c.polling_interval = parse_duration(cl["polling-interval"])
+        if "default-read-staleness" in cl:
+            c.default_read_staleness = parse_duration(
+                cl["default-read-staleness"])
+        c.result_cache_size = int(cl.get("result-cache-size",
+                                         c.result_cache_size))
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             c.anti_entropy_interval = parse_duration(ae["interval"])
@@ -585,6 +615,8 @@ class Config:
                                             c.integrity_rate_limit))
         c.integrity_shadow_sample = int(it.get("shadow-sample-1-in",
                                                c.integrity_shadow_sample))
+        c.result_cache_verify_1_in = int(it.get(
+            "result-cache-verify-1-in", c.result_cache_verify_1_in))
         sl = data.get("slo", {})
         c.slo_enabled = bool(sl.get("enabled", c.slo_enabled))
         c.slo_availability = float(sl.get("availability",
@@ -698,6 +730,9 @@ class Config:
             f'hint-drain-interval = '
             f'"{int(self.hint_drain_interval * 1000)}ms"\n'
             f'polling-interval = "{int(self.polling_interval)}s"\n'
+            f'default-read-staleness = '
+            f'"{int(self.default_read_staleness * 1000)}ms"\n'
+            f"result-cache-size = {self.result_cache_size}\n"
             f"\n[anti-entropy]\n"
             f'interval = "{int(self.anti_entropy_interval)}s"\n'
             f'jitter = "{int(self.anti_entropy_jitter)}s"\n'
@@ -754,6 +789,8 @@ class Config:
             f'scrub-interval = "{int(self.integrity_scrub_interval)}s"\n'
             f"scrub-rate-limit-bytes = {self.integrity_rate_limit}\n"
             f"shadow-sample-1-in = {self.integrity_shadow_sample}\n"
+            f"result-cache-verify-1-in = "
+            f"{self.result_cache_verify_1_in}\n"
             f"\n[slo]\n"
             f"enabled = {'true' if self.slo_enabled else 'false'}\n"
             f"availability = {self.slo_availability}\n"

@@ -291,11 +291,85 @@ class Fragment:
         self._log_base = 0
         self._log_limit = 8192
 
+        # Replication epoch (ISSUE 18): a monotonic count of mutations
+        # applied to THIS replica of the fragment, comparable across
+        # replicas because every write fans out to all owners and each
+        # bumps once per op — a replica whose epoch trails the max is
+        # exactly that many writes behind. Durability rides a tiny
+        # sidecar file (`<path>.epoch`) holding a BASE such that
+        # epoch = base + op_n at load; the base is rewritten at the
+        # points where op_n's meaning changes (snapshot freeze, clean
+        # close, floor-raise). Crash windows can only OVER-state the
+        # reloaded epoch (the sidecar lands before the snapshot
+        # rename), never regress it — an overshoot merely invalidates
+        # caches early, a regression would serve stale ones.
+        self.epoch = 0
+        self._snap_epoch_base = 0
+
     # -- lifecycle ---------------------------------------------------------
 
     @property
     def cache_path(self) -> str:
         return self.path + ".cache"
+
+    @property
+    def epoch_path(self) -> str:
+        return self.path + ".epoch"
+
+    def _read_epoch_base(self) -> int:
+        """The persisted sidecar base (0 when absent/unreadable —
+        pre-epoch data starts counting from its parsed op count)."""
+        try:
+            with open(self.epoch_path, "rb") as f:
+                return max(0, int(f.read().decode().strip() or "0"))
+        except (OSError, ValueError):
+            return 0
+
+    def _write_epoch_base(self, base: int) -> None:
+        """Durably persist the sidecar base (tmp + fsync + rename, the
+        snapshot idiom — a torn sidecar must never parse as a smaller
+        number). Max-merged with the current sidecar: the base is
+        monotone over a fragment's life (epoch only grows, and op_n
+        never outruns the bumps it contributed), so taking the max
+        makes the snapshot worker and a concurrent floor-raise
+        commutative. Best-effort: a failed write only costs exactness
+        at the next load, and the load-time fallback over-states."""
+        base = max(int(base), self._read_epoch_base())
+        tmp = self.epoch_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(str(base).encode())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.epoch_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def advance_epoch(self, to: int) -> int:
+        """Floor-raise the replication epoch to at least `to` (anti-
+        entropy / hint-replay reconcile: a replica that converged by
+        block merge may have bumped fewer times than the origin —
+        equalizing the counters keeps cross-replica digests comparable).
+        Never regresses; persists the new base eagerly so a restart
+        cannot fall back below the reconciled floor. Returns the
+        resulting epoch."""
+        with self._mu:
+            self.ensure_loaded()
+            to = int(to)
+            if to <= self.epoch:
+                return self.epoch
+            delta = to - self.epoch
+            self.epoch = to
+            if self._snapshotting:
+                # The in-flight worker will persist _snap_epoch_base at
+                # rename; carry the raise so the reload can't fall
+                # below the reconciled floor.
+                self._snap_epoch_base += delta
+            self._write_epoch_base(self.epoch - self.op_n)
+            return self.epoch
 
     @_locked
     def open(self, lazy: bool = False):
@@ -403,6 +477,11 @@ class Fragment:
                 pass
             self._op_file = None
             raise
+        # Replication epoch restore: sidecar base + every op parsed
+        # beyond the snapshot region (side-WAL replay included — those
+        # ops bumped the epoch before the crash). Floor-merged with any
+        # in-memory value so a reload can only advance it.
+        self.epoch = max(self.epoch, self._read_epoch_base() + self.op_n)
 
     def _recover_corrupt(self, err: BaseException):
         """Corrupt-storage recovery: stream a verified replica copy
@@ -472,7 +551,12 @@ class Fragment:
                 os.replace(side_path, side_path + ".corrupt")
             except OSError:
                 pass
+        # Repaired state is at least as new as whatever the sidecar
+        # covered; the _mark_dirty reset below bumps once more so every
+        # epoch-keyed cache over this fragment invalidates.
+        self.epoch = max(self.epoch, self._read_epoch_base() + self.op_n)
         self._mark_dirty(None)  # device pools/caches rebuild from scratch
+        self._write_epoch_base(self.epoch - self.op_n)
         INTEGRITY_STATS.inc("repaired")
         log.warning("read-repair: %s (%s/%s/%d) restored from replica",
                     self.path, self.frame, self.view, self.slice)
@@ -543,6 +627,11 @@ class Fragment:
                 fcntl.flock(self._lock_file, fcntl.LOCK_UN)
                 self._lock_file.close()
                 self._lock_file = None
+            # Clean close: persist the exact epoch base (a loaded
+            # fragment only — an untouched lazy fragment has nothing
+            # truer than the sidecar already on disk).
+            if not self._pending_load:
+                self._write_epoch_base(self.epoch - self.op_n)
             # A reopened fragment must re-parse and re-attach the WAL —
             # a stale loaded flag would leave op_writer detached and
             # silently drop acked writes on the floor.
@@ -700,6 +789,7 @@ class Fragment:
 
     def _log_append(self, op: int, pos: int, churn: bool):
         self.generation += 1
+        self.epoch += 1
         MUTATION_EPOCH.bump()
         self._log.append((op, pos, churn))
         if len(self._log) > self._log_limit:
@@ -711,6 +801,7 @@ class Fragment:
         """Wholesale storage replacement (import, restore): consumers at
         any earlier generation must rebuild."""
         self.generation += 1
+        self.epoch += 1
         MUTATION_EPOCH.bump()
         self._log.clear()
         self._log_base = self.generation
@@ -838,6 +929,7 @@ class Fragment:
         a racing writer skews a counter by one, never tears)."""
         return {
             "op_n": self.op_n,
+            "epoch": self.epoch,
             "max_op_n": self.max_op_n,
             "pending_wal_ops": self._pending_wal_ops(),
             "snapshotting": self._snapshotting,
@@ -868,6 +960,10 @@ class Fragment:
         # main/side split is exactly at the freeze point.
         self._wal.retarget(self._side_file)
         self._snap_base_op_n = self.op_n
+        # Epoch base the landed snapshot will persist: everything up to
+        # the freeze is folded into the snapshot region, so on reload
+        # epoch = this base + the (side) ops parsed beyond it.
+        self._snap_epoch_base = self.epoch
         self._snapshotting = True
         self._snap_done = threading.Event()
         self._snap_thread = threading.Thread(
@@ -890,6 +986,12 @@ class Fragment:
                             kind="snapshot")
                 os.fsync(f.fileno())
             fault.point("storage.rename", path=self.path)
+            # Sidecar BEFORE the rename: a crash between the two leaves
+            # the new base paired with the OLD (op-richer) file, which
+            # can only over-state the reloaded epoch — the safe
+            # direction. The reverse order could pair the new snapshot
+            # (op_n reset) with the old base and regress it.
+            self._write_epoch_base(self._snap_epoch_base)
             os.replace(tmp, self.path)
         except BaseException as e:  # noqa: BLE001 — must reach _finish
             err = e

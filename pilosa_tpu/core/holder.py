@@ -148,6 +148,24 @@ class Holder:
                         out.append(state)
         return out
 
+    def fragment_epochs(self) -> Dict[str, int]:
+        """fragment key ("index/frame/view/slice") -> replication
+        epoch, for the GET /internal/epochs digest (ISSUE 18).
+        Lazily-opened fragments report their durable sidecar base
+        without forcing a parse — an understatement (WAL ops beyond
+        the base are invisible until load), which only makes this
+        replica look STALER than it is: safe direction."""
+        out: Dict[str, int] = {}
+        for iname, idx in sorted(self.indexes.items()):
+            for fname, frame in sorted(idx.frames.items()):
+                for vname, view in sorted(frame.views.items()):
+                    for slice_, frag in sorted(view.fragments.items()):
+                        e = (frag._read_epoch_base()
+                             if frag._pending_load else frag.epoch)
+                        if e:
+                            out[f"{iname}/{fname}/{vname}/{slice_}"] = e
+        return out
+
     def flush_caches(self):
         """Persist fragment count caches (holder.go:326-358)."""
         for idx in self.indexes.values():

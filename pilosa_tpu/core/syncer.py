@@ -122,13 +122,19 @@ class FragmentSyncer:
         # Block ids where any replica disagrees with local (either side
         # missing, or checksums differ).
         dirty = set()
-        for _, blocks in remote_sets:
+        converged = []  # peers whose block map matched local exactly
+        for host, blocks in remote_sets:
+            peer_dirty = False
             for bid, cs in blocks.items():
                 if local.get(bid) != cs:
                     dirty.add(bid)
+                    peer_dirty = True
             for bid, cs in local.items():
                 if blocks.get(bid) != cs:
                     dirty.add(bid)
+                    peer_dirty = True
+            if not peer_dirty:
+                converged.append(host)
 
         scanned = {bid for _, blocks in remote_sets for bid in blocks}
         scanned.update(local)
@@ -138,6 +144,39 @@ class FragmentSyncer:
             if self.closing.closed:
                 return
             self.sync_block(bid)
+        self._reconcile_epochs(converged)
+
+    def _reconcile_epochs(self, hosts: List[str]) -> None:
+        """Replication-epoch reconcile (read-repair raises the loser's
+        numbering to the winner's): replicas converge on CONTENT via
+        the merges above, but each node's fragment epoch is a local
+        counter — two bit-identical replicas can disagree on it, and
+        the coordinator's staleness judge fails closed on the lower
+        one forever. For every peer whose block map matched local
+        EXACTLY (checksum-proven identical — a peer that just took
+        diff pushes waits for the next pass, so an epoch never runs
+        ahead of the bits it vouches for), floor-raise its epoch to
+        ours. advance_epoch is monotone, so pushing to a peer that is
+        actually ahead is a no-op there."""
+        f = self.fragment
+        epoch = int(getattr(f, "epoch", 0) or 0)
+        if not epoch or not hosts:
+            return
+        key = f"{f.index}/{f.frame}/{f.view}/{f.slice}"
+        for host in hosts:
+            if self.closing.closed:
+                return
+            client = self.client_factory(host)
+            advance = getattr(client, "advance_epochs", None)
+            if advance is None:
+                continue  # test fakes / older peers: digest-only
+            try:
+                advance({key: epoch})
+                _count(self.stats, "syncer_epochs_reconciled")
+            except Exception as e:  # noqa: BLE001 — advisory; the
+                # digest stays conservative until a later pass.
+                self._log(f"sync {key}: epoch reconcile to {host} "
+                          f"failed: {e}")
 
     def sync_block(self, block_id: int):
         """Majority-merge one block and push diffs to remotes

@@ -44,8 +44,10 @@ from .parallel.cluster import (
     NODE_STATE_DOWN,
     NODE_STATE_UP,
     SERVING_STATES,
+    pick_read_replica,
     preferred_owner,
 )
+from .parallel.epochs import EpochTracker, ResultCache, fragment_key
 from .pql import Call, Query
 from . import SLICE_WIDTH
 from . import fault
@@ -118,10 +120,25 @@ class ExecOptions:
     failing the query with SliceUnavailableError."""
 
     def __init__(self, remote: bool = False,
-                 deadline: Optional[float] = None, partial: bool = False):
+                 deadline: Optional[float] = None, partial: bool = False,
+                 staleness: float = 0.0):
         self.remote = remote
         self.deadline = deadline
         self.partial = partial
+        # Bounded-staleness read budget in seconds (X-Pilosa-Staleness
+        # / [cluster] default-read-staleness): > 0 lets the placement
+        # layer spread eligible slices over in-sync replicas and the
+        # coordinator serve from the epoch-keyed result cache. 0 (the
+        # default) is a STRICT read — owner-only placement, no result
+        # cache — bit-for-bit the pre-ISSUE-18 path.
+        self.staleness = max(0.0, float(staleness))
+        # Breaker states snapshotted ONCE per query (satellite of
+        # ISSUE 18): every placement decision in this execution — the
+        # initial split and any failure re-split — sees the same
+        # breaker world, so a breaker flapping half-open mid-query
+        # can't flip the pick between legs. None until execute() fills
+        # it (or the client has no registry).
+        self.breaker_snapshot: Optional[dict] = None
         # Slices this query could not serve (partial mode only); the
         # handler surfaces them as {partial: true, missing_slices}.
         self.missing_slices: List[int] = []
@@ -291,6 +308,25 @@ class Executor:
 
         self.shadow_sample = 0
         self._shadow_counter = itertools.count()
+        # Read-path resilience plane (ISSUE 18): the replication-epoch
+        # tracker (what this coordinator knows about every replica's
+        # write progress) and the epoch-keyed whole-query result cache
+        # serving bounded-staleness repeats. Both live even on bare
+        # executors — they are cheap dicts — and the server wires
+        # their knobs ([cluster] result-cache-size, [integrity]
+        # result-cache-verify-1-in).
+        self.epochs = EpochTracker()
+        self.result_cache = ResultCache()
+        # Every Nth result-cache hit is recomputed and compared (the
+        # PR-10 shadow-verify discipline): a mismatch means an entry
+        # survived an epoch bump it should not have. 0 = off.
+        self.result_cache_verify_1_in = 16
+        self._rc_verify_counter = itertools.count(1)
+        # Read-replica pick counters, keyed "pick|staleness_class"
+        # (pick ∈ owner|follower|fallback_owner, class ∈
+        # strict|bounded) -> pilosa_read_replica_total{replica,
+        # staleness} at /metrics.
+        self.read_stats = obs.StatMap()
 
     def set_spmd(self, spmd):
         """Wire the SPMD descriptor plane (rank 0 of a multi-host
@@ -325,6 +361,16 @@ class Executor:
         # with the per-call plan bracket in _execute_count).
         with obs.profile.phase("plan"):
             opt = opt or ExecOptions()
+
+            # Snapshot breaker states once per query: placement (the
+            # initial split AND any failure re-split) must not re-read
+            # a registry a half-open probe is flapping mid-execution.
+            if opt.breaker_snapshot is None:
+                state = getattr(self.client, "breaker_state", None)
+                if callable(state) and self.cluster is not None:
+                    opt.breaker_snapshot = {
+                        n.host: state(n.host)
+                        for n in self.cluster.nodes}
 
             need = needs_slices(q.calls)
             # Built lazily on the first inverse call: most queries
@@ -624,6 +670,44 @@ class Executor:
                                        call=c)
                     return hit
 
+        # Epoch-keyed result cache (ISSUE 18): the clustered
+        # counterpart of the memo above. Serves BOUNDED reads only
+        # (X-Pilosa-Staleness > 0) on a multi-node cluster — strict
+        # reads bypass (counted), keeping their byte-identical
+        # owner-only path — keyed by (plan signature, slices, max
+        # fragment epoch over the touched slices), so any write this
+        # coordinator has observed to a touched slice produces a
+        # different key and the stale entry invalidates instead of
+        # serving. Every Nth hit is recomputed and compared (shadow
+        # verify) to prove epoch-freshness end to end.
+        rc = self.result_cache
+        rc_key = rc_epoch = rc_verify = None
+        if (rc is not None and not opt.remote and nodes
+                and len(nodes) > 1):
+            rck = c.cache_key()
+            if opt.staleness <= 0 or rck is None:
+                rc.bypass()
+            else:
+                rc_key = (index, rck, tuple(slices))
+                # Epoch read BEFORE the probe/compute (the memo's
+                # discipline): a write racing the fold bumps the max,
+                # so the entry stored below can never validate for a
+                # post-write read.
+                rc_epoch = self.epochs.max_epoch_slices(index, slices)
+                cached = rc.get(rc_key, rc_epoch)
+                if cached is not None:
+                    v1 = self.result_cache_verify_1_in
+                    if v1 and next(self._rc_verify_counter) % v1 == 0:
+                        rc_verify = cached  # recompute + compare below
+                    else:
+                        psp.tag(route="result-cache").finish()
+                        pph.stop()
+                        self._record_route(
+                            "result-cache", t0,
+                            tier=self._query_tier(opt, False),
+                            call=c, cache="hit")
+                        return cached
+
         # Lower the tree ONCE; every count engine shares it. The
         # per-slice CountPlan is only built if the mesh batch declines
         # (it compiles per-slice jits the batch path never uses).
@@ -731,10 +815,25 @@ class Executor:
                 # them, so the entry can never validate — stale results
                 # invalidate, they don't serve.
                 self._host_cache.query_put(qkey, qepoch, n, qsepoch, qtoken)
+        cache_tag = None
+        if rc_verify is not None:
+            # Shadow verify: the hit we withheld vs the fresh compute.
+            # A mismatch is an epoch-freshness bug — count it where
+            # the PR-10 machinery already alerts (pilosa_shadow_
+            # mismatch_total) and quarantine the entry.
+            cache_tag = "verify"
+            SHADOW_STATS.inc("checks:result-cache")
+            if int(rc_verify) != n:
+                SHADOW_STATS.inc("mismatch:result-cache")
+                rc.invalidate(rc_key)
+        elif rc_key is not None:
+            cache_tag = "miss"
+            rc.put(rc_key, rc_epoch, n)
         self._record_route(route, t0,
                            tier=self._query_tier(opt, route == "mesh"),
                            call=c,
-                           staged_bytes=max(0, self._h2d_bytes() - h2d0))
+                           staged_bytes=max(0, self._h2d_bytes() - h2d0),
+                           cache=cache_tag)
         return n
 
     # Above this fan-out, gathering (fragment, generation) pairs for
@@ -1174,7 +1273,8 @@ class Executor:
                       tier: Optional[str] = None, call=None,
                       staged_bytes: int = 0,
                       shadow_checked: bool = False,
-                      shadow_mismatch: bool = False):
+                      shadow_mismatch: bool = False,
+                      cache: Optional[str] = None):
         self.route_stats.inc(f"count_{route}")
         # Tier split rides a parallel StatMap (route|tier) so the
         # legacy count_* keys — bench dumps, tests, dashboards — keep
@@ -1199,6 +1299,7 @@ class Executor:
                                staged_bytes=staged_bytes,
                                shadow_checked=shadow_checked,
                                shadow_mismatch=shadow_mismatch,
+                               cache=cache,
                                example=lambda: str(call))
 
     @property
@@ -1273,18 +1374,19 @@ class Executor:
         return {
             "index": index,
             "slices": len(slices),
-            "calls": [self._explain_call(index, c, slices)
+            "calls": [self._explain_call(index, c, slices, opt)
                       for c in q.calls],
         }
 
-    def _explain_call(self, index: str, c: Call,
-                      slices: Sequence[int]) -> dict:
+    def _explain_call(self, index: str, c: Call, slices: Sequence[int],
+                      opt: Optional[ExecOptions] = None) -> dict:
         import json as _json
 
         info: dict = {"call": c.name}
         if c.name in _WRITE_CALLS:
             info["route"] = "write"
-            info["placement"] = self._explain_placement(index, slices)
+            info["placement"] = self._explain_placement(index, slices,
+                                                        opt)
             owners = (self.cluster.replica_n
                       if self.cluster is not None and self.cluster.nodes
                       else 1)
@@ -1297,7 +1399,8 @@ class Executor:
             }
             return info
         if c.name in _BSI_AGGREGATES:
-            return self._explain_bsi_aggregate(index, c, slices, info)
+            return self._explain_bsi_aggregate(index, c, slices, info,
+                                               opt)
         if c.name != "Count" or len(c.children) != 1:
             # Non-Count reads run the per-slice roaring map-reduce.
             info["route"] = "roaring"
@@ -1315,7 +1418,8 @@ class Executor:
                                    "planes": len(leaves)}
                     info["staging"] = self._explain_staging(
                         index, leaves, slices)
-            info["placement"] = self._explain_placement(index, slices)
+            info["placement"] = self._explain_placement(index, slices,
+                                                        opt)
             return info
 
         child = c.children[0]
@@ -1381,7 +1485,7 @@ class Executor:
                 index, leaves, shape, mgr)
         if lowerable:
             info["staging"] = self._explain_staging(index, leaves, slices)
-        info["placement"] = self._explain_placement(index, slices)
+        info["placement"] = self._explain_placement(index, slices, opt)
         return info
 
     @classmethod
@@ -1400,8 +1504,8 @@ class Executor:
         return None
 
     def _explain_bsi_aggregate(self, index: str, c: Call,
-                               slices: Sequence[int],
-                               info: dict) -> dict:
+                               slices: Sequence[int], info: dict,
+                               opt: Optional[ExecOptions] = None) -> dict:
         """Planned execution of Sum/Min/Max: which engine serves it,
         the plane count behind the field, and what a device dispatch
         would stage (every row of the bsi view)."""
@@ -1440,7 +1544,7 @@ class Executor:
         leaves = [(frame, schema.view, r, False)
                   for r in range(schema.row_count)]
         info["staging"] = self._explain_staging(index, leaves, slices)
-        info["placement"] = self._explain_placement(index, slices)
+        info["placement"] = self._explain_placement(index, slices, opt)
         return info
 
     @staticmethod
@@ -1560,15 +1664,19 @@ class Executor:
                 "sparse_density_threshold": threshold,
                 "views": views}
 
-    def _explain_placement(self, index: str,
-                           slices: Sequence[int]) -> dict:
+    def _explain_placement(self, index: str, slices: Sequence[int],
+                           opt: Optional[ExecOptions] = None) -> dict:
         """slice→owner picks as _slices_by_node would make them —
-        breaker/liveness-aware — plus each host's current breaker
+        breaker/liveness-aware, and follower-spread when the request
+        carries a staleness bound — plus each host's current breaker
         state, the locality tier of each pick (same-chip → same-pod-
         ICI → cross-node-HTTP), and the per-device group sizes one
         local mesh dispatch would shard the local+ici slices into.
         Slice lists are sampled (first 16) so a 960-slice explain
-        stays readable."""
+        stays readable. The follower p2c sample is seeded per explain
+        so the rendered picks are stable within one response."""
+        import random as _random
+
         from .parallel.cluster import owner_tier
 
         if self.cluster is None or not self.cluster.nodes:
@@ -1576,27 +1684,53 @@ class Executor:
                    "tier": "ici" if self._multi_device() else "local"}
             self._explain_device_groups(out, slices, len(slices))
             return out
-        state = self._breaker_callable()
+        state = self._breaker_callable(opt)
+        read_bound = (opt.staleness
+                      if opt is not None and not opt.remote else 0.0)
+        rnd = _random.Random(0)
         nodes = list(self.cluster.nodes)
         per_host: dict = {}
         unowned: list = []
         tiers = {"local": 0, "ici": 0, "http": 0}
+        read = {"staleness_s": read_bound, "followers": 0,
+                "fallback_owner": 0} if read_bound > 0 else None
         for slice_ in slices:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
                       if o in nodes]
             if not owners:
                 unowned.append(slice_)
                 continue
-            pick = preferred_owner(
-                owners, state,
-                prefer=self.host if self.prefer_local_reads else None,
-                ici_hosts=self.ici_hosts or None)
+            pick = None
+            role = "owner"
+            if read_bound > 0 and len(owners) > 1:
+                pick = pick_read_replica(
+                    owners, state,
+                    staleness_ok=lambda h, s=slice_:
+                        self.epochs.staleness_ok_slice(
+                            h, index, s, read_bound),
+                    queue_depth=self.epochs.queue_depth,
+                    prefer=self.host,
+                    ici_hosts=self.ici_hosts or None, rnd=rnd)
+                if pick is not None and pick.host != owners[0].host:
+                    role = "follower"
+                    read["followers"] += 1
+                elif pick is None:
+                    role = "fallback_owner"
+                    read["fallback_owner"] += 1
+            if pick is None:
+                pick = preferred_owner(
+                    owners, state,
+                    prefer=self.host if self.prefer_local_reads else None,
+                    ici_hosts=self.ici_hosts or None)
             tier = owner_tier(pick.host, self.host, self.ici_hosts)
             tiers[tier] += 1
             ent = per_host.setdefault(pick.host,
                                       {"slices": 0, "sample": [],
                                        "tier": tier})
             ent["slices"] += 1
+            if read is not None:
+                ent.setdefault("roles", {})
+                ent["roles"][role] = ent["roles"].get(role, 0) + 1
             if len(ent["sample"]) < 16:
                 ent["sample"].append(slice_)
         out = {"mode": "cluster", "nodes": per_host, "tiers": tiers,
@@ -1604,6 +1738,8 @@ class Executor:
                         else "ici" if tiers["ici"] or (
                             tiers["local"] and self._multi_device())
                         else "local")}
+        if read is not None:
+            out["read"] = read
         self._explain_device_groups(out, slices,
                                     tiers["local"] + tiers["ici"])
         if unowned:
@@ -2234,6 +2370,8 @@ class Executor:
             for _ in locals_:
                 if local_fn():
                     ret = True
+            if locals_:
+                self._observe_write_epochs(index, c, slice_)
             return ret
 
         level = self.write_consistency
@@ -2263,6 +2401,9 @@ class Executor:
             if local_fn():
                 ret = True
             acked += 1
+        wrote_epochs: dict = {}
+        if locals_:
+            wrote_epochs = self._observe_write_epochs(index, c, slice_)
 
         q = Query(calls=[c])
         futures = [
@@ -2290,7 +2431,7 @@ class Executor:
         pql = str(q)
         missed = [n.host for n in down] + [h for h, _ in failures]
         for host in missed:
-            hints.enqueue_query(host, index, pql)
+            hints.enqueue_query(host, index, pql, epochs=wrote_epochs)
 
         if acked >= required:
             CONSISTENCY_STATS.inc(
@@ -2302,6 +2443,33 @@ class Executor:
             f"replica acks ({len(failures)} failed mid-write; misses "
             f"journaled as hints)",
             level=level, required=required, acked=acked)
+
+    def _observe_write_epochs(self, index: str, c: Call,
+                              slice_: int) -> dict:
+        """Feed the epoch tracker the post-apply epochs of every
+        fragment a local mutation touched (the write fans out to one
+        frame, but a SetBit may land in standard + inverse + time
+        views): the coordinator's freshness bar advances at WRITE
+        time, not at the next digest poll, so a follower missing this
+        write ages from now. Returns the observed (key -> epoch) map —
+        the write path carries it on hints so replay can floor-raise
+        the recovered replica to the origin's numbering."""
+        out: dict = {}
+        tracker = self.epochs
+        if tracker is None:
+            return out
+        frame = c.args.get("frame")
+        f = self.holder.frame(index, frame if isinstance(frame, str)
+                              and frame else DEFAULT_FRAME)
+        if f is None:
+            return out
+        for vname, view in list(f.views.items()):
+            frag = view.fragments.get(slice_)
+            if frag is not None and not frag._pending_load:
+                key = fragment_key(index, f.name, vname, slice_)
+                tracker.observe_local(key, frag.epoch)
+                out[key] = frag.epoch
+        return out
 
     def _fragment_nodes(self, index: str, slice_: int):
         if self.cluster is None or not self.cluster.nodes:
@@ -2467,9 +2635,14 @@ class Executor:
                 # remaining budget in /debug/queries.
                 sp.tag(deadline_left_us=int(left * 1e6))
 
-    def _breaker_callable(self):
-        """The injected client's breaker_state(host) callable, or None
+    def _breaker_callable(self, opt: Optional[ExecOptions] = None):
+        """The per-query breaker snapshot when `opt` carries one
+        (execute() filled it — stable across re-splits), else the
+        injected client's live breaker_state(host) callable, or None
         when it has no breaker registry (test fakes, single client)."""
+        if opt is not None and opt.breaker_snapshot is not None:
+            snap = opt.breaker_snapshot
+            return lambda host: snap.get(host, "closed")
         state = getattr(self.client, "breaker_state", None)
         return state if callable(state) else None
 
@@ -2490,6 +2663,13 @@ class Executor:
             # e.g. a re-split that excluded this node: don't route an
             # ICI peer's slices back into the excluded local group.
             local_node = None
+        breaker = self._breaker_callable(opt)
+        # Bounded-staleness reads (X-Pilosa-Staleness > 0) spread over
+        # every in-sync replica; strict reads (the default) and remote
+        # legs keep the owner-only pick bit-for-bit.
+        read_bound = (opt.staleness
+                      if opt is not None and not opt.remote else 0.0)
+        sclass = "bounded" if read_bound > 0 else "strict"
         m = {}
         for slice_ in slices:
             owners = [o for o in self.cluster.fragment_nodes(index, slice_)
@@ -2507,15 +2687,42 @@ class Executor:
                 owners = serving
             elif not owners:
                 raise SliceUnavailableError()
-            # Prefer replicas the status-poll daemon currently sees UP
-            # AND whose circuit breaker is closed; a slice whose owners
-            # are all marked DOWN/open still tries one (liveness is
-            # advisory — the reactive re-split below is the authority,
-            # executor.go:1140-1151).
-            pick = preferred_owner(
-                owners, self._breaker_callable(),
-                prefer=self.host if self.prefer_local_reads else None,
-                ici_hosts=self.ici_hosts or None)
+            # Bounded reads first try the follower-spread ladder:
+            # pick_read_replica over in-sync replicas (breaker-closed,
+            # epoch staleness within the client's bound, p2c by
+            # gossiped queue depth). An empty candidate set falls DOWN
+            # the ladder to the strict owner pick — never sideways to
+            # a staler replica — and the fallback is counted.
+            pick = None
+            if read_bound > 0 and len(owners) > 1:
+                pick = pick_read_replica(
+                    owners, breaker,
+                    staleness_ok=lambda h, s=slice_:
+                        self.epochs.staleness_ok_slice(
+                            h, index, s, read_bound),
+                    queue_depth=self.epochs.queue_depth,
+                    prefer=self.host,
+                    ici_hosts=self.ici_hosts or None)
+            if pick is not None:
+                # "follower" = spread away from the ring primary
+                # (owners[0] is ring order) — the label that proves
+                # replicas actually absorb read load.
+                self.read_stats.inc(
+                    ("follower|" if pick.host != owners[0].host
+                     else "owner|") + sclass)
+            else:
+                self.read_stats.inc(
+                    ("fallback_owner|" if read_bound > 0
+                     and len(owners) > 1 else "owner|") + sclass)
+                # Prefer replicas the status-poll daemon currently
+                # sees UP AND whose circuit breaker is closed; a slice
+                # whose owners are all marked DOWN/open still tries
+                # one (liveness is advisory — the reactive re-split
+                # below is the authority, executor.go:1140-1151).
+                pick = preferred_owner(
+                    owners, breaker,
+                    prefer=self.host if self.prefer_local_reads else None,
+                    ici_hosts=self.ici_hosts or None)
             if (local_node is not None and pick.host != self.host
                     and pick.host in self.ici_hosts):
                 # ICI-tier slice: serve it from the local mesh dispatch

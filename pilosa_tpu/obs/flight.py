@@ -36,14 +36,17 @@ SORTS = ("cost", "p99", "routed_host", "count")
 
 
 class _Shape:
-    __slots__ = ("count", "routes", "tiers", "hist", "staged_bytes",
-                 "shadow_checks", "shadow_mismatches", "first_seen",
-                 "last_seen", "example")
+    __slots__ = ("count", "routes", "tiers", "cache", "hist",
+                 "staged_bytes", "shadow_checks", "shadow_mismatches",
+                 "first_seen", "last_seen", "example")
 
     def __init__(self):
         self.count = 0
         self.routes: dict = {}
         self.tiers: dict = {}
+        # Result-cache interactions per shape (hit / miss / verify):
+        # which shapes actually amortize through the epoch-keyed cache.
+        self.cache: dict = {}
         self.hist = Histogram()
         self.staged_bytes = 0
         self.shadow_checks = 0
@@ -67,6 +70,7 @@ class FlightRecorder:
                latency_us: float, staged_bytes: int = 0,
                shadow_checked: bool = False,
                shadow_mismatch: bool = False,
+               cache: Optional[str] = None,
                example=None) -> None:
         """One served query of shape `sig`. `example` (the query text,
         or a zero-arg callable producing it — only invoked on the FIRST
@@ -89,6 +93,8 @@ class FlightRecorder:
             sh.routes[route] = sh.routes.get(route, 0) + 1
             sh.tiers[tier] = sh.tiers.get(tier, 0) + 1
             sh.staged_bytes += int(staged_bytes)
+            if cache is not None:
+                sh.cache[cache] = sh.cache.get(cache, 0) + 1
             if shadow_checked:
                 sh.shadow_checks += 1
             if shadow_mismatch:
@@ -128,6 +134,7 @@ class FlightRecorder:
                 "count": sh.count,
                 "routes": dict(sorted(sh.routes.items())),
                 "tiers": dict(sorted(sh.tiers.items())),
+                "cache": dict(sorted(sh.cache.items())),
                 "p50_us": round(sh.hist.percentile(0.50), 1),
                 "p99_us": round(sh.hist.percentile(0.99), 1),
                 "total_us": round(lat_sum, 1),

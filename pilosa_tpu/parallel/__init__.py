@@ -18,6 +18,7 @@ from .broadcast import (
     StaticNodeSet,
 )
 from .gossip import GossipNodeSet
+from .epochs import EpochTracker, ResultCache, fragment_key
 from .cluster import (
     DEFAULT_PARTITION_N,
     DEFAULT_REPLICA_N,
@@ -120,4 +121,7 @@ __all__ = [
     "SERVING_STATES",
     "Rebalancer",
     "Transfer",
+    "EpochTracker",
+    "ResultCache",
+    "fragment_key",
 ]

@@ -13,6 +13,7 @@ the device-sharded path.
 
 from __future__ import annotations
 
+import random
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -383,6 +384,58 @@ def preferred_owner(owners: List[Node], breaker_state=None,
         return pick(up)
     leaving = [o for o in owners if o.state == NODE_STATE_LEAVING]
     return pick(leaving or owners)
+
+
+def pick_read_replica(owners: List[Node], breaker_state=None,
+                      staleness_ok=None, queue_depth=None,
+                      prefer: Optional[str] = None,
+                      ici_hosts=None, rnd=None) -> Optional[Node]:
+    """Bounded-staleness read placement (ISSUE 18): spread an eligible
+    read over EVERY in-sync replica instead of pinning it to
+    `preferred_owner`'s deterministic pick. Eligibility is strict —
+    ACTIVE, breaker closed, and `staleness_ok(host) -> bool` (the
+    EpochTracker's writes-behind check) — because this path trades
+    freshness for throughput only within the client's stated bound;
+    anything weaker falls back to the owner ladder, never sideways to
+    a staler replica.
+
+    Among eligible replicas: a locally-held replica always wins (free
+    is better than balanced), then power-of-two-choices by gossiped
+    `queue_depth(host) -> int`, with ICI locality as the tie-break —
+    p2c gives near-best-of-N load spreading from two samples without
+    herding every coordinator onto the same momentarily-idle replica
+    the way full-min selection would.
+
+    Returns None when no replica is eligible; the caller falls back to
+    `preferred_owner` (strict semantics) and counts the fallback."""
+    up = [o for o in owners if o.state == NODE_STATE_UP]
+    cands = up
+    if breaker_state is not None:
+        cands = [o for o in cands if breaker_state(o.host) == "closed"]
+    if staleness_ok is not None:
+        cands = [o for o in cands
+                 if o.host == prefer or staleness_ok(o.host)]
+    if not cands:
+        return None
+    if prefer is not None:
+        for o in cands:
+            if o.host == prefer:
+                return o
+    if len(cands) == 1:
+        return cands[0]
+    if rnd is None:
+        rnd = random
+    a, b = rnd.sample(cands, 2)
+    qd = queue_depth or (lambda _h: 0)
+    da, db = qd(a.host), qd(b.host)
+    if da != db:
+        return a if da < db else b
+    if ici_hosts:
+        if a.host in ici_hosts and b.host not in ici_hosts:
+            return a
+        if b.host in ici_hosts and a.host not in ici_hosts:
+            return b
+    return a
 
 
 def new_test_cluster(n: int) -> Cluster:

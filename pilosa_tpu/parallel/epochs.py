@@ -1,0 +1,297 @@
+"""Replication-epoch tracking + the epoch-keyed result cache (ISSUE 18).
+
+Every fragment carries a monotonic mutation epoch (core/fragment.py):
+one bump per applied op, floor-raised by anti-entropy and hint replay
+so the counters stay comparable across replicas. This module is the
+COORDINATOR side of that story:
+
+  - `EpochTracker` aggregates what this node knows about every
+    replica's epochs — its own holder's live fragments, the write
+    fan-out it coordinates, and the `(fragment -> epoch, queue_depth)`
+    digests peers serve at GET /internal/epochs (pulled on the status
+    poll, piggybacked on gossip). A replica's staleness is measured in
+    WRITES-BEHIND (its epoch vs the max known), mapped to wall-clock
+    through the tracker's first-seen history: the age of the oldest
+    write a replica is missing is the time since this node first
+    learned of the epoch past it.
+
+  - `ResultCache` is the coordinator-level LRU keyed by
+    `(plan signature, slices, max fragment epoch over touched slices)`
+    — the clustered generalization of the executor's single-node memo
+    (parallel/plan.HostQueryCache): entries never revalidate, they are
+    keyed to an epoch and a newer epoch is simply a different key, so
+    stale results invalidate instead of serving.
+
+Staleness semantics (documented in README "Read-path scale-out"): a
+bound of X means "reads reflect every write this coordinator has known
+about for at least X" — knowledge arrives at local apply / write
+fan-out instantly and at digest cadence for writes coordinated
+elsewhere. The conservative fallbacks below (history exhausted, digest
+missing) all fail CLOSED: an ineligible replica costs a hop up the
+ladder, a wrongly-eligible one would serve stale data.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import StatMap
+
+# Per-key history ring: (epoch, first-seen monotonic time) pairs. 256
+# entries cover a deep backlog; anything deeper falls back to
+# "unknown-old", which is ineligible (fail closed).
+HISTORY_MAX = 256
+
+DEFAULT_RESULT_CACHE_SIZE = 4096
+
+
+def fragment_key(index: str, frame: str, view: str, slice_: int) -> str:
+    """Canonical digest key for one fragment replica."""
+    return f"{index}/{frame}/{view}/{slice_}"
+
+
+class EpochTracker:
+    """What this coordinator knows about every replica's write
+    progress. Thread-safe; all methods are cheap dict work (the write
+    path calls observe_local per coordinated op)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # key -> max epoch known from ANY source (the freshness bar).
+        self._max: Dict[str, int] = {}
+        # key -> deque[(epoch, first_seen_monotonic)] appended when the
+        # known max advances; key -> highest epoch dropped off the ring
+        # (staleness older than the ring is "unknown-old" = ineligible).
+        self._history: Dict[str, deque] = {}
+        self._dropped: Dict[str, int] = {}
+        # host -> (epochs dict, queue_depth, received_monotonic).
+        self._digests: Dict[str, Tuple[Dict[str, int], int, float]] = {}
+        # (index, slice) -> set of full keys: the placement layer and
+        # the result cache ask questions per SLICE (they don't know
+        # which frames a plan touches yet), so keep a secondary index
+        # instead of scanning every key per query.
+        self._slice_keys: Dict[Tuple[str, int], set] = {}
+
+    # -- feeds ---------------------------------------------------------------
+
+    def observe_local(self, key: str, epoch: int,
+                      now: Optional[float] = None) -> None:
+        """A write this node applied or coordinated (fan-out ack), or a
+        local fragment's live epoch: the known max advances NOW."""
+        with self._mu:
+            self._note_locked(key, int(epoch),
+                              time.monotonic() if now is None else now)
+
+    def observe_digest(self, host: str, epochs: Dict[str, int],
+                       queue_depth: int = 0,
+                       now: Optional[float] = None) -> None:
+        """A peer's GET /internal/epochs answer (status poll / gossip)."""
+        t = time.monotonic() if now is None else now
+        epochs = {str(k): int(v) for k, v in (epochs or {}).items()}
+        with self._mu:
+            self._digests[host] = (epochs, int(queue_depth), t)
+            for k, e in epochs.items():
+                self._note_locked(k, e, t)
+
+    def forget_host(self, host: str) -> None:
+        with self._mu:
+            self._digests.pop(host, None)
+
+    def _note_locked(self, key: str, epoch: int, now: float) -> None:
+        if epoch <= self._max.get(key, 0):
+            return
+        if key not in self._max:
+            parts = key.split("/")
+            if len(parts) == 4:
+                try:
+                    sk = (parts[0], int(parts[3]))
+                except ValueError:
+                    sk = None
+                if sk is not None:
+                    self._slice_keys.setdefault(sk, set()).add(key)
+        self._max[key] = epoch
+        h = self._history.get(key)
+        if h is None:
+            h = self._history[key] = deque()
+        h.append((epoch, now))
+        while len(h) > HISTORY_MAX:
+            dropped_epoch, _ = h.popleft()
+            if dropped_epoch > self._dropped.get(key, 0):
+                self._dropped[key] = dropped_epoch
+
+    # -- reads ---------------------------------------------------------------
+
+    def max_epoch(self, key: str) -> int:
+        with self._mu:
+            return self._max.get(key, 0)
+
+    def max_epoch_many(self, keys) -> int:
+        """Max known epoch over a set of fragment keys (the result
+        cache's epoch component: any touched fragment advancing busts
+        the entry)."""
+        with self._mu:
+            return max((self._max.get(k, 0) for k in keys), default=0)
+
+    def host_epoch(self, host: str, key: str) -> int:
+        with self._mu:
+            d = self._digests.get(host)
+            return d[0].get(key, 0) if d else 0
+
+    def queue_depth(self, host: str) -> int:
+        with self._mu:
+            d = self._digests.get(host)
+            return d[1] if d else 0
+
+    def digest_age(self, host: str) -> Optional[float]:
+        with self._mu:
+            d = self._digests.get(host)
+            return None if d is None else time.monotonic() - d[2]
+
+    def max_epoch_slices(self, index: str, slices) -> int:
+        """Max known epoch over every tracked fragment of (index,
+        slice) for slice in slices — the result cache's epoch token.
+        Conservative across frames on purpose: a write to ANY frame of
+        a touched slice busts entries for plans over that slice."""
+        with self._mu:
+            best = 0
+            for s in slices:
+                for k in self._slice_keys.get((index, int(s)), ()):
+                    e = self._max.get(k, 0)
+                    if e > best:
+                        best = e
+            return best
+
+    def staleness_ok(self, host: str, keys, bound_s: float,
+                     now: Optional[float] = None) -> bool:
+        """Is `host` an eligible bounded-staleness read target for the
+        fragments in `keys`? True when, for every key, the host is
+        fully caught up OR the oldest write it is missing is younger
+        than `bound_s`. Fails closed: no digest from the host, or a
+        backlog deeper than the history ring, is ineligible."""
+        t = time.monotonic() if now is None else now
+        with self._mu:
+            return self._staleness_ok_locked(host, keys, bound_s, t)
+
+    def staleness_ok_slice(self, host: str, index: str, slice_: int,
+                           bound_s: float,
+                           now: Optional[float] = None) -> bool:
+        """staleness_ok over every tracked fragment of one (index,
+        slice) — the per-slice question `pick_read_replica` asks (the
+        placement layer doesn't know which frames the plan touches, so
+        it requires the replica fresh-enough on ALL of them)."""
+        t = time.monotonic() if now is None else now
+        with self._mu:
+            keys = self._slice_keys.get((index, int(slice_)), ())
+            return self._staleness_ok_locked(host, keys, bound_s, t)
+
+    def _staleness_ok_locked(self, host: str, keys, bound_s: float,
+                             t: float) -> bool:
+        d = self._digests.get(host)
+        if d is None:
+            return False
+        host_epochs = d[0]
+        for key in keys:
+            known = self._max.get(key, 0)
+            if known <= 0:
+                continue  # no known writes: nothing to miss
+            he = host_epochs.get(key, 0)
+            if he >= known:
+                continue  # fully caught up on this fragment
+            if he < self._dropped.get(key, 0):
+                return False  # older than the ring remembers
+            # First history entry past the host's epoch = when this
+            # node learned of the oldest write the host is missing.
+            first_seen = None
+            for epoch, seen in self._history.get(key, ()):
+                if epoch > he:
+                    first_seen = seen
+                    break
+            if first_seen is None or (t - first_seen) > bound_s:
+                return False
+        return True
+
+    def snapshot(self) -> dict:
+        """/debug/vars `epochs` section."""
+        with self._mu:
+            return {
+                "tracked_fragments": len(self._max),
+                "peers": {
+                    h: {"fragments": len(d[0]), "queue_depth": d[1],
+                        "age_s": round(time.monotonic() - d[2], 3)}
+                    for h, d in self._digests.items()
+                },
+            }
+
+
+class ResultCache:
+    """Coordinator-level LRU of whole-query results keyed by
+    (plan signature + slices, epoch). Invalidation IS the key: the
+    caller computes `epoch` as the max fragment epoch over every slice
+    the plan touches (EpochTracker.max_epoch_many), so any observed
+    write produces a different key and the old entry dies by LRU or by
+    the explicit same-plan invalidate below. Events are counted for
+    pilosa_result_cache_events_total{event}."""
+
+    def __init__(self, cap: int = DEFAULT_RESULT_CACHE_SIZE):
+        self.cap = max(1, int(cap))
+        self._mu = threading.Lock()
+        # base_key -> (epoch, value)
+        self._entries: "OrderedDict[tuple, Tuple[int, object]]" = \
+            OrderedDict()
+        self.stats = StatMap()
+
+    def get(self, base_key: tuple, epoch: int):
+        """The cached value for this plan at exactly `epoch`, or None.
+        A surviving entry keyed to an OLDER epoch is dropped and
+        counted as an invalidation (the write that advanced the epoch
+        is what killed it)."""
+        with self._mu:
+            ent = self._entries.get(base_key)
+            if ent is None:
+                self.stats.inc("miss")
+                return None
+            if ent[0] != epoch:
+                del self._entries[base_key]
+                self.stats.inc("invalidate")
+                self.stats.inc("miss")
+                return None
+            self._entries.move_to_end(base_key)
+            self.stats.inc("hit")
+            return ent[1]
+
+    def put(self, base_key: tuple, epoch: int, value) -> None:
+        with self._mu:
+            self._entries[base_key] = (int(epoch), value)
+            self._entries.move_to_end(base_key)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+                self.stats.inc("evict")
+
+    def invalidate(self, base_key: tuple) -> None:
+        """Drop one entry (shadow-verify mismatch quarantine)."""
+        with self._mu:
+            if self._entries.pop(base_key, None) is not None:
+                self.stats.inc("invalidate")
+
+    def bypass(self) -> None:
+        """A query that consulted the cache but was ineligible (strict
+        read, non-cacheable plan) — counted so hit-rate math has a
+        denominator that covers the whole read stream."""
+        self.stats.inc("bypass")
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        s = self.stats.copy()
+        with self._mu:
+            size = len(self._entries)
+        hits = s.get("hit", 0)
+        misses = s.get("miss", 0)
+        return {"size": size, "cap": self.cap,
+                "hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else None, **s}

@@ -86,13 +86,22 @@ class GossipNodeSet(NodeSet, Broadcaster):
                  probe_interval: float = 1.0, probe_timeout: float = 0.5,
                  suspicion_mult: float = 4.0, push_pull_interval: float = 30.0,
                  gossip_fanout: int = 3, indirect_n: int = 2,
-                 retransmit_mult: int = 4, logger=None):
+                 retransmit_mult: int = 4, logger=None,
+                 epoch_digest_fn=None, on_epoch_digest=None):
         self.local_host = local_host
         self.bind = bind
         self.seeds = list(seeds)
         self.broadcast_handler = broadcast_handler
         self.status_handler = status_handler
         self.on_change = on_change
+        # Replication-epoch digest piggyback (ISSUE 18): the push-pull
+        # state exchange carries this node's (fragment -> epoch,
+        # queue_depth) digest so follower-read eligibility converges
+        # at gossip cadence too, not just on the HTTP status poll.
+        # epoch_digest_fn() -> {"epochs": {...}, "queue_depth": n};
+        # on_epoch_digest(host, digest) feeds the EpochTracker.
+        self.epoch_digest_fn = epoch_digest_fn
+        self.on_epoch_digest = on_epoch_digest
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.suspicion_mult = suspicion_mult
@@ -564,9 +573,16 @@ class GossipNodeSet(NodeSet, Broadcaster):
                 status = self.status_handler.local_status().SerializeToString()
             except Exception:  # noqa: BLE001 — status is best-effort
                 pass
-        return json.dumps({"members": members,
-                           "status": base64.b64encode(status).decode()}
-                          ).encode()
+        out = {"members": members,
+               "status": base64.b64encode(status).decode()}
+        if self.epoch_digest_fn is not None:
+            try:
+                digest = dict(self.epoch_digest_fn() or {})
+                digest["host"] = self.local_host
+                out["epochs"] = digest
+            except Exception:  # noqa: BLE001 — digest is best-effort
+                pass
+        return json.dumps(out).encode()
 
     def _merge_remote_state(self, payload: bytes):
         """MergeRemoteState (gossip.go:206-222)."""
@@ -583,6 +599,14 @@ class GossipNodeSet(NodeSet, Broadcaster):
             ns = pb.NodeStatus()
             ns.ParseFromString(status)
             self.status_handler.handle_remote_status(ns)
+        digest = state.get("epochs")
+        if digest and self.on_epoch_digest is not None:
+            host = digest.get("host", "")
+            if host and host != self.local_host:
+                try:
+                    self.on_epoch_digest(host, digest)
+                except Exception:  # noqa: BLE001 — digest is best-effort
+                    pass
 
     def _log(self, msg: str):
         if self.logger is not None:

@@ -340,21 +340,35 @@ class HintManager:
                     logger=self.logger)
             return log
 
-    def enqueue_query(self, host: str, index: str, pql: str) -> None:
+    def enqueue_query(self, host: str, index: str, pql: str,
+                      epochs: Optional[dict] = None) -> None:
         """Journal a missed PQL write for `host` (SetBit/ClearBit/
         attr broadcasts all travel as their canonical serialization,
-        the same encoding the live fan-out uses)."""
-        self._log_for(host).append({
-            "kind": "query", "host": host, "index": index, "pql": pql})
+        the same encoding the live fan-out uses). `epochs` (fragment
+        key -> the origin's post-apply epoch) rides along so replay
+        can floor-raise the target's fragment epochs to the origin's
+        numbering — without it the recovered replica replays the ops
+        but its epoch digest stays incomparable to the origin's."""
+        payload = {"kind": "query", "host": host, "index": index,
+                   "pql": pql}
+        if epochs:
+            payload["epochs"] = {str(k): int(v)
+                                 for k, v in epochs.items()}
+        self._log_for(host).append(payload)
 
     def enqueue_import(self, host: str, index: str, frame: str,
-                       slice_: int, rows, cols, ts=None) -> None:
-        self._log_for(host).append({
+                       slice_: int, rows, cols, ts=None,
+                       epochs: Optional[dict] = None) -> None:
+        payload = {
             "kind": "import", "host": host, "index": index,
             "frame": frame, "slice": int(slice_),
             "rows": [int(r) for r in rows],
             "cols": [int(c) for c in cols],
-            "ts": [int(t) for t in ts] if ts else None})
+            "ts": [int(t) for t in ts] if ts else None}
+        if epochs:
+            payload["epochs"] = {str(k): int(v)
+                                 for k, v in epochs.items()}
+        self._log_for(host).append(payload)
 
     def notify(self, host: str) -> None:
         """A target announced readiness (gossip alive, status-poll
@@ -440,6 +454,20 @@ class HintManager:
                                remote=True)
         else:
             raise ValueError(f"unknown hint kind: {kind!r}")
+        epochs = payload.get("epochs")
+        if epochs:
+            # Floor-raise AFTER the ops landed (advance-then-crash
+            # would over-state the target's freshness). Advisory: a
+            # peer without the endpoint, or a transient failure here,
+            # only delays digest convergence to the next anti-entropy
+            # reconcile — never worth failing an already-applied
+            # replay over.
+            advance = getattr(client, "advance_epochs", None)
+            if advance is not None:
+                try:
+                    advance(epochs)
+                except Exception:  # noqa: BLE001 — advisory
+                    pass
 
     # -- introspection -------------------------------------------------------
 

@@ -171,7 +171,9 @@ class Server:
                 local_host=self.host, bind=bind_ip,
                 gossip_port=self.config.gossip_port, seeds=seeds,
                 broadcast_handler=self, status_handler=self,
-                on_change=self._set_live_hosts, logger=self.logger)
+                on_change=self._set_live_hosts, logger=self.logger,
+                epoch_digest_fn=self._local_epoch_digest,
+                on_epoch_digest=self._handle_epoch_digest)
             self.broadcaster = self.node_set
         elif ctype == "http" and len(self.config.cluster_hosts) > 1:
             self.node_set = StaticNodeSet(self.config.cluster_hosts)
@@ -286,6 +288,18 @@ class Server:
             self.config.fleet_scrape_interval)
         self.executor.flight.ring = max(1, int(
             self.config.queryshape_ring))
+        # Read-path resilience (ISSUE 18): bounded-staleness follower
+        # reads + the epoch-keyed result cache. default-read-staleness
+        # applies to queries without an X-Pilosa-Staleness header
+        # (0 = strict everywhere); the cache cap and shadow-verify
+        # cadence are operator knobs because the cache trades memory
+        # for zipf-head throughput.
+        self.handler.default_read_staleness = (
+            self.config.default_read_staleness)
+        self.executor.result_cache.cap = max(
+            1, int(self.config.result_cache_size))
+        self.executor.result_cache_verify_1_in = (
+            self.config.result_cache_verify_1_in)
         # Adaptive query scheduler ([sched]): deadline-aware admission
         # (429 + Retry-After), adaptive batching window whose cohort
         # releases hint the mesh batch loop (executor.burst_hint), and
@@ -305,6 +319,13 @@ class Server:
                 estimator=self.executor.estimate_service_us,
                 on_release=self.executor.burst_hint)
             self.handler.scheduler = self.scheduler
+            # Gossiped load signal for follower-read p2c spreading:
+            # peers pull this node's queued+inflight depth with the
+            # epoch digest.
+            self.handler.queue_depth_fn = (
+                lambda: (lambda d: d.get("queued", 0)
+                         + d.get("inflight", 0))(
+                    self.scheduler.queue_depths()))
         # SLO observatory ([slo]): replace the handler's default
         # recorder with the config-declared objectives; tenant label
         # cardinality is bounded by the [sched] tenant-weights keys.
@@ -499,7 +520,22 @@ class Server:
         """Pull NodeStatus from every peer; merge schema/max-slices;
         track liveness. mark_live/mark_unreachable (not raw set_state)
         so a poll success can't stomp a JOINING/LEAVING node back to
-        ACTIVE mid-migration."""
+        ACTIVE mid-migration. The replication-epoch digest (ISSUE 18)
+        rides the same cadence: each reachable peer's
+        (fragment -> epoch, queue_depth) feeds the executor's
+        EpochTracker, which is what judges follower-read
+        eligibility."""
+        tracker = self.executor.epochs
+        # Refresh local knowledge first: mutation seams that don't
+        # pass through the coordinator write path (bulk imports,
+        # read-repair, hint replay INTO this node) advance fragment
+        # epochs the tracker must see — and invalidate result-cache
+        # entries keyed to the old max.
+        try:
+            tracker.observe_digest(self.host,
+                                   self.holder.fragment_epochs())
+        except Exception:  # noqa: BLE001 — telemetry never kills polls
+            pass
         for node in self.cluster.nodes:
             if node.host == self.host:
                 continue
@@ -507,6 +543,9 @@ class Server:
                 status = self.client.for_host(node.host).node_status()
             except Exception:  # noqa: BLE001 — unreachable peer
                 node.mark_unreachable()
+                # Fail closed: without a live digest the peer is not
+                # an eligible follower-read target.
+                tracker.forget_host(node.host)
                 continue
             was_down = node.state == NODE_STATE_DOWN
             node.mark_live()
@@ -515,6 +554,34 @@ class Server:
                 self.hints.notify(node.host)
             self._peer_status[node.host] = status
             self.handle_remote_status(status)
+            try:
+                digest = self.client.for_host(node.host).epoch_digest()
+                tracker.observe_digest(
+                    node.host, digest.get("epochs") or {},
+                    int(digest.get("queue_depth") or 0))
+            except Exception:  # noqa: BLE001 — older peer without the
+                pass           # endpoint: digest simply stays absent
+
+    def _local_epoch_digest(self) -> dict:
+        """This node's replication-epoch digest — the same document
+        GET /internal/epochs serves — for the gossip push-pull
+        piggyback."""
+        depth = 0
+        fn = self.handler.queue_depth_fn
+        if fn is not None:
+            try:
+                depth = int(fn())
+            except Exception:  # noqa: BLE001 — load signal only
+                depth = 0
+        return {"epochs": self.holder.fragment_epochs(),
+                "queue_depth": depth}
+
+    def _handle_epoch_digest(self, host: str, digest: dict) -> None:
+        """A peer's digest arrived over gossip push-pull: feed the
+        follower-read staleness judge."""
+        self.executor.epochs.observe_digest(
+            host, digest.get("epochs") or {},
+            int(digest.get("queue_depth") or 0))
 
     def _breaker_change(self, host: str, state: str):
         """Circuit-breaker liveness feedback (BreakerRegistry

@@ -83,7 +83,7 @@ def parse_mix(text: str) -> List[tuple]:
         name, _, w = item.partition("=")
         name = name.strip()
         if name not in ("read", "write", "topn", "range",
-                        "bsi_sum", "bsi_range"):
+                        "bsi_sum", "bsi_range", "zipf_read"):
             raise ValueError(f"unknown op {name!r} in mix")
         total += float(w)
         ops.append((name, total))
@@ -148,7 +148,11 @@ def build_schedule(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
         op = mix_ops[pick(rng, mix_cdf)]
         row = pick(rng, row_cdf)
         col = rng.randrange(cols)
-        if op == "read":
+        if op in ("read", "zipf_read"):
+            # zipf_read is the same Count shape, named so the
+            # follower-read verdict can compute its cache-hit ceiling
+            # over exactly the zipf-skewed read stream (the row pick
+            # is already zipfian for both).
             pql = f"Count(Bitmap(rowID={row}, frame={frame}))"
         elif op == "write":
             pql = f"SetBit(rowID={row}, frame={frame}, columnID={col})"
@@ -186,10 +190,11 @@ class HTTPTransport:
 
     def __init__(self, host: str, index: str = "loadgen",
                  timeout: float = 10.0, partial: bool = False,
-                 deadline: str = ""):
+                 deadline: str = "", staleness_ms: float = 0.0):
         self.base = host if "://" in host else "http://" + host
         self.index = index
         self.timeout = timeout
+        self.staleness_ms = float(staleness_ms)
         params = []
         if partial:
             params.append("partial=true")
@@ -201,11 +206,16 @@ class HTTPTransport:
     def do(self, entry: Dict[str, Any]) -> tuple:
         """-> (status, partial flag). Transport-level failure is 599 —
         counted as an error outcome, never an exception."""
+        headers = {"X-Pilosa-Tenant": entry["tenant"],
+                   "Content-Type": "text/plain"}
+        if self.staleness_ms > 0:
+            # Bounded-staleness reads: writes ignore the header, so it
+            # rides every request unconditionally.
+            headers["X-Pilosa-Staleness"] = f"{self.staleness_ms:g}ms"
         req = urllib.request.Request(
             self.base + self.query_path,
             data=entry["pql"].encode(),
-            headers={"X-Pilosa-Tenant": entry["tenant"],
-                     "Content-Type": "text/plain"},
+            headers=headers,
             method="POST")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -258,6 +268,19 @@ class StubTransport:
 
 
 # -- run + report ----------------------------------------------------------
+
+
+def _metric_value(metrics_text: str, prefix: str) -> float:
+    """Sum every sample whose name+labels start with `prefix` (e.g.
+    'pilosa_result_cache_events_total{event="hit"}')."""
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(prefix):
+            try:
+                total += float(line.rsplit(None, 1)[1])
+            except (ValueError, IndexError):
+                pass
+    return total
 
 
 def _mismatch_total(metrics_text: str) -> float:
@@ -348,12 +371,28 @@ def run(spec: Dict[str, Any], transport,
     # -- tally (run phase only; warmup requests were sent, not judged)
     phases = {e["i"]: e["phase"] for e in schedule}
     tenants_of = {e["i"]: e["tenant"] for e in schedule}
+    ops_of = {e["i"]: e["op"] for e in schedule}
+    times_of = {e["i"]: e["t"] for e in schedule}
     judged = [(i, st, p, dt) for i, st, p, dt in results
               if phases.get(i) == "run"]
     total = len(judged)
     by_outcome: Dict[str, int] = {}
     lat_by_tenant: Dict[str, List[float]] = {}
+    # Read-stream availability + a schedule-time decile timeline:
+    # the follower-read verdict gates on zero read 5xx during replica
+    # churn and on the tail-decile ok-rate recovering after restart.
+    read_total = read_5xx = 0
+    ok_by_decile = [0] * 10
+    total_by_decile = [0] * 10
+    warmup_off = float(spec.get("warmup", 0.0))
     for i, st, partial, dt in judged:
+        dec = min(9, max(0, int(
+            10.0 * (times_of.get(i, 0.0) - warmup_off) / duration)))
+        total_by_decile[dec] += 1
+        if ops_of.get(i) in ("read", "zipf_read"):
+            read_total += 1
+            if st >= 500:
+                read_5xx += 1
         if st == 429:
             oc = "shed"
         elif st == 504:
@@ -367,6 +406,7 @@ def run(spec: Dict[str, Any], transport,
         else:
             oc = "partial" if partial else "ok"
             lat_by_tenant.setdefault(tenants_of[i], []).append(dt * 1e6)
+            ok_by_decile[dec] += 1
         by_outcome[oc] = by_outcome.get(oc, 0) + 1
 
     good = sum(by_outcome.get(o, 0)
@@ -429,6 +469,10 @@ def run(spec: Dict[str, Any], transport,
         "outcomes": by_outcome,
         "shed_rate": round(shed / total, 6) if total else 0.0,
         "error_rate": round((total - good) / total, 6) if total else 0.0,
+        "read_total": read_total,
+        "read_5xx": read_5xx,
+        "ok_by_decile": ok_by_decile,
+        "total_by_decile": total_by_decile,
         "mismatch_growth": mm_growth,
         "per_tenant": per_tenant,
         "objectives": verdicts,
@@ -592,6 +636,106 @@ def _judge_write_churn(report: Dict[str, Any], servers, configs,
         f"-> {'OK' if ok else 'VIOLATED'}")
 
 
+def _judge_follower_reads(report: Dict[str, Any], transport,
+                          spec: Dict[str, Any], args, log) -> None:
+    """Post-run verdict for bounded-staleness runs (--staleness-ms>0):
+
+    - read availability: ZERO 5xx on the read stream — a bounded read
+      always has a ladder rung (fresher replica -> owner -> partial),
+      so a churned replica must never surface as a read error;
+    - staleness: the result-cache shadow-verify mismatch counter
+      (backend="result-cache") stays 0 — every served cache hit was
+      provably epoch-fresh;
+    - cache hit rate: against the zipf ceiling (1 - distinct/total
+      over the read stream) minus 10 points, gated only once the
+      cache saw enough traffic to judge;
+    - qps recovery (churn runs): the final schedule-decile ok-rate
+      recovers to >= --qps-recovery-min of the first decile's."""
+    # Theoretical hit ceiling: replay the deterministic schedule
+    # through a PERFECT epoch-keyed cache (infinite capacity, free
+    # lookups). A write advances some touched fragment's epoch, and a
+    # Count's cache key takes the max epoch over every slice it
+    # touches — so any write invalidates everything; zipf repeats
+    # between writes are the only possible hits. The real cache can
+    # only do worse (LRU bound, concurrency races), hence the −10pt
+    # margin on the gate.
+    cached: set = set()
+    possible_hits = read_n = 0
+    for e in build_schedule(spec):
+        if e["phase"] != "run":
+            continue
+        if e["op"] == "write":
+            cached.clear()
+        elif e["op"] in ("read", "zipf_read"):
+            read_n += 1
+            if e["pql"] in cached:
+                possible_hits += 1
+            else:
+                cached.add(e["pql"])
+    ceiling = possible_hits / read_n if read_n else 0.0
+
+    metrics = transport.get_text("/metrics")
+    hits = _metric_value(
+        metrics, 'pilosa_result_cache_events_total{event="hit"}')
+    misses = _metric_value(
+        metrics, 'pilosa_result_cache_events_total{event="miss"}')
+    probes = hits + misses
+    hit_rate = hits / probes if probes else 0.0
+    stale_served = _metric_value(
+        metrics, 'pilosa_shadow_mismatch_total{backend="result-cache"}')
+
+    read_5xx = int(report.get("read_5xx", 0))
+    report["follower_reads"] = {
+        "staleness_ms": spec.get("staleness_ms", 0.0),
+        "read_total": report.get("read_total", 0),
+        "read_5xx": read_5xx,
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_hit_rate": round(hit_rate, 4),
+        "zipf_hit_ceiling": round(ceiling, 4),
+        "stale_cache_serves": stale_served,
+    }
+
+    obj = report["objectives"]
+    obj["read_availability"] = {
+        "target": 0, "measured": read_5xx,
+        "verdict": "OK" if read_5xx == 0 else "VIOLATED"}
+    obj["staleness"] = {
+        "target": 0, "measured": stale_served,
+        "verdict": "OK" if stale_served == 0 else "VIOLATED"}
+    # The hit-rate gate needs a populated cache AND a sample that can
+    # stand behind a percentage; tiny smoke runs report it ungated.
+    target = max(0.0, ceiling - 0.10)
+    if probes >= 20:
+        obj["cache_hit_rate"] = {
+            "target": round(target, 4), "measured": round(hit_rate, 4),
+            "verdict": "OK" if hit_rate >= target else "VIOLATED"}
+    else:
+        obj["cache_hit_rate"] = {
+            "target": round(target, 4), "measured": round(hit_rate, 4),
+            "verdict": "OK"}  # informational: under the sample floor
+
+    if args.kill_replica_at >= 0:
+        okd, totd = report["ok_by_decile"], report["total_by_decile"]
+        first = okd[0] / totd[0] if totd[0] else 1.0
+        last = okd[9] / totd[9] if totd[9] else 0.0
+        ratio = last / first if first > 0 else 1.0
+        report["follower_reads"]["qps_recovery_ratio"] = round(ratio, 4)
+        obj["qps_recovery"] = {
+            "target": args.qps_recovery_min, "measured": round(ratio, 4),
+            "verdict": ("OK" if ratio >= args.qps_recovery_min
+                        else "VIOLATED")}
+
+    bad = [k for k in ("read_availability", "staleness",
+                       "cache_hit_rate", "qps_recovery")
+           if obj.get(k, {}).get("verdict") == "VIOLATED"]
+    if bad:
+        report["verdict"] = "VIOLATED"
+    log(f"follower-reads: 5xx={read_5xx} hit_rate={hit_rate:.3f} "
+        f"(ceiling {ceiling:.3f}) stale={stale_served:g} "
+        f"-> {'VIOLATED: ' + ','.join(bad) if bad else 'OK'}")
+
+
 def prepare_index(host: str, index: str, frame: str, log,
                   mix: str = "", columns: int = 1 << 16,
                   seed: int = 1) -> None:
@@ -673,6 +817,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="send ?partial=true (graceful degradation)")
     p.add_argument("--deadline", default="",
                    help='per-query deadline (Go duration, e.g. "50ms")')
+    p.add_argument("--staleness-ms", type=float, default=0.0,
+                   help="send X-Pilosa-Staleness on every request "
+                        "(bounded-staleness follower reads); >0 also "
+                        "arms the follower-read verdict gates")
+    p.add_argument("--qps-recovery-min", type=float, default=0.5,
+                   help="churn runs: final-decile ok-rate must recover "
+                        "to this fraction of the first decile's")
     p.add_argument("--availability", type=float, default=99.9)
     p.add_argument("--p99-us", type=float, default=50_000.0)
     p.add_argument("--latency-target", type=float, default=99.0)
@@ -722,6 +873,7 @@ def spec_from_args(args) -> Dict[str, Any]:
         "burst": args.burst,
         "frame": args.frame,
         "fault_at": args.fault_at,
+        "staleness_ms": args.staleness_ms,
         "objectives": {
             "availability": args.availability,
             "p99_us": args.p99_us,
@@ -763,7 +915,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         srv, host = start_inprocess(spec, log)
     transport = HTTPTransport(host, index=args.index,
                               partial=args.partial,
-                              deadline=args.deadline)
+                              deadline=args.deadline,
+                              staleness_ms=args.staleness_ms)
 
     fault_cb = None
     fault_rules: list = []
@@ -793,6 +946,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if servers:
             _judge_write_churn(report, servers, configs, churn_state,
                                args, log)
+        if args.staleness_ms > 0:
+            _judge_follower_reads(report, transport, spec, args, log)
         mm1 = _mismatch_total(transport.get_text("/metrics"))
         growth = max(0.0, mm1 - mm0)
         report["mismatch_growth"] = growth

@@ -68,6 +68,9 @@ ALLOWED_LABEL_KEYS = frozenset((
     "kind",          # stat kinds (code-defined)
     "tag",           # expvar bare-tag bridge
     "value",         # expvar string-set info bridge
+    "replica",       # read-path pick: owner | follower | fallback_owner
+    "staleness",     # read class: strict | bounded
+    "cache",         # result-cache interaction: hit | miss | verify
 ))
 
 # Suffixes that carry a recognized unit for histogram families.
@@ -195,6 +198,16 @@ def live_scrape() -> str:
                 ).status == 200
             assert h.handle("POST", "/index/i/query",
                             body=b"TopN(frame=f, n=2)").status == 200
+            # Bounded-staleness read: exercises the follower-read
+            # pick counters (pilosa_read_replica_total{replica,
+            # staleness}) and the result-cache families.
+            # rowID differs from the strict Counts above so the query
+            # memo can't swallow the placement.
+            assert h.handle(
+                "POST", "/index/i/query",
+                body=b"Count(Bitmap(rowID=2, frame=f))",
+                headers={"x-pilosa-staleness": "100ms"},
+            ).status == 200
             resp = h.handle("GET", "/metrics",
                             params={"exemplars": "true"})
             assert resp.status == 200
