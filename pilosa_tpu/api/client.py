@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..errors import DeadlineExceededError, PilosaError
 from ..obs import StatMap, current_span
+from ..obs import costs
 from ..obs import profile as obs_profile
 from ..obs.metrics import TIER_BYTES
 from .. import fault
@@ -285,7 +286,13 @@ class InternalClient:
                     data = resp.read()
                     if self.breaker is not None:
                         self.breaker.record_success()
-                    TIER_BYTES.inc("http", len(body or b"") + len(data))
+                    nbytes = len(body or b"") + len(data)
+                    TIER_BYTES.inc("http", nbytes)
+                    # Per-call attribution under the same global
+                    # counter: charges the ambient (tenant, shape)
+                    # account, or the reserved system row for
+                    # background legs (hint drain, anti-entropy).
+                    costs.LEDGER.charge("net_http_bytes", nbytes)
                     return resp.status, data
             except urllib.error.HTTPError as e:
                 data = e.read()

@@ -489,6 +489,11 @@ class Handler:
         # section. The counter is monotonic across all queries.
         self.profile_sample_rate = 0
         self._profile_seq = itertools.count(1)
+        # Cost observatory ([obs] cost-debt-threshold, server wiring):
+        # a tenant whose attributed device_us share exceeds this gets
+        # the observe-only X-Pilosa-Cost-Debt header on its query
+        # responses. <= 0 disables the stamp.
+        self.cost_debt_threshold = 0.5
         # Adaptive query scheduler (sched.QueryScheduler, server
         # wiring; [sched] config). When set, POST /query goes through
         # admission control — tenant from X-Pilosa-Tenant, shed answers
@@ -580,6 +585,7 @@ class Handler:
         r("GET", r"/debug/slo", self._get_debug_slo)
         r("GET", r"/debug/fleet", self._get_debug_fleet)
         r("GET", r"/debug/queryshapes", self._get_debug_queryshapes)
+        r("GET", r"/debug/costs", self._get_debug_costs)
         r("GET", r"/debug/queries", self._get_debug_queries)
         r("GET", r"/debug/traces/(?P<tid>[^/]+)", self._get_debug_trace)
         r("GET", r"/debug/pprof/profile", self._get_cpu_profile)
@@ -698,6 +704,9 @@ class Handler:
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
+        # Cost observatory: per-(tenant, shape) cumulative counters
+        # (fleet-mergeable) + pilosa_perf_regression gauges.
+        reg.register_collector(obs.costs.families)
 
     def _collect_slo(self) -> list:
         if self.slo is None:
@@ -889,6 +898,26 @@ class Handler:
         return _json_resp(fr.snapshot(
             sort=params.get("sort", "cost"),
             limit=int(params.get("limit", "50"))))
+
+    def _get_debug_costs(self, pv, params, headers, body):
+        """Cost observatory: top-K (tenant, shape) accounts across
+        every metered dimension plus the baseline watch's regression
+        bands. ?sort=device_us|hbm|staged|wal|net|queries|regression,
+        ?limit=N."""
+        ledger = obs.costs.LEDGER
+        doc = ledger.snapshot(
+            sort=params.get("sort", "device_us"),
+            limit=int(params.get("limit", "50")),
+            watch=obs.costs.WATCH)
+        doc["enabled"] = ledger.enabled
+        doc["regression"] = {
+            "active": [{"shape": s, "dimension": d}
+                       for s, d in obs.costs.WATCH.active()],
+            "bands": obs.costs.WATCH.snapshot(
+                limit=int(params.get("limit", "50"))),
+        }
+        doc["debt_threshold"] = self.cost_debt_threshold
+        return _json_resp(doc)
 
     def _collect_runtime(self) -> list:
         prom = obs.prom
@@ -2030,7 +2059,32 @@ class Handler:
                         tenant=info.get("tenant", "default"),
                         latency_us=latency_us,
                         trace_id=info.get("trace_id"))
+        if resp.status < 400:
+            debt = self._cost_debt(info.get("tenant", "default"))
+            if debt is not None:
+                resp.headers["X-Pilosa-Cost-Debt"] = debt
         return resp
+
+    def _cost_debt(self, tenant: str):
+        """Observe-only cost-debt stamp: when the tenant's measured
+        device_us share (the scheduler's admission estimator consults
+        the same number) exceeds [obs] cost-debt-threshold, query
+        responses carry X-Pilosa-Cost-Debt: <share>. No throttling —
+        the header is the tenant-side signal that its traffic is
+        dominating the device."""
+        thr = self.cost_debt_threshold
+        if thr is None or thr <= 0 or not obs.costs.LEDGER.enabled:
+            return None
+        label = (self.slo.tenant_label(tenant)
+                 if self.slo is not None else tenant)
+        share = None
+        if self.scheduler is not None:
+            share = self.scheduler.tenant_cost_share(label)
+        if share is None:
+            share = obs.costs.LEDGER.tenant_share(label)
+        if share > thr:
+            return f"{share:.3f}"
+        return None
 
     def _post_query_inner(self, pv, params, headers, body,
                           info: dict) -> Response:
@@ -2076,6 +2130,18 @@ class Handler:
         sampled = (self.profile_sample_rate > 0 and not remote
                    and next(self._profile_seq)
                    % self.profile_sample_rate == 0)
+        # Cost-attribution context (obs/costs.py): binds the bounded
+        # tenant label for everything this request charges — route
+        # taps, WAL bytes, tier bytes, staged-view residency. The
+        # sampled path carries the sample rate as its extrapolation
+        # weight so ledger device_us stays an unbiased estimate.
+        cost_ctx = cost_token = None
+        if obs.costs.LEDGER.enabled:
+            clabel = (self.slo.tenant_label(tenant)
+                      if self.slo is not None else tenant)
+            cost_ctx, cost_token = obs.costs.activate(
+                clabel, float(self.profile_sample_rate) if sampled
+                else 1.0)
         prof = ptoken = None
         if want_profile or remote_profile or sampled:
             prof = obs.profile.QueryProfile()
@@ -2135,6 +2201,21 @@ class Handler:
                 obs.profile.deactivate(ptoken)
                 prof.finish()
                 obs.profile.STATS.record(prof)
+            if cost_ctx is not None:
+                obs.costs.deactivate(cost_token)
+                if prof is not None:
+                    # Execution-engine microseconds from the measured
+                    # profile — device_exec plus the host_fold
+                    # fallback (a host-routed query burns the same
+                    # serving budget), extrapolated by the sampling
+                    # weight. The executor stamped the shape during
+                    # _record_route.
+                    obs.costs.LEDGER.record_device_us(
+                        prof.phase_us("device_exec")
+                        + prof.phase_us("host_fold"),
+                        weight=cost_ctx.weight,
+                        tenant=cost_ctx.tenant,
+                        shape=cost_ctx.shape)
         if th:
             resp.headers["X-Pilosa-Trace-Spans"] = json.dumps(
                 trace.serialize_spans(), separators=(",", ":"))
@@ -2160,6 +2241,30 @@ class Handler:
         except (PilosaError, ParseError) as e:
             return self._query_error(e, headers)
         plan["query"] = query[:1024]
+        ledger = obs.costs.LEDGER
+        if ledger.enabled and getattr(q, "calls", None):
+            # Cost block: what the ledger already knows about this
+            # tenant × shape — accumulated spend, the tenant's
+            # device_us share, and whether the baseline watch has the
+            # shape flagged. Planned-cost context, zero dispatch.
+            tenant = headers.get("x-pilosa-tenant", "") or "default"
+            label = (self.slo.tenant_label(tenant)
+                     if self.slo is not None else tenant)
+            shape = self.executor._shape_sig(q.calls[0])
+            acct = ledger.snapshot(limit=ledger.max_accounts)
+            row = next((a for a in acct["accounts"]
+                        if a["tenant"] == label and a["shape"] == shape),
+                       None)
+            plan["cost"] = {
+                "tenant": label,
+                "shape": shape,
+                "tenant_device_us_share":
+                    round(ledger.tenant_share(label), 4),
+                "account": {k: v for k, v in (row or {}).items()
+                            if k not in ("tenant", "shape")},
+                "regressed": [
+                    d for s, d in obs.costs.WATCH.active() if s == shape],
+            }
         return _json_resp(plan)
 
     def _exec_options(self, params, headers, remote) -> ExecOptions:
@@ -2332,21 +2437,35 @@ class Handler:
         import write path is where WAL backpressure (503) surfaces, so
         its outcomes land in the same pilosa_query_outcome_total
         family under route="import"."""
-        if self.slo is None:
-            return self._post_import_inner(pv, params, headers, body)
         tenant = headers.get("x-pilosa-tenant", "") or "default"
+        # Imports meter into the ledger too — the WAL-byte and
+        # replication-byte taps below us charge the ambient account,
+        # keyed (tenant, "import") since imports have no plan shape.
+        cost_token = None
+        if obs.costs.LEDGER.enabled:
+            clabel = (self.slo.tenant_label(tenant)
+                      if self.slo is not None else tenant)
+            ctx, cost_token = obs.costs.activate(clabel)
+            ctx.shape = "import"
+            obs.costs.LEDGER.charge("queries", 1)
         try:
-            resp = self._post_import_inner(pv, params, headers, body)
-        except PilosaError as e:
-            self.slo.record(
-                obs.slo.outcome_for_status(_error_status(e)),
-                tenant=tenant, route="import")
-            raise
-        # No latency_us: the latency SLI means "query p99 under the
-        # declared threshold"; batch imports must not dilute it.
-        self.slo.record(obs.slo.outcome_for_status(resp.status),
-                        tenant=tenant, route="import")
-        return resp
+            if self.slo is None:
+                return self._post_import_inner(pv, params, headers, body)
+            try:
+                resp = self._post_import_inner(pv, params, headers, body)
+            except PilosaError as e:
+                self.slo.record(
+                    obs.slo.outcome_for_status(_error_status(e)),
+                    tenant=tenant, route="import")
+                raise
+            # No latency_us: the latency SLI means "query p99 under the
+            # declared threshold"; batch imports must not dilute it.
+            self.slo.record(obs.slo.outcome_for_status(resp.status),
+                            tenant=tenant, route="import")
+            return resp
+        finally:
+            if cost_token is not None:
+                obs.costs.deactivate(cost_token)
 
     def _post_import_inner(self, pv, params, headers, body) -> Response:
         req = pb.ImportRequest()

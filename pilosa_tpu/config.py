@@ -78,6 +78,17 @@ TOML schema:
     profile-sample-rate = 0     # 0 = profile only on ?profile=true;
                                 # N = also profile every Nth query
                                 # (feeds the /metrics phase histograms)
+    cost-ledger = true          # per-(tenant, shape) cost accounts +
+                                # baseline regression watch (obs/costs)
+    cost-max-accounts = 256     # account-table bound; LRU overflow
+                                # folds into the ("system","-") row
+    cost-watch-bands = 256      # EWMA+MAD bands retained (LRU)
+    cost-regression-k = 4.0     # MAD band multiplier before a shape
+                                # counts as regressed
+    cost-regression-min-n = 32  # observations before a band judges
+    cost-debt-threshold = 0.5   # tenant device_us share that earns
+                                # the X-Pilosa-Cost-Debt header; <=0
+                                # disables the stamp (observe-only)
 
     [log]
     level = "info"              # debug | info | warning | error
@@ -403,6 +414,20 @@ class Config:
         # Query-shape flight recorder ring (GET /debug/queryshapes):
         # distinct plan signatures retained (LRU beyond that).
         self.queryshape_ring: int = 256
+        # Cost observatory (obs/costs.py): bounded (tenant × shape)
+        # resource accounts (LRU overflow folds into the reserved
+        # system row, so dimensions stay conserved) and the EWMA+MAD
+        # baseline watch behind pilosa_perf_regression. cost-ledger =
+        # false turns every attribution tap into one attribute read.
+        self.cost_ledger: bool = True
+        self.cost_max_accounts: int = 256
+        self.cost_watch_bands: int = 256
+        self.cost_regression_k: float = 4.0
+        self.cost_regression_min_n: int = 32
+        # device_us share beyond which a tenant's query responses
+        # carry the observe-only X-Pilosa-Cost-Debt header (share and
+        # debt ratio, no throttling). <= 0 disables the stamp.
+        self.cost_debt_threshold: float = 0.5
         # [log] — structured logging (obs/log.py). `log_format` "json"
         # injects the active trace/span id into every record so log
         # lines join against /debug/traces. `log_file` empty falls back
@@ -562,6 +587,17 @@ class Config:
                 ob["fleet-scrape-interval"])
         c.queryshape_ring = int(ob.get("queryshape-ring",
                                        c.queryshape_ring))
+        c.cost_ledger = bool(ob.get("cost-ledger", c.cost_ledger))
+        c.cost_max_accounts = int(ob.get("cost-max-accounts",
+                                         c.cost_max_accounts))
+        c.cost_watch_bands = int(ob.get("cost-watch-bands",
+                                        c.cost_watch_bands))
+        c.cost_regression_k = float(ob.get("cost-regression-k",
+                                           c.cost_regression_k))
+        c.cost_regression_min_n = int(ob.get("cost-regression-min-n",
+                                             c.cost_regression_min_n))
+        c.cost_debt_threshold = float(ob.get("cost-debt-threshold",
+                                             c.cost_debt_threshold))
         lg = data.get("log", {})
         c.log_level = str(lg.get("level", c.log_level))
         c.log_format = str(lg.get("format", c.log_format))
@@ -752,6 +788,12 @@ class Config:
             f'fleet-scrape-interval = '
             f'"{int(self.fleet_scrape_interval)}s"\n'
             f"queryshape-ring = {self.queryshape_ring}\n"
+            f"cost-ledger = {'true' if self.cost_ledger else 'false'}\n"
+            f"cost-max-accounts = {self.cost_max_accounts}\n"
+            f"cost-watch-bands = {self.cost_watch_bands}\n"
+            f"cost-regression-k = {self.cost_regression_k}\n"
+            f"cost-regression-min-n = {self.cost_regression_min_n}\n"
+            f"cost-debt-threshold = {self.cost_debt_threshold}\n"
             f"\n[log]\n"
             f'level = "{self.log_level}"\n'
             f'format = "{self.log_format}"\n'

@@ -49,6 +49,7 @@ from typing import Optional
 
 from .. import fault
 from ..obs import Histogram, StatMap
+from ..obs import costs
 
 FSYNC_NEVER = "never"
 FSYNC_GROUP = "group"
@@ -148,7 +149,12 @@ class WalCommitter:
             else:
                 self._target.write(data)
             self._appended += 1
-            return len(data)
+        # Group-committer byte attribution: writes arrive on the
+        # request thread (fragment lock held above us), so the ambient
+        # (tenant, shape) account — or the system row for replay and
+        # drain — pays for its own WAL traffic.
+        costs.LEDGER.charge("wal_bytes", len(data))
+        return len(data)
 
     def seq(self) -> int:
         """Sequence number of the newest accepted op — the barrier

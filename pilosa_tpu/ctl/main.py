@@ -686,6 +686,7 @@ def render_fleet(host: str, doc: dict, prev: Optional[dict] = None,
                 f"req {int(row.get('requests_total', 0)):<8} "
                 f"tiers {tier_mix:<24} "
                 f"hints backlog {int(hints.get('backlog', 0)):<6} "
+                f"q {int(row.get('queue_depth', 0)):<5} "
                 f"hbm {_fmt_bytes(hbm.get('resident_bytes', 0))}")
         budget = hbm.get("budget_bytes", 0)
         if budget:
@@ -727,6 +728,85 @@ def cmd_fleet(args) -> int:
         sys.stdout.write(out)
         sys.stdout.flush()
         prev, t_prev = doc, now
+        n += 1
+        if args.n and n >= args.n:
+            return 0
+        time.sleep(args.interval)
+
+
+def render_costs(host: str, doc: dict) -> str:
+    """One screenful from a /debug/costs document: dimension totals,
+    ledger health, active regressions, then the top accounts. Pure —
+    tests feed it canned snapshots."""
+    totals = doc.get("totals") or {}
+    lines = [f"pilosa-tpu costs — via {host}   "
+             f"accounts {doc.get('n_accounts', 0)}   "
+             f"views {doc.get('resident_views', 0)}   "
+             f"sort {doc.get('sort', 'device_us')}"]
+    if not doc.get("enabled", True):
+        lines.append("cost ledger DISABLED ([obs] cost-ledger = false)")
+        return "\n".join(lines) + "\n"
+    lines.append(
+        f"totals: device {_fmt_us(totals.get('device_us', 0.0))}"
+        f" (saved {_fmt_us(totals.get('saved_device_us', 0.0))})   "
+        f"hbm {_fmt_bytes(totals.get('hbm_byte_seconds', 0.0))}·s   "
+        f"staged {_fmt_bytes(totals.get('staged_bytes', 0.0))}   "
+        f"wal {_fmt_bytes(totals.get('wal_bytes', 0.0))}   "
+        f"net http {_fmt_bytes(totals.get('net_http_bytes', 0.0))}"
+        f" / ici {_fmt_bytes(totals.get('net_ici_bytes', 0.0))}")
+    ev = doc.get("events") or {}
+    if ev.get("folded") or ev.get("unattributed"):
+        lines.append(f"ledger events: tracked {int(ev.get('tracked', 0))}"
+                     f"   folded {int(ev.get('folded', 0))}"
+                     f"   unattributed {int(ev.get('unattributed', 0))}")
+    reg = (doc.get("regression") or {}).get("active") or []
+    for r in reg:
+        lines.append(f"REGRESSION: shape {r.get('shape', '?')} "
+                     f"{r.get('dimension', '?')}")
+    lines.append("")
+    lines.append(f"{'tenant':<14} {'shape':<22} {'queries':>8} "
+                 f"{'device':>9} {'saved':>9} {'hbm·s':>9} "
+                 f"{'staged':>9} {'wal':>9} {'net':>9}")
+    for row in doc.get("accounts") or []:
+        net = (row.get("net_http_bytes", 0.0)
+               + row.get("net_ici_bytes", 0.0))
+        line = (f"{row.get('tenant', '?'):<14} "
+                f"{row.get('shape', '-')[:22]:<22} "
+                f"{int(row.get('queries', 0)):>8} "
+                f"{_fmt_us(row.get('device_us', 0.0)):>9} "
+                f"{_fmt_us(row.get('saved_device_us', 0.0)):>9} "
+                f"{_fmt_bytes(row.get('hbm_byte_seconds', 0.0)):>9} "
+                f"{_fmt_bytes(row.get('staged_bytes', 0.0)):>9} "
+                f"{_fmt_bytes(row.get('wal_bytes', 0.0)):>9} "
+                f"{_fmt_bytes(net):>9}")
+        if row.get("regressed"):
+            line += "  REGRESSED"
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def cmd_costs(args) -> int:
+    """Poll /debug/costs on an interval and render the attribution
+    panel: who is spending the fleet's device time, HBM byte-seconds,
+    WAL and network bytes — plus any active perf regressions."""
+    import json as _json
+    import urllib.request
+
+    url = (f"http://{args.host}/debug/costs?sort={args.sort}"
+           f"&limit={args.limit}")
+    n = 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                doc = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"scrape {url}: {e}", file=sys.stderr)
+            return 1
+        out = render_costs(args.host, doc)
+        if sys.stdout.isatty() and args.n != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(out)
+        sys.stdout.flush()
         n += 1
         if args.n and n >= args.n:
             return 0
@@ -886,6 +966,21 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", type=int, default=0,
                    help="number of polls, 0 = until interrupted")
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser("costs",
+                       help="per-tenant/per-shape cost attribution panel")
+    _add_host(p)
+    p.add_argument("--sort", default="device_us",
+                   choices=["device_us", "hbm", "staged", "wal", "net",
+                            "queries", "regression"],
+                   help="account ordering (default device_us)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="accounts shown (default 20)")
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="seconds between polls (default 5)")
+    p.add_argument("-n", type=int, default=0,
+                   help="number of polls, 0 = until interrupted")
+    p.set_defaults(fn=cmd_costs)
 
     # Placeholder row for --help only: main() routes "loadgen" before
     # argparse runs, because tools/loadgen.py's parser owns its flags

@@ -1294,13 +1294,22 @@ class Executor:
         trace = getattr(cur, "trace", None)
         h.observe(lat_us, exemplar=getattr(trace, "trace_id", None))
         if call is not None:
-            self.flight.record(self._shape_sig(call), route,
+            sig = self._shape_sig(call)
+            self.flight.record(sig, route,
                                tier or "local", lat_us,
                                staged_bytes=staged_bytes,
                                shadow_checked=shadow_checked,
                                shadow_mismatch=shadow_mismatch,
                                cache=cache,
                                example=lambda: str(call))
+            # Cost observatory tap: stamps the shape on the ambient
+            # attribution context (the handler bound the tenant),
+            # meters staged bytes + op count into the (tenant, shape)
+            # account, and feeds the baseline watch. One attribute
+            # read when the ledger is off.
+            obs.costs.observe_route(sig, route, tier or "local",
+                                    lat_us, staged_bytes=staged_bytes,
+                                    cache=cache)
 
     @property
     def route_latency_hists(self) -> dict:

@@ -10,6 +10,7 @@ trace is a single ContextVar read returning a shared no-op object, and
 """
 
 from .metrics import Histogram, StatMap
+from . import costs
 from . import fleet
 from . import flight
 from . import log
@@ -35,6 +36,7 @@ __all__ = [
     "StatMap",
     "Trace",
     "Tracer",
+    "costs",
     "current_span",
     "fleet",
     "flight",

@@ -178,10 +178,27 @@ def node_row(samples: dict, vars_snap: Optional[dict] = None) -> dict:
         samples, "pilosa_query_outcome_total"))
     row["uptime_seconds"] = samples.get(("pilosa_uptime_seconds", ()),
                                         0.0)
+    # Scheduler queue depth is a per-tenant gauge; tenant="all" is the
+    # node total. Prefer the scrape (always present when [sched] is
+    # on); /debug/vars is the fallback garnish.
+    qd = samples.get(("pilosa_sched_queue_depth", (("tenant", "all"),)))
+    if qd is not None:
+        row["queue_depth"] = int(qd)
     if vars_snap:
         sched = vars_snap.get("sched")
         if isinstance(sched, dict) and "queued" in sched:
             row["sched_queued"] = sched.get("queued")
+            row.setdefault("queue_depth", int(sched.get("queued", 0)))
+    # Gauge blind spot: merge() drops non-cumulative families by design
+    # (a summed gauge lies), which historically made every gauge this
+    # row didn't hand-pick invisible fleet-wide. Surface them all, per
+    # node, keyed in exposition form — the fleet pane's only window
+    # into instantaneous state (HBM residency, queue depth, regression
+    # flags).
+    row["gauges"] = {
+        sample_key(n, labels): v
+        for (n, labels), v in sorted(samples.items())
+        if not is_cumulative(n)}
     return row
 
 

@@ -46,6 +46,12 @@ BYTE_COUNTERS = ("bytes_staged", "bytes_touched_hbm", "bytes_read_back")
 CURRENT_PROFILE: "contextvars.ContextVar[Optional[QueryProfile]]" = \
     contextvars.ContextVar("pilosa_tpu_profile", default=None)
 
+# Injectable clock: every timestamp the profiler takes goes through
+# this hook so tests can drive phase accounting with a deterministic
+# fake clock instead of asserting against wall-clock sleeps (which
+# flake under suite load).
+monotonic_ns = time.monotonic_ns
+
 
 class _NoopPhase:
     """Shared do-nothing phase timer returned when no profile is
@@ -125,7 +131,7 @@ class QueryProfile:
         self._bytes: Dict[str, int] = {}
         self._slices: List[Dict[str, Any]] = []
         self.remotes: List[Dict[str, Any]] = []
-        self.start_ns = time.monotonic_ns()
+        self.start_ns = monotonic_ns()
         self.end_ns: Optional[int] = None
         self.backend = backend or default_backend()
         self.tags: Dict[str, Any] = {}
@@ -133,7 +139,7 @@ class QueryProfile:
     # -- phase timers ----------------------------------------------------
 
     def _enter(self, name: str) -> None:
-        now = time.monotonic_ns()
+        now = monotonic_ns()
         with self._mu:
             ent = self._active.get(name)
             if ent is None:
@@ -142,7 +148,7 @@ class QueryProfile:
                 ent[0] += 1
 
     def _exit(self, name: str) -> None:
-        now = time.monotonic_ns()
+        now = monotonic_ns()
         with self._mu:
             ent = self._active.get(name)
             if ent is None:  # unbalanced exit: ignore rather than raise
@@ -197,11 +203,11 @@ class QueryProfile:
 
     def finish(self) -> None:
         if self.end_ns is None:
-            self.end_ns = time.monotonic_ns()
+            self.end_ns = monotonic_ns()
 
     @property
     def total_us(self) -> float:
-        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        end = self.end_ns if self.end_ns is not None else monotonic_ns()
         return (end - self.start_ns) / 1e3
 
     def phase_us(self, name: str) -> float:
@@ -243,7 +249,7 @@ class QueryProfile:
             phase_ns = dict(self._phase_ns)
             # Credit still-open phases up to now so a mid-flight dump
             # (or a caller that forgot an exit) stays roughly honest.
-            now = time.monotonic_ns()
+            now = monotonic_ns()
             for name, (_, t0) in self._active.items():
                 phase_ns[name] = phase_ns.get(name, 0) + now - t0
             bts = dict(self._bytes)

@@ -280,13 +280,14 @@ def span(name: str, **tags):
 
 def wrap_ctx(fn):
     """Bind `fn` to the caller's contextvars context so pool workers
-    inherit the active span (and the active query profile). Each call
-    copies its own Context (a Context can't be entered concurrently),
-    and when neither a trace nor a profile is active the function is
-    returned untouched."""
+    inherit the active span (and the active query profile / cost
+    account). Each call copies its own Context (a Context can't be
+    entered concurrently), and when no trace, profile, or cost account
+    is active the function is returned untouched."""
     if CURRENT.get() is None:
+        from .costs import CURRENT_ACCOUNT
         from .profile import CURRENT_PROFILE
-        if CURRENT_PROFILE.get() is None:
+        if CURRENT_PROFILE.get() is None and CURRENT_ACCOUNT.get() is None:
             return fn
     ctx = contextvars.copy_context()
 

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.fragment import MUTATION_EPOCH
-from ..obs import StatMap, jax_scope, profile, span
+from ..obs import StatMap, costs, jax_scope, profile, span
 from ..ops.pool import (
     CONTAINER_WORDS,
     INVALID_KEY,
@@ -695,6 +695,7 @@ class MeshManager:
                 total -= self._view_bytes(sv)
                 self.stats.inc("evicted")
                 self.stats.inc("evicted_budget")
+                costs.LEDGER.view_evicted(key)
         self.stats["staged_bytes"] = total
 
     def _evict_for_oom(self) -> int:
@@ -713,6 +714,7 @@ class MeshManager:
                 self._views_gen += 1
                 self.stats.inc("evicted")
                 self.stats.inc("evicted_oom")
+                costs.LEDGER.view_evicted(key)
                 dropped += 1
             self.stats["staged_bytes"] = sum(
                 self._view_bytes(v) for v in self._views.values())
@@ -1016,6 +1018,7 @@ class MeshManager:
             total -= self._view_bytes(sv)
             self.stats.inc("evicted")
             self.stats.inc("evicted_budget")
+            costs.LEDGER.view_evicted(k)
         self.stats["staged_bytes"] = total
 
     def _stage(self, key, num_slices: int) -> StagedView:
@@ -1135,6 +1138,9 @@ class MeshManager:
         sv.inc_ewma_s = inherit_inc_ewma
         self._views[key] = sv
         self._views_gen += 1
+        # Residency meter: bytes × dt accrues to the accounts that
+        # touch this view from now until eviction (obs/costs.py).
+        costs.LEDGER.view_staged(key, self._view_bytes(sv))
         self._evict_over_budget()
         self._sparse_views = sum(1 for v in self._views.values()
                                  if v.sparse is not None)
@@ -1271,6 +1277,9 @@ class MeshManager:
             if sv is not None:
                 self._views.move_to_end(key)  # LRU: most recently used
                 sv.last_used = self._use_epoch
+                # Charge the residency interval so far, then join the
+                # ambient account to the view's touch set.
+                costs.LEDGER.view_touched(key)
                 if (sv.validated_epoch == ep
                         and sv.num_slices == num_slices):
                     # O(1) fast path: nothing in the process has
@@ -1453,6 +1462,8 @@ class MeshManager:
         """Drop staged views (all, or one index's)."""
         with self._mu:
             if index is None:
+                for key in self._views:
+                    costs.LEDGER.view_evicted(key)
                 self._views.clear()
                 self._views_gen += 1
                 self._sparse_views = 0
@@ -1469,6 +1480,7 @@ class MeshManager:
                     self._purge_memo(self._views[key].sharded.words)
                     del self._views[key]
                     self._views_gen += 1
+                    costs.LEDGER.view_evicted(key)
                 self._sparse_views = sum(
                     1 for v in self._views.values()
                     if v.sparse is not None)

@@ -64,6 +64,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..obs import Histogram, StatMap
+from ..obs import costs
 from ..obs.metrics import TIER_BYTES
 from .broadcast import Broadcaster
 
@@ -126,6 +127,8 @@ def op_hist_snapshot() -> dict:
 def _encode(obj: dict) -> np.ndarray:
     raw = json.dumps(obj).encode()
     TIER_BYTES.inc("ici", len(raw))
+    # Per-call ICI attribution mirroring the HTTP client tap.
+    costs.LEDGER.charge("net_ici_bytes", len(raw))
     if len(raw) > _DESC_BYTES:
         raise ValueError(f"descriptor too large: {len(raw)} bytes")
     buf = np.zeros(_DESC_BYTES, dtype=np.uint8)

@@ -114,6 +114,12 @@ class QueryScheduler:
                                for k, v in (tenant_weights or {}).items()}
         self.estimator = estimator
         self.on_release = on_release
+        # Admission-time cost estimator: the server wires this to the
+        # cost ledger's tenant_share so the handler can stamp an
+        # observe-only X-Pilosa-Cost-Debt header for tenants consuming
+        # an outsized share of device time. None = unwired (no debt
+        # accounting; the handler falls back to the ledger directly).
+        self.cost_share_fn: Optional[Callable[[str], float]] = None
         self.stats = StatMap({
             "admitted": 0, "fastpath": 0, "queued": 0,
             "shed_deadline": 0, "shed_queue_full": 0,
@@ -358,6 +364,19 @@ class QueryScheduler:
             out = {t: len(q) for t, q in self._queues.items() if q}
             out["all"] = self._pending
             return out
+
+    def tenant_cost_share(self, tenant: str) -> Optional[float]:
+        """Fraction of total attributed device time this tenant has
+        consumed (0..1), per the wired cost estimator. None when the
+        estimator is unwired or fails — callers treat that as "no
+        opinion", never as zero debt."""
+        fn = self.cost_share_fn
+        if fn is None:
+            return None
+        try:
+            return float(fn(tenant))
+        except Exception:
+            return None
 
     def snapshot(self) -> dict:
         """Flat dict for /debug/vars."""

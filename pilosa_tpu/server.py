@@ -46,7 +46,7 @@ from .parallel.cluster import (
 )
 from .parallel.hints import HintManager
 from .parallel.rebalance import Rebalancer
-from .obs import StatMap, Tracer, slo as obs_slo
+from .obs import StatMap, Tracer, costs as obs_costs, slo as obs_slo
 from .utils.stats import ExpvarStats
 from .wire import pb
 
@@ -326,6 +326,35 @@ class Server:
                 lambda: (lambda d: d.get("queued", 0)
                          + d.get("inflight", 0))(
                     self.scheduler.queue_depths()))
+        # Cost observatory ([obs] cost-*): per-(tenant, shape) resource
+        # attribution ledger + self-baselining regression watch. The
+        # ledger and watch are process-wide singletons (charges arrive
+        # from the executor, WAL, stager, and transports, none of which
+        # hold a server reference); the server just applies the knobs
+        # and wires the scheduler's admission-time cost estimator.
+        obs_costs.LEDGER.enabled = bool(self.config.cost_ledger)
+        obs_costs.LEDGER.max_accounts = max(
+            1, int(self.config.cost_max_accounts))
+        obs_costs.WATCH.enabled = bool(self.config.cost_ledger)
+        obs_costs.WATCH.max_bands = max(
+            1, int(self.config.cost_watch_bands))
+        obs_costs.WATCH.k = float(self.config.cost_regression_k)
+        obs_costs.WATCH.min_n = max(
+            2, int(self.config.cost_regression_min_n))
+        self.handler.cost_debt_threshold = float(
+            self.config.cost_debt_threshold)
+        if self.scheduler is not None and self.config.cost_ledger:
+            self.scheduler.cost_share_fn = obs_costs.LEDGER.tenant_share
+        if self.config.cost_ledger:
+            # Warm-start the regression bands from whatever the flight
+            # recorder already holds (a no-op on a cold process; on an
+            # embedded restart it spares the watch its min_n warmup).
+            try:
+                obs_costs.WATCH.seed_from_flight(
+                    self.executor.flight.snapshot(limit=obs_costs
+                                                  .WATCH.max_bands))
+            except Exception:
+                pass
         # SLO observatory ([slo]): replace the handler's default
         # recorder with the config-declared objectives; tenant label
         # cardinality is bounded by the [sched] tenant-weights keys.
@@ -464,6 +493,13 @@ class Server:
         self.node_set.close()
         if self._api is not None:
             self._api.close()
+        # Drop staged device views so the cost ledger's residency
+        # meters finalize: an abandoned record would keep accruing
+        # hbm_byte_seconds forever against views that no longer exist.
+        try:
+            self.executor.invalidate_device_index()
+        except Exception as e:  # noqa: BLE001 — device layer may be gone
+            self.logger.warning(f"view drop at close: {e}")
         self.holder.close()
 
     def _set_live_hosts(self, hosts):

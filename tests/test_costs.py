@@ -1,0 +1,773 @@
+"""Cost observatory tests: (tenant × shape) ledger attribution and
+conservation (LRU folds, rollups, HBM byte-second amortization under a
+fake clock), the BaselineWatch regression detector (flip under a 3×
+device-exec slowdown injected through the fault seam, recovery, zero
+false positives over a clean 10k-observation run, flight-recorder
+warm-start), the /debug/costs endpoint + observe-only
+X-Pilosa-Cost-Debt header, net-bytes conservation against the global
+tier counter over real HTTP fan-out, the fleet pane's per-node gauge
+rows, the ctl costs panel renderer, and the [obs] cost knob
+round-trip.
+"""
+
+import random
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, fault
+from pilosa_tpu.api import Handler, InternalClient
+from pilosa_tpu.config import Config
+from pilosa_tpu.core import Holder
+from pilosa_tpu.ctl.main import render_costs
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.obs import costs, fleet
+from pilosa_tpu.obs.costs import (DIMENSIONS, FALLBACK, BaselineWatch,
+                                  CostLedger)
+from pilosa_tpu.obs.metrics import TIER_BYTES
+from pilosa_tpu.parallel import new_test_cluster
+from pilosa_tpu.server import Server
+
+
+class _Clock:
+    """Injectable monotonic stand-in for the ledger's residency
+    clock: time advances only when the test says so, making byte ×
+    second arithmetic exact."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _totals(led):
+    return led.totals()
+
+
+class TestLedgerAccounts:
+    def test_contextless_charge_lands_in_fallback(self):
+        led = CostLedger()
+        led.charge("wal_bytes", 128)
+        snap = led.snapshot()
+        assert snap["n_accounts"] == 1
+        row = snap["accounts"][0]
+        assert (row["tenant"], row["shape"]) == FALLBACK
+        assert row["wal_bytes"] == 128
+        assert led.events["unattributed"] == 1
+
+    def test_ambient_context_resolution_and_shape_stamp(self):
+        led = CostLedger()
+        ctx, tok = costs.activate("gold")
+        try:
+            # The executor's route tap stamps the plan shape on the
+            # ambient context; everything charged afterwards in this
+            # request lands on (gold, sig-a).
+            led.observe_route("sig-a", "mesh", "local", 1500.0,
+                              staged_bytes=4096)
+            assert ctx.shape == "sig-a"
+            led.charge("wal_bytes", 64)
+        finally:
+            costs.deactivate(tok)
+        snap = led.snapshot()
+        row = next(a for a in snap["accounts"]
+                   if (a["tenant"], a["shape"]) == ("gold", "sig-a"))
+        assert row["queries"] == 1
+        assert row["staged_bytes"] == 4096
+        assert row["wal_bytes"] == 64
+
+    def test_disabled_ledger_is_a_noop(self):
+        led = CostLedger()
+        led.enabled = False
+        _, tok = costs.activate("gold")
+        try:
+            led.charge("device_us", 100)
+            led.observe_route("s", "mesh", "local", 10.0)
+            led.record_device_us(100.0)
+            led.view_staged("v", 1024)
+        finally:
+            costs.deactivate(tok)
+        led.enabled = True
+        assert led.snapshot()["n_accounts"] == 0
+        assert sum(led.events.values()) == 0
+
+    def test_lru_fold_conserves_every_dimension(self):
+        """Hostile cardinality: 10 tenants into a 4-account table.
+        Folds reroute history into the reserved row instead of
+        dropping it, so dimension totals are invariant."""
+        led = CostLedger(max_accounts=4)
+        for i in range(10):
+            led.charge("device_us", 10.0, tenant=f"t{i}", shape="s")
+            led.charge("wal_bytes", 7.0, tenant=f"t{i}", shape="s")
+        snap = led.snapshot(limit=100)
+        assert snap["n_accounts"] <= 4
+        assert led.events["folded"] >= 6
+        totals = snap["totals"]
+        assert totals["device_us"] == pytest.approx(100.0)
+        assert totals["wal_bytes"] == pytest.approx(70.0)
+        # The fallback row absorbed the folds.
+        fb = next(a for a in snap["accounts"]
+                  if (a["tenant"], a["shape"]) == FALLBACK)
+        assert fb["device_us"] > 0
+        # The per-tenant device rollup conserves independently of the
+        # account-table folds (the debt signal must not forget).
+        assert sum(led._tenant_dev.values()) == pytest.approx(100.0)
+        assert led._total_dev == pytest.approx(100.0)
+
+    def test_fallback_row_survives_any_overflow(self):
+        led = CostLedger(max_accounts=2)
+        led.charge("wal_bytes", 1.0)  # creates FALLBACK first
+        for i in range(20):
+            led.charge("wal_bytes", 1.0, tenant=f"t{i}", shape="s")
+        snap = led.snapshot(limit=10)
+        assert any((a["tenant"], a["shape"]) == FALLBACK
+                   for a in snap["accounts"])
+        assert snap["totals"]["wal_bytes"] == pytest.approx(21.0)
+
+    def test_cache_hit_credits_shape_history(self):
+        led = CostLedger()
+        led.record_device_us(900.0, tenant="gold", shape="sig-a")
+        led.record_device_us(1100.0, tenant="gold", shape="sig-a")
+        _, tok = costs.activate("gold")
+        try:
+            led.observe_route("sig-a", "result-cache", "local", 5.0,
+                              cache="hit")
+        finally:
+            costs.deactivate(tok)
+        snap = led.snapshot()
+        row = next(a for a in snap["accounts"]
+                   if (a["tenant"], a["shape"]) == ("gold", "sig-a"))
+        # Credit is the shape's own mean device cost: (900+1100)/2.
+        assert row["saved_device_us"] == pytest.approx(1000.0)
+
+    def test_device_weight_extrapolates_but_history_stays_raw(self):
+        """1-in-N sampling: the charged estimate is us × N (unbiased),
+        while the cache-savings history keeps the raw observation."""
+        led = CostLedger()
+        led.record_device_us(500.0, weight=4.0, tenant="g", shape="s")
+        snap = led.snapshot()
+        assert snap["accounts"][0]["device_us"] == pytest.approx(2000.0)
+        _, tok = costs.activate("g")
+        try:
+            led.observe_route("s", "result-cache", "local", 1.0,
+                              cache="hit")
+        finally:
+            costs.deactivate(tok)
+        row = led.snapshot()["accounts"][0]
+        assert row["saved_device_us"] == pytest.approx(500.0)
+
+    def test_tenant_share_stays_silent_through_warmup(self):
+        led = CostLedger()
+        for _ in range(CostLedger.MIN_SHARE_SAMPLES - 1):
+            led.record_device_us(100.0, tenant="gold", shape="s")
+        assert led.tenant_share("gold") == 0.0
+        led.record_device_us(100.0, tenant="gold", shape="s")
+        assert led.tenant_share("gold") == pytest.approx(1.0)
+
+    def test_tenant_shares_sum_to_one(self):
+        led = CostLedger()
+        for i in range(CostLedger.MIN_SHARE_SAMPLES):
+            led.record_device_us(float(10 + i), tenant=f"t{i % 3}",
+                                 shape="s")
+        total = sum(led.tenant_share(f"t{j}") for j in range(3))
+        assert total == pytest.approx(1.0)
+
+    def test_snapshot_sort_aliases(self):
+        led = CostLedger()
+        led.charge("hbm_byte_seconds", 9.0, tenant="hog", shape="a")
+        led.charge("wal_bytes", 9.0, tenant="writer", shape="b")
+        led.charge("net_http_bytes", 9.0, tenant="chatty", shape="c")
+        led.charge("device_us", 9.0, tenant="burner", shape="d")
+        for sort, tenant in (("hbm", "hog"), ("wal", "writer"),
+                             ("net", "chatty"), ("device_us", "burner"),
+                             ("bogus", "burner")):
+            snap = led.snapshot(sort=sort)
+            assert snap["accounts"][0]["tenant"] == tenant, sort
+
+    def test_families_are_fleet_mergeable_counters(self):
+        led = CostLedger()
+        led.charge("device_us", 5.0, tenant="g", shape="s")
+        led.charge("net_ici_bytes", 7.0, tenant="g", shape="s")
+        led.charge("wal_bytes", 3.0)  # fallback → unattributed event
+        fams = led.families()
+        assert fams, "populated ledger must export families"
+        for fam in fams:
+            assert fam.mtype == "counter"
+            assert fam.name.endswith("_total")
+        by_name = {f.name: f for f in fams}
+        # Samples are (suffix, ((label, value), ...), numeric).
+        net = by_name["pilosa_cost_net_bytes_total"]
+        assert any(dict(s[1]).get("tier") == "ici" for s in net.samples)
+        ev = by_name["pilosa_cost_ledger_events_total"]
+        assert any(dict(s[1]).get("account") == "unattributed"
+                   for s in ev.samples)
+
+
+class TestHbmByteSeconds:
+    def test_residency_conservation(self):
+        clk = _Clock()
+        led = CostLedger(clock=clk)
+        _, tok = costs.activate("gold")
+        try:
+            led.view_staged("va", 1000)
+            clk.advance(2.0)
+            led.view_staged("vb", 500)
+            clk.advance(3.0)
+        finally:
+            costs.deactivate(tok)
+        totals = led.totals()  # totals() checkpoints first
+        # va resident 5s × 1000B + vb resident 3s × 500B
+        assert totals["hbm_byte_seconds"] == pytest.approx(6500.0)
+        assert led.snapshot()["resident_views"] == 2
+
+    def test_touch_amortization_splits_by_touch_count(self):
+        clk = _Clock()
+        led = CostLedger(clock=clk)
+        _, ta = costs.activate("a")
+        try:
+            led.view_staged("v", 100)
+        finally:
+            costs.deactivate(ta)
+        clk.advance(1.0)
+        _, tb = costs.activate("b")
+        try:
+            # Touch charges the interval so far (a alone), then joins.
+            led.view_touched("v")
+        finally:
+            costs.deactivate(tb)
+        clk.advance(1.0)
+        led.checkpoint()
+        snap = {(r["tenant"], r["shape"]): r
+                for r in led.snapshot(limit=10)["accounts"]}
+        assert snap[("a", "-")]["hbm_byte_seconds"] == pytest.approx(150.0)
+        assert snap[("b", "-")]["hbm_byte_seconds"] == pytest.approx(50.0)
+        assert led.totals()["hbm_byte_seconds"] == pytest.approx(200.0)
+
+    def test_evict_finalizes_and_stops_the_meter(self):
+        clk = _Clock()
+        led = CostLedger(clock=clk)
+        _, tok = costs.activate("gold")
+        try:
+            led.view_staged("v", 256)
+        finally:
+            costs.deactivate(tok)
+        clk.advance(4.0)
+        led.view_evicted("v")
+        assert led.totals()["hbm_byte_seconds"] == pytest.approx(1024.0)
+        clk.advance(100.0)
+        assert led.totals()["hbm_byte_seconds"] == pytest.approx(1024.0)
+        assert led.snapshot()["resident_views"] == 0
+
+    def test_toucher_cap_folds_into_fallback(self):
+        clk = _Clock()
+        led = CostLedger(clock=clk)
+        _, tok = costs.activate("t0")
+        try:
+            led.view_staged("v", 80)
+        finally:
+            costs.deactivate(tok)
+        for i in range(1, 12):
+            _, tok = costs.activate(f"t{i}")
+            try:
+                led.view_touched("v")
+            finally:
+                costs.deactivate(tok)
+        clk.advance(1.0)
+        led.checkpoint()
+        snap = {(r["tenant"], r["shape"]): r
+                for r in led.snapshot(limit=50)["accounts"]}
+        assert FALLBACK in snap and snap[FALLBACK]["hbm_byte_seconds"] > 0
+        assert led.totals()["hbm_byte_seconds"] == pytest.approx(80.0)
+
+
+class TestBaselineWatch:
+    def test_no_judgement_before_min_n(self):
+        w = BaselineWatch(min_n=32)
+        for _ in range(10):
+            w.observe("s", "cpu", "local", 1000.0)
+        w.observe("s", "cpu", "local", 50_000.0)
+        assert w.active() == []
+
+    def test_flip_on_3x_slowdown_then_recover(self):
+        w = BaselineWatch(min_n=16, k=4.0)
+        rng = random.Random(19)
+        for _ in range(200):
+            w.observe("sig-a", "cpu", "local",
+                      1000.0 + rng.uniform(-50, 50))
+        assert w.active() == []
+        for _ in range(20):
+            w.observe("sig-a", "cpu", "local", 3000.0)
+        assert ("sig-a", "latency_us") in w.active()
+        # Baseline freezes while regressed — the slowdown must not
+        # launder itself into the new normal.
+        row = next(r for r in w.snapshot(limit=10)
+                   if r["shape"] == "sig-a")
+        assert row["regressed"] and row["baseline"] < 1200.0
+        for _ in range(60):
+            w.observe("sig-a", "cpu", "local",
+                      1000.0 + rng.uniform(-50, 50))
+        assert w.active() == []
+
+    def test_clean_10k_run_has_zero_false_positives(self):
+        """The acceptance bar: realistic jitter (gaussian, multiple
+        shapes/tiers) over 10k observations never flags."""
+        w = BaselineWatch()
+        rng = random.Random(7)
+        shapes = ("sig-a", "sig-b", "sig-c")
+        tripped = 0
+        for i in range(10_000):
+            shape = shapes[i % 3]
+            lat = max(1.0, rng.gauss(1000.0 * (1 + i % 3), 60.0))
+            w.observe(shape, "cpu", "local" if i % 5 else "ici", lat)
+            if i % 100 == 99 and w.active():
+                tripped += 1
+        assert tripped == 0
+        assert w.active() == []
+
+    def test_cached_routes_do_not_teach_the_baseline(self):
+        w = BaselineWatch(min_n=2)
+        for _ in range(50):
+            w.observe("s", "cpu", "local", 3.0, route="memo")
+            w.observe("s", "cpu", "local", 5.0, route="result-cache")
+        assert w.snapshot(limit=10) == []
+
+    def test_bytes_per_s_regression_is_lower_is_worse(self):
+        w = BaselineWatch(min_n=16, k=4.0)
+        rng = random.Random(3)
+        for _ in range(100):
+            w.observe("s", "tpu", "local", 1000.0,
+                      bytes_per_s=1e9 + rng.uniform(-2e7, 2e7))
+        assert w.active() == []
+        for _ in range(20):
+            w.observe("s", "tpu", "local", 1000.0, bytes_per_s=2e8)
+        assert ("s", "bytes_per_s") in w.active()
+
+    def test_seed_from_flight_document_and_bare_list(self):
+        w = BaselineWatch(min_n=32)
+        doc = {"ring": 512, "top": [
+            {"signature": "sig-a", "count": 500, "p50_us": 2000.0,
+             "p99_us": 2200.0, "tiers": {"local": 9, "ici": 1}},
+            {"signature": "", "count": 5, "p50_us": 100.0},  # skipped
+        ]}
+        assert w.seed_from_flight(doc, backend="cpu") == 2
+        rows = w.snapshot(limit=10)
+        assert {(r["tier"]) for r in rows} == {"local", "ici"}
+        # Warm-started bands are past min_n: a sustained 3× shift
+        # trips without a relearning period.
+        assert all(r["n"] >= w.min_n for r in rows)
+        for _ in range(10):
+            w.observe("sig-a", "cpu", "local", 6000.0)
+        assert ("sig-a", "latency_us") in w.active()
+        w2 = BaselineWatch()
+        assert w2.seed_from_flight(
+            [{"shape": "x", "p50_us": 10.0}], backend="cpu") == 1
+
+    def test_band_table_is_lru_bounded(self):
+        w = BaselineWatch(max_bands=4)
+        for i in range(20):
+            w.observe(f"s{i}", "cpu", "local", 100.0)
+        assert len(w.snapshot(limit=100)) <= 4
+
+    def test_families_export_regression_gauge(self):
+        w = BaselineWatch(min_n=4, k=4.0)
+        for _ in range(30):
+            w.observe("s", "cpu", "local", 1000.0)
+        for _ in range(10):
+            w.observe("s", "cpu", "local", 4000.0)
+        fams = w.families()
+        assert len(fams) == 1
+        fam = fams[0]
+        assert fam.name == "pilosa_perf_regression"
+        assert fam.mtype == "gauge"
+        _suffix, labels, value = fam.samples[0][:3]
+        labels = dict(labels)
+        assert value == 1
+        assert labels["shape"] == "s"
+        assert labels["dimension"] == "latency_us"
+
+
+class TestDeviceExecFaultSeam:
+    def test_injected_device_stall_flips_the_band_and_recovery_clears(self):
+        """A 3×+ device-exec slowdown injected at the fault seam: arm
+        a delay on device.exec, measure each pass through the seam
+        exactly as the serve layer's launch path would experience it,
+        and feed the measured latencies to the watch. Deterministic by
+        construction — sleep jitter is upward-only, so the stalled
+        observations can only get further from baseline."""
+        w = BaselineWatch(min_n=16, k=4.0)
+        rng = random.Random(11)
+        base_us = 1000.0
+        for _ in range(100):
+            w.observe("sig-f", "cpu", "local",
+                      base_us + rng.uniform(-20, 20))
+        assert w.active() == []
+        fault.arm("device.exec", delay=0.004)  # ≥4000us per launch
+        try:
+            for _ in range(12):
+                t0 = time.perf_counter()
+                fault.point("device.exec", sig="sig-f", kind="count")
+                stall_us = (time.perf_counter() - t0) * 1e6
+                assert stall_us >= 3500.0
+                w.observe("sig-f", "cpu", "local", base_us + stall_us)
+            assert ("sig-f", "latency_us") in w.active()
+        finally:
+            fault.reset()
+        for _ in range(60):
+            w.observe("sig-f", "cpu", "local", base_us)
+        assert w.active() == []
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    """Single-node handler over fresh cost singletons: every call
+    site resolves obs.costs.LEDGER / WATCH at call time, so swapping
+    the module attributes isolates the process-global state."""
+    monkeypatch.setattr(costs, "LEDGER", CostLedger())
+    monkeypatch.setattr(costs, "WATCH", BaselineWatch())
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    cluster = new_test_cluster(1)
+    ex = Executor(holder, host=cluster.nodes[0].host, cluster=cluster,
+                  use_device=False)
+    h = Handler(holder, ex, cluster=cluster, host=cluster.nodes[0].host)
+    yield holder, h
+    holder.close()
+
+
+def _seed(h, rows=4, slices=4):
+    assert h.handle("POST", "/index/i").status == 200
+    assert h.handle("POST", "/index/i/frame/f").status == 200
+    for row in range(rows):
+        q = "".join(
+            f"SetBit(rowID={row}, frame=f, columnID={s * SLICE_WIDTH + row})"
+            for s in range(slices))
+        assert h.handle("POST", "/index/i/query",
+                        body=q.encode()).status == 200
+
+
+class TestHandlerIntegration:
+    def test_debug_costs_endpoint_shape(self, env):
+        _, h = env
+        _seed(h)
+        for _ in range(3):
+            r = h.handle("POST", "/index/i/query",
+                         body=b"Count(Bitmap(rowID=0, frame=f))",
+                         headers={"x-pilosa-tenant": "gold"})
+            assert r.status == 200
+        r = h.handle("GET", "/debug/costs", params={"sort": "queries"})
+        assert r.status == 200
+        doc = r.json()
+        assert doc["enabled"] is True
+        assert doc["debt_threshold"] == h.cost_debt_threshold
+        assert set(doc) >= {"sort", "accounts", "n_accounts", "totals",
+                            "events", "resident_views", "regression"}
+        assert set(doc["regression"]) == {"active", "bands"}
+        label = h.slo.tenant_label("gold")
+        assert any(a["tenant"] == label and a["queries"] >= 1
+                   for a in doc["accounts"])
+        assert set(doc["totals"]) == set(DIMENSIONS)
+
+    def test_writes_charge_wal_bytes_to_the_tenant(self, env):
+        _, h = env
+        assert h.handle("POST", "/index/i").status == 200
+        assert h.handle("POST", "/index/i/frame/f").status == 200
+        r = h.handle("POST", "/index/i/query",
+                     body=b"SetBit(rowID=1, frame=f, columnID=3)",
+                     headers={"x-pilosa-tenant": "gold"})
+        assert r.status == 200
+        label = h.slo.tenant_label("gold")
+        snap = costs.LEDGER.snapshot(sort="wal", limit=50)
+        charged = sum(a["wal_bytes"] for a in snap["accounts"]
+                      if a["tenant"] == label)
+        assert charged > 0
+
+    def test_cost_debt_header_is_observe_only(self, env, monkeypatch):
+        _, h = env
+        # Drop the share-sample floor (32 real profiled queries is a
+        # load test, not a unit test) and sample every query so
+        # device_us lands on the first pass.
+        monkeypatch.setattr(CostLedger, "MIN_SHARE_SAMPLES", 0)
+        h.profile_sample_rate = 1
+        h.cost_debt_threshold = 0.05
+        _seed(h)
+        debt = None
+        for row in range(3):
+            r = h.handle("POST", "/index/i/query",
+                         body=f"Count(Bitmap(rowID={row}, frame=f))"
+                         .encode(),
+                         headers={"x-pilosa-tenant": "gold"})
+            assert r.status == 200  # observe-only: never throttles
+            debt = r.headers.get("X-Pilosa-Cost-Debt") or debt
+        assert debt is not None
+        assert 0.0 < float(debt) <= 1.0
+        # Threshold 0 disables the stamp entirely.
+        h.cost_debt_threshold = 0.0
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=0, frame=f))",
+                     headers={"x-pilosa-tenant": "gold"})
+        assert "X-Pilosa-Cost-Debt" not in r.headers
+
+    def test_explain_carries_the_cost_block(self, env):
+        _, h = env
+        h.profile_sample_rate = 1
+        _seed(h)
+        assert h.handle("POST", "/index/i/query",
+                        body=b"Count(Bitmap(rowID=0, frame=f))",
+                        headers={"x-pilosa-tenant": "gold"}).status == 200
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=0, frame=f))",
+                     params={"explain": "true"},
+                     headers={"x-pilosa-tenant": "gold"})
+        assert r.status == 200
+        cost = r.json()["cost"]
+        assert set(cost) >= {"tenant", "shape", "tenant_device_us_share",
+                             "account", "regressed"}
+        assert cost["tenant"] == h.slo.tenant_label("gold")
+        assert cost["account"].get("queries", 0) >= 1
+
+    def test_device_us_rollup_conservation(self, env):
+        """Sum over accounts == per-tenant rollup == global total:
+        the invariant the debt header and the snapshot both lean on,
+        across real handler traffic from two tenants."""
+        _, h = env
+        h.profile_sample_rate = 1
+        _seed(h)
+        for row in range(4):
+            for tenant in ("gold", "tin"):
+                assert h.handle(
+                    "POST", "/index/i/query",
+                    body=f"Count(Bitmap(rowID={row}, frame=f))".encode(),
+                    headers={"x-pilosa-tenant": tenant}).status == 200
+        led = costs.LEDGER
+        totals = led.totals()
+        assert totals["device_us"] == pytest.approx(led._total_dev)
+        assert sum(led._tenant_dev.values()) == \
+            pytest.approx(led._total_dev)
+        assert totals["queries"] >= 8
+
+    def test_metrics_scrape_exports_cost_families(self, env):
+        _, h = env
+        _seed(h)
+        assert h.handle("POST", "/index/i/query",
+                        body=b"Count(Bitmap(rowID=0, frame=f))",
+                        headers={"x-pilosa-tenant": "gold"}).status == 200
+        r = h.handle("GET", "/metrics")
+        assert r.status == 200
+        text = r.body.decode()
+        assert "pilosa_cost_queries_total" in text
+        assert 'tenant="' in text and 'shape="' in text
+
+    def test_disabled_ledger_reported_and_unstamped(self, env):
+        _, h = env
+        _seed(h)
+        costs.LEDGER.enabled = False
+        r = h.handle("GET", "/debug/costs")
+        assert r.json()["enabled"] is False
+        r = h.handle("POST", "/index/i/query",
+                     body=b"Count(Bitmap(rowID=0, frame=f))",
+                     headers={"x-pilosa-tenant": "gold"})
+        assert r.status == 200
+        assert "X-Pilosa-Cost-Debt" not in r.headers
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TestNetBytesConservation:
+    def test_http_attribution_tracks_the_tier_counter(self, tmp_path,
+                                                      monkeypatch):
+        """Every InternalClient response charges net_http_bytes and
+        the global pilosa_tier_bytes_total{tier=http} at the same
+        site, so their deltas over a burst of real fan-out traffic
+        must match byte for byte — attributed + system rows included."""
+        monkeypatch.setattr(costs, "LEDGER", CostLedger())
+        monkeypatch.setattr(costs, "WATCH", BaselineWatch())
+        ports = _free_ports(2)
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        servers = []
+        try:
+            for i, hostname in enumerate(hosts):
+                c = Config()
+                c.data_dir = str(tmp_path / f"node{i}")
+                c.host = hostname
+                c.cluster_hosts = hosts
+                c.replica_n = 1
+                c.anti_entropy_interval = 3600
+                c.polling_interval = 3600
+                s = Server(c)
+                s.open()
+                servers.append(s)
+            cli = InternalClient(hosts[0])
+            http_before = TIER_BYTES.copy().get("http", 0)
+            led_before = costs.LEDGER.totals()["net_http_bytes"]
+            _, tok = costs.activate("gold")
+            try:
+                cli.create_index("i")
+                cli.create_frame("i", "f")
+                q = "".join(
+                    f"SetBit(rowID=1, frame=f, columnID={s * SLICE_WIDTH})"
+                    for s in range(6))
+                cli.execute_query(None, "i", q, [], remote=False)
+                cli.execute_query(None, "i",
+                                  "Count(Bitmap(rowID=1, frame=f))",
+                                  [], remote=False)
+            finally:
+                costs.deactivate(tok)
+            http_delta = TIER_BYTES.copy().get("http", 0) - http_before
+            led_delta = costs.LEDGER.totals()["net_http_bytes"] \
+                - led_before
+            assert http_delta > 0
+            assert led_delta == pytest.approx(http_delta)
+            # The activated tenant got a nonzero slice of it.
+            snap = costs.LEDGER.snapshot(sort="net", limit=50)
+            assert sum(a["net_http_bytes"] for a in snap["accounts"]
+                       if a["tenant"] == "gold") > 0
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestServerWiring:
+    def test_config_knobs_reach_the_singletons(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setattr(costs, "LEDGER", CostLedger())
+        monkeypatch.setattr(costs, "WATCH", BaselineWatch())
+        c = Config()
+        c.data_dir = str(tmp_path / "d")
+        c.cost_max_accounts = 64
+        c.cost_watch_bands = 48
+        c.cost_regression_k = 6.5
+        c.cost_regression_min_n = 12
+        c.cost_debt_threshold = 0.75
+        s = Server(c)
+        assert costs.LEDGER.enabled is True
+        assert costs.LEDGER.max_accounts == 64
+        assert costs.WATCH.max_bands == 48
+        assert costs.WATCH.k == 6.5
+        assert costs.WATCH.min_n == 12
+        assert s.handler.cost_debt_threshold == 0.75
+
+    def test_cost_ledger_false_disables_both(self, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setattr(costs, "LEDGER", CostLedger())
+        monkeypatch.setattr(costs, "WATCH", BaselineWatch())
+        c = Config()
+        c.data_dir = str(tmp_path / "d")
+        c.cost_ledger = False
+        Server(c)
+        assert costs.LEDGER.enabled is False
+        assert costs.WATCH.enabled is False
+
+
+class TestFleetPane:
+    SAMPLES = {
+        ("pilosa_query_route_total",
+         (("backend", "mesh"), ("tier", "local"))): 5.0,
+        ("pilosa_hbm_resident_bytes", (("device", "0"),)): 1024.0,
+        ("pilosa_hbm_budget_bytes", ()): 4096.0,
+        ("pilosa_hbm_residency_ratio", ()): 0.25,
+        ("pilosa_sched_queue_depth", (("tenant", "all"),)): 3.0,
+        ("pilosa_sched_queue_depth", (("tenant", "gold"),)): 2.0,
+        ("pilosa_uptime_seconds", ()): 12.5,
+        ("pilosa_perf_regression",
+         (("dimension", "latency_us"), ("shape", "sig-a"))): 1.0,
+        ("pilosa_cost_queries_total",
+         (("shape", "sig-a"), ("tenant", "gold"))): 9.0,
+    }
+
+    def test_node_row_queue_depth_from_scrape(self):
+        row = fleet.node_row(dict(self.SAMPLES))
+        assert row["queue_depth"] == 3
+        assert row["hbm"]["resident_bytes"] == 1024
+
+    def test_node_row_queue_depth_vars_fallback(self):
+        row = fleet.node_row({}, {"sched": {"queued": 7}})
+        assert row["queue_depth"] == 7
+
+    def test_node_row_surfaces_every_gauge_but_no_counters(self):
+        """merge() drops gauges by design (a summed gauge lies); the
+        per-node row must surface them all instead, keyed in
+        exposition form, with cumulative families excluded."""
+        row = fleet.node_row(dict(self.SAMPLES))
+        g = row["gauges"]
+        assert g['pilosa_sched_queue_depth{tenant="all"}'] == 3.0
+        assert g['pilosa_sched_queue_depth{tenant="gold"}'] == 2.0
+        assert g['pilosa_perf_regression'
+                 '{dimension="latency_us",shape="sig-a"}'] == 1.0
+        assert g['pilosa_hbm_residency_ratio'] == 0.25
+        assert not any(k.startswith("pilosa_cost_queries_total")
+                       for k in g)
+        assert not any(k.startswith("pilosa_query_route_total")
+                       for k in g)
+
+
+class TestCtlCostsPanel:
+    DOC = {
+        "sort": "device_us", "n_accounts": 2, "resident_views": 1,
+        "enabled": True,
+        "totals": {"queries": 12, "device_us": 123456.0,
+                   "saved_device_us": 1000.0,
+                   "hbm_byte_seconds": 2 ** 21, "staged_bytes": 4096.0,
+                   "wal_bytes": 512.0, "net_http_bytes": 100.0,
+                   "net_ici_bytes": 50.0},
+        "events": {"tracked": 2, "folded": 3, "unattributed": 1},
+        "regression": {"active": [
+            {"shape": "sig-a", "dimension": "latency_us"}]},
+        "accounts": [
+            {"tenant": "gold", "shape": "sig-a", "queries": 10,
+             "device_us": 120000.0, "saved_device_us": 1000.0,
+             "hbm_byte_seconds": 2 ** 20, "staged_bytes": 4096.0,
+             "wal_bytes": 512.0, "net_http_bytes": 100.0,
+             "net_ici_bytes": 50.0, "regressed": True},
+            {"tenant": "system", "shape": "-", "queries": 2,
+             "device_us": 3456.0, "saved_device_us": 0.0,
+             "hbm_byte_seconds": 2 ** 20, "staged_bytes": 0.0,
+             "wal_bytes": 0.0, "net_http_bytes": 0.0,
+             "net_ici_bytes": 0.0, "regressed": False},
+        ],
+    }
+
+    def test_render_costs_panel(self):
+        out = render_costs("127.0.0.1:10101", self.DOC)
+        assert "accounts 2" in out
+        assert "REGRESSION: shape sig-a latency_us" in out
+        assert "folded 3" in out
+        lines = out.splitlines()
+        gold = next(l for l in lines if l.startswith("gold"))
+        assert "sig-a" in gold and gold.endswith("REGRESSED")
+        system = next(l for l in lines if l.startswith("system"))
+        assert not system.endswith("REGRESSED")
+
+    def test_render_costs_disabled(self):
+        out = render_costs("h:1", {"enabled": False})
+        assert "DISABLED" in out
+
+
+class TestConfigKnobs:
+    def test_obs_cost_knobs_round_trip(self, tmp_path):
+        c = Config()
+        c.data_dir = str(tmp_path / "d")
+        c.cost_ledger = False
+        c.cost_max_accounts = 64
+        c.cost_watch_bands = 32
+        c.cost_regression_k = 6.0
+        c.cost_regression_min_n = 8
+        c.cost_debt_threshold = 0.9
+        c2 = Config.from_toml(c.to_toml(), is_text=True)
+        assert c2.cost_ledger is False
+        assert c2.cost_max_accounts == 64
+        assert c2.cost_watch_bands == 32
+        assert c2.cost_regression_k == 6.0
+        assert c2.cost_regression_min_n == 8
+        assert c2.cost_debt_threshold == 0.9

@@ -620,8 +620,9 @@ class TestCardinalityAndLint:
         assert tiers <= {"local", "ici", "http"}
         assert ops <= {"count", "stop", "rowcounts", "write", "schema",
                        "pql", "import", "rcsrc", "bsisum", "unknown"}
-        # No per-config tenants here: only the defaults may appear.
-        assert tenants <= {"default", "other"}
+        # No per-config tenants here: only the defaults plus the cost
+        # ledger's reserved fallback row may appear.
+        assert tenants <= {"default", "other", "system"}
 
     def test_live_scrape_passes_lint(self, env):
         _, _, h = env

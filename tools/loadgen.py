@@ -202,6 +202,11 @@ class HTTPTransport:
             params.append(f"deadline={deadline}")
         self.query_path = (f"/index/{index}/query"
                            + ("?" + "&".join(params) if params else ""))
+        # X-Pilosa-Cost-Debt sightings, tenant -> count (the cost_skew
+        # judge gates on the header firing for the whale and ONLY the
+        # whale).
+        self.debt_by_tenant: Dict[str, int] = {}
+        self._debt_mu = threading.Lock()
 
     def do(self, entry: Dict[str, Any]) -> tuple:
         """-> (status, partial flag). Transport-level failure is 599 —
@@ -220,6 +225,11 @@ class HTTPTransport:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 body = r.read()
+                if r.headers.get("X-Pilosa-Cost-Debt"):
+                    with self._debt_mu:
+                        t = entry["tenant"]
+                        self.debt_by_tenant[t] = \
+                            self.debt_by_tenant.get(t, 0) + 1
                 partial = b'"partial": true' in body
                 return r.status, partial
         except urllib.error.HTTPError as e:
@@ -254,6 +264,7 @@ class StubTransport:
         self.entries: List[Dict[str, Any]] = []
         self._fn = status_fn or (lambda entry: (200, False))
         self._mu = threading.Lock()
+        self.debt_by_tenant: Dict[str, int] = {}
 
     def do(self, entry):
         with self._mu:
@@ -501,6 +512,11 @@ def start_inprocess(spec: Dict[str, Any], log) -> tuple:
     cfg.use_device = os.environ.get("PILOSA_TPU_USE_DEVICE", "off")
     cfg.sched_tenant_weights = {t: 1.0 for t in spec["tenants"]}
     cfg.integrity_shadow_sample = 4   # every 4th read shadow-verified
+    if spec.get("cost_skew"):
+        # The cost judge needs device_us attribution, which only the
+        # profiler produces: sample 1-in-2 (the ledger extrapolates by
+        # the sample rate, so shares stay unbiased).
+        cfg.profile_sample_rate = 2
     for k in ("availability", "latency_target", "shed_rate_max"):
         setattr(cfg, "slo_" + k, float(spec["objectives"][k]))
     cfg.slo_p99_us = float(spec["objectives"]["p99_us"])
@@ -736,6 +752,69 @@ def _judge_follower_reads(report: Dict[str, Any], transport,
         f"-> {'VIOLATED: ' + ','.join(bad) if bad else 'OK'}")
 
 
+def _judge_cost_skew(report: Dict[str, Any], transport,
+                     spec: Dict[str, Any], args, log) -> None:
+    """Post-run verdict for --cost-skew (whale + minnows mix):
+
+    - attribution: the whale's share of attributed device_us in
+      /debug/costs matches its share of the generated schedule within
+      --cost-share-tol (tenant and op picks are independent, so query
+      share ~ device share);
+    - debt: every X-Pilosa-Cost-Debt sighting was on a whale response
+      — a minnow stamped with debt means attribution leaked across
+      accounts."""
+    counts: Dict[str, int] = {}
+    for e in build_schedule(spec):
+        if e["phase"] == "run":
+            counts[e["tenant"]] = counts.get(e["tenant"], 0) + 1
+    total_q = sum(counts.values())
+    whale = max(counts, key=lambda t: counts[t]) if counts else ""
+    sched_share = counts.get(whale, 0) / total_q if total_q else 0.0
+
+    doc = transport.get_json("/debug/costs?sort=device_us&limit=200") \
+        or {}
+    dev_by_tenant: Dict[str, float] = {}
+    for row in doc.get("accounts") or []:
+        t = row.get("tenant", "")
+        dev_by_tenant[t] = dev_by_tenant.get(t, 0.0) \
+            + float(row.get("device_us", 0.0))
+    total_dev = sum(dev_by_tenant.values())
+    measured = dev_by_tenant.get(whale, 0.0) / total_dev \
+        if total_dev > 0 else 0.0
+
+    debt = dict(getattr(transport, "debt_by_tenant", {}))
+    strays = sorted(t for t in debt if t != whale)
+
+    tol = float(args.cost_share_tol)
+    ok_share = total_dev > 0 and abs(measured - sched_share) <= tol
+    ok_debt = not strays
+
+    report["cost_skew"] = {
+        "whale": whale,
+        "scheduled_share": round(sched_share, 4),
+        "device_us_share": round(measured, 4),
+        "device_us_by_tenant": {t: round(v, 1)
+                                for t, v in sorted(
+                                    dev_by_tenant.items())},
+        "debt_headers": debt,
+        "debt_strays": strays,
+    }
+    obj = report["objectives"]
+    obj["cost_attribution"] = {
+        "target": round(sched_share, 4),
+        "measured": round(measured, 4),
+        "verdict": "OK" if ok_share else "VIOLATED"}
+    obj["cost_debt"] = {
+        "target": 0, "measured": len(strays),
+        "verdict": "OK" if ok_debt else "VIOLATED"}
+    if not (ok_share and ok_debt):
+        report["verdict"] = "VIOLATED"
+    log(f"cost-skew: whale={whale} share {measured:.3f} "
+        f"(scheduled {sched_share:.3f}, tol {tol}) "
+        f"debt={sum(debt.values())} strays={strays or 'none'} "
+        f"-> {'OK' if ok_share and ok_debt else 'VIOLATED'}")
+
+
 def prepare_index(host: str, index: str, frame: str, log,
                   mix: str = "", columns: int = 1 << 16,
                   seed: int = 1) -> None:
@@ -824,6 +903,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--qps-recovery-min", type=float, default=0.5,
                    help="churn runs: final-decile ok-rate must recover "
                         "to this fraction of the first decile's")
+    p.add_argument("--cost-skew", action="store_true",
+                   help="arm the cost-attribution judge: the heaviest "
+                        "tenant's /debug/costs device_us share must "
+                        "match its schedule share, and the "
+                        "X-Pilosa-Cost-Debt header must stamp that "
+                        "tenant only")
+    p.add_argument("--cost-share-tol", type=float, default=0.25,
+                   help="absolute tolerance on the whale's device_us "
+                        "share vs its scheduled share")
     p.add_argument("--availability", type=float, default=99.9)
     p.add_argument("--p99-us", type=float, default=50_000.0)
     p.add_argument("--latency-target", type=float, default=99.0)
@@ -874,6 +962,7 @@ def spec_from_args(args) -> Dict[str, Any]:
         "frame": args.frame,
         "fault_at": args.fault_at,
         "staleness_ms": args.staleness_ms,
+        "cost_skew": args.cost_skew,
         "objectives": {
             "availability": args.availability,
             "p99_us": args.p99_us,
@@ -948,6 +1037,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                args, log)
         if args.staleness_ms > 0:
             _judge_follower_reads(report, transport, spec, args, log)
+        if args.cost_skew:
+            _judge_cost_skew(report, transport, spec, args, log)
         mm1 = _mismatch_total(transport.get_text("/metrics"))
         growth = max(0.0, mm1 - mm0)
         report["mismatch_growth"] = growth

@@ -71,7 +71,19 @@ ALLOWED_LABEL_KEYS = frozenset((
     "replica",       # read-path pick: owner | follower | fallback_owner
     "staleness",     # read class: strict | bounded
     "cache",         # result-cache interaction: hit | miss | verify
+    "shape",         # query-shape signatures (flight-ring-bounded)
+    "dimension",     # regression-watch dimensions (code-defined)
+    "account",       # cost-ledger event rows (code-defined)
 ))
+
+# Families whose label product includes the query-shape signature.
+# Shape cardinality is bounded by the flight ring / ledger account
+# caps (default 256 accounts, x tier for the net family), not by the
+# general --max-series default — they get a dedicated ceiling sized to
+# the caps. A cost family sailing past it means the LRU fold stopped
+# working.
+SHAPE_LABELED_PREFIXES = ("pilosa_cost_", "pilosa_perf_regression")
+SHAPE_SERIES_CEILING = 2048
 
 # Suffixes that carry a recognized unit for histogram families.
 # `_size` is the dimensionless-count ladder (e.g. writes per WAL group
@@ -152,10 +164,13 @@ def lint(text: str, max_series: int = 500) -> List[str]:
                     f"{name}: nonstandard unit suffix {banned} "
                     f"(standardize on _us / _seconds / _bytes)")
         rows = series.get(name, [])
-        if len(rows) > max_series:
+        ceiling = max_series
+        if name.startswith(SHAPE_LABELED_PREFIXES):
+            ceiling = SHAPE_SERIES_CEILING
+        if len(rows) > ceiling:
             problems.append(
                 f"{name}: {len(rows)} series exceeds the "
-                f"--max-series ceiling of {max_series}")
+                f"ceiling of {ceiling}")
         seen_keys = set()
         for _, labels in rows:
             seen_keys.update(labels)
@@ -208,10 +223,26 @@ def live_scrape() -> str:
                 body=b"Count(Bitmap(rowID=2, frame=f))",
                 headers={"x-pilosa-staleness": "100ms"},
             ).status == 200
+            # Tenant-attributed traffic: populates the cost-ledger
+            # families (pilosa_cost_*{tenant,shape}) so the lint
+            # covers their label vocabulary, and confirms the
+            # /debug/costs endpoint is backed by the same ledger.
+            assert h.handle(
+                "POST", "/index/i/query",
+                body=b"Count(Bitmap(rowID=1, frame=f))",
+                headers={"x-pilosa-tenant": "lint"},
+            ).status == 200
+            costs = h.handle("GET", "/debug/costs",
+                             params={"sort": "device_us"})
+            assert costs.status == 200
+            assert b"accounts" in costs.body
             resp = h.handle("GET", "/metrics",
                             params={"exemplars": "true"})
             assert resp.status == 200
-            return resp.body.decode()
+            text = resp.body.decode()
+            assert "pilosa_cost_queries_total" in text, \
+                "cost ledger families missing from the live scrape"
+            return text
         finally:
             holder.close()
 
