@@ -1469,6 +1469,68 @@ def main():
             f"metered end-to-end path {e2e_overhead:.1%} over baseline " \
             f"— way past measurement noise, a tap is misrouted"
 
+    with section("health_overhead"):
+        # Liveness-plane guard, two halves. (1) The per-iteration tap
+        # a registered loop pays — one beat() (a handful of attribute
+        # writes) plus one in-flight bracket (object alloc + two small
+        # dict ops under _imu) — must stay under 1% of the lone-query
+        # fast path: instrumentation that taxes the thing it watches
+        # gets turned off in production, and then nobody sees the
+        # hang. (2) A full watchdog sweep over a realistic population
+        # (the ~dozen registered subsystems plus in-flight ops) must
+        # finish in under 5 ms — it runs every sweep-interval on its
+        # own thread and must never become a GIL tenant.
+        _progress("health liveness tap overhead")
+        from pilosa_tpu.obs.health import HEALTH as _health
+
+        _health.reset()
+        for _name in ("wal", "hint-drain", "sched-dispatch",
+                      "mesh-count-batch", "gossip-probe",
+                      "gossip-pushpull", "rebalance", "anti-entropy",
+                      "status-poll", "cache-flush", "scrub",
+                      "spmd-worker"):
+            _health.register(_name, interval=1.0)
+        hb = _health.register("bench-loop", interval=1.0)
+        n_tap = 20000
+        t0 = time.perf_counter()
+        for _ in range(n_tap):
+            hb.beat()
+        beat_us = (time.perf_counter() - t0) / n_tap * 1e6
+        t0 = time.perf_counter()
+        for _ in range(n_tap):
+            with _health.inflight("bench-loop", "op", base=5.0):
+                pass
+        inflight_us = (time.perf_counter() - t0) / n_tap * 1e6
+        health_overhead = (beat_us + inflight_us) / (base_best * 1e6)
+
+        # Sweep cost with brackets live (worst case: held ops must be
+        # aged, not just counted).
+        stack = [_health.inflight(f"s{i}", "op", base=60.0)
+                 for i in range(8)]
+        for cm in stack:
+            cm.__enter__()
+        n_sweep = 200
+        t0 = time.perf_counter()
+        for _ in range(n_sweep):
+            _health.sweep()
+        sweep_ms = (time.perf_counter() - t0) / n_sweep * 1e3
+        for cm in stack:
+            cm.__exit__(None, None, None)
+        _health.reset()
+
+        details["health_overhead"] = {
+            "beat_us": beat_us,
+            "inflight_us": inflight_us,
+            "overhead_frac": health_overhead,
+            "sweep_ms": sweep_ms,
+            "subsystems": 13}
+        assert health_overhead < 0.01, \
+            f"health tap {beat_us + inflight_us:.2f} us is " \
+            f"{health_overhead:.1%} of the lone query — exceeds the " \
+            f"1% guard"
+        assert sweep_ms < 5.0, \
+            f"watchdog sweep {sweep_ms:.2f} ms exceeds 5 ms"
+
     with section("profile_overhead"):
         # Measured-profiling guard, two halves. (1) Profiling OFF: the
         # per-query cost of the handler's sampling decision plus the

@@ -215,6 +215,9 @@ GET  /metrics                      Prometheus text   GET  /version
 POST /index/{i}/query?explain=true predicted plan (routing, quarantine, no dispatch)
 POST /index/{i}/query?profile=true measured profile (phase times, bytes, roofline)
 GET  /debug/queries                recent + slow     GET  /debug/traces/{id} spans
+GET  /healthz                      liveness (LB)     GET  /readyz            readiness (LB)
+GET  /debug/health                 watchdog + heartbeat table
+GET  /debug/bundle                 diagnostic dossier (?write=true persists)
 GET  /debug/pprof/profile          sampling profiler
 GET  /debug/pprof/heap?start=1     alloc tracing (opt-in: PILOSA_TPU_HEAP_TRACE=1)
 </pre>
@@ -523,6 +526,12 @@ class Handler:
         # p2c load signal peers spread reads by); server wiring points
         # it at the query scheduler. None = report 0.
         self.queue_depth_fn = None
+        # Liveness plane (obs.health, [health] config). /healthz and
+        # /readyz read the process-global registry; ready_fn is the
+        # server's serving-state half of readiness (open() completed,
+        # close() not begun). None = embedded/test handlers count as
+        # serving.
+        self.ready_fn = None
         # SLO observatory (obs.slo.SLORecorder; [slo] config). Every
         # coordinator query outcome — success, partial, shed 429,
         # deadline 504, backpressure 503, other errors — is recorded
@@ -581,6 +590,10 @@ class Handler:
         r("GET", r"/status", self._get_status)
         r("GET", r"/version", self._get_version)
         r("GET", r"/metrics", self._get_metrics)
+        r("GET", r"/healthz", self._get_healthz)
+        r("GET", r"/readyz", self._get_readyz)
+        r("GET", r"/debug/health", self._get_debug_health)
+        r("GET", r"/debug/bundle", self._get_debug_bundle)
         r("GET", r"/debug/vars", self._get_expvar)
         r("GET", r"/debug/slo", self._get_debug_slo)
         r("GET", r"/debug/fleet", self._get_debug_fleet)
@@ -701,6 +714,10 @@ class Handler:
         reg.register_collector(self._collect_slo)
         reg.register_collector(self._collect_spmd)
         reg.register_collector(self._collect_read_path)
+        # Liveness plane: pilosa_health_state{subsystem} +
+        # pilosa_watchdog_trips_total{subsystem,kind} (process-wide
+        # registry, bounded to the registered loops).
+        reg.register_collector(obs.health.families)
         # Measured-profile histograms (process-wide: every profiled
         # query records into obs.profile.STATS regardless of handler).
         reg.register_collector(obs.profile.STATS.families)
@@ -918,6 +935,65 @@ class Handler:
         }
         doc["debt_threshold"] = self.cost_debt_threshold
         return _json_resp(doc)
+
+    # -- liveness plane (/healthz, /readyz, /debug/health, /debug/bundle) ----
+
+    def _get_healthz(self, pv, params, headers, body):
+        """k8s-style liveness: 200 while the watchdog itself is
+        beating. A STALLED subsystem does NOT flip this — a node that
+        can still diagnose itself must not be restarted out from under
+        its own dossier; that is /readyz's job."""
+        h = obs.health.HEALTH
+        if h.watchdog_alive():
+            return _json_resp({"status": "ok",
+                               "watchdog": "alive" if h.enabled
+                               and h._thread is not None else "off"})
+        return _json_resp({"status": "unhealthy",
+                           "watchdog": "dead"}, 503)
+
+    def _get_readyz(self, pv, params, headers, body):
+        """k8s-style readiness: serving-state ∧ no STALLED critical
+        subsystem. A mesh that lost its device plane stays ready — the
+        executor host-folds (degraded-mode-capable) — so readiness
+        only drops when traffic would actually be harmed. 503 carries
+        the reasons so an operator can go straight to the dossier."""
+        reasons = []
+        if self.ready_fn is not None:
+            try:
+                if not self.ready_fn():
+                    reasons.append("not-serving")
+            except Exception:  # noqa: BLE001 — a broken probe reads
+                reasons.append("not-serving")  # as not serving
+        h = obs.health.HEALTH
+        for name in h.stalled_critical():
+            reasons.append(f"stalled:{name}")
+        if not h.watchdog_alive():
+            reasons.append("watchdog-dead")
+        if reasons:
+            return _json_resp({"status": "unready",
+                               "reasons": reasons}, 503)
+        return _json_resp({"status": "ok"})
+
+    def _get_debug_health(self, pv, params, headers, body):
+        """The full health table: every registered heartbeat's state,
+        age, and owning thread; in-flight ops with deadlines; trip
+        counters; gossiped peer rollups."""
+        return _json_resp(obs.health.HEALTH.snapshot())
+
+    def _get_debug_bundle(self, pv, params, headers, body):
+        """The diagnostic dossier, on demand — identical to what a
+        watchdog trip writes under <data-dir>/.dossier/ and what
+        `pilosa-tpu diagnose` fetches. ?write=true also persists it."""
+        h = obs.health.HEALTH
+        doc = h.build_bundle(reason="on-demand")
+        if params.get("write") == "true":
+            try:
+                doc["written_to"] = h.write_dossier(doc=doc)
+            except OSError as e:
+                doc["written_to"] = None
+                doc["write_error"] = str(e)
+        return Response(200, {"Content-Type": "application/json"},
+                        h.encode_bundle(doc) + b"\n")
 
     def _collect_runtime(self) -> list:
         prom = obs.prom
@@ -1688,7 +1764,13 @@ class Handler:
             "  /debug/queries      recent + slow query trace rings "
             "(?threshold_us=N re-filters)\n"
             "  /debug/traces/<id>  one query trace, all spans with "
-            "timings and tags\n\n"
+            "timings and tags\n"
+            "  /debug/health       watchdog verdicts: per-subsystem "
+            "heartbeats, in-flight ops, peers\n"
+            "  /debug/bundle       diagnostic dossier (thread stacks, "
+            "health, rings; ?write=true persists)\n"
+            "  /healthz /readyz    load-balancer probes (liveness / "
+            "readiness; 503 when unready)\n\n"
             "query scheduling (when [sched] enabled):\n"
             "  POST /index/<i>/query reads X-Pilosa-Tenant for fair "
             "queuing; overload answers\n"

@@ -202,6 +202,20 @@ TOML schema:
     shed-rate-max = 0.05        # max tolerated admission-shed (429)
                                 # fraction
 
+    [health]
+    enabled = true              # liveness plane (obs/health.py):
+                                # heartbeats, watchdog, /healthz,
+                                # /readyz, dossiers
+    sweep-interval = "1s"       # watchdog sweep period
+    stall-after = 4.0           # deadline multiple: a heartbeat older
+                                # than stall-after x its interval (or
+                                # an in-flight op past stall-after x
+                                # its base budget) is STALLED
+    dossier-max = 262144        # max bytes per diagnostic dossier
+                                # (over-budget bundles shed sections)
+    dossier-keep = 8            # newest dossiers retained under
+                                # <data-dir>/.dossier/
+
 Defaults match the reference (port 10101, 1 replica, 16 partitions,
 10-minute anti-entropy, 60-second status polling). Durations accept Go
 style strings ("10m", "60s", "1h30m").
@@ -490,6 +504,15 @@ class Config:
         self.slo_p99_us: float = 50_000.0
         self.slo_latency_target: float = 99.0
         self.slo_shed_rate_max: float = 0.05
+        # [health] — liveness plane (obs/health.py): the watchdog
+        # sweep period, the stall-after deadline multiple applied to
+        # every heartbeat interval and in-flight op budget, and the
+        # dossier size/retention bounds.
+        self.health_enabled: bool = True
+        self.health_sweep_interval: float = 1.0
+        self.health_stall_after: float = 4.0
+        self.health_dossier_max: int = 262_144
+        self.health_dossier_keep: int = 8
         # [[schema.indexes]] — declarative schema applied at server
         # open (module docstring). Normalized dicts: {"name", optional
         # "column-label", "frames": [{"name", optional "row-label",
@@ -662,6 +685,16 @@ class Config:
                                             c.slo_latency_target))
         c.slo_shed_rate_max = float(sl.get("shed-rate-max",
                                            c.slo_shed_rate_max))
+        he = data.get("health", {})
+        c.health_enabled = bool(he.get("enabled", c.health_enabled))
+        if "sweep-interval" in he:
+            c.health_sweep_interval = parse_duration(he["sweep-interval"])
+        c.health_stall_after = float(he.get("stall-after",
+                                            c.health_stall_after))
+        c.health_dossier_max = int(he.get("dossier-max",
+                                          c.health_dossier_max))
+        c.health_dossier_keep = int(he.get("dossier-keep",
+                                           c.health_dossier_keep))
         c.schema_indexes = _parse_schema(data.get("schema", {}))
         return c
 
@@ -839,6 +872,13 @@ class Config:
             f"p99-us = {int(self.slo_p99_us)}\n"
             f"latency-target = {self.slo_latency_target}\n"
             f"shed-rate-max = {self.slo_shed_rate_max}\n"
+            f"\n[health]\n"
+            f"enabled = {'true' if self.health_enabled else 'false'}\n"
+            f'sweep-interval = '
+            f'"{int(self.health_sweep_interval * 1000)}ms"\n'
+            f"stall-after = {self.health_stall_after}\n"
+            f"dossier-max = {self.health_dossier_max}\n"
+            f"dossier-keep = {self.health_dossier_keep}\n"
             + self._schema_toml()
         )
 

@@ -973,11 +973,18 @@ class Fragment:
         self._snap_thread.start()
 
     def _snapshot_worker(self, frozen: Bitmap):
+        from ..obs.health import HEALTH
+
         start = time.monotonic()
         err: Optional[BaseException] = None
         tmp = self.path + ".snapshotting"
         try:
-            with open(tmp, "wb") as f:
+            # Visibility-only bracket (base=None): snapshot wall time
+            # scales with fragment size so the watchdog never judges
+            # it, but a disk-wedged snapshot shows up in /debug/health
+            # with this thread's name and stack.
+            with HEALTH.inflight("snapshot", "write"), \
+                    open(tmp, "wb") as f:
                 # Integrity footer rides the temp through the atomic
                 # rename: every durable snapshot is born verifiable.
                 frozen.write_to(f, footer=True)

@@ -50,6 +50,7 @@ from typing import Optional
 from .. import fault
 from ..obs import Histogram, StatMap
 from ..obs import costs
+from ..obs.health import HEALTH
 
 FSYNC_NEVER = "never"
 FSYNC_GROUP = "group"
@@ -219,11 +220,17 @@ class WalCommitter:
                     self._leader = True
                     break
                 self._cv.wait(0.05)
-        # Leader, outside the lock: let the group accumulate.
-        if window > 0:
-            time.sleep(window)
+        # Leader, outside the lock: let the group accumulate. The
+        # whole leader turn — window sleep, buffered write, fsync — is
+        # one in-flight op for the watchdog: a disk that stops
+        # answering fsync wedges every writer behind this lock, which
+        # is exactly the hang the liveness plane must see.
         try:
-            self._commit()
+            with HEALTH.inflight("wal", "commit",
+                                 base=max(1.0, window * 4)):
+                if window > 0:
+                    time.sleep(window)
+                self._commit()
         finally:
             with self._cv:
                 self._leader = False

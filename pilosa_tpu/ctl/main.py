@@ -813,6 +813,126 @@ def cmd_costs(args) -> int:
         time.sleep(args.interval)
 
 
+def render_health(host: str, doc: dict, ready_doc: dict) -> str:
+    """One screenful from a /debug/health document plus the /readyz
+    verdict: watchdog vitals, then the per-subsystem heartbeat table,
+    in-flight ops, gossiped peer health. Pure — tests feed it canned
+    snapshots."""
+    ready = ready_doc.get("status") == "ok"
+    lines = [f"pilosa-tpu health — via {host}   "
+             f"readyz {'OK' if ready else 'UNREADY'}   "
+             f"watchdog {'alive' if doc.get('watchdog_alive') else 'DEAD'}"
+             f"   sweeps {int(doc.get('sweeps', 0))}"
+             f"   trips {int(doc.get('trips_total', 0))}"]
+    if not ready:
+        reasons = ready_doc.get("reasons") or []
+        lines.append("unready: " + ", ".join(str(r) for r in reasons))
+    lines.append("")
+    lines.append(f"{'subsystem':<18} {'state':<8} {'crit':<5} "
+                 f"{'interval':>9} {'age':>8} {'beats':>9} "
+                 f"{'trips':>6}  thread")
+    subs = doc.get("subsystems") or {}
+    for name in sorted(subs):
+        s = subs[name]
+        state = s.get("state", "?")
+        if s.get("parked"):
+            state = "idle"
+        iv = s.get("interval_s")
+        age = s.get("age_s")
+        line = (f"{name:<18} {state:<8} "
+                f"{'yes' if s.get('critical') else '-':<5} "
+                f"{(f'{iv:.2f}s' if iv else 'event'):>9} "
+                f"{(f'{age:.1f}s' if age is not None else '-'):>8} "
+                f"{int(s.get('beats', 0)):>9} "
+                f"{int(s.get('trips', 0)):>6}  {s.get('thread', '-')}")
+        if s.get("state") == "stalled":
+            line += f"   STALLED {s.get('stalled_for_s', 0):.1f}s"
+        lines.append(line)
+    infl = doc.get("inflight") or []
+    if infl:
+        lines.append("")
+        lines.append("in-flight ops:")
+        for op in infl:
+            bound = op.get("deadline_s")
+            lines.append(
+                f"  {op.get('subsystem', '?')}/{op.get('kind', '?')} "
+                f"running {op.get('age_s', 0):.1f}s"
+                f" (bound {f'{bound:.1f}s' if bound else 'none'})"
+                f" on {op.get('thread', '?')}")
+    peers = doc.get("peers") or {}
+    if peers:
+        lines.append("")
+        lines.append("gossiped peers:")
+        for h in sorted(peers):
+            p = peers[h]
+            verdict = "ok" if p.get("ready", True) else "UNREADY"
+            stalled = p.get("stalled") or []
+            line = f"  {h:<24} {verdict}"
+            if stalled:
+                line += "   stalled: " + ",".join(stalled)
+            lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def cmd_health(args) -> int:
+    """Poll /debug/health (+ /readyz) on an interval and render the
+    liveness panel: watchdog vitals, per-subsystem heartbeats,
+    in-flight ops, gossiped peer verdicts."""
+    import json as _json
+    import urllib.request
+
+    n = 0
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{args.host}/debug/health", timeout=10) as resp:
+                doc = _json.loads(resp.read().decode())
+            try:
+                with urllib.request.urlopen(
+                        f"http://{args.host}/readyz", timeout=10) as resp:
+                    ready_doc = _json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:  # 503 carries the body
+                ready_doc = _json.loads(e.read().decode())
+        except OSError as e:
+            print(f"scrape {args.host}: {e}", file=sys.stderr)
+            return 1
+        out = render_health(args.host, doc, ready_doc)
+        if sys.stdout.isatty() and args.n != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        n += 1
+        if args.n and n >= args.n:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_diagnose(args) -> int:
+    """Pull GET /debug/bundle — the same bounded JSON dossier the
+    watchdog writes on a trip — and save it locally for attachment to
+    an incident. `--write` also asks the node to persist a copy under
+    its own <data-dir>/.dossier/."""
+    import urllib.request
+
+    url = f"http://{args.host}/debug/bundle"
+    if args.write:
+        url += "?write=true"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            body = resp.read()
+    except OSError as e:
+        print(f"fetch {url}: {e}", file=sys.stderr)
+        return 1
+    out = args.output
+    if out == "-":
+        sys.stdout.write(body.decode())
+        return 0
+    with open(out, "wb") as f:
+        f.write(body)
+    print(f"wrote {out} ({len(body)} bytes)")
+    return 0
+
+
 def cmd_loadgen(args) -> int:
     """`pilosa-tpu loadgen` — delegate to tools/loadgen.py (its parser
     owns every flag; exit code is the SLO verdict)."""
@@ -981,6 +1101,27 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", type=int, default=0,
                    help="number of polls, 0 = until interrupted")
     p.set_defaults(fn=cmd_costs)
+
+    p = sub.add_parser("health",
+                       help="liveness panel: watchdog, heartbeats, "
+                            "in-flight ops, peer verdicts")
+    _add_host(p)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (default 2)")
+    p.add_argument("-n", type=int, default=0,
+                   help="number of polls, 0 = until interrupted")
+    p.set_defaults(fn=cmd_health)
+
+    p = sub.add_parser("diagnose",
+                       help="pull a diagnostic dossier (/debug/bundle) "
+                            "from a node")
+    _add_host(p)
+    p.add_argument("-o", "--output", default="-",
+                   help="file to write ('-' for stdout)")
+    p.add_argument("--write", action="store_true",
+                   help="also persist a copy under the node's "
+                        "<data-dir>/.dossier/")
+    p.set_defaults(fn=cmd_diagnose)
 
     # Placeholder row for --help only: main() routes "loadgen" before
     # argparse runs, because tools/loadgen.py's parser owns its flags

@@ -245,6 +245,11 @@ class Executor:
         # legacy fail-on-remote-error behavior while `hints` is None.
         self.write_consistency: str = "quorum"
         self.hints = None
+        # Liveness-plane read steering (ISSUE 20): server-wired to
+        # HEALTH.peer_ready so follower reads route around a peer
+        # whose gossiped health digest says a critical subsystem is
+        # stalled. None = no filtering (bare executors, unit tests).
+        self.peer_health_ok = None
         # None = auto (device path when available); False = host roaring only.
         self.use_device = use_device
         # Cost-routing threshold (see _route_to_host); None = resolve
@@ -1719,7 +1724,8 @@ class Executor:
                             h, index, s, read_bound),
                     queue_depth=self.epochs.queue_depth,
                     prefer=self.host,
-                    ici_hosts=self.ici_hosts or None, rnd=rnd)
+                    ici_hosts=self.ici_hosts or None, rnd=rnd,
+                    node_ok=self.peer_health_ok)
                 if pick is not None and pick.host != owners[0].host:
                     role = "follower"
                     read["followers"] += 1
@@ -2711,7 +2717,8 @@ class Executor:
                             h, index, s, read_bound),
                     queue_depth=self.epochs.queue_depth,
                     prefer=self.host,
-                    ici_hosts=self.ici_hosts or None)
+                    ici_hosts=self.ici_hosts or None,
+                    node_ok=self.peer_health_ok)
             if pick is not None:
                 # "follower" = spread away from the ring primary
                 # (owners[0] is ring order) — the label that proves

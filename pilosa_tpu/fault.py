@@ -82,6 +82,13 @@ Injection points currently wired:
                       the main file, kind="side-wal" for the snapshot
                       side log) — a `bits=`/`offset=` rule flips bits
                       in the bytes read, simulating at-rest bit rot
+    watchdog.stall    inside every registered heartbeat's beat()
+                      (subsystem) and before each SPMD descriptor
+                      dispatch (subsystem="spmd-dispatch", op) — a
+                      `delay=` rule wedges that loop mid-iteration
+                      with its heartbeat stale, the deterministic
+                      hang the liveness watchdog must detect; e.g.
+                      `watchdog.stall:delay=2,subsystem=hint-drain`
 
 Every fired fault is counted in `fault.STATS` and recorded in the
 bounded `fault.log()` ring for assertions.

@@ -13,6 +13,7 @@ from .metrics import Histogram, StatMap
 from . import costs
 from . import fleet
 from . import flight
+from . import health
 from . import log
 from . import profile
 from . import prom
@@ -41,6 +42,7 @@ __all__ = [
     "fleet",
     "flight",
     "get_logger",
+    "health",
     "jax_scope",
     "log",
     "profile",

@@ -174,6 +174,19 @@ def node_row(samples: dict, vars_snap: Optional[dict] = None) -> dict:
                                         0)),
         "residency_ratio": ratio if ratio is not None else 1.0,
     }
+    # Liveness verdict (ISSUE 20): pilosa_health_state{subsystem} is
+    # 1 while that subsystem is STALLED; list the wedged ones so the
+    # fleet pane names the stuck loop, not just a red node.
+    stalled = sorted(
+        dict(labels).get("subsystem", "")
+        for (n, labels), v in samples.items()
+        if n == "pilosa_health_state" and v >= 1.0)
+    row["health"] = {
+        "ready": bool(samples.get(("pilosa_health_ready", ()), 1.0)),
+        "stalled": [s for s in stalled if s],
+        "watchdog_trips": int(_sum_series(
+            samples, "pilosa_watchdog_trips_total")),
+    }
     row["requests_total"] = int(_sum_series(
         samples, "pilosa_query_outcome_total"))
     row["uptime_seconds"] = samples.get(("pilosa_uptime_seconds", ()),

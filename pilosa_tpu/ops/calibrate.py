@@ -215,14 +215,21 @@ def calibrate_count_backend(force_measure: bool = False) -> dict:
             done = threading.Event()
 
             def work():
+                from ..obs.health import HEALTH
                 try:
                     from .kernels import pallas_probe_ok
 
-                    if on_tpu and not pallas_probe_ok():
-                        box["rec"] = {"backend": "xla",
-                                      "source": "probe-failed"}
-                    else:
-                        box["rec"] = _measure(interpret=not on_tpu)
+                    # Visibility-only bracket (base=None): the caller
+                    # already bounds this with done.wait(timeout) and
+                    # abandons a hung compile, so the watchdog never
+                    # judges it — but /debug/health shows what the
+                    # abandoned thread is stuck in.
+                    with HEALTH.inflight("calibrate", "measure"):
+                        if on_tpu and not pallas_probe_ok():
+                            box["rec"] = {"backend": "xla",
+                                          "source": "probe-failed"}
+                        else:
+                            box["rec"] = _measure(interpret=not on_tpu)
                 except Exception as e:  # noqa: BLE001 — any failure
                     # means the safe backend, with the reason recorded
                     box["rec"] = {"backend": "xla", "source": "error",

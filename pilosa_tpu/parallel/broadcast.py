@@ -111,4 +111,4 @@ class HTTPBroadcaster(Broadcaster):
         data = marshal_message(msg)
         for host in self._peers():
             threading.Thread(target=self._send, args=(host, data),
-                             daemon=True).start()
+                             name="broadcast-send", daemon=True).start()

@@ -389,7 +389,8 @@ def preferred_owner(owners: List[Node], breaker_state=None,
 def pick_read_replica(owners: List[Node], breaker_state=None,
                       staleness_ok=None, queue_depth=None,
                       prefer: Optional[str] = None,
-                      ici_hosts=None, rnd=None) -> Optional[Node]:
+                      ici_hosts=None, rnd=None,
+                      node_ok=None) -> Optional[Node]:
     """Bounded-staleness read placement (ISSUE 18): spread an eligible
     read over EVERY in-sync replica instead of pinning it to
     `preferred_owner`'s deterministic pick. Eligibility is strict —
@@ -415,6 +416,14 @@ def pick_read_replica(owners: List[Node], breaker_state=None,
     if staleness_ok is not None:
         cands = [o for o in cands
                  if o.host == prefer or staleness_ok(o.host)]
+    if node_ok is not None:
+        # Liveness-plane filter (ISSUE 20): `node_ok(host) -> bool` is
+        # the gossiped per-node health verdict (HEALTH.peer_ready) —
+        # a peer advertising a stalled critical subsystem is wedged,
+        # not down, so membership still shows it UP and the breaker
+        # may not have opened yet. Advisory: unknown/stale peers pass.
+        cands = [o for o in cands
+                 if o.host == prefer or node_ok(o.host)]
     if not cands:
         return None
     if prefer is not None:

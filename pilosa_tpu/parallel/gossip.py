@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs.health import HEALTH
 from ..wire import marshal_message, unmarshal_message
 from .broadcast import Broadcaster, NodeSet
 
@@ -179,6 +180,8 @@ class GossipNodeSet(NodeSet, Broadcaster):
 
     def close(self) -> None:
         self._closed.set()
+        HEALTH.unregister("gossip-probe")
+        HEALTH.unregister("gossip-pushpull")
         for s in (self._udp, self._tcp):
             if s is not None:
                 try:
@@ -418,7 +421,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
                 threading.Thread(
                     target=self._indirect_probe,
                     args=((str(target[0]), int(target[1])), seq, src),
-                    daemon=True).start()
+                    name="gossip-indirect", daemon=True).start()
 
     def _indirect_probe(self, target: Tuple[str, int], seq, reply_to):
         if self._ping(target):
@@ -436,7 +439,9 @@ class GossipNodeSet(NodeSet, Broadcaster):
             self._acks.pop(seq, None)
 
     def _probe_loop(self):
+        hb = HEALTH.register("gossip-probe", interval=self.probe_interval)
         while not self._closed.wait(self.probe_interval):
+            hb.beat()
             m = self._next_probe_target()
             if m is not None:
                 self._probe(m)
@@ -501,7 +506,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
             except OSError:
                 return
             threading.Thread(target=self._serve_tcp, args=(conn,),
-                             daemon=True).start()
+                             name="gossip-serve-tcp", daemon=True).start()
 
     def _serve_tcp(self, conn: socket.socket):
         with conn:
@@ -541,6 +546,8 @@ class GossipNodeSet(NodeSet, Broadcaster):
             self._log(f"gossip: join {addr} failed: {e}")
 
     def _push_pull_loop(self):
+        hb = HEALTH.register("gossip-pushpull",
+                             interval=self.push_pull_interval)
         while not self._closed.is_set():
             # Isolated (no members yet, e.g. seed was down at open):
             # retry the seeds on a fast cadence instead of waiting out
@@ -550,6 +557,7 @@ class GossipNodeSet(NodeSet, Broadcaster):
                      else self.push_pull_interval)
             if self._closed.wait(delay):
                 return
+            hb.beat()
             members = self._snapshot_members()
             if members:
                 self._join(random.choice(members).addr)

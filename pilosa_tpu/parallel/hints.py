@@ -64,6 +64,7 @@ from typing import Callable, Dict, List, Optional
 from .. import fault
 from ..core.wal import WalCommitter, WalConfig
 from ..obs import StatMap, get_logger
+from ..obs.health import HEALTH
 from ..roaring.serialize import fnv32a
 
 HINT_MAGIC = 0xF9
@@ -279,6 +280,7 @@ class HintManager:
         self._wake = threading.Event()
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._hb = None  # registered at start()
         self._recover_existing()
 
     # -- lifecycle -----------------------------------------------------------
@@ -312,6 +314,9 @@ class HintManager:
     def start(self):
         if self._thread is not None:
             return
+        self._hb = HEALTH.register("hint-drain",
+                                   interval=self.drain_interval,
+                                   critical=True)
         self._thread = threading.Thread(target=self._drain_loop,
                                         name="hint-drain", daemon=True)
         self._thread.start()
@@ -322,6 +327,7 @@ class HintManager:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+            HEALTH.unregister("hint-drain")
         with self._mu:
             for log in self._logs.values():
                 log.close()
@@ -384,6 +390,7 @@ class HintManager:
             self._wake.clear()
             if self._closed.is_set():
                 return
+            self._hb.beat()
             try:
                 self.drain_once()
             except Exception as e:  # noqa: BLE001 — drainer never dies
@@ -416,7 +423,14 @@ class HintManager:
                         break  # known-down: wait for half-open/notify
                     fault.point("hints.replay", target=host,
                                 kind=payload.get("kind", ""))
-                    self._replay(host, payload)
+                    # Each replay is one tracked op: a dead-slow target
+                    # blocking the drainer inside the client timeout is
+                    # accounted (excuses the heartbeat); past 4x the
+                    # drain pacing + stall-after it is a wedge.
+                    with HEALTH.inflight("hint-drain", "replay",
+                                         base=max(30.0,
+                                                  4 * self.drain_interval)):
+                        self._replay(host, payload)
                     acked += 1
             except Exception as e:  # noqa: BLE001 — stop, keep order
                 HINT_STATS.inc("replay_failures")

@@ -152,14 +152,20 @@ def _stage_pipeline(pack_range, ranges, dev, on_chunk=None):
             q.put(("err", e))
 
     threading.Thread(target=produce, daemon=True, name="h2d-pack").start()
-    pieces = []
-    for _ in ranges:
-        tag, payload = q.get()
-        if tag == "err":
-            raise payload
-        pieces.append(jax.device_put(payload, dev))
-        if on_chunk is not None:
-            on_chunk(payload.nbytes)
+    from ..obs.health import HEALTH
+
+    # Visibility-only bracket (base=None): staging time scales with
+    # the slab, so the watchdog never judges it — but a wedged
+    # device_put shows this thread pinned in /debug/health.
+    with HEALTH.inflight("h2d-pack", "stage"):
+        pieces = []
+        for _ in ranges:
+            tag, payload = q.get()
+            if tag == "err":
+                raise payload
+            pieces.append(jax.device_put(payload, dev))
+            if on_chunk is not None:
+                on_chunk(payload.nbytes)
     return pieces
 
 

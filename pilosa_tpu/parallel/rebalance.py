@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import fault
+from ..obs.health import HEALTH
 from .cluster import (
     NODE_STATE_JOINING,
     NODE_STATE_LEAVING,
@@ -112,16 +113,22 @@ class Rebalancer:
     def run(self, poll_interval: float = 0.25):
         """Service loop: wait for a trigger (or closing), run a pass.
         Errors never kill the loop — the next trigger retries."""
-        while self.closing is None or not self.closing.closed:
-            if not self._wake.wait(poll_interval):
-                continue
-            self._wake.clear()
-            try:
-                self.rebalance_once()
-            except Exception as e:  # noqa: BLE001 — daemons never die
-                with self._mu:
-                    self._last_error = str(e)
-                self._log(f"rebalance pass failed: {e}")
+        hb = HEALTH.register("rebalance", interval=poll_interval)
+        try:
+            while self.closing is None or not self.closing.closed:
+                triggered = self._wake.wait(poll_interval)
+                hb.beat()
+                if not triggered:
+                    continue
+                self._wake.clear()
+                try:
+                    self.rebalance_once()
+                except Exception as e:  # noqa: BLE001 — daemons never die
+                    with self._mu:
+                        self._last_error = str(e)
+                    self._log(f"rebalance pass failed: {e}")
+        finally:
+            HEALTH.unregister("rebalance")
 
     def _closed(self) -> bool:
         return self.closing is not None and self.closing.closed
@@ -256,7 +263,10 @@ class Rebalancer:
                     fault.point("rebalance.transfer", index=t.index,
                                 frame=t.frame, view=t.view, slice=t.slice,
                                 target=t.target)
-                    if self._transfer_attempt(t):
+                    with HEALTH.inflight("rebalance", "transfer",
+                                         base=60.0):
+                        ok = self._transfer_attempt(t)
+                    if ok:
                         with self._mu:
                             self._completed += 1
                             self._bytes_total += t.bytes

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..core.fragment import MUTATION_EPOCH
 from ..obs import StatMap, costs, jax_scope, profile, span
+from ..obs.health import HEALTH
 from ..ops.pool import (
     CONTAINER_WORDS,
     INVALID_KEY,
@@ -2312,9 +2313,17 @@ class MeshManager:
         what fragmentation still costs is one extra program dispatch
         (~2.5 ms floor) plus padded-width device time per extra group,
         which the 3 ms window remains correctly priced against."""
+        # Event-driven (interval=None): blocking in q.get() with an
+        # empty queue is idle, not a hang — the watchdog judges this
+        # subsystem only through the in-flight record around each
+        # group's device execution below.
+        hb = HEALTH.register("mesh-count-batch", interval=None,
+                             critical=True)
         last_group = 1
         while True:
+            hb.idle()
             first = self._batch_q.get()
+            hb.beat()
             reqs = [first]
             with self._burst_mu:
                 hinted = self._burst_hint > 1
@@ -2343,7 +2352,12 @@ class MeshManager:
                 groups.setdefault(r.group_key(), []).append(r)
             for group in groups.values():
                 try:
-                    self._run_count_group(group)
+                    # A device launch that never returns (wedged
+                    # runtime, lost collective) must trip the watchdog:
+                    # every queued count behind this loop is stuck.
+                    with HEALTH.inflight("mesh-count-batch", "count-group",
+                                         base=30.0):
+                        self._run_count_group(group)
                 except Exception as e:  # noqa: BLE001 — fail the group only
                     for r in group:
                         r.error = e

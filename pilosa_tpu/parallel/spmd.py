@@ -63,8 +63,10 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import fault
 from ..obs import Histogram, StatMap
 from ..obs import costs
+from ..obs.health import HEALTH
 from ..obs.metrics import TIER_BYTES
 from .broadcast import Broadcaster
 
@@ -220,13 +222,26 @@ class SpmdServer:
         self._local = threading.local()
 
     def _run(self, desc: dict):
-        """Execute one descriptor with the re-entrancy flag set."""
+        """Execute one descriptor with the re-entrancy flag set.
+
+        The whole descriptor — collective broadcast included on the
+        dispatch side — runs under one in-flight health record: a rank
+        that never enters its collective wedges every peer inside
+        broadcast_one_to_all, and that blocked thread is exactly what
+        the watchdog's "spmd-dispatch" bound must catch.
+        """
         op = _OP_NAMES.get(desc.get("op"), "unknown")
         SPMD_STATS.inc(f"dispatch:{op}")
         t0 = time.monotonic()
         self._local.in_exec = True
         try:
-            return self._dispatch(desc)
+            with HEALTH.inflight("spmd-dispatch", op, base=30.0):
+                # Deterministic hang seam INSIDE the bracket
+                # (watchdog.stall:delay=...,subsystem=spmd-dispatch):
+                # the injected delay must be a tracked, judgeable op.
+                fault.point("watchdog.stall",
+                            subsystem="spmd-dispatch", op=op)
+                return self._dispatch(desc)
         finally:
             self._local.in_exec = False
             op_hist(op).observe((time.monotonic() - t0) * 1e6)
@@ -479,6 +494,10 @@ class SpmdServer:
         from ..obs import get_logger
 
         log = get_logger("spmd")
+        # Event-driven follower: interval=None so blocking in the
+        # collective (no descriptor pending) never reads as a stall —
+        # the heartbeat exists for stack attribution only.
+        hb = HEALTH.register("spmd-worker", interval=None)
         while True:
             # The COLLECTIVE runs outside any catch: a distributed-
             # runtime error (dead coordinator, heartbeat loss — even
@@ -497,8 +516,10 @@ class SpmdServer:
                 log.warning("spmd worker: undecodable descriptor: %s", e)
                 continue
             if desc["op"] == _OP_STOP:
+                HEALTH.unregister("spmd-worker")
                 return
             try:
+                hb.beat()
                 self._run(desc)
             except Exception as e:  # noqa: BLE001 — stay in the pact
                 log.warning("spmd worker: descriptor failed: %s", e)

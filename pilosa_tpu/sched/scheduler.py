@@ -55,6 +55,7 @@ from typing import Callable, Dict, Optional
 from .. import fault
 from ..errors import DeadlineExceededError, PilosaError
 from ..obs import Histogram, StatMap
+from ..obs.health import HEALTH
 
 
 class AdmissionError(PilosaError):
@@ -137,6 +138,7 @@ class QueryScheduler:
         self._est_cache = (0.0, self.default_service_us)
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._hb = None  # registered when the dispatcher spawns
 
     # -- admission -----------------------------------------------------------
 
@@ -282,6 +284,12 @@ class QueryScheduler:
 
     def _ensure_dispatcher_locked(self) -> None:
         if self._thread is None or not self._thread.is_alive():
+            # Event-driven loop (interval=None): the watchdog never
+            # age-judges it — an empty queue parks the dispatcher
+            # legitimately — but beats attribute its thread in stack
+            # dumps and the release path is tracked in-flight.
+            self._hb = HEALTH.register("sched-dispatch", interval=None,
+                                       critical=True)
             self._thread = threading.Thread(
                 target=self._dispatch_loop, name="sched-dispatch",
                 daemon=True)
@@ -291,7 +299,9 @@ class QueryScheduler:
         while True:
             with self._mu:
                 while not self._closed and self._pending == 0:
+                    self._hb.idle()
                     self._cv.wait()
+                self._hb.beat()
                 if not self._closed and self._pending < self.max_cohort:
                     # Adaptive window: linear in the pending backlog,
                     # capped. A full cohort skips the wait entirely.
@@ -345,6 +355,10 @@ class QueryScheduler:
     def _release(self, cohort: list) -> None:
         if not cohort:
             return
+        with HEALTH.inflight("sched-dispatch", "release", base=5.0):
+            self._release_inner(cohort)
+
+    def _release_inner(self, cohort: list) -> None:
         if self.on_release is not None and len(cohort) > 1:
             # Burst hint: tell the mesh batch loop a cohort is landing
             # so its drain window holds open for the whole group.
@@ -399,3 +413,4 @@ class QueryScheduler:
         t = self._thread
         if t is not None:
             t.join(timeout=2.0)
+            HEALTH.unregister("sched-dispatch")

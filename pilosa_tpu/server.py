@@ -46,7 +46,8 @@ from .parallel.cluster import (
 )
 from .parallel.hints import HintManager
 from .parallel.rebalance import Rebalancer
-from .obs import StatMap, Tracer, costs as obs_costs, slo as obs_slo
+from .obs import (StatMap, Tracer, costs as obs_costs,
+                  health as obs_health, slo as obs_slo)
 from .utils.stats import ExpvarStats
 from .wire import pb
 
@@ -397,6 +398,43 @@ class Server:
             broadcast=self._broadcast_resize)
         self.handler.resizer = self.rebalancer
 
+        # Liveness plane ([health]): apply knobs to the process-global
+        # registry (STATS/LEDGER idiom — the instrumented loops in
+        # core/ and parallel/ never hold a server reference), point
+        # dossiers under the data dir, and wire the bundle sections a
+        # trip captures. Critical subsystems are the ones whose stall
+        # means this node should stop taking traffic (/readyz 503);
+        # the rest degrade service without invalidating it.
+        hreg = obs_health.HEALTH
+        hreg.enabled = bool(self.config.health_enabled)
+        hreg.sweep_interval = max(
+            0.01, float(self.config.health_sweep_interval))
+        hreg.stall_after = max(
+            1.0, float(self.config.health_stall_after))
+        hreg.dossier_max_bytes = max(
+            1024, int(self.config.health_dossier_max))
+        hreg.dossier_keep = max(1, int(self.config.health_dossier_keep))
+        hreg.dossier_dir = os.path.join(
+            self.config.expanded_data_dir(), ".dossier")
+        hreg.mark_critical("sched-dispatch", "spmd-dispatch", "wal",
+                           "hint-drain", "mesh-count-batch")
+        self._ready = False
+        self.handler.ready_fn = lambda: self._ready
+        hreg.bundle_providers.update({
+            "config": lambda: obs_health.redact_config(
+                vars(self.config)),
+            "slow_queries": self._bundle_endpoint("/debug/queries"),
+            "queryshapes": self._bundle_endpoint("/debug/queryshapes"),
+            "slo": self._bundle_endpoint("/debug/slo"),
+            "costs": self._bundle_endpoint("/debug/costs"),
+            "epochs": self._bundle_endpoint("/internal/epochs"),
+            "vars": self._bundle_endpoint("/debug/vars"),
+        })
+        # Gossiped health feeds read placement: a peer that announced
+        # itself wedged is not an eligible follower-read target, even
+        # before its breaker ever opens.
+        self.executor.peer_health_ok = hreg.peer_ready
+
         self._api: Optional[APIServer] = None
         self._threads: list = []
         # Last NodeStatus seen per peer host (gossip-lite state).
@@ -429,6 +467,9 @@ class Server:
         self.node_set.open()
         if self.hints is not None:
             self.hints.start()
+        # Watchdog before the daemons it supervises (refcounted: an
+        # in-process cluster shares the one sweep thread).
+        obs_health.HEALTH.start()
 
         for name, fn, interval, jitter in [
             ("anti-entropy", self._anti_entropy_tick,
@@ -442,8 +483,11 @@ class Server:
              self.config.integrity_scrub_interval,
              0.1 * self.config.integrity_scrub_interval),
         ]:
+            hb = obs_health.HEALTH.register(name,
+                                            interval=interval + jitter)
             t = threading.Thread(target=self._loop, name=name,
-                                 args=(fn, interval, jitter), daemon=True)
+                                 args=(fn, interval, jitter, hb),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
 
@@ -470,8 +514,10 @@ class Server:
             args=(self.closing,), daemon=True)
         t.start()
         self._threads.append(t)
+        self._ready = True
 
     def close(self):
+        self._ready = False
         if self.spmd is not None and self._spmd_rank == 0:
             try:
                 self.spmd.stop()  # release every worker loop
@@ -501,6 +547,13 @@ class Server:
         except Exception as e:  # noqa: BLE001 — device layer may be gone
             self.logger.warning(f"view drop at close: {e}")
         self.holder.close()
+        # Silence from a closed daemon is shutdown, not a hang: drop
+        # the interval-bearing heartbeats this server registered, then
+        # release the shared watchdog.
+        for name in ("anti-entropy", "status-poll", "cache-flush",
+                     "scrub"):
+            obs_health.HEALTH.unregister(name)
+        obs_health.HEALTH.stop()
 
     def _set_live_hosts(self, hosts):
         """Gossip membership feed -> cluster liveness
@@ -529,12 +582,14 @@ class Server:
         if joined:
             self.rebalancer.trigger()
 
-    def _loop(self, fn, interval: float, jitter: float = 0.0):
+    def _loop(self, fn, interval: float, jitter: float = 0.0, hb=None):
         while not self.closing.wait(interval):
             if jitter > 0:
                 import random
                 if self.closing.wait(random.uniform(0, jitter)):
                     return
+            if hb is not None:
+                hb.beat()
             try:
                 fn()
             except Exception as e:  # noqa: BLE001 — daemons never die
@@ -595,6 +650,8 @@ class Server:
                 tracker.observe_digest(
                     node.host, digest.get("epochs") or {},
                     int(digest.get("queue_depth") or 0))
+                obs_health.HEALTH.observe_peer(node.host,
+                                              digest.get("health"))
             except Exception:  # noqa: BLE001 — older peer without the
                 pass           # endpoint: digest simply stays absent
 
@@ -610,14 +667,27 @@ class Server:
             except Exception:  # noqa: BLE001 — load signal only
                 depth = 0
         return {"epochs": self.holder.fragment_epochs(),
-                "queue_depth": depth}
+                "queue_depth": depth,
+                "health": obs_health.HEALTH.gossip_summary()}
 
     def _handle_epoch_digest(self, host: str, digest: dict) -> None:
         """A peer's digest arrived over gossip push-pull: feed the
-        follower-read staleness judge."""
+        follower-read staleness judge and the health plane (a wedged
+        drainer on a peer is visible here before its breaker opens)."""
         self.executor.epochs.observe_digest(
             host, digest.get("epochs") or {},
             int(digest.get("queue_depth") or 0))
+        obs_health.HEALTH.observe_peer(host, digest.get("health"))
+
+    def _bundle_endpoint(self, path: str):
+        """Dossier section provider: answer `path` through the local
+        handler (the _fleet_fetch idiom — always fresh, no HTTP)."""
+        def fetch():
+            resp = self.handler.handle("GET", path)
+            if resp.status != 200:
+                return {"error": f"status={resp.status}"}
+            return json.loads(resp.body.decode())
+        return fetch
 
     def _breaker_change(self, host: str, state: str):
         """Circuit-breaker liveness feedback (BreakerRegistry

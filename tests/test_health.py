@@ -1,0 +1,618 @@
+"""Liveness plane (ISSUE 20): health registry, stall watchdog,
+stack-dump dossiers, health endpoints, and gossip propagation.
+
+Layers, mirroring the subsystem's seams:
+  - HealthRegistry contract tests on private instances (heartbeat /
+    in-flight bookkeeping, trip + recovery edges, the excused set,
+    dossier rate-limit reset) — sweeps driven with explicit `now` so
+    nothing sleeps;
+  - stack-dump attribution by thread NAME (the satellite that makes
+    every spawn site pass name=);
+  - /healthz + /readyz + /debug/health + /debug/bundle handler
+    semantics against the process-global HEALTH, including the
+    degraded partial mode (non-critical stall keeps /readyz 200);
+  - dossier schema, size bound (progressive shedding), retention;
+  - gossip propagation: digest summary -> observe_peer -> peer_ready
+    read steering and the /debug/fleet row extraction;
+  - one slow test wedging a REAL hint drainer through the
+    `watchdog.stall` fault seam, asserting detection within the
+    stall-after x interval bound, the dossier, serving staying alive,
+    and clean recovery.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu import fault
+from pilosa_tpu.api import Handler
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.obs import health as health_mod
+from pilosa_tpu.obs.fleet import node_row
+from pilosa_tpu.obs.health import (
+    DOSSIER_SCHEMA,
+    HEALTH,
+    OK,
+    STALLED,
+    HealthRegistry,
+    redact_config,
+    thread_stack,
+    thread_stacks,
+)
+from pilosa_tpu.parallel import new_test_cluster
+from pilosa_tpu.parallel.cluster import Node, pick_read_replica
+from pilosa_tpu.parallel.hints import HintManager
+
+
+_KNOBS = ("enabled", "stall_after", "sweep_interval", "dossier_dir",
+          "dossier_max_bytes", "dossier_keep")
+
+
+@pytest.fixture(autouse=True)
+def clean_global_registry():
+    """The process-global HEALTH must not leak one test's stalls,
+    knob mutations, or lingering registrations into the next."""
+    HEALTH.reset()
+    fault.reset(seed=0)
+    saved = {k: getattr(HEALTH, k) for k in _KNOBS}
+    providers = dict(HEALTH.bundle_providers)
+    yield
+    for k, v in saved.items():
+        setattr(HEALTH, k, v)
+    HEALTH.bundle_providers.clear()
+    HEALTH.bundle_providers.update(providers)
+    HEALTH.reset()
+    fault.reset(seed=0)
+
+
+def _reg(**kw) -> HealthRegistry:
+    r = HealthRegistry()
+    for k, v in kw.items():
+        setattr(r, k, v)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping
+
+
+class TestHeartbeatBookkeeping:
+    def test_register_is_idempotent_and_refreshes(self):
+        r = _reg()
+        hb1 = r.register("loop", interval=1.0)
+        hb2 = r.register("loop", interval=2.0, critical=True)
+        assert hb1 is hb2
+        assert hb1.interval == 2.0
+        assert "loop" in r.snapshot()["subsystems"]
+
+    def test_beat_stamps_thread_and_counts(self):
+        r = _reg()
+        hb = r.register("loop", interval=1.0)
+        out = {}
+
+        def work():
+            hb.beat()
+            out["name"] = hb.thread_name
+
+        t = threading.Thread(target=work, name="my-loop")
+        t.start()
+        t.join()
+        assert hb.beats == 1
+        assert out["name"] == "my-loop"
+        assert r.snapshot()["subsystems"]["loop"]["thread"] == "my-loop"
+
+    def test_disabled_registry_is_inert(self):
+        r = _reg(enabled=False)
+        hb = r.register("loop", interval=0.001)
+        hb.beat()
+        assert hb.beats == 0  # beat() returned at the enabled check
+        cm = r.inflight("loop", "op", base=0.001)
+        assert cm is health_mod._NOOP_INFLIGHT
+        with cm:
+            pass
+        assert r.sweep(now=time.monotonic() + 1e6) == []
+
+    def test_unregister_clears_state(self):
+        r = _reg()
+        r.register("loop", interval=0.001)
+        assert r.sweep(now=time.monotonic() + 10) == ["loop"]
+        r.unregister("loop")
+        assert r.stalled() == []
+        assert "loop" not in r.snapshot()["subsystems"]
+
+    def test_inflight_tracked_and_untracked(self):
+        r = _reg()
+        with r.inflight("wal", "commit", base=5.0) as rec:
+            snap = r.snapshot()
+            assert len(snap["inflight"]) == 1
+            op = snap["inflight"][0]
+            assert op["subsystem"] == "wal" and op["kind"] == "commit"
+            assert op["deadline_s"] == pytest.approx(
+                5.0 * r.stall_after)
+            assert rec.thread_name == threading.current_thread().name
+        assert r.snapshot()["inflight"] == []
+
+
+# ---------------------------------------------------------------------------
+# trip + recovery edges
+
+
+class TestTripAndRecovery:
+    def test_heartbeat_trip_and_recovery(self):
+        r = _reg()
+        hb = r.register("drain", interval=0.01, critical=True)
+        t0 = time.monotonic()
+        # Within bound: no trip.
+        assert r.sweep(now=t0 + 0.01) == []
+        # Past stall-after x interval: one trip edge.
+        assert r.sweep(now=t0 + 1.0) == ["drain"]
+        assert r.state_of("drain") == STALLED
+        assert r.stalled_critical() == ["drain"]
+        assert not r.ready()
+        info = r.snapshot()["subsystems"]["drain"]
+        assert info["stall"]["kind"] == "heartbeat"
+        # Still stalled: NOT a second edge.
+        assert r.sweep(now=t0 + 2.0) == []
+        assert r.trips_total() == 1
+        # The loop beats again -> recovery.
+        hb.beat()
+        assert r.sweep() == []
+        assert r.state_of("drain") == OK
+        assert r.ready()
+
+    def test_inflight_trip_and_recovery(self):
+        r = _reg()
+        with r.inflight("wal", "commit", base=0.01):
+            t0 = time.monotonic()
+            assert r.sweep(now=t0 + 5.0) == ["wal"]
+            info = r.snapshot()["subsystems"]["wal"]
+            assert info["stall"]["kind"] == "inflight"
+            assert info["stall"]["op"] == "commit"
+        # Op exited -> next sweep recovers.
+        assert r.sweep() == []
+        assert r.state_of("wal") == OK
+
+    def test_unbounded_inflight_never_judged(self):
+        r = _reg()
+        with r.inflight("snapshot", "write"):  # base=None
+            assert r.sweep(now=time.monotonic() + 1e6) == []
+
+    def test_parked_heartbeat_never_judged(self):
+        r = _reg()
+        hb = r.register("sched", interval=0.01)
+        hb.idle()
+        assert r.sweep(now=time.monotonic() + 1e6) == []
+
+    def test_event_loop_heartbeat_never_judged(self):
+        r = _reg()
+        r.register("spmd-worker", interval=None)
+        assert r.sweep(now=time.monotonic() + 1e6) == []
+
+    def test_inflight_within_bound_excuses_heartbeat(self):
+        """A drainer blocked inside a TRACKED replay (still within its
+        own deadline) is working, not wedged."""
+        r = _reg()
+        r.register("drain", interval=0.01)
+        with r.inflight("drain", "replay", base=1e6):
+            assert r.sweep(now=time.monotonic() + 10.0) == []
+        # Bracket gone, heartbeat still stale -> now it IS a hang.
+        assert r.sweep(now=time.monotonic() + 10.0) == ["drain"]
+
+    def test_dossier_rate_limit_resets_on_recovery(self, tmp_path):
+        r = _reg(dossier_dir=str(tmp_path / "d"))
+        hb = r.register("drain", interval=0.01)
+        t0 = time.monotonic()
+        assert r.sweep(now=t0 + 1.0) == ["drain"]
+        assert len(r.list_dossiers()) == 1
+        # Still stalled across later sweeps: no second dossier.
+        r.sweep(now=t0 + 2.0)
+        r.sweep(now=t0 + 3.0)
+        assert len(r.list_dossiers()) == 1
+        # Recover, then trip again: the limit reset, fresh dossier.
+        hb.beat()
+        r.sweep()
+        assert r.sweep(now=time.monotonic() + 1.0) == ["drain"]
+        assert len(r.list_dossiers()) == 2
+        assert r.trips_total() == 2
+
+
+# ---------------------------------------------------------------------------
+# stack attribution
+
+
+class TestStackAttribution:
+    def test_named_thread_attributed_in_dump(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def wedge():
+            entered.set()
+            release.wait(10)
+
+        t = threading.Thread(target=wedge, name="hint-drain-test",
+                             daemon=True)
+        t.start()
+        assert entered.wait(5)
+        try:
+            dump = thread_stacks()
+            mine = [d for d in dump if d["name"] == "hint-drain-test"]
+            assert len(mine) == 1
+            assert any("wedge" in ln for ln in mine[0]["stack"])
+            # Single-thread variant: the trip log's stack.
+            stack = thread_stack(t.ident)
+            assert any("release.wait" in ln or "wedge" in ln
+                       for ln in stack)
+        finally:
+            release.set()
+            t.join()
+
+    def test_unknown_tid_empty_stack(self):
+        assert thread_stack(999999999) == []
+        assert thread_stack(None) == []
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+
+
+@pytest.fixture
+def handler(tmp_path):
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    cluster = new_test_cluster(1)
+    ex = Executor(holder, host=cluster.nodes[0].host, cluster=cluster,
+                  use_device=False)
+    h = Handler(holder, ex, cluster=cluster, host=cluster.nodes[0].host)
+    yield h
+    holder.close()
+
+
+class TestEndpoints:
+    def test_healthz_ok(self, handler):
+        r = handler.handle("GET", "/healthz")
+        assert r.status == 200
+        assert r.json()["status"] == "ok"
+
+    def test_readyz_flips_on_critical_stall_healthz_stays(self, handler):
+        assert handler.handle("GET", "/readyz").status == 200
+        hb = HEALTH.register("hint-drain", interval=0.01, critical=True)
+        HEALTH.sweep(now=time.monotonic() + 1.0)
+        r = handler.handle("GET", "/readyz")
+        assert r.status == 503
+        assert "stalled:hint-drain" in r.json()["reasons"]
+        # Liveness is about the watchdog, not the workload: still 200.
+        assert handler.handle("GET", "/healthz").status == 200
+        hb.beat()
+        HEALTH.sweep()
+        assert handler.handle("GET", "/readyz").status == 200
+
+    def test_readyz_degraded_partial_mode(self, handler):
+        """A NON-critical stall (rebalance, gossip) degrades but does
+        not unready the node — partial mode keeps serving."""
+        HEALTH.register("rebalance", interval=0.01, critical=False)
+        HEALTH.sweep(now=time.monotonic() + 1.0)
+        assert HEALTH.stalled() == ["rebalance"]
+        assert handler.handle("GET", "/readyz").status == 200
+
+    def test_readyz_not_serving(self, handler):
+        handler.ready_fn = lambda: False
+        r = handler.handle("GET", "/readyz")
+        assert r.status == 503
+        assert "not-serving" in r.json()["reasons"]
+        handler.ready_fn = lambda: True
+        assert handler.handle("GET", "/readyz").status == 200
+
+    def test_debug_health_document(self, handler):
+        HEALTH.register("wal", interval=None)
+        doc = handler.handle("GET", "/debug/health").json()
+        assert doc["enabled"] is True
+        assert doc["watchdog_alive"] is True
+        assert "wal" in doc["subsystems"]
+        assert doc["subsystems"]["wal"]["interval_s"] is None
+
+    def test_debug_bundle_schema(self, handler):
+        doc = handler.handle("GET", "/debug/bundle").json()
+        assert doc["schema"] == DOSSIER_SCHEMA
+        assert doc["reason"] == "on-demand"
+        assert isinstance(doc["threads"], list)
+        assert any(t["name"] == "MainThread" for t in doc["threads"])
+        assert "health" in doc and "sections" in doc
+
+    def test_metrics_families_present(self, handler):
+        HEALTH.register("wal", interval=None)
+        text = handler.handle("GET", "/metrics").body.decode()
+        assert "pilosa_health_ready 1" in text
+        assert 'pilosa_health_state{subsystem="wal"} 0' in text
+        assert "pilosa_watchdog_sweeps_total" in text
+
+    def test_trip_visible_in_metrics(self, handler):
+        HEALTH.register("hint-drain", interval=0.01, critical=True)
+        HEALTH.sweep(now=time.monotonic() + 1.0)
+        text = handler.handle("GET", "/metrics").body.decode()
+        assert 'pilosa_health_state{subsystem="hint-drain"} 1' in text
+        assert ('pilosa_watchdog_trips_total{subsystem="hint-drain",'
+                'kind="heartbeat"} 1') in text
+        assert "pilosa_health_ready 0" in text
+
+
+# ---------------------------------------------------------------------------
+# dossiers
+
+
+class TestDossiers:
+    def test_no_dossier_dir_returns_none(self):
+        assert _reg().write_dossier() is None
+
+    def test_size_bound_progressive_shedding(self, tmp_path):
+        r = _reg(dossier_dir=str(tmp_path / "d"), dossier_max_bytes=4096)
+        r.bundle_providers["huge"] = lambda: ["x" * 100] * 200
+        r.bundle_providers["small"] = lambda: {"ok": 1}
+        data = r.encode_bundle(r.build_bundle())
+        assert len(data) <= 4096
+        doc = json.loads(data)
+        # The big section shed first; the small one survives if room.
+        assert "huge" in doc.get("truncated", [])
+
+    def test_thread_heavy_process_sheds_threads_not_trip(self):
+        # Hundreds of live threads (a real server, or a full test
+        # run) overflow the bound even at 5-frame stacks — the
+        # thread list drops as a unit and the trip survives.
+        r = _reg(dossier_max_bytes=4096)
+        r.bundle_providers["huge"] = lambda: ["x" * 100] * 200
+        doc = r.build_bundle(reason="stall-wal",
+                             trip={"kind": "inflight"})
+        doc["threads"] = [{"name": f"t{i}", "stack": ["frame"] * 40}
+                          for i in range(300)]
+        data = r.encode_bundle(doc)
+        assert len(data) <= 4096
+        out = json.loads(data)
+        assert out.get("truncated") != "all"
+        assert "huge" in out["truncated"]
+        assert out["threads"] == "truncated"
+        assert out["reason"] == "stall-wal"
+        assert out["trip"]["kind"] == "inflight"
+
+    def test_minimal_doc_under_tiny_bound(self):
+        r = _reg(dossier_max_bytes=1024)
+        r.bundle_providers["huge"] = lambda: ["y" * 100] * 100
+        data = r.encode_bundle(r.build_bundle(
+            reason="stall-x", trip={"kind": "heartbeat"}))
+        assert len(data) <= 1024
+        doc = json.loads(data)
+        assert doc["reason"] == "stall-x"
+        assert doc["trip"]["kind"] == "heartbeat"
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        r = _reg(dossier_dir=str(tmp_path / "d"), dossier_keep=3)
+        paths = [r.write_dossier(reason=f"r{i}") for i in range(6)]
+        kept = r.list_dossiers()
+        assert len(kept) == 3
+        assert kept == sorted(kept)
+        assert paths[-1] in kept and paths[0] not in kept
+
+    def test_broken_provider_contained(self):
+        r = _reg()
+        r.bundle_providers["bad"] = lambda: 1 / 0
+        doc = r.build_bundle()
+        assert "error" in doc["sections"]["bad"]
+
+    def test_redact_config_masks_secrets(self):
+        cfg = {"bind": "h:1", "api_token": "hunter2",
+               "tls_password": "x", "_private_attr": 1,
+               "weird": object()}
+        out = redact_config(cfg)
+        assert out["bind"] == "h:1"
+        assert out["api_token"] == "<redacted>"
+        assert out["tls_password"] == "<redacted>"
+        assert "_private_attr" not in out
+        assert isinstance(out["weird"], str)
+
+
+# ---------------------------------------------------------------------------
+# gossip propagation + read steering
+
+
+class TestGossipPropagation:
+    def test_summary_roundtrip_to_peer_verdict(self):
+        a, b = _reg(), _reg()
+        a.register("hint-drain", interval=0.01, critical=True)
+        a.sweep(now=time.monotonic() + 1.0)
+        summary = a.gossip_summary()
+        assert summary["ready"] is False
+        assert summary["stalled"] == ["hint-drain"]
+        assert summary["trips"] == 1
+        b.observe_peer("node-a:1", summary)
+        assert b.peer_ready("node-a:1") is False
+        assert b.snapshot()["peers"]["node-a:1"]["stalled"] == \
+            ["hint-drain"]
+
+    def test_unknown_and_stale_peers_pass(self):
+        r = _reg()
+        assert r.peer_ready("never-seen:1") is True
+        r.observe_peer("old:1", {"ready": False})
+        r._peers["old:1"]["at"] = time.time() - 1e6
+        assert r.peer_ready("old:1") is True
+
+    def test_garbage_summary_ignored(self):
+        r = _reg()
+        r.observe_peer("x:1", None)
+        r.observe_peer("x:1", "not-a-dict")
+        r.observe_peer("", {"ready": False})
+        assert r.snapshot()["peers"] == {}
+
+    def test_fleet_row_extraction(self):
+        samples = {
+            ("pilosa_health_ready", ()): 0.0,
+            ("pilosa_health_state", (("subsystem", "hint-drain"),)): 1.0,
+            ("pilosa_health_state", (("subsystem", "wal"),)): 0.0,
+            ("pilosa_watchdog_trips_total",
+             (("kind", "heartbeat"), ("subsystem", "hint-drain"))): 2.0,
+        }
+        row = node_row(samples)
+        assert row["health"] == {
+            "ready": False,
+            "stalled": ["hint-drain"],
+            "watchdog_trips": 2,
+        }
+        # A node that predates the liveness plane: defaults to healthy.
+        assert node_row({})["health"]["ready"] is True
+
+    def test_pick_read_replica_routes_around_wedged_peer(self):
+        owners = [Node("host0"), Node("host1"), Node("host2")]
+        wedged = {"host1"}
+        for _ in range(20):
+            pick = pick_read_replica(
+                owners, node_ok=lambda h: h not in wedged)
+            assert pick is not None and pick.host != "host1"
+        # The local host is exempt: its own wedge is judged by
+        # /readyz, not by read steering.
+        pick = pick_read_replica(
+            owners[:2], prefer="host1",
+            node_ok=lambda h: h not in wedged)
+        assert pick is not None and pick.host == "host1"
+        # Everything filtered -> None (caller falls back to owner).
+        assert pick_read_replica(owners,
+                                 node_ok=lambda h: False) is None
+
+
+# ---------------------------------------------------------------------------
+# watchdog thread + the real wedged drainer (slow)
+
+
+class TestWatchdogThread:
+    def test_refcounted_start_stop(self):
+        HEALTH.sweep_interval = 0.01
+        HEALTH.start()
+        HEALTH.start()
+        try:
+            assert HEALTH._thread is not None
+            deadline = time.monotonic() + 5
+            while HEALTH.snapshot()["sweeps"] == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert HEALTH.watchdog_alive()
+        finally:
+            HEALTH.stop()
+            assert HEALTH._thread is not None  # one ref remains
+            HEALTH.stop()
+            assert HEALTH._thread is None
+            HEALTH.sweep_interval = 1.0
+
+    @pytest.mark.slow
+    def test_wedged_hint_drainer_detected_and_recovers(self, tmp_path,
+                                                       handler):
+        """End to end through the REAL seam: a hint drainer wedged by
+        `watchdog.stall` (deterministic injected delay inside its
+        beat) must be detected within stall-after x interval, flip
+        /readyz while /healthz and serving stay up, write a dossier
+        naming the stuck thread, and recover once the delay clears."""
+        drain_interval = 0.05
+        stall_delay = 1.5
+        HEALTH.sweep_interval = 0.02
+        HEALTH.stall_after = 4.0
+        HEALTH.dossier_dir = str(tmp_path / ".dossier")
+        fault.arm("watchdog.stall", delay=stall_delay, times=1,
+                  subsystem="hint-drain")
+        mgr = HintManager(str(tmp_path / "hints"),
+                          drain_interval=drain_interval)
+        HEALTH.start()
+        t0 = time.monotonic()
+        try:
+            mgr.start()
+            # Detection: within the allowed bound (stall-after x
+            # interval) plus sweep cadence — long before the injected
+            # delay clears.
+            allowed = drain_interval * HEALTH.stall_after
+            deadline = t0 + stall_delay
+            while HEALTH.state_of("hint-drain") != STALLED:
+                assert time.monotonic() < deadline, \
+                    "watchdog missed the wedged drainer"
+                time.sleep(0.01)
+            detect_s = time.monotonic() - t0
+            assert detect_s < stall_delay
+            assert detect_s >= allowed * 0.5  # not a false-instant trip
+            # /readyz flips; /healthz and serving stay up.
+            assert handler.handle("GET", "/readyz").status == 503
+            assert handler.handle("GET", "/healthz").status == 200
+            assert handler.handle("POST", "/index/i").status == 200
+            # Dossier: written once, names the stuck thread.
+            paths = HEALTH.list_dossiers()
+            assert len(paths) == 1
+            with open(paths[0]) as f:
+                doc = json.load(f)
+            assert doc["schema"] == DOSSIER_SCHEMA
+            assert doc["reason"] == "stall-hint-drain"
+            assert doc["trip"]["subsystem"] == "hint-drain"
+            assert doc["trip"]["thread_name"] == "hint-drain"
+            assert any(t["name"] == "hint-drain" for t in doc["threads"])
+            assert any("watchdog.stall" in ln or "fault" in ln
+                       for ln in doc["trip"]["stack"])
+            # Recovery: the delay clears, the loop beats, state
+            # returns to OK and /readyz to 200 — no restart needed.
+            deadline = time.monotonic() + stall_delay + 5.0
+            while HEALTH.state_of("hint-drain") != OK:
+                assert time.monotonic() < deadline, \
+                    "drainer never recovered"
+                time.sleep(0.02)
+            assert handler.handle("GET", "/readyz").status == 200
+            assert HEALTH.trips_total() == 1
+            assert len(HEALTH.list_dossiers()) == 1
+        finally:
+            mgr.close()
+            HEALTH.stop()
+            HEALTH.sweep_interval = 1.0
+        # CI artifact export: keep the dossier where the workflow's
+        # upload step can find it.
+        export = os.environ.get("PILOSA_TPU_DOSSIER_EXPORT")
+        if export:
+            os.makedirs(export, exist_ok=True)
+            for p in HEALTH.list_dossiers():
+                with open(p, "rb") as src, open(
+                        os.path.join(export, os.path.basename(p)),
+                        "wb") as dst:
+                    dst.write(src.read())
+
+    @pytest.mark.slow
+    def test_wedged_spmd_dispatch_seam_detected(self):
+        """The second injected hang the acceptance bar names: an SPMD
+        descriptor dispatch that never returns. Driven at the seam
+        level — the fault fires inside the `spmd-dispatch` in-flight
+        bracket exactly as SpmdServer._run brackets it."""
+        HEALTH.sweep_interval = 0.02
+        HEALTH.mark_critical("spmd-dispatch")
+        fault.arm("watchdog.stall", delay=1.0, times=1,
+                  subsystem="spmd-dispatch")
+        HEALTH.start()
+
+        def dispatch():
+            # Exactly SpmdServer._run's bracketing: the seam fires
+            # INSIDE the in-flight record, so the injected delay is a
+            # tracked op past its deadline.
+            with HEALTH.inflight("spmd-dispatch", "count", base=0.05):
+                fault.point("watchdog.stall",
+                            subsystem="spmd-dispatch", op="count")
+
+        try:
+            t = threading.Thread(target=dispatch, name="spmd-dispatch",
+                                 daemon=True)
+            t.start()
+            deadline = time.monotonic() + 0.9
+            while HEALTH.state_of("spmd-dispatch") != STALLED:
+                assert time.monotonic() < deadline, \
+                    "watchdog missed the wedged SPMD dispatch"
+                time.sleep(0.01)
+            assert not HEALTH.ready()
+            t.join(timeout=5)
+            # Recovery once the dispatch returns.
+            deadline = time.monotonic() + 5.0
+            while HEALTH.state_of("spmd-dispatch") != OK:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert HEALTH.ready()
+        finally:
+            HEALTH.stop()
+            HEALTH.sweep_interval = 1.0

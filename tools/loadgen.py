@@ -28,7 +28,8 @@ rates, shadow-mismatch growth, per-objective verdicts both client-side
 and as the server's own /debug/slo judgment — and exits nonzero on any
 VIOLATED objective, which is what makes the verdict CI-gateable.
 
-`--fault` arms PILOSA_TPU_FAULT seams mid-run (in-process server only)
+`--fault` arms PILOSA_TPU_FAULT seams mid-run (in-process server or
+in-process cluster only)
 for churn scenarios: e.g. `device.exec:error=ResourceExhausted,prob=.5`
 exercises the evict→retry→host-fold ladder under live traffic, where
 the acceptance bar is zero wrong answers and availability degraded
@@ -497,15 +498,23 @@ def run(spec: Dict[str, Any], transport,
 # -- in-process server ----------------------------------------------------
 
 
-def start_inprocess(spec: Dict[str, Any], log) -> tuple:
+def start_inprocess(spec: Dict[str, Any], log,
+                    watchdog_drill: bool = False) -> tuple:
     """Boot a single-node Server on a loopback port with the spec's
     tenants declared in [sched] tenant-weights and shadow verification
     on — the self-contained target for CI smoke and fault-churn runs.
-    Returns (server, host)."""
+    With `watchdog_drill` the periodic daemons and the watchdog sweep
+    run at second-scale cadence so a watchdog.stall delay on a daemon
+    loop (e.g. subsystem=scrub) trips and recovers within a short run
+    — a single node has no hint drainer, so the scrub daemon is the
+    drill's judged loop. Returns (server, host)."""
     from pilosa_tpu.config import Config
     from pilosa_tpu.server import Server
 
     cfg = Config()
+    if watchdog_drill:
+        cfg.integrity_scrub_interval = 0.5
+        cfg.health_sweep_interval = 0.2
     cfg.data_dir = tempfile.mkdtemp(prefix="pilosa-loadgen-")
     cfg.host = "127.0.0.1:0"
     cfg.cluster_hosts = [cfg.host]
@@ -750,6 +759,51 @@ def _judge_follower_reads(report: Dict[str, Any], transport,
     log(f"follower-reads: 5xx={read_5xx} hit_rate={hit_rate:.3f} "
         f"(ceiling {ceiling:.3f}) stale={stale_served:g} "
         f"-> {'VIOLATED: ' + ','.join(bad) if bad else 'OK'}")
+
+
+def _judge_watchdog(report: Dict[str, Any], transport, args,
+                    log) -> None:
+    """Post-run verdict for --fault specs carrying a `watchdog.stall`
+    rule: the injected hang (a delay wedging a registered loop) must
+    have been DETECTED — /debug/health shows at least one watchdog
+    trip — and the node must have RECOVERED once the delay cleared
+    (no subsystem still stalled at run end, /readyz back to OK).
+    Serving stayed alive throughout by construction: the run's own
+    requests are the proof (availability is judged separately)."""
+    # Recovery needs one watchdog sweep AFTER the injected delay
+    # clears — poll briefly instead of racing the sweep cadence.
+    deadline = time.monotonic() + 10.0
+    doc: Dict[str, Any] = {}
+    while True:
+        doc = transport.get_json("/debug/health") or {}
+        if int(doc.get("trips_total", 0)) > 0 \
+                and not doc.get("stalled"):
+            break
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.25)
+    trips = int(doc.get("trips_total", 0))
+    still_stalled = list(doc.get("stalled") or [])
+    detected = trips > 0
+    recovered = not still_stalled
+    obj = report["objectives"]
+    obj["watchdog_detection"] = {
+        "target": ">=1 trip", "measured": trips,
+        "verdict": "OK" if detected else "VIOLATED"}
+    obj["watchdog_recovery"] = {
+        "target": "no stalled subsystem at run end",
+        "measured": still_stalled,
+        "verdict": "OK" if recovered else "VIOLATED"}
+    report["watchdog"] = {
+        "trips_total": trips,
+        "stalled_at_end": still_stalled,
+        "watchdog_alive": bool(doc.get("watchdog_alive")),
+    }
+    if not (detected and recovered):
+        report["verdict"] = "VIOLATED"
+    log(f"watchdog: trips={trips} stalled_at_end="
+        f"{still_stalled or 'none'} -> "
+        f"{'OK' if detected and recovered else 'VIOLATED'}")
 
 
 def _judge_cost_skew(report: Dict[str, Any], transport,
@@ -1001,7 +1055,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                       log, churn_state),
                 daemon=True)
     elif args.in_process:
-        srv, host = start_inprocess(spec, log)
+        srv, host = start_inprocess(
+            spec, log,
+            watchdog_drill="watchdog.stall" in (args.fault or ""))
     transport = HTTPTransport(host, index=args.index,
                               partial=args.partial,
                               deadline=args.deadline,
@@ -1010,9 +1066,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     fault_cb = None
     fault_rules: list = []
     if args.fault:
-        if not args.in_process:
-            log("--fault requires --in-process (seams live in the "
-                "server process); ignoring")
+        if not (args.in_process or args.cluster_nodes > 0):
+            log("--fault requires --in-process or --cluster-nodes "
+                "(seams live in the server process); ignoring")
         else:
             from pilosa_tpu import fault as _fault
 
@@ -1039,6 +1095,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             _judge_follower_reads(report, transport, spec, args, log)
         if args.cost_skew:
             _judge_cost_skew(report, transport, spec, args, log)
+        if fault_rules and "watchdog.stall" in (args.fault or ""):
+            _judge_watchdog(report, transport, args, log)
         mm1 = _mismatch_total(transport.get_text("/metrics"))
         growth = max(0.0, mm1 - mm0)
         report["mismatch_growth"] = growth

@@ -66,6 +66,7 @@ ALLOWED_LABEL_KEYS = frozenset((
     "host",          # ring-membership-bounded
     "target",        # hint targets (ring-membership-bounded)
     "kind",          # stat kinds (code-defined)
+    "subsystem",     # liveness-plane heartbeat names (code-defined)
     "tag",           # expvar bare-tag bridge
     "value",         # expvar string-set info bridge
     "replica",       # read-path pick: owner | follower | fallback_owner
@@ -84,6 +85,13 @@ ALLOWED_LABEL_KEYS = frozenset((
 # working.
 SHAPE_LABELED_PREFIXES = ("pilosa_cost_", "pilosa_perf_regression")
 SHAPE_SERIES_CEILING = 2048
+
+# The liveness plane's per-subsystem gauge: one series per registered
+# heartbeat. Heartbeat names are code-defined (a dozen or so loops),
+# so a family sailing past this means someone is registering
+# per-request or per-fragment heartbeats — a leak, not growth.
+HEALTH_STATE_FAMILY = "pilosa_health_state"
+HEALTH_STATE_CEILING = 64
 
 # Suffixes that carry a recognized unit for histogram families.
 # `_size` is the dimensionless-count ladder (e.g. writes per WAL group
@@ -167,6 +175,8 @@ def lint(text: str, max_series: int = 500) -> List[str]:
         ceiling = max_series
         if name.startswith(SHAPE_LABELED_PREFIXES):
             ceiling = SHAPE_SERIES_CEILING
+        if name == HEALTH_STATE_FAMILY:
+            ceiling = HEALTH_STATE_CEILING
         if len(rows) > ceiling:
             problems.append(
                 f"{name}: {len(rows)} series exceeds the "
